@@ -1,0 +1,10 @@
+"""metis_tpu — TPU-native automatic distributed-training planner and
+execution layer.
+
+Capabilities of SamsungLabs/Metis (USENIX ATC'24) rebuilt TPU-first:
+profile-driven search over DP×TP×PP(×SP/CP) plans for homogeneous and
+heterogeneous TPU fleets, an ICI/DCN-aware cost model, and a JAX execution
+layer that lowers chosen plans onto jax.sharding.Mesh.
+"""
+
+__version__ = "0.1.0"

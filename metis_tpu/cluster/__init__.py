@@ -1,0 +1,27 @@
+from metis_tpu.cluster.spec import (
+    DeviceSpec,
+    NodeSpec,
+    ClusterSpec,
+    DEVICE_REGISTRY,
+    register_device,
+)
+from metis_tpu.cluster.tpu import (
+    TpuGeneration,
+    TpuSliceSpec,
+    TpuClusterSpec,
+    TPU_GENERATIONS,
+    slice_from_name,
+)
+
+__all__ = [
+    "DeviceSpec",
+    "NodeSpec",
+    "ClusterSpec",
+    "DEVICE_REGISTRY",
+    "register_device",
+    "TpuGeneration",
+    "TpuSliceSpec",
+    "TpuClusterSpec",
+    "TPU_GENERATIONS",
+    "slice_from_name",
+]

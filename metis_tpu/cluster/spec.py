@@ -1,0 +1,225 @@
+"""Cluster description: open device registry + node list.
+
+Replaces the reference's closed ``DeviceType`` enum (A100/V100/P100/T4 only,
+``utils.py:46-57`` — adding a type required a code change) and its
+``GPUCluster`` façade (``gpu_cluster.py:8-58``) with an open, data-driven
+registry.  TPU slices plug in through :mod:`metis_tpu.cluster.tpu`, which
+lowers a torus topology onto this same interface so the whole planner is
+device-agnostic.
+
+Known reference quirks handled here (SURVEY.md §2.3 / §7):
+
+- ``GPUCluster.get_inter_bandwidth`` returns the *intra* bandwidth field
+  (``gpu_cluster.py:52-58``).  ``ClusterSpec.inter_bw_for_types`` reproduces
+  that only when ``strict_compat=True``; native mode reads the real field.
+- hostfile slot counts were parsed with a ``[6:7]`` slice (single digit only,
+  ``utils.py:15``); our parser splits on ``=`` and handles any width.
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, replace
+from pathlib import Path
+
+from metis_tpu.core.errors import ClusterSpecError
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    """One accelerator type.  Bandwidths in GB/s, memory in GB."""
+
+    name: str
+    memory_gb: float
+    intra_bw_gbps: float  # within a node (NVLink) / within a slice (ICI)
+    inter_bw_gbps: float  # across nodes (IB/Ethernet) / across slices (DCN)
+
+    @property
+    def memory_mb(self) -> float:
+        # The reference converts GB→MB with ×1024 (gpu_cluster.py:45); profile
+        # memory is recorded in MB, so we keep the same convention.
+        return self.memory_gb * 1024
+
+
+# Open registry — callers may register new types at runtime (the reference's
+# closed enum is the anti-pattern this replaces).
+DEVICE_REGISTRY: dict[str, DeviceSpec] = {}
+
+
+def register_device(spec: DeviceSpec, overwrite: bool = True) -> DeviceSpec:
+    if not overwrite and spec.name in DEVICE_REGISTRY:
+        raise ClusterSpecError(f"device type {spec.name!r} already registered")
+    DEVICE_REGISTRY[spec.name] = spec
+    return spec
+
+
+# Baseline GPU presets (bandwidths are placeholders; real runs take values from
+# the clusterfile, which overrides these per cluster).
+for _name, _mem in [("A100", 80), ("V100", 16), ("P100", 16), ("T4", 15)]:
+    register_device(DeviceSpec(_name, _mem, intra_bw_gbps=50, inter_bw_gbps=10))
+
+
+@dataclass(frozen=True)
+class NodeSpec:
+    """One host: a device type and how many accelerators it carries."""
+
+    device_type: str
+    num_devices: int
+
+
+@dataclass(frozen=True)
+class ClusterSpec:
+    """An ordered list of nodes plus per-type device specs.
+
+    Node order is the physical rank order (rank = node_index *
+    devices_per_node + local index), matching the reference's linear placement
+    (``cluster_bandwidth.py:34-47``).
+    """
+
+    nodes: tuple[NodeSpec, ...]
+    devices: dict[str, DeviceSpec]
+
+    def __post_init__(self) -> None:
+        if not self.nodes:
+            raise ClusterSpecError("cluster has no nodes")
+        for node in self.nodes:
+            if node.device_type not in self.devices:
+                raise ClusterSpecError(f"no DeviceSpec for {node.device_type!r}")
+
+    # -- counts ------------------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def total_devices(self) -> int:
+        return sum(n.num_devices for n in self.nodes)
+
+    @property
+    def devices_per_node(self) -> int:
+        """Uniform node width.  Raises on mixed-width clusters — callers that
+        support ragged nodes must use node_of_rank instead (the reference
+        silently assumed node 0's width, gpu_cluster.py:25-26)."""
+        widths = {n.num_devices for n in self.nodes}
+        if len(widths) > 1:
+            raise ClusterSpecError(
+                f"cluster has mixed node widths {sorted(widths)}; "
+                "devices_per_node is undefined")
+        return self.nodes[0].num_devices
+
+    @property
+    def device_types(self) -> tuple[str, ...]:
+        """Unique device types in node order."""
+        seen: list[str] = []
+        for n in self.nodes:
+            if n.device_type not in seen:
+                seen.append(n.device_type)
+        return tuple(seen)
+
+    def num_devices_by_type(self, device_type: str) -> int:
+        return sum(n.num_devices for n in self.nodes if n.device_type == device_type)
+
+    def node_of_rank(self, rank: int) -> int:
+        acc = 0
+        for i, n in enumerate(self.nodes):
+            acc += n.num_devices
+            if rank < acc:
+                return i
+        raise IndexError(f"rank {rank} out of range ({self.total_devices} devices)")
+
+    # -- per-type properties ----------------------------------------------
+    def spec(self, device_type: str) -> DeviceSpec:
+        return self.devices[device_type]
+
+    def memory_mb(self, device_type: str) -> float:
+        return self.devices[device_type].memory_mb
+
+    def intra_bw_for_type(self, device_type: str) -> float:
+        return self.devices[device_type].intra_bw_gbps
+
+    def inter_bw_for_types(
+        self, device_types: list[str] | tuple[str, ...], strict_compat: bool = False
+    ) -> float:
+        """Slowest cross-node bandwidth among member types.
+
+        strict_compat reproduces the reference bug where the inter getter
+        reads the intra field (``gpu_cluster.py:56-58``).
+        """
+        if strict_compat:
+            return min(self.devices[t].intra_bw_gbps for t in device_types)
+        return min(self.devices[t].inter_bw_gbps for t in device_types)
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_files(hostfile: str | Path, clusterfile: str | Path) -> "ClusterSpec":
+        """Parse the reference's two cluster-description files
+        (``README.md:194-230``): hostfile lines ``<ip> slots=<n>`` and a JSON
+        clusterfile keyed by IP with instance_type/bandwidths/memory."""
+        with open(clusterfile) as f:
+            info = json.load(f)
+
+        devices: dict[str, DeviceSpec] = {}
+        for entry in info.values():
+            t = str(entry["instance_type"])
+            devices[t] = DeviceSpec(
+                name=t,
+                memory_gb=float(entry["memory"]),
+                intra_bw_gbps=float(entry["intra_bandwidth"]),
+                inter_bw_gbps=float(entry["inter_bandwidth"]),
+            )
+
+        nodes: list[NodeSpec] = []
+        for line in Path(hostfile).read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            m = re.match(r"(\S+)\s+slots\s*=\s*(\d+)", line)
+            if not m:
+                raise ClusterSpecError(f"bad hostfile line: {line!r}")
+            ip, slots = m.group(1), int(m.group(2))
+            if ip not in info:
+                raise ClusterSpecError(f"hostfile ip {ip} missing from clusterfile")
+            nodes.append(NodeSpec(str(info[ip]["instance_type"]), slots))
+
+        return ClusterSpec(nodes=tuple(nodes), devices=devices)
+
+    @staticmethod
+    def homogeneous(
+        device_type: str, num_nodes: int, devices_per_node: int,
+        spec: DeviceSpec | None = None,
+    ) -> "ClusterSpec":
+        dev = spec or _registry_lookup(device_type)
+        return ClusterSpec(
+            nodes=tuple(NodeSpec(device_type, devices_per_node) for _ in range(num_nodes)),
+            devices={device_type: dev},
+        )
+
+    @staticmethod
+    def of(*groups: tuple[str, int, int], overrides: dict[str, DeviceSpec] | None = None) -> "ClusterSpec":
+        """Build from (device_type, num_nodes, devices_per_node) groups."""
+        nodes: list[NodeSpec] = []
+        devices: dict[str, DeviceSpec] = {}
+        for device_type, num_nodes, per_node in groups:
+            nodes.extend(NodeSpec(device_type, per_node) for _ in range(num_nodes))
+            if overrides and device_type in overrides:
+                devices[device_type] = overrides[device_type]
+            else:
+                devices[device_type] = _registry_lookup(device_type)
+        return ClusterSpec(nodes=tuple(nodes), devices=devices)
+
+    def with_device_spec(self, spec: DeviceSpec) -> "ClusterSpec":
+        devices = dict(self.devices)
+        devices[spec.name] = spec
+        return replace(self, devices=devices)
+
+
+def _registry_lookup(device_type: str) -> DeviceSpec:
+    """Registry access that raises ClusterSpecError, never a bare KeyError —
+    search loops prune on KeyError (the ProfileMissError contract), so an
+    unregistered device type must not masquerade as a profile miss."""
+    try:
+        return DEVICE_REGISTRY[device_type]
+    except KeyError:
+        raise ClusterSpecError(
+            f"device type {device_type!r} is not registered; call "
+            "register_device() or pass an explicit DeviceSpec") from None

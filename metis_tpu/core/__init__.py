@@ -1,0 +1,34 @@
+from metis_tpu.core.types import (
+    Strategy,
+    UniformPlan,
+    InterStagePlan,
+    IntraStagePlan,
+    PlanCost,
+    RankedPlan,
+    divisors,
+    dump_ranked_plans,
+)
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.errors import (
+    MetisError,
+    ProfileMissError,
+    InfeasiblePlanError,
+    ClusterSpecError,
+)
+
+__all__ = [
+    "Strategy",
+    "UniformPlan",
+    "InterStagePlan",
+    "IntraStagePlan",
+    "PlanCost",
+    "RankedPlan",
+    "divisors",
+    "dump_ranked_plans",
+    "ModelSpec",
+    "SearchConfig",
+    "MetisError",
+    "ProfileMissError",
+    "InfeasiblePlanError",
+    "ClusterSpecError",
+]

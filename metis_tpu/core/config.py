@@ -1,0 +1,81 @@
+"""Typed configuration — one source of truth for model shape and search knobs.
+
+Replaces the reference's three-tier config (bash env vars → flat argparse with
+no defaults → two cluster files; SURVEY.md §5 "Config / flag system",
+``arguments.py:5-49``) with validated dataclasses.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """Transformer model shape (≅ reference ``utils.py:72-79`` ModelConfig).
+
+    ``num_layers`` counts *profiled* layers including the embedding (first) and
+    LM-head (last) pseudo-layers, matching the reference profile contract
+    (``profile_data_samples``: 10 entries = embed + 8 blocks + head).
+    """
+
+    name: str
+    num_layers: int
+    hidden_size: int
+    sequence_length: int
+    vocab_size: int
+    num_heads: int
+    ffn_multiplier: int = 4
+    dtype_bytes: int = 2  # bf16 activations — the TPU-native default
+
+    def __post_init__(self) -> None:
+        if self.num_layers < 3:
+            raise ValueError("num_layers must include embed + >=1 block + head")
+        if self.hidden_size % self.num_heads != 0:
+            raise ValueError("hidden_size must divide evenly into num_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_blocks(self) -> int:
+        """Transformer blocks proper (excluding embed/head pseudo-layers)."""
+        return self.num_layers - 2
+
+
+@dataclass(frozen=True)
+class SearchConfig:
+    """Search-space knobs (≅ reference "hetspeed" args, ``arguments.py:42-49``).
+
+    ``strict_compat`` reproduces the reference cost model's unit conventions
+    and documented quirks bit-for-bit so golden-parity tests can check our
+    estimator against ``results/hetero_cost_model`` (SURVEY.md §7 "Reference
+    quirk triage").  Native mode (default) fixes them:
+
+    - activation volumes in bytes (dtype-aware), not element counts
+      (ref ``activation_parameter.py:29-32``)
+    - inter-node bandwidth actually reads the inter field
+      (ref ``gpu_cluster.py:52-58`` returns intra for both)
+    - hetero-stage memory lookups use each replica's own device type
+      (ref ``load_balancer.py:51`` always reads ``device_types[0]``)
+    """
+
+    gbs: int
+    max_profiled_tp: int = 4
+    max_profiled_bs: int = 16
+    min_group_scale_variance: float = 1.0
+    max_permute_len: int = 6
+    mem_coef: float = 5.0  # ref load_balancer.py:31 fudge factor
+    optimizer_factor: float = 2.0  # ref data_loader.py:19 doubles profiled opt time
+    max_partition_attempts: int = 3  # ref load_balancer.py:123
+    strict_compat: bool = False
+    # TPU extensions (no reference counterpart):
+    enable_sp: bool = False  # add sequence-parallel variants to the plan space
+    enable_cp: bool = False  # add context-parallel (ring attention) variants
+    max_cp_degree: int = 1
+
+    def __post_init__(self) -> None:
+        if self.gbs < 1:
+            raise ValueError("gbs must be positive")
+        if self.max_permute_len < 1:
+            raise ValueError("max_permute_len must be >= 1")

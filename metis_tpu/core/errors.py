@@ -1,0 +1,33 @@
+"""Framework exception hierarchy.
+
+The reference signals "this plan can't be costed" with a bare ``KeyError``
+caught per-plan (``cost_het_cluster.py:46-47``); we keep that contract but give
+it a name so callers can distinguish missing-profile pruning from real bugs.
+"""
+from __future__ import annotations
+
+
+class MetisError(Exception):
+    """Base class for all framework errors."""
+
+
+class ProfileMissError(MetisError, KeyError):
+    """A (device_type, tp, bs) combination is absent from the profile store.
+
+    Subclasses KeyError so strict-compat call sites behave exactly like the
+    reference's per-plan KeyError pruning.
+    """
+
+    def __init__(self, device_type: str, tp: int, bs: int):
+        super().__init__(f"no profile for device_type={device_type} tp={tp} bs={bs}")
+        self.device_type = device_type
+        self.tp = tp
+        self.bs = bs
+
+
+class InfeasiblePlanError(MetisError):
+    """No memory-feasible layer partition exists for a candidate."""
+
+
+class ClusterSpecError(MetisError):
+    """Malformed cluster description."""

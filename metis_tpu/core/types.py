@@ -1,0 +1,161 @@
+"""Plan types — the lingua franca between search, cost model, and execution.
+
+These are the leaf dataclasses every other layer imports, deliberately placed in
+a dependency-free module (the reference resolves the same need with
+TYPE_CHECKING-guarded cycles between ``search_space/plan.py:8-9`` and
+``model/load_balancer.py:10-11``; we break the cycle structurally instead).
+
+Reference parity: ``UniformPlan`` ≅ reference ``search_space/plan.py:12-18``,
+``InterStagePlan`` ≅ ``plan.py:21-29``, ``IntraStagePlan`` ≅ ``plan.py:32-37``.
+Extensions beyond the reference: a per-stage ``Strategy`` carries optional
+sequence-parallel (``sp``) and expert-parallel (``ep``) degrees for the TPU
+plan space (absent from the reference — SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, asdict
+from typing import Iterator, Sequence
+
+
+@dataclass(frozen=True)
+class Strategy:
+    """Intra-stage parallelization of one pipeline stage.
+
+    ``dp * tp`` must equal the stage's device-group size.  ``sp`` is
+    Megatron-style sequence parallelism riding the tp axis (degree shared with
+    tp); ``cp`` is context parallelism (ring attention) over a dedicated mesh
+    axis; ``ep`` is expert parallelism.  The reference plans only (dp, tp)
+    tuples (``plan.py:34``).
+    """
+
+    dp: int
+    tp: int
+    sp: bool = False
+    cp: int = 1
+    ep: int = 1
+
+    @property
+    def devices(self) -> int:
+        return self.dp * self.tp * self.cp * self.ep
+
+    def as_tuple(self) -> tuple[int, int]:
+        return (self.dp, self.tp)
+
+
+@dataclass(frozen=True)
+class UniformPlan:
+    """One homogeneous Megatron-style plan: dp×pp×tp grid + batch split."""
+
+    dp: int
+    pp: int
+    tp: int
+    mbs: int
+    gbs: int
+
+    @property
+    def num_microbatches(self) -> int:
+        return self.gbs // self.mbs // self.dp
+
+    def valid_for(self, num_devices: int) -> bool:
+        return (
+            self.dp * self.pp * self.tp == num_devices
+            and self.gbs % (self.mbs * self.dp) == 0
+        )
+
+
+@dataclass(frozen=True)
+class InterStagePlan:
+    """Pipeline-level plan: device placement order, per-stage group sizes,
+    number of microbatches.
+
+    ``node_sequence`` orders device *types* (placement: all devices of
+    ``node_sequence[0]`` get the lowest ranks, and so on);
+    ``device_groups[s]`` is the device count of pipeline stage ``s``;
+    ``batches`` is the number of microbatches per step.
+    """
+
+    node_sequence: tuple[str, ...]
+    device_groups: tuple[int, ...]
+    batches: int
+    gbs: int
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.device_groups)
+
+    def stage_rank_range(self, stage_id: int) -> tuple[int, int]:
+        start = sum(self.device_groups[:stage_id])
+        return start, start + self.device_groups[stage_id]
+
+
+@dataclass(frozen=True)
+class IntraStagePlan:
+    """Per-stage strategies + layer partition for a given InterStagePlan.
+
+    ``layer_partition`` holds S+1 cumulative boundaries (``partition[s] ..
+    partition[s+1]`` are stage s's layers).  ``num_repartition`` mirrors the
+    reference's repair-attempt counter (``plan.py:37``): 1 means the
+    compute-optimal partition was memory-feasible as-is; >1 means the memory
+    repair path ran.
+    """
+
+    strategies: tuple[Strategy, ...]
+    layer_partition: tuple[int, ...]
+    memory_state: tuple[float, ...]
+    num_repartition: int
+
+
+@dataclass(frozen=True)
+class PlanCost:
+    """Cost-model breakdown for one candidate (all milliseconds)."""
+
+    total_ms: float
+    execution_ms: float = 0.0
+    fb_sync_ms: float = 0.0
+    optimizer_ms: float = 0.0
+    dp_comm_ms: float = 0.0
+    pp_comm_ms: float = 0.0
+    batch_gen_ms: float = 0.0
+    oom: bool = False
+
+
+@dataclass(frozen=True)
+class RankedPlan:
+    """One fully-specified, costed candidate — the planner's output unit."""
+
+    inter: InterStagePlan
+    intra: IntraStagePlan
+    cost: PlanCost
+
+    def to_json_dict(self) -> dict:
+        return {
+            "cost_ms": self.cost.total_ms,
+            "cost_breakdown": asdict(self.cost),
+            "node_sequence": list(self.inter.node_sequence),
+            "device_groups": list(self.inter.device_groups),
+            "num_stages": self.inter.num_stages,
+            "batches": self.inter.batches,
+            "gbs": self.inter.gbs,
+            "strategies": [
+                {"dp": s.dp, "tp": s.tp, "sp": s.sp, "cp": s.cp, "ep": s.ep}
+                for s in self.intra.strategies
+            ],
+            "layer_partition": list(self.intra.layer_partition),
+            "num_repartition": self.intra.num_repartition,
+        }
+
+
+def dump_ranked_plans(plans: Sequence[RankedPlan], limit: int | None = None) -> str:
+    """Serialize a ranked plan list to JSON (the machine-readable analogue of
+    the reference's stdout ranking, ``cost_het_cluster.py:73-77``)."""
+    out = [p.to_json_dict() for p in (plans if limit is None else plans[:limit])]
+    for rank, d in enumerate(out, start=1):
+        d["rank"] = rank
+    return json.dumps(out, indent=2)
+
+
+def divisors(n: int, descending: bool = False) -> Iterator[int]:
+    """All divisors of n (ascending by default)."""
+    ds = [i for i in range(1, n + 1) if n % i == 0]
+    return iter(reversed(ds)) if descending else iter(ds)

@@ -1,0 +1,21 @@
+from metis_tpu.profiles.store import (
+    LayerProfile,
+    ModelProfileMeta,
+    ProfileStore,
+)
+from metis_tpu.profiles.synthetic import (
+    ChipPerf,
+    CHIP_PERF,
+    synthesize_profiles,
+    tiny_test_model,
+)
+
+__all__ = [
+    "LayerProfile",
+    "ModelProfileMeta",
+    "ProfileStore",
+    "ChipPerf",
+    "CHIP_PERF",
+    "synthesize_profiles",
+    "tiny_test_model",
+]

@@ -1,0 +1,196 @@
+"""Profile store — the data contract everything downstream runs on.
+
+Implements the reference's profile-ingestion contract (``README.md:61-113``,
+``data_loader.py:10-61``): per-(device_type, tp, bs) JSON files named
+``[DeviceType.]{TYPE}_tp{N}_bs{M}.json`` containing per-layer fwd+bwd times,
+per-layer memory, and model-level totals.  Differences from the reference
+loader, all deliberate:
+
+- ``optimizer_time_ms`` is stored **raw**; the reference doubles it at load
+  time (``data_loader.py:19``) — we apply that factor in the cost estimator
+  (``SearchConfig.optimizer_factor``) where it is visible and configurable.
+- missing (type, tp, bs) lookups raise :class:`ProfileMissError` (a KeyError
+  subclass), preserving the reference's per-plan pruning contract
+  (``cost_het_cluster.py:46-47``).
+- model-level metadata is cross-checked across files instead of being taken
+  from whichever file happens to be read first (``data_loader.py:54-56``).
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Mapping
+
+from metis_tpu.core.errors import MetisError, ProfileMissError
+
+_FNAME_RE = re.compile(r"(?:DeviceType\.)?(?P<type>\w+?)_tp(?P<tp>\d+)_bs(?P<bs>\d+)\.json$")
+
+
+@dataclass(frozen=True)
+class LayerProfile:
+    """Measured behavior of one (device_type, tp, bs) configuration."""
+
+    layer_times_ms: tuple[float, ...]   # per-layer fwd+bwd
+    layer_memory_mb: tuple[float, ...]  # per-layer peak memory
+    fb_sync_ms: float                   # fwd/bwd total minus per-layer sum
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.layer_times_ms)
+
+    def time_slice(self, start: int, end: int) -> float:
+        return sum(self.layer_times_ms[start:end])
+
+    def memory_slice(self, start: int, end: int) -> float:
+        return sum(self.layer_memory_mb[start:end])
+
+    @property
+    def total_time_ms(self) -> float:
+        return sum(self.layer_times_ms)
+
+
+@dataclass(frozen=True)
+class ModelProfileMeta:
+    """Model-level profile facts shared across configurations."""
+
+    num_layers: int
+    optimizer_time_ms: float      # raw (NOT pre-doubled)
+    batch_generator_ms: float
+    params_per_layer_bytes: tuple[int, ...]
+
+    @property
+    def total_params_bytes(self) -> int:
+        return sum(self.params_per_layer_bytes)
+
+
+class ProfileStore:
+    """In-memory profile database keyed by (device_type, tp, bs)."""
+
+    def __init__(
+        self,
+        entries: Mapping[tuple[str, int, int], LayerProfile],
+        model: ModelProfileMeta,
+    ):
+        self._entries = dict(entries)
+        self.model = model
+        types: list[str] = []
+        for (t, _, _) in self._entries:
+            if t not in types:
+                types.append(t)
+        self.device_types: tuple[str, ...] = tuple(types)
+
+    def has(self, device_type: str, tp: int, bs: int) -> bool:
+        return (device_type, tp, bs) in self._entries
+
+    def get(self, device_type: str, tp: int, bs: int) -> LayerProfile:
+        try:
+            return self._entries[(device_type, tp, bs)]
+        except KeyError:
+            raise ProfileMissError(device_type, tp, bs) from None
+
+    def configs(self, device_type: str | None = None) -> list[tuple[str, int, int]]:
+        return [k for k in self._entries if device_type is None or k[0] == device_type]
+
+    def max_tp(self, device_type: str) -> int:
+        return max((tp for (t, tp, _) in self._entries if t == device_type), default=0)
+
+    def max_bs(self, device_type: str) -> int:
+        return max((bs for (t, _, bs) in self._entries if t == device_type), default=0)
+
+    def merged_with(self, other: "ProfileStore") -> "ProfileStore":
+        """Union of two stores (e.g. per-device-type profiling runs of the
+        same model).  The stores must describe the same model."""
+        if (self.model.num_layers != other.model.num_layers
+                or self.model.params_per_layer_bytes != other.model.params_per_layer_bytes):
+            raise MetisError("cannot merge profile stores of different models")
+        entries = dict(self._entries)
+        entries.update(other._entries)
+        return ProfileStore(entries, self.model)
+
+    # -- serialization -----------------------------------------------------
+    @staticmethod
+    def from_dir(profile_dir: str | Path) -> "ProfileStore":
+        paths = sorted(Path(profile_dir).glob("*.json"))
+        parsed = []
+        for p in paths:
+            m = _FNAME_RE.search(p.name)
+            if m:
+                parsed.append((p, m.group("type"), int(m.group("tp")), int(m.group("bs"))))
+        if not parsed:
+            raise MetisError(f"no profile files found under {profile_dir}")
+        entries: dict[tuple[str, int, int], LayerProfile] = {}
+        model: ModelProfileMeta | None = None
+        for p, dtype, tp, bs in parsed:
+            raw = json.loads(p.read_text())
+            entries[(dtype, tp, bs)] = _layer_profile_from_raw(raw)
+            meta = _model_meta_from_raw(raw)
+            if model is None:
+                model = meta
+            elif (model.num_layers != meta.num_layers
+                  or model.params_per_layer_bytes != meta.params_per_layer_bytes):
+                # Fixes the reference taking model metadata from whichever
+                # file loads first (data_loader.py:54-56); stale mixed-model
+                # profile dirs must fail loudly.
+                raise MetisError(
+                    f"inconsistent model metadata across profile files ({p.name})")
+        assert model is not None
+        return ProfileStore(entries, model)
+
+    def dump_to_dir(self, out_dir: str | Path, extra_model_fields: dict | None = None) -> list[Path]:
+        """Write reference-schema JSON files (so external tools consuming the
+        Metis format can read our profiles)."""
+        out = Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        written = []
+        for (dtype, tp, bs), prof in sorted(self._entries.items()):
+            raw = {
+                "model": {
+                    "model_name": (extra_model_fields or {}).get("model_name", "model"),
+                    "num_layers": self.model.num_layers,
+                    "parameters": {
+                        "total_parameters_bytes": self.model.total_params_bytes,
+                        "parameters_per_layer_bytes": list(self.model.params_per_layer_bytes),
+                    },
+                },
+                "execution_time": {
+                    "total_time_ms": sum(prof.layer_times_ms) + prof.fb_sync_ms
+                    + self.model.optimizer_time_ms + self.model.batch_generator_ms,
+                    "forward_backward_time_ms": sum(prof.layer_times_ms) + prof.fb_sync_ms,
+                    "batch_generator_time_ms": self.model.batch_generator_ms,
+                    "layernorm_grads_all_reduce_time_ms": 0.0,
+                    "embedding_grads_all_reduce_time_ms": 0.0,
+                    "optimizer_time_ms": self.model.optimizer_time_ms,
+                    "layer_compute_total_ms": list(prof.layer_times_ms),
+                },
+                "execution_memory": {
+                    "total_memory": sum(prof.layer_memory_mb),
+                    "layer_memory_total_mb": list(prof.layer_memory_mb),
+                },
+            }
+            path = out / f"DeviceType.{dtype}_tp{tp}_bs{bs}.json"
+            path.write_text(json.dumps(raw, indent=2))
+            written.append(path)
+        return written
+
+
+def _layer_profile_from_raw(raw: dict) -> LayerProfile:
+    times = tuple(float(t) for t in raw["execution_time"]["layer_compute_total_ms"])
+    fb_total = float(raw["execution_time"]["forward_backward_time_ms"])
+    mem = tuple(float(m) for m in raw["execution_memory"]["layer_memory_total_mb"])
+    return LayerProfile(
+        layer_times_ms=times,
+        layer_memory_mb=mem,
+        fb_sync_ms=fb_total - sum(times),
+    )
+
+
+def _model_meta_from_raw(raw: dict) -> ModelProfileMeta:
+    return ModelProfileMeta(
+        num_layers=len(raw["execution_time"]["layer_compute_total_ms"]),
+        optimizer_time_ms=float(raw["execution_time"]["optimizer_time_ms"]),
+        batch_generator_ms=float(raw["execution_time"]["batch_generator_time_ms"]),
+        params_per_layer_bytes=tuple(
+            int(b) for b in raw["model"]["parameters"]["parameters_per_layer_bytes"]),
+    )

@@ -1,0 +1,164 @@
+"""Synthetic profile generation — analytic roofline stand-ins for measured
+profiles.
+
+The reference ships 9 measured A100 fixture files (``profile_data_samples/``)
+and documents, but does not implement, profile collection (``README.md:142-186``).
+We keep the planner runnable with zero TPUs (SURVEY.md §4) by synthesizing
+self-consistent profiles from a roofline model: MXU-bound matmul FLOPs at a
+batch-dependent utilization, HBM-bound embedding/softmax terms, Adam-state
+memory.  Real measured profiles (metis_tpu.profiler) use the identical schema
+and simply replace these.
+
+The absolute values are not meant to match any real chip; what matters for the
+planner is self-consistency and the right monotonicities (time falls with tp,
+rises with bs; memory falls with tp, rises with bs).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from metis_tpu.core.config import ModelSpec
+from metis_tpu.cluster.tpu import TPU_GENERATIONS
+from metis_tpu.profiles.store import LayerProfile, ModelProfileMeta, ProfileStore
+
+
+@dataclass(frozen=True)
+class ChipPerf:
+    """Roofline inputs for one device type."""
+
+    name: str
+    bf16_tflops: float
+    hbm_bw_gbps: float
+    hbm_gb: float
+    base_mfu: float = 0.45  # large-matmul MXU utilization
+
+    def mfu(self, per_device_bs: int, tp: int) -> float:
+        # Small local batches / high tp shrink matmul tiles and MXU efficiency.
+        shrink = 1.0 - 0.25 / (per_device_bs + 1) - 0.04 * math.log2(max(tp, 1))
+        return max(self.base_mfu * shrink, 0.05)
+
+
+# Rough public-spec rooflines for the GPU types used by reference-shaped tests,
+# plus TPU generations pulled from the topology model.
+CHIP_PERF: dict[str, ChipPerf] = {
+    "A100": ChipPerf("A100", bf16_tflops=312, hbm_bw_gbps=2039, hbm_gb=80),
+    "V100": ChipPerf("V100", bf16_tflops=125, hbm_bw_gbps=900, hbm_gb=16),
+    "P100": ChipPerf("P100", bf16_tflops=21, hbm_bw_gbps=732, hbm_gb=16),
+    "T4": ChipPerf("T4", bf16_tflops=65, hbm_bw_gbps=320, hbm_gb=15),
+}
+for _g in TPU_GENERATIONS.values():
+    CHIP_PERF[_g.name] = ChipPerf(_g.name, _g.bf16_tflops, _g.hbm_bw_gbps, _g.hbm_gb)
+
+_ADAM_STATE_FACTOR = 6.0   # fp32 master + 2 moments over bf16 weights
+_BWD_FLOP_FACTOR = 2.0     # backward ≈ 2x forward FLOPs
+
+
+def _params_per_layer(model: ModelSpec) -> tuple[int, ...]:
+    h, v = model.hidden_size, model.vocab_size
+    embed = v * h + model.sequence_length * h          # token + position tables
+    block = 12 * h * h + 13 * h                        # qkvo + mlp + norms
+    head = v * h                                       # untied LM head
+    layers = [embed] + [block] * model.num_blocks + [head]
+    return tuple(p * model.dtype_bytes for p in layers)
+
+
+def _block_flops(model: ModelSpec, bs: int) -> float:
+    h, s = model.hidden_size, model.sequence_length
+    matmul = 24 * bs * s * h * h       # qkv + proj + 2 mlp matmuls
+    attn = 4 * bs * s * s * h          # scores + context
+    return (matmul + attn) * (1 + _BWD_FLOP_FACTOR)
+
+
+def _head_flops(model: ModelSpec, bs: int) -> float:
+    return 2 * bs * model.sequence_length * model.hidden_size * model.vocab_size \
+        * (1 + _BWD_FLOP_FACTOR)
+
+
+def synthesize_profiles(
+    model: ModelSpec,
+    device_types: list[str],
+    tps: list[int] | None = None,
+    bss: list[int] | None = None,
+    chip_perf: dict[str, ChipPerf] | None = None,
+) -> ProfileStore:
+    """Build a ProfileStore covering ``device_types`` × ``tps`` × ``bss``."""
+    tps = tps or [1, 2, 4]
+    bss = bss or [1, 2, 4, 8]
+    perf_map = chip_perf or CHIP_PERF
+
+    params = _params_per_layer(model)
+    entries: dict[tuple[str, int, int], LayerProfile] = {}
+    for dtype in device_types:
+        perf = perf_map[dtype]
+        for tp in tps:
+            for bs in bss:
+                entries[(dtype, tp, bs)] = _synth_layer_profile(
+                    model, perf, tp, bs, params)
+
+    # Model-level: optimizer reads/writes all Adam state at HBM bandwidth on
+    # the first device type's chips.
+    first = perf_map[device_types[0]]
+    opt_bytes = sum(params) * (1 + _ADAM_STATE_FACTOR)
+    optimizer_ms = opt_bytes / (first.hbm_bw_gbps * 1e9) * 1e3
+    meta = ModelProfileMeta(
+        num_layers=model.num_layers,
+        optimizer_time_ms=optimizer_ms,
+        batch_generator_ms=0.5,
+        params_per_layer_bytes=params,
+    )
+    return ProfileStore(entries, meta)
+
+
+def _synth_layer_profile(
+    model: ModelSpec, perf: ChipPerf, tp: int, bs: int, params: tuple[int, ...]
+) -> LayerProfile:
+    h, s = model.hidden_size, model.sequence_length
+    eff_flops = perf.bf16_tflops * 1e12 * perf.mfu(bs, tp)
+    hbm_bps = perf.hbm_bw_gbps * 1e9
+
+    def matmul_ms(flops: float) -> float:
+        return flops / tp / eff_flops * 1e3
+
+    # Embedding: gather + position add — HBM bound on the activation volume.
+    embed_bytes = 3 * bs * s * h * model.dtype_bytes
+    embed_ms = embed_bytes / hbm_bps * 1e3
+
+    block_ms = matmul_ms(_block_flops(model, bs))
+    head_ms = matmul_ms(_head_flops(model, bs)) + embed_ms  # matmul + softmax IO
+
+    times = [embed_ms] + [block_ms] * model.num_blocks + [head_ms]
+
+    # Memory: sharded weights + Adam state + activations kept for backward.
+    act_bytes_block = 10 * bs * s * h * model.dtype_bytes / tp
+    act_bytes_head = bs * s * model.vocab_size * model.dtype_bytes / tp
+
+    def layer_mem_mb(param_bytes: int, act_bytes: float) -> float:
+        state = param_bytes / tp * (1 + _ADAM_STATE_FACTOR)
+        return (state + act_bytes) / (1024 * 1024)
+
+    mems = (
+        [layer_mem_mb(params[0], act_bytes_block)]
+        + [layer_mem_mb(params[1], act_bytes_block)] * model.num_blocks
+        + [layer_mem_mb(params[-1], act_bytes_head)]
+    )
+
+    fb_sync = 0.02 * sum(times) + 0.1  # launch/sync overhead not in layer times
+    return LayerProfile(
+        layer_times_ms=tuple(times),
+        layer_memory_mb=tuple(mems),
+        fb_sync_ms=fb_sync,
+    )
+
+
+def tiny_test_model(num_layers: int = 10) -> ModelSpec:
+    """The GPT-shaped model used across unit tests (mirrors the reference
+    fixture scale: 10 profiled layers, hidden 4096, seq 1024)."""
+    return ModelSpec(
+        name="gpt-test",
+        num_layers=num_layers,
+        hidden_size=4096,
+        sequence_length=1024,
+        vocab_size=51200,
+        num_heads=32,
+    )

@@ -1,0 +1,39 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh before any jax import.
+
+Multi-chip sharding tests run on --xla_force_host_platform_device_count=8
+(SURVEY.md §4: multi-host behavior must be testable with zero TPUs).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+REFERENCE_ROOT = pathlib.Path("/root/reference")
+
+sys.path.insert(0, str(REPO_ROOT))
+
+
+@pytest.fixture(scope="session")
+def reference_root() -> pathlib.Path:
+    """Path to the read-only upstream reference checkout; tests that use it as
+    a behavioral oracle skip when it is absent."""
+    if not REFERENCE_ROOT.exists():
+        pytest.skip("reference checkout not available")
+    return REFERENCE_ROOT
+
+
+@pytest.fixture(scope="session")
+def reference_profiles(reference_root):
+    """The reference's measured A100 profile fixtures, loaded through OUR
+    loader (schema-compat check by construction)."""
+    from metis_tpu.profiles import ProfileStore
+
+    return ProfileStore.from_dir(reference_root / "profile_data_samples")

@@ -1,0 +1,103 @@
+import pytest
+
+from metis_tpu.cluster import (
+    ClusterSpec,
+    DeviceSpec,
+    NodeSpec,
+    TpuClusterSpec,
+    TpuSliceSpec,
+    slice_from_name,
+)
+from metis_tpu.core.errors import ClusterSpecError
+
+
+def make_hetero_cluster() -> ClusterSpec:
+    """8xA100 + 8xT4, 4 per node — the reference golden-run topology
+    (results/hetero_cost_model:1-29)."""
+    return ClusterSpec.of(
+        ("T4", 2, 4),
+        ("A100", 2, 4),
+        overrides={
+            "T4": DeviceSpec("T4", 15, intra_bw_gbps=50, inter_bw_gbps=10),
+            "A100": DeviceSpec("A100", 80, intra_bw_gbps=46, inter_bw_gbps=10),
+        },
+    )
+
+
+class TestClusterSpec:
+    def test_counts(self):
+        c = make_hetero_cluster()
+        assert c.total_devices == 16
+        assert c.num_nodes == 4
+        assert c.devices_per_node == 4
+        assert c.num_devices_by_type("A100") == 8
+        assert set(c.device_types) == {"A100", "T4"}
+
+    def test_memory_mb_convention(self):
+        c = make_hetero_cluster()
+        assert c.memory_mb("A100") == 80 * 1024
+
+    def test_inter_bw_strict_compat_reads_intra(self):
+        # Reference bug: inter getter returns intra field (gpu_cluster.py:56-58).
+        c = make_hetero_cluster()
+        assert c.inter_bw_for_types(["A100", "T4"], strict_compat=True) == 46
+        assert c.inter_bw_for_types(["A100", "T4"], strict_compat=False) == 10
+
+    def test_rank_to_node(self):
+        c = make_hetero_cluster()
+        assert c.node_of_rank(0) == 0
+        assert c.node_of_rank(7) == 1
+        assert c.node_of_rank(15) == 3
+
+    def test_from_files(self, tmp_path):
+        (tmp_path / "hostfile").write_text(
+            "10.0.0.1 slots=16\n10.0.0.2 slots=16\n")
+        (tmp_path / "cluster.json").write_text(
+            '{"10.0.0.1": {"instance_type": "A100", "inter_bandwidth": 10,'
+            ' "intra_bandwidth": 50, "memory": 80},'
+            ' "10.0.0.2": {"instance_type": "T4", "inter_bandwidth": 10,'
+            ' "intra_bandwidth": 50, "memory": 15}}')
+        c = ClusterSpec.from_files(tmp_path / "hostfile", tmp_path / "cluster.json")
+        # multi-digit slots parse correctly (reference's [6:7] slice could not)
+        assert c.total_devices == 32
+        assert c.nodes[0].device_type == "A100"
+
+    def test_empty_cluster_rejected(self):
+        with pytest.raises(ClusterSpecError):
+            ClusterSpec(nodes=(), devices={})
+
+
+class TestTpuTopology:
+    def test_slice_from_name(self):
+        s = slice_from_name("v4-32")
+        assert s.generation == "tpu_v4"
+        assert s.num_chips == 32
+        assert sorted(s.topology, reverse=True) == list(s.topology)
+
+        s16 = slice_from_name("v5e-16")
+        assert s16.topology == (4, 4)
+        assert s16.wrap == (True, True)
+
+    def test_axis_ring_bandwidth_doubles_on_wrap(self):
+        s = TpuSliceSpec("tpu_v4", (4, 4, 2))
+        assert s.axis_ring_bw_gbps(0) == 90  # wrapped 4-ring: both directions
+        assert s.axis_ring_bw_gbps(2) == 45  # extent-2 axis: single link
+
+    def test_wrong_dims_rejected(self):
+        with pytest.raises(ClusterSpecError):
+            TpuSliceSpec("tpu_v5e", (4, 4, 2))  # v5e is a 2D torus
+
+    def test_hetero_tpu_cluster_lowering(self):
+        # The BASELINE north-star topology: v4-32 + v5e-16 over DCN.
+        tc = TpuClusterSpec(slices=(slice_from_name("v4-32"), slice_from_name("v5e-16")))
+        assert tc.total_chips == 48
+        assert tc.slice_of_rank(0) == 0
+        assert tc.slice_of_rank(32) == 1
+
+        c = tc.as_cluster_spec(chips_per_node=4)
+        assert c.total_devices == 48
+        assert set(c.device_types) == {"tpu_v4", "tpu_v5e"}
+        assert c.memory_mb("tpu_v4") == 32 * 1024
+        # DCN is the cross-slice bandwidth; ICI the within-slice one.
+        assert c.inter_bw_for_types(["tpu_v4", "tpu_v5e"]) == 25
+        assert c.intra_bw_for_type("tpu_v4") == 45  # slowest axis: extent-2, unwrapped

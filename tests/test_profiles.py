@@ -1,0 +1,66 @@
+import pytest
+
+from metis_tpu.core.errors import ProfileMissError
+from metis_tpu.profiles import (
+    ProfileStore,
+    synthesize_profiles,
+    tiny_test_model,
+)
+
+
+@pytest.fixture(scope="module")
+def synth_store():
+    return synthesize_profiles(
+        tiny_test_model(), ["tpu_v5e", "tpu_v4"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16])
+
+
+class TestSyntheticProfiles:
+    def test_shapes(self, synth_store):
+        p = synth_store.get("tpu_v5e", 1, 1)
+        assert p.num_layers == 10
+        assert len(p.layer_memory_mb) == 10
+        assert synth_store.model.num_layers == 10
+
+    def test_monotonicity(self, synth_store):
+        # More tp => faster and smaller; more bs => slower and bigger.
+        t1 = synth_store.get("tpu_v5e", 1, 4).total_time_ms
+        t4 = synth_store.get("tpu_v5e", 4, 4).total_time_ms
+        assert t4 < t1
+        b1 = synth_store.get("tpu_v5e", 2, 1)
+        b8 = synth_store.get("tpu_v5e", 2, 8)
+        assert b8.total_time_ms > b1.total_time_ms
+        assert sum(b8.layer_memory_mb) > sum(b1.layer_memory_mb)
+
+    def test_miss_raises_keyerror_subclass(self, synth_store):
+        with pytest.raises(ProfileMissError):
+            synth_store.get("tpu_v5e", 8, 1)
+        with pytest.raises(KeyError):  # preserves reference pruning contract
+            synth_store.get("nope", 1, 1)
+
+    def test_roundtrip_through_reference_schema(self, synth_store, tmp_path):
+        synth_store.dump_to_dir(tmp_path)
+        reloaded = ProfileStore.from_dir(tmp_path)
+        orig = synth_store.get("tpu_v4", 2, 4)
+        back = reloaded.get("tpu_v4", 2, 4)
+        assert back.layer_times_ms == pytest.approx(orig.layer_times_ms)
+        assert back.fb_sync_ms == pytest.approx(orig.fb_sync_ms)
+        assert reloaded.model.params_per_layer_bytes == synth_store.model.params_per_layer_bytes
+
+
+class TestReferenceFixtureCompat:
+    """Load the upstream measured fixtures through our loader (data-contract
+    parity, SURVEY.md §3.5)."""
+
+    def test_load_reference_fixtures(self, reference_profiles):
+        assert reference_profiles.device_types == ("A100",)
+        assert reference_profiles.max_tp("A100") == 4
+        assert reference_profiles.max_bs("A100") == 4
+        p = reference_profiles.get("A100", 1, 1)
+        assert p.num_layers == 10
+        # fb_sync = forward_backward_total - sum(layer times) (data_loader.py:33-34)
+        assert p.fb_sync_ms == pytest.approx(292.7964687347412 - sum(p.layer_times_ms))
+        # optimizer time stored RAW (ref doubles at load; we keep the factor
+        # in the estimator — SearchConfig.optimizer_factor)
+        assert reference_profiles.model.optimizer_time_ms == pytest.approx(
+            39.308977127075195)
+        assert reference_profiles.model.total_params_bytes == 2405502976
