@@ -46,7 +46,10 @@ class DeviceSpec:
 DEVICE_REGISTRY: dict[str, DeviceSpec] = {}
 
 
-def register_device(spec: DeviceSpec, overwrite: bool = True) -> DeviceSpec:
+def register_device(spec: DeviceSpec, overwrite: bool = False) -> DeviceSpec:
+    """Add a device type to the process-global registry.  Collisions raise
+    unless ``overwrite=True`` — silently clobbering a registered type would
+    change every later ClusterSpec lookup in the process."""
     if not overwrite and spec.name in DEVICE_REGISTRY:
         raise ClusterSpecError(f"device type {spec.name!r} already registered")
     DEVICE_REGISTRY[spec.name] = spec

@@ -157,7 +157,19 @@ class TpuClusterSpec:
         devices: dict[str, DeviceSpec] = {}
         for s in self.slices:
             nodes.extend(s.as_nodes(chips_per_node))
-            devices[s.generation] = s.as_device_spec()
+            spec = s.as_device_spec()
+            prev = devices.get(s.generation)
+            if prev is not None and prev != spec:
+                # Two same-generation slices with different topologies project
+                # to different scalar bandwidths; the flat ClusterSpec keys
+                # device specs by type, so it cannot represent that.  Fail
+                # loudly rather than silently costing one slice with the
+                # other's bandwidth.
+                raise ClusterSpecError(
+                    f"slices of generation {s.generation} have differing "
+                    f"scalar projections ({prev} vs {spec}); use the ICI/DCN "
+                    "bandwidth model or uniform slice topologies")
+            devices[s.generation] = spec
         return ClusterSpec(nodes=tuple(nodes), devices=devices)
 
 

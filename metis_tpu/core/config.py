@@ -31,7 +31,7 @@ class ModelSpec:
         if self.num_layers < 3:
             raise ValueError("num_layers must include embed + >=1 block + head")
         if self.hidden_size % self.num_heads != 0:
-            raise ValueError("hidden_size must divide evenly into num_heads")
+            raise ValueError("num_heads must divide hidden_size evenly")
 
     @property
     def head_dim(self) -> int:

@@ -12,8 +12,12 @@ loader, all deliberate:
 - missing (type, tp, bs) lookups raise :class:`ProfileMissError` (a KeyError
   subclass), preserving the reference's per-plan pruning contract
   (``cost_het_cluster.py:46-47``).
-- model-level metadata is cross-checked across files instead of being taken
-  from whichever file happens to be read first (``data_loader.py:54-56``).
+- structural model facts (layer count, parameter sizes) are cross-checked
+  across files instead of being taken from whichever file happens to be read
+  first (``data_loader.py:54-56``); per-device-type timings that legitimately
+  differ across chips (optimizer step, batch generator) are kept **per type**
+  (``ProfileStore.type_meta``) — the reference collapses them to one global
+  value from an arbitrary file.
 """
 from __future__ import annotations
 
@@ -53,7 +57,13 @@ class LayerProfile:
 
 @dataclass(frozen=True)
 class ModelProfileMeta:
-    """Model-level profile facts shared across configurations."""
+    """Model-level profile facts shared across configurations.
+
+    ``optimizer_time_ms``/``batch_generator_ms`` here are the *default*
+    (first device type's) values — per-type values live in
+    ``ProfileStore.type_meta`` and should be preferred when the consumer
+    knows which chips run the stage.
+    """
 
     num_layers: int
     optimizer_time_ms: float      # raw (NOT pre-doubled)
@@ -65,6 +75,14 @@ class ModelProfileMeta:
         return sum(self.params_per_layer_bytes)
 
 
+@dataclass(frozen=True)
+class DeviceTypeMeta:
+    """Per-device-type timings that are not per-layer."""
+
+    optimizer_time_ms: float
+    batch_generator_ms: float
+
+
 class ProfileStore:
     """In-memory profile database keyed by (device_type, tp, bs)."""
 
@@ -72,6 +90,7 @@ class ProfileStore:
         self,
         entries: Mapping[tuple[str, int, int], LayerProfile],
         model: ModelProfileMeta,
+        type_meta: Mapping[str, DeviceTypeMeta] | None = None,
     ):
         self._entries = dict(entries)
         self.model = model
@@ -80,6 +99,10 @@ class ProfileStore:
             if t not in types:
                 types.append(t)
         self.device_types: tuple[str, ...] = tuple(types)
+        self.type_meta: dict[str, DeviceTypeMeta] = dict(type_meta or {})
+        for t in self.device_types:
+            self.type_meta.setdefault(
+                t, DeviceTypeMeta(model.optimizer_time_ms, model.batch_generator_ms))
 
     def has(self, device_type: str, tp: int, bs: int) -> bool:
         return (device_type, tp, bs) in self._entries
@@ -107,7 +130,9 @@ class ProfileStore:
             raise MetisError("cannot merge profile stores of different models")
         entries = dict(self._entries)
         entries.update(other._entries)
-        return ProfileStore(entries, self.model)
+        type_meta = dict(self.type_meta)
+        type_meta.update(other.type_meta)
+        return ProfileStore(entries, self.model, type_meta)
 
     # -- serialization -----------------------------------------------------
     @staticmethod
@@ -122,6 +147,7 @@ class ProfileStore:
             raise MetisError(f"no profile files found under {profile_dir}")
         entries: dict[tuple[str, int, int], LayerProfile] = {}
         model: ModelProfileMeta | None = None
+        type_meta: dict[str, DeviceTypeMeta] = {}
         for p, dtype, tp, bs in parsed:
             raw = json.loads(p.read_text())
             entries[(dtype, tp, bs)] = _layer_profile_from_raw(raw)
@@ -135,8 +161,12 @@ class ProfileStore:
                 # profile dirs must fail loudly.
                 raise MetisError(
                     f"inconsistent model metadata across profile files ({p.name})")
+            # Per-type timings: first (sorted-path) file of each type wins —
+            # deterministic, unlike the reference's os.listdir order.
+            type_meta.setdefault(
+                dtype, DeviceTypeMeta(meta.optimizer_time_ms, meta.batch_generator_ms))
         assert model is not None
-        return ProfileStore(entries, model)
+        return ProfileStore(entries, model, type_meta)
 
     def dump_to_dir(self, out_dir: str | Path, extra_model_fields: dict | None = None) -> list[Path]:
         """Write reference-schema JSON files (so external tools consuming the
@@ -145,6 +175,9 @@ class ProfileStore:
         out.mkdir(parents=True, exist_ok=True)
         written = []
         for (dtype, tp, bs), prof in sorted(self._entries.items()):
+            tmeta = self.type_meta.get(
+                dtype, DeviceTypeMeta(self.model.optimizer_time_ms,
+                                      self.model.batch_generator_ms))
             raw = {
                 "model": {
                     "model_name": (extra_model_fields or {}).get("model_name", "model"),
@@ -156,12 +189,12 @@ class ProfileStore:
                 },
                 "execution_time": {
                     "total_time_ms": sum(prof.layer_times_ms) + prof.fb_sync_ms
-                    + self.model.optimizer_time_ms + self.model.batch_generator_ms,
+                    + tmeta.optimizer_time_ms + tmeta.batch_generator_ms,
                     "forward_backward_time_ms": sum(prof.layer_times_ms) + prof.fb_sync_ms,
-                    "batch_generator_time_ms": self.model.batch_generator_ms,
+                    "batch_generator_time_ms": tmeta.batch_generator_ms,
                     "layernorm_grads_all_reduce_time_ms": 0.0,
                     "embedding_grads_all_reduce_time_ms": 0.0,
-                    "optimizer_time_ms": self.model.optimizer_time_ms,
+                    "optimizer_time_ms": tmeta.optimizer_time_ms,
                     "layer_compute_total_ms": list(prof.layer_times_ms),
                 },
                 "execution_memory": {

@@ -20,7 +20,12 @@ from dataclasses import dataclass
 
 from metis_tpu.core.config import ModelSpec
 from metis_tpu.cluster.tpu import TPU_GENERATIONS
-from metis_tpu.profiles.store import LayerProfile, ModelProfileMeta, ProfileStore
+from metis_tpu.profiles.store import (
+    DeviceTypeMeta,
+    LayerProfile,
+    ModelProfileMeta,
+    ProfileStore,
+)
 
 
 @dataclass(frozen=True)
@@ -96,18 +101,23 @@ def synthesize_profiles(
                 entries[(dtype, tp, bs)] = _synth_layer_profile(
                     model, perf, tp, bs, params)
 
-    # Model-level: optimizer reads/writes all Adam state at HBM bandwidth on
-    # the first device type's chips.
-    first = perf_map[device_types[0]]
+    # Optimizer reads/writes all Adam state at each chip type's HBM bandwidth.
     opt_bytes = sum(params) * (1 + _ADAM_STATE_FACTOR)
-    optimizer_ms = opt_bytes / (first.hbm_bw_gbps * 1e9) * 1e3
+    type_meta = {
+        t: DeviceTypeMeta(
+            optimizer_time_ms=opt_bytes / (perf_map[t].hbm_bw_gbps * 1e9) * 1e3,
+            batch_generator_ms=0.5,
+        )
+        for t in device_types
+    }
+    first = type_meta[device_types[0]]
     meta = ModelProfileMeta(
         num_layers=model.num_layers,
-        optimizer_time_ms=optimizer_ms,
-        batch_generator_ms=0.5,
+        optimizer_time_ms=first.optimizer_time_ms,
+        batch_generator_ms=first.batch_generator_ms,
         params_per_layer_bytes=params,
     )
-    return ProfileStore(entries, meta)
+    return ProfileStore(entries, meta, type_meta)
 
 
 def _synth_layer_profile(

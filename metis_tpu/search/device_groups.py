@@ -1,0 +1,146 @@
+"""Device-group enumeration: how many chips each pipeline stage gets.
+
+Re-derivation of the reference's three "key ideas" (``search_space/
+device_group.py``):
+
+1. group sizes restricted to powers of two (``gen_device_group_shapes:84-90``)
+   — on TPU this is also the hardware-true constraint: a power-of-two group
+   maps onto a contiguous ICI sub-torus;
+2. a variance knob that discards groups much smaller than the even share
+   (``gen_dgroups_for_stages_with_variance:93-98``);
+3. a permutation-length cap that merges equal-size smallest groups pairwise
+   before permuting stage order, bounding the orderings explosion
+   (``permute:7-55``).
+
+The composition enumerator and the merge cap reproduce the reference's
+*observable* outputs (oracle-tested against the upstream module in
+tests/test_search_parity.py); the implementation is our own.
+"""
+from __future__ import annotations
+
+from itertools import chain
+from typing import Iterator, Sequence
+
+from metis_tpu.search.multiperm import multiset_permutations
+
+
+def power_of_two_shapes(num_devices: int) -> list[int]:
+    """Allowed per-stage group sizes: 1, 2, 4, ... <= num_devices."""
+    shapes = []
+    p = 1
+    while p <= num_devices:
+        shapes.append(p)
+        p *= 2
+    return shapes
+
+
+def nondecreasing_compositions(
+    num_stages: int, total: int, shapes: Sequence[int]
+) -> Iterator[tuple[int, ...]]:
+    """All non-decreasing ways to write ``total`` as a sum of ``num_stages``
+    values drawn (with repetition) from ``shapes``."""
+    shapes = sorted(shapes)
+    if not shapes:
+        return
+
+    def rec(remaining: int, stages_left: int, min_idx: int) -> Iterator[tuple[int, ...]]:
+        if stages_left == 0:
+            if remaining == 0:
+                yield ()
+            return
+        for i in range(min_idx, len(shapes)):
+            s = shapes[i]
+            if s > remaining or s * stages_left > remaining:
+                break  # shapes ascending + non-decreasing suffix ⇒ no fit
+            if shapes[-1] * (stages_left - 1) < remaining - s:
+                continue  # even the largest shape can't absorb the rest
+            for rest in rec(remaining - s, stages_left - 1, i):
+                yield (s, *rest)
+
+    yield from rec(total, num_stages, 0)
+
+
+def merge_for_permute_cap(
+    composition: Sequence[int], max_permute_len: int
+) -> list[tuple[int, ...]]:
+    """Bound permutation count by fusing equal-size smallest groups pairwise.
+
+    Takes a non-decreasing composition; returns "super-groups" (tuples of
+    original group sizes) whose count is at most ``max_permute_len`` when
+    achievable.  Behavioral parity with the reference's ``permute`` merge
+    phase, including its two quirks we keep deliberately (oracle-tested):
+    it may over-merge (half the smallest groups fuse even when fewer merges
+    would do), and after a partial merge the leading group may no longer be
+    the smallest.
+    """
+    groups: list[tuple[int, ...]] = [(g,) for g in composition]
+    reduce_target = len(groups) - max_permute_len
+    while reduce_target > 0:
+        lead = groups[0]
+        lead_sum = sum(lead)
+        lead_count = 0
+        for g in groups:
+            if g != lead:
+                break
+            lead_count += 1
+        # Reference's find_num_min (device_group.py:8-12) returns the index of
+        # the first non-equal group plus one — i.e. leading-run + 1 unless the
+        # whole list is equal.  The over-merge decision keys on that value, so
+        # we reproduce it exactly (oracle-tested).
+        min_run = lead_count if lead_count == len(groups) else lead_count + 1
+        reduce_target = max(reduce_target, min_run // 2)
+
+        merged: list[tuple[int, ...]] = []
+        for i in range(0, len(groups), 2):
+            if reduce_target <= i // 2:
+                merged.extend(groups[i:])
+                break
+            if i + 1 >= len(groups):
+                merged.append(groups[i])
+            elif sum(groups[i]) == lead_sum and sum(groups[i + 1]) == lead_sum:
+                merged.append(groups[i] + groups[i + 1])
+            else:
+                merged.append(groups[i])
+                merged.append(groups[i + 1])
+
+        groups = merged
+        if reduce_target == len(groups) - max_permute_len:
+            break  # no further reduction possible
+        reduce_target = len(groups) - max_permute_len
+    return groups
+
+
+def arrangements_of_composition(
+    composition: Sequence[int], max_permute_len: int
+) -> Iterator[tuple[int, ...]]:
+    """All stage orderings of one composition, under the permutation cap.
+
+    Super-groups permute as units and are then flattened back to per-stage
+    sizes (≅ reference ``permute`` + ``chain`` at ``device_group.py:102-105``).
+    """
+    groups = merge_for_permute_cap(composition, max_permute_len)
+    for perm in multiset_permutations(groups):
+        yield tuple(chain.from_iterable(perm))
+
+
+def enumerate_device_groups(
+    num_stages: int,
+    num_devices: int,
+    variance: float = 1.0,
+    max_permute_len: int = 6,
+    shapes: Sequence[int] | None = None,
+) -> list[tuple[int, ...]]:
+    """Every candidate per-stage device-count arrangement for a stage count.
+
+    ``variance`` filters shapes below ``max(num_devices // num_stages,
+    num_stages // num_devices) * variance`` — the reference's "key idea 1"
+    (small-group pruning).
+    """
+    all_shapes = list(shapes) if shapes is not None else power_of_two_shapes(num_devices)
+    min_group = max(num_devices // num_stages, num_stages // num_devices) * variance
+    eligible = [s for s in all_shapes if s >= min_group]
+
+    out: list[tuple[int, ...]] = []
+    for comp in nondecreasing_compositions(num_stages, num_devices, eligible):
+        out.extend(arrangements_of_composition(comp, max_permute_len))
+    return out

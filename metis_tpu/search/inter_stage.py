@@ -1,0 +1,53 @@
+"""Inter-stage (pipeline-level) plan enumeration for heterogeneous clusters.
+
+The reference's outer hot loop (``search_space/plan.py:100-175``): device-type
+placement permutations × stage counts × device-group arrangements ×
+microbatch counts.  Rewritten as a plain generator — the reference's odometer
+object with mutating ``__next__`` state is an implementation detail, not a
+behavior; the enumerated *set* is oracle-tested for parity.
+"""
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Iterator, Sequence
+
+from metis_tpu.core.types import InterStagePlan, divisors
+from metis_tpu.search.device_groups import enumerate_device_groups
+
+
+def inter_stage_plans(
+    device_types: Sequence[str],
+    num_devices: int,
+    gbs: int,
+    num_layers: int,
+    variance: float = 1.0,
+    max_permute_len: int = 6,
+    max_stages: int | None = None,
+) -> Iterator[InterStagePlan]:
+    """Yield every inter-stage candidate.
+
+    Stage count is capped at ``min(num_devices, num_layers)`` (a stage needs
+    at least one layer and one device, ``plan.py:139,165``); microbatch counts
+    sweep the divisors of gbs descending (``plan.py:120-124``).
+    """
+    cap = min(num_devices, num_layers)
+    if max_stages is not None:
+        cap = min(cap, max_stages)
+    batch_options = list(divisors(gbs, descending=True))
+    # Group arrangements don't depend on the node sequence — compute once per
+    # stage count, not once per device-type permutation.
+    groups_by_stage = {
+        n: enumerate_device_groups(n, num_devices, variance, max_permute_len)
+        for n in range(1, cap + 1)
+    }
+
+    for node_sequence in permutations(sorted(set(device_types))):
+        for num_stage in range(1, cap + 1):
+            for groups in groups_by_stage[num_stage]:
+                for batches in batch_options:
+                    yield InterStagePlan(
+                        node_sequence=tuple(node_sequence),
+                        device_groups=groups,
+                        batches=batches,
+                        gbs=gbs,
+                    )

@@ -1,0 +1,128 @@
+"""Intra-stage strategy search: per-stage (dp, tp) under memory pressure.
+
+The reference's most intricate control flow (``search_space/plan.py:178-268``,
+SURVEY.md §3.3): start every stage fully data-parallel, and when the layer
+balancer reports memory pressure, convert the most-pressured stage's dp to tp
+(halve dp, double tp) and retry.  Search and feasibility-repair interleave —
+escalation order keys on the per-stage memory headroom from the previous
+(possibly failed) partition attempt.
+
+Policy parity notes (each mirrors a reference behavior):
+- a strategy set is valid iff every stage's microbatch is >= 1, within the
+  profiled batch range, and tp within the profiled tp range (``plan.py:238-249``);
+- after a partition that succeeded on the first attempt (num_repartition == 1)
+  the search stops — good enough, no need to trade dp for tp (``plan.py:193-194``);
+- a successful-but-repaired partition (num_repartition > 1) keeps escalating
+  in search of a strategy that doesn't need repair (``plan.py:192-226``);
+- with no memory feedback yet, stages escalate largest-dp-first
+  (default pressure 1/dp, ``plan.py:255``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Protocol, Sequence
+
+from metis_tpu.core.types import InterStagePlan, IntraStagePlan, Strategy
+
+
+@dataclass(frozen=True)
+class PartitionResult:
+    """Outcome of one layer-partition attempt."""
+
+    partition: tuple[int, ...] | None  # None => infeasible
+    attempts: int                      # 1 = feasible without repair
+    memory_state: tuple[float, ...] | None  # per-stage capacity - demand (MB)
+
+
+class StageEvaluator(Protocol):
+    """Per-stage memory capacity and normalized compute performance
+    (implemented by metis_tpu.balance.StagePerformanceModel)."""
+
+    def memory_capacity(self, plan: InterStagePlan) -> list[float]: ...
+
+    def compute_performance(
+        self, plan: InterStagePlan, strategies: Sequence[Strategy]
+    ) -> list[float]: ...
+
+
+class LayerPartitioner(Protocol):
+    """Layer->stage partitioning with memory repair
+    (implemented by metis_tpu.balance.LayerBalancer)."""
+
+    def partition(
+        self,
+        plan: InterStagePlan,
+        strategies: Sequence[Strategy],
+        compute_performance: Sequence[float],
+        memory_capacity: Sequence[float],
+    ) -> PartitionResult: ...
+
+
+def initial_strategies(plan: InterStagePlan) -> tuple[Strategy, ...]:
+    """Every stage starts fully data-parallel (``plan.py:231-236``)."""
+    return tuple(Strategy(dp=g, tp=1) for g in plan.device_groups)
+
+
+def strategies_valid(
+    plan: InterStagePlan,
+    strategies: Sequence[Strategy],
+    max_tp: int,
+    max_bs: int,
+) -> bool:
+    for s in strategies:
+        mbs = plan.gbs // s.dp // plan.batches
+        if mbs == 0 or mbs > max_bs:
+            return False
+        if s.tp > max_tp:
+            return False
+    return True
+
+
+def escalate_dp_to_tp(
+    strategies: Sequence[Strategy],
+    memory_state: Sequence[float] | None,
+) -> tuple[Strategy, ...] | None:
+    """Halve dp / double tp on the most memory-pressured stage that still has
+    dp to give.  Returns None when no stage can escalate (search exhausted)."""
+    # Truthiness (not `is not None`): an empty memory_state means "no per-stage
+    # feedback", same as None — matches the reference guard (plan.py:252-255).
+    pressure = (
+        list(memory_state) if memory_state else [1.0 / s.dp for s in strategies]
+    )
+    order = sorted(range(len(strategies)), key=lambda i: pressure[i])
+    out = list(strategies)
+    for stage_id in order:
+        s = out[stage_id]
+        if s.dp != 1:
+            out[stage_id] = Strategy(dp=s.dp // 2, tp=s.tp * 2, sp=s.sp, cp=s.cp, ep=s.ep)
+            return tuple(out)
+    return None
+
+
+def intra_stage_plans(
+    plan: InterStagePlan,
+    evaluator: StageEvaluator,
+    partitioner: LayerPartitioner,
+    max_tp: int,
+    max_bs: int,
+) -> Iterator[IntraStagePlan]:
+    """Yield feasible intra-stage plans for one inter-stage candidate."""
+    strategies: tuple[Strategy, ...] | None = initial_strategies(plan)
+    memory_state: tuple[float, ...] | None = None
+
+    while strategies is not None:
+        if strategies_valid(plan, strategies, max_tp, max_bs):
+            capacity = evaluator.memory_capacity(plan)
+            performance = evaluator.compute_performance(plan, strategies)
+            result = partitioner.partition(plan, strategies, performance, capacity)
+            memory_state = result.memory_state
+            if result.partition is not None:
+                yield IntraStagePlan(
+                    strategies=strategies,
+                    layer_partition=result.partition,
+                    memory_state=result.memory_state or (),
+                    num_repartition=result.attempts,
+                )
+                if result.attempts == 1:
+                    return
+        strategies = escalate_dp_to_tp(strategies, memory_state)

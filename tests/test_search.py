@@ -1,0 +1,180 @@
+import math
+
+import pytest
+
+from metis_tpu.core.types import InterStagePlan, Strategy
+from metis_tpu.search import (
+    arrangements_of_composition,
+    count_multiset_permutations,
+    enumerate_device_groups,
+    escalate_dp_to_tp,
+    initial_strategies,
+    inter_stage_plans,
+    intra_stage_plans,
+    merge_for_permute_cap,
+    multiset_permutations,
+    nondecreasing_compositions,
+    power_of_two_shapes,
+    strategies_valid,
+    uniform_plans,
+    PartitionResult,
+)
+
+
+class TestMultiperm:
+    def test_distinct_and_complete(self):
+        perms = list(multiset_permutations((1, 1, 2)))
+        assert len(perms) == len(set(perms)) == 3
+        assert set(perms) == {(1, 1, 2), (1, 2, 1), (2, 1, 1)}
+
+    def test_count_matches_enumeration(self):
+        items = (1, 1, 2, 2, 4)
+        assert count_multiset_permutations(items) == len(list(multiset_permutations(items)))
+        assert count_multiset_permutations(items) == math.factorial(5) // 4
+
+
+class TestDeviceGroups:
+    def test_shapes(self):
+        assert power_of_two_shapes(16) == [1, 2, 4, 8, 16]
+        assert power_of_two_shapes(6) == [1, 2, 4]
+
+    def test_compositions_sum_and_order(self):
+        comps = list(nondecreasing_compositions(3, 16, [1, 2, 4, 8, 16]))
+        for c in comps:
+            assert sum(c) == 16
+            assert list(c) == sorted(c)
+        assert (4, 4, 8) in comps
+        assert (2, 2, 4) not in comps  # wrong sum
+
+    def test_merge_cap_reduces_count(self):
+        groups = merge_for_permute_cap([1] * 16, 6)
+        assert len(groups) <= 6
+        assert sum(sum(g) for g in groups) == 16
+
+    def test_arrangements_flatten(self):
+        arrs = set(arrangements_of_composition((4, 4, 8), 6))
+        assert (8, 4, 4) in arrs and (4, 8, 4) in arrs and (4, 4, 8) in arrs
+        assert all(sum(a) == 16 for a in arrs)
+
+    def test_variance_filters_small_groups(self):
+        loose = enumerate_device_groups(4, 16, variance=0.0)
+        tight = enumerate_device_groups(4, 16, variance=1.0)
+        assert len(tight) < len(loose)
+        # with variance=1 and 4 stages of 16 devices, min group = 16//4 = 4
+        assert all(min(g) >= 4 for g in tight)
+
+    def test_every_group_sums_to_cluster(self):
+        for stages in (1, 2, 3, 4):
+            for g in enumerate_device_groups(stages, 16, variance=0.5):
+                assert sum(g) == 16 and len(g) == stages
+
+
+class TestUniformPlans:
+    def test_valid_grids(self):
+        plans = list(uniform_plans(num_devices=8, max_tp=4, gbs=32))
+        assert plans
+        for p in plans:
+            assert p.dp * p.pp * p.tp == 8
+            assert p.tp <= 4
+            assert p.gbs % (p.dp * p.mbs) == 0
+            assert p.num_microbatches >= 1
+
+    def test_no_duplicates(self):
+        plans = list(uniform_plans(num_devices=8, max_tp=4, gbs=32))
+        assert len(plans) == len(set(plans))
+
+
+class TestInterStagePlans:
+    def test_structure(self):
+        plans = list(inter_stage_plans(
+            ["A100", "T4"], num_devices=16, gbs=128, num_layers=10,
+            variance=1.0, max_permute_len=6))
+        assert plans
+        for p in plans:
+            assert sum(p.device_groups) == 16
+            assert p.gbs % p.batches == 0
+            assert 1 <= p.num_stages <= 10
+            assert p.node_sequence in {("A100", "T4"), ("T4", "A100")}
+
+    def test_stage_cap_respects_layers(self):
+        plans = list(inter_stage_plans(["A100"], 16, 16, num_layers=3,
+                                       variance=0.5))
+        assert max(p.num_stages for p in plans) == 3
+
+
+class _FakeEvaluator:
+    def __init__(self, capacity):
+        self._cap = capacity
+
+    def memory_capacity(self, plan):
+        return list(self._cap)
+
+    def compute_performance(self, plan, strategies):
+        n = len(plan.device_groups)
+        return [1.0 / n] * n
+
+
+class _FakePartitioner:
+    """Feasible only when every stage runs tp >= min_tp (simulates memory
+    pressure that dp->tp escalation relieves)."""
+
+    def __init__(self, min_tp=1, attempts=1):
+        self.min_tp = min_tp
+        self.attempts = attempts
+        self.calls = 0
+
+    def partition(self, plan, strategies, perf, cap):
+        self.calls += 1
+        n = len(strategies)
+        if all(s.tp >= self.min_tp for s in strategies):
+            bounds = tuple(round(i * 10 / n) for i in range(n + 1))
+            return PartitionResult(bounds, self.attempts, tuple(1.0 for _ in strategies))
+        return PartitionResult(None, -1, tuple(-1.0 if s.tp < self.min_tp else 1.0
+                                               for s in strategies))
+
+
+class TestIntraStagePlans:
+    def _plan(self, groups=(8, 8), batches=8, gbs=128):
+        return InterStagePlan(("T4", "A100"), tuple(groups), batches, gbs)
+
+    def test_initial_strategies_full_dp(self):
+        s = initial_strategies(self._plan())
+        assert s == (Strategy(8, 1), Strategy(8, 1))
+
+    def test_validity_bounds(self):
+        p = self._plan(batches=8)
+        assert strategies_valid(p, (Strategy(2, 1), Strategy(2, 1)), max_tp=4, max_bs=16)
+        # mbs = 128/8/8 = 2 ok; tp above profiled cap invalid
+        assert not strategies_valid(p, (Strategy(1, 8), Strategy(8, 1)), max_tp=4, max_bs=16)
+        # dp too big => mbs 0
+        assert not strategies_valid(
+            InterStagePlan(("T4",), (256,), 1, 128), (Strategy(256, 1),), 4, 16)
+
+    def test_escalation_order_prefers_pressured_stage(self):
+        s = (Strategy(4, 1), Strategy(4, 1))
+        out = escalate_dp_to_tp(s, memory_state=(5.0, -3.0))
+        assert out == (Strategy(4, 1), Strategy(2, 2))  # stage 1 most pressured
+
+    def test_escalation_exhausts(self):
+        assert escalate_dp_to_tp((Strategy(1, 4),), None) is None
+
+    def test_first_attempt_success_stops_search(self):
+        ev = _FakeEvaluator([1e9, 1e9])
+        part = _FakePartitioner(min_tp=1, attempts=1)
+        plans = list(intra_stage_plans(self._plan(), ev, part, max_tp=4, max_bs=16))
+        assert len(plans) == 1
+        assert plans[0].strategies == (Strategy(8, 1), Strategy(8, 1))
+        assert part.calls == 1
+
+    def test_escalates_until_feasible(self):
+        ev = _FakeEvaluator([1e9, 1e9])
+        part = _FakePartitioner(min_tp=2, attempts=1)
+        plans = list(intra_stage_plans(self._plan(), ev, part, max_tp=4, max_bs=16))
+        assert len(plans) == 1
+        assert all(s.tp >= 2 for s in plans[0].strategies)
+
+    def test_repaired_partition_keeps_searching(self):
+        ev = _FakeEvaluator([1e9, 1e9])
+        part = _FakePartitioner(min_tp=1, attempts=2)  # always needs repair
+        plans = list(intra_stage_plans(self._plan(), ev, part, max_tp=4, max_bs=16))
+        assert len(plans) > 1  # kept yielding while escalating
