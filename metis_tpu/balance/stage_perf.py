@@ -1,0 +1,87 @@
+"""Per-stage performance and memory-capacity evaluation.
+
+≅ reference ``StagePerformance`` (``model/device_group.py:13-101``): maps an
+inter-stage plan's node sequence to a rank->device-type placement, then scores
+each stage's normalized compute throughput (1/exec-time, with hetero groups
+split by the data balancer) and aggregate memory capacity.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.core.types import InterStagePlan, Strategy
+from metis_tpu.profiles.store import ProfileStore
+from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
+
+
+def rank_device_types(cluster: ClusterSpec, node_sequence: Sequence[str]) -> list[str]:
+    """Device type of each rank under a node-sequence placement: all devices
+    of ``node_sequence[0]`` take the lowest ranks, and so on
+    (≅ ``device_group.py:22-32``)."""
+    out: list[str] = []
+    for device_type in node_sequence:
+        out.extend([device_type] * cluster.num_devices_by_type(device_type))
+    return out
+
+
+def node_device_types(cluster: ClusterSpec, node_sequence: Sequence[str]) -> list[str]:
+    """Device type of each *node* under the same placement
+    (≅ ``cluster_bandwidth.py:158-167``)."""
+    out: list[str] = []
+    for device_type in node_sequence:
+        n_nodes = sum(1 for n in cluster.nodes if n.device_type == device_type)
+        out.extend([device_type] * n_nodes)
+    return out
+
+
+class StagePerformanceModel:
+    """Implements the search layer's StageEvaluator protocol."""
+
+    def __init__(self, cluster: ClusterSpec, profiles: ProfileStore):
+        self.cluster = cluster
+        self.profiles = profiles
+        self.data_balancer = DataBalancer(profiles)
+
+    def stage_types(self, plan: InterStagePlan, stage_id: int) -> list[str]:
+        ranks = rank_device_types(self.cluster, plan.node_sequence)
+        start, end = plan.stage_rank_range(stage_id)
+        return ranks[start:end]
+
+    def memory_capacity(self, plan: InterStagePlan) -> list[float]:
+        """Aggregate HBM per stage, MB (≅ ``device_group.py:87-101``)."""
+        ranks = rank_device_types(self.cluster, plan.node_sequence)
+        out = []
+        for stage_id in range(plan.num_stages):
+            start, end = plan.stage_rank_range(stage_id)
+            out.append(sum(self.cluster.memory_mb(t) for t in ranks[start:end]))
+        return out
+
+    def compute_performance(
+        self, plan: InterStagePlan, strategies: Sequence[Strategy]
+    ) -> list[float]:
+        """Normalized per-stage throughput (sums to 1;
+        ≅ ``device_group.py:54-85``)."""
+        ranks = rank_device_types(self.cluster, plan.node_sequence)
+        raw: list[float] = []
+        for stage_id, strat in enumerate(strategies):
+            start, end = plan.stage_rank_range(stage_id)
+            types = ranks[start:end]
+            bs = plan.gbs // plan.batches // strat.dp
+            if len(set(types)) == 1:
+                t = self.profiles.get(types[0], strat.tp, bs).total_time_ms
+                raw.append(1.0 / t)
+            else:
+                split = self.data_balancer.partition(
+                    types, strat.dp, strat.tp, plan.gbs // plan.batches)
+                chunks = replica_chunks(types, strat.dp)
+                times = []
+                for replica_id, h_bs in enumerate(split):
+                    rep_type = chunks[replica_id][0]
+                    times.append(sum(
+                        self.profiles.get(rep_type, strat.tp, c).total_time_ms
+                        for c in power_of_two_chunks(h_bs)))
+                worst = max(times) if times else 0.0
+                raw.append(1.0 / worst if worst else 0.0)
+        total = sum(raw)
+        return [r / total for r in raw] if total else raw

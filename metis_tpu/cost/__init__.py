@@ -1,0 +1,37 @@
+from metis_tpu.cost.volume import TransformerVolume
+from metis_tpu.cost.bandwidth import (
+    StageBandwidthModel,
+    HeteroScalarBandwidth,
+    HomoScalarBandwidth,
+)
+from metis_tpu.cost.ici import (
+    IciDcnBandwidth,
+    ring_all_reduce_ms,
+    all_gather_ms,
+    reduce_scatter_ms,
+    all_to_all_ms,
+    p2p_ms,
+)
+from metis_tpu.cost.estimator import (
+    EstimatorOptions,
+    UniformCostEstimator,
+    HeteroCostEstimator,
+    uniform_layer_split,
+)
+
+__all__ = [
+    "TransformerVolume",
+    "StageBandwidthModel",
+    "HeteroScalarBandwidth",
+    "HomoScalarBandwidth",
+    "IciDcnBandwidth",
+    "ring_all_reduce_ms",
+    "all_gather_ms",
+    "reduce_scatter_ms",
+    "all_to_all_ms",
+    "p2p_ms",
+    "EstimatorOptions",
+    "UniformCostEstimator",
+    "HeteroCostEstimator",
+    "uniform_layer_split",
+]

@@ -1,0 +1,272 @@
+"""Step-time cost estimators for uniform and heterogeneous plans.
+
+≅ reference ``model/cost_estimator.py`` (C12 in SURVEY.md §2.1), with every
+formula preserved under ``strict_compat`` and differential-tested against the
+upstream implementation:
+
+- GPipe fill-drain: ``(num_microbatches - 1) * max_stage + sum(stages)``
+- ring all-reduce DP gradient cost ``2(d-1)/(d*B) * stage_params``
+- point-to-point PP cost ``activation / B``
+- fb_sync looked up at the stage microbatch, maxed over member device types
+- optimizer cost scaled by profiled time / tp (and layer share for hetero),
+  **max** over stages; DP cost likewise max over stages (hetero)
+
+Unit quirks reproduced only under strict_compat (SURVEY.md §2.3):
+bandwidth GB/s -> bytes/ms via 1024*1024 (≈2.4% off), activation volumes in
+element counts.  Native mode uses bytes and decimal GB/s, real inter-node
+bandwidth, and per-device-type optimizer/batch-generator timings.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.errors import ProfileMissError
+from metis_tpu.core.types import InterStagePlan, PlanCost, Strategy, UniformPlan
+from metis_tpu.profiles.store import ProfileStore
+from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
+from metis_tpu.balance.stage_perf import rank_device_types
+from metis_tpu.cost.bandwidth import (
+    HeteroScalarBandwidth,
+    HomoScalarBandwidth,
+    StageBandwidthModel,
+)
+from metis_tpu.cost.volume import TransformerVolume
+
+
+@dataclass(frozen=True)
+class EstimatorOptions:
+    strict_compat: bool = False
+    optimizer_factor: float = 2.0   # ref data_loader.py:19
+    max_profiled_bs: int = 16       # ref cost_estimator.py:166 cap
+    dp_over_pp_rows: bool = True    # homo: whole pp-row treated as one dp group
+
+    @staticmethod
+    def from_config(cfg: SearchConfig) -> "EstimatorOptions":
+        return EstimatorOptions(
+            strict_compat=cfg.strict_compat,
+            optimizer_factor=cfg.optimizer_factor,
+            max_profiled_bs=cfg.max_profiled_bs,
+        )
+
+    def bw_to_bytes_per_ms(self, bw_gbps: float) -> float:
+        # Reference converts GB/s with 1024*1024 (cost_estimator.py:40,46);
+        # natively GB/s = 1e6 bytes/ms.
+        return bw_gbps * (1024 * 1024 if self.strict_compat else 1e6)
+
+
+def uniform_layer_split(total_layers: int, num_stages: int) -> list[int]:
+    """Even layer counts per stage; first/last get +1 for embed/head
+    (≅ ``model/utils.py:5-31``)."""
+    base = (total_layers - 2) // num_stages
+    rem = (total_layers - 2) % num_stages
+    counts = [base] * num_stages
+    for i in range(1, rem + 1):
+        counts[i % num_stages] += 1
+    counts[0] += 1
+    counts[-1] += 1
+    return counts
+
+
+class _EstimatorBase:
+    def __init__(
+        self,
+        cluster: ClusterSpec,
+        profiles: ProfileStore,
+        volume: TransformerVolume,
+        options: EstimatorOptions,
+    ):
+        self.cluster = cluster
+        self.profiles = profiles
+        self.volume = volume
+        self.options = options
+
+    def _dp_cost_ms(self, param_bytes: float, bw_gbps: float, dp: int) -> float:
+        if dp <= 1:
+            return 0.0
+        return 2 * (dp - 1) / (dp * self.options.bw_to_bytes_per_ms(bw_gbps)) * param_bytes
+
+    def _pp_cost_ms(self, activation: float, bw_gbps: float) -> float:
+        return activation / self.options.bw_to_bytes_per_ms(bw_gbps)
+
+    def _activation(self, boundary: int, mbs: int, tp: int) -> float:
+        return self.volume.boundary_activation(
+            boundary, mbs, tp, elements=self.options.strict_compat)
+
+    def _fb_sync_ms(self, device_types: Sequence[str], tp: int, bs: int) -> float:
+        return max(
+            self.profiles.get(t, tp, bs).fb_sync_ms for t in set(device_types))
+
+    def _optimizer_ms(self, device_type: str | None = None) -> float:
+        if self.options.strict_compat or device_type is None:
+            raw = self.profiles.model.optimizer_time_ms
+        else:
+            raw = self.profiles.type_meta[device_type].optimizer_time_ms
+        return raw * self.options.optimizer_factor
+
+    def _batch_gen_ms(self, count: int, device_type: str | None = None) -> float:
+        """Input-pipeline cost; native mode reads the feeding stage's device
+        type (the host attached to stage 0's chips generates batches)."""
+        if self.options.strict_compat or device_type is None:
+            per = self.profiles.model.batch_generator_ms
+        else:
+            per = self.profiles.type_meta[device_type].batch_generator_ms
+        return per * count
+
+
+class UniformCostEstimator(_EstimatorBase):
+    """Cost of a uniform Megatron-grid plan on a (nominally) homogeneous
+    cluster (≅ ``HomoCostEstimator.get_cost``, ``cost_estimator.py:98-138``)."""
+
+    def __init__(self, cluster, profiles, volume, options):
+        super().__init__(cluster, profiles, volume, options)
+        self.bandwidth = HomoScalarBandwidth(cluster, options.strict_compat)
+
+    def get_cost(self, plan: UniformPlan, device_type: str) -> PlanCost:
+        L = self.volume.num_layers
+        counts = uniform_layer_split(L, plan.pp)
+        prof = self.profiles.get(device_type, plan.tp, plan.mbs)
+        params = self.volume.parameter_bytes_per_layer(plan.tp)
+        num_mbs = plan.gbs // plan.mbs // plan.dp
+
+        lens: list[float] = []
+        stage_params: list[float] = []
+        stage_memory: list[float] = []
+        fb_sync = pp_cost = 0.0
+        for s in range(plan.pp):
+            start = sum(counts[:s])
+            end = start + counts[s]
+            lens.append(prof.time_slice(start, end))
+            stage_params.append(sum(params[start:end]))
+            stage_memory.append(prof.memory_slice(start, end))
+            if s == plan.pp - 1:
+                fb_sync = self._fb_sync_ms([device_type], plan.tp, plan.mbs) * num_mbs
+            else:
+                bw = self.bandwidth.pp_bandwidth(plan.pp, plan.tp, s)
+                pp_cost += self._pp_cost_ms(
+                    self._activation(end, plan.mbs, plan.tp), bw)
+
+        # Per-device capacity of the profiled type (the reference reads node
+        # 0's memory regardless of the device type being costed,
+        # cost_estimator.py:31-32 — that's only right when they coincide).
+        cap_type = (
+            self.cluster.nodes[0].device_type if self.options.strict_compat
+            else device_type)
+        oom = self.cluster.memory_mb(cap_type) < max(stage_memory)
+        execution = (num_mbs - 1) * max(lens) + sum(lens)
+        optimizer = self._optimizer_ms(device_type) / plan.pp / plan.tp
+        dp_cost = self._dp_cost_ms(
+            max(stage_params), self.bandwidth.dp_bandwidth(plan.pp, plan.tp), plan.dp)
+        batch_gen = self._batch_gen_ms(num_mbs, device_type)
+
+        return PlanCost(
+            total_ms=execution + fb_sync + optimizer + dp_cost + pp_cost + batch_gen,
+            execution_ms=execution,
+            fb_sync_ms=fb_sync,
+            optimizer_ms=optimizer,
+            dp_comm_ms=dp_cost,
+            pp_comm_ms=pp_cost,
+            batch_gen_ms=batch_gen,
+            oom=oom,
+        )
+
+
+BandwidthFactory = Callable[[InterStagePlan], StageBandwidthModel]
+
+
+class HeteroCostEstimator(_EstimatorBase):
+    """Cost of a heterogeneous inter+intra stage plan
+    (≅ ``HeteroCostEstimator.get_cost``, ``cost_estimator.py:199-244``)."""
+
+    def __init__(self, cluster, profiles, volume, options,
+                 bandwidth_factory: BandwidthFactory | None = None):
+        super().__init__(cluster, profiles, volume, options)
+        self.data_balancer = DataBalancer(profiles)
+        self.bandwidth_factory = bandwidth_factory or (
+            lambda plan: HeteroScalarBandwidth(cluster, plan, options.strict_compat))
+
+    def _stage_execution_ms(
+        self,
+        plan: InterStagePlan,
+        strategy: Strategy,
+        stage_types: Sequence[str],
+        start: int,
+        end: int,
+    ) -> float:
+        dp, tp = strategy.dp, strategy.tp
+        if len(set(stage_types)) == 1:
+            bs = plan.gbs // dp // plan.batches
+            return self.profiles.get(stage_types[0], tp, bs).time_slice(start, end)
+        split = self.data_balancer.partition(
+            stage_types, dp, tp, plan.gbs // plan.batches)
+        chunks = replica_chunks(stage_types, dp)
+        costs = []
+        for replica_id, h_bs in enumerate(split):
+            if h_bs == 0:
+                continue
+            rep_type = chunks[replica_id][0]
+            total = 0.0
+            for c in power_of_two_chunks(h_bs):
+                if c > self.options.max_profiled_bs:
+                    raise ProfileMissError(rep_type, tp, c)
+                total += self.profiles.get(rep_type, tp, c).time_slice(start, end)
+            costs.append(total)
+        return max(costs)
+
+    def get_cost(
+        self,
+        plan: InterStagePlan,
+        strategies: Sequence[Strategy],
+        layer_partition: Sequence[int],
+        rank_types: Sequence[str] | None = None,
+    ) -> PlanCost:
+        ranks = (
+            list(rank_types) if rank_types is not None
+            else rank_device_types(self.cluster, plan.node_sequence)
+        )
+        bandwidth = self.bandwidth_factory(plan)
+        L = self.volume.num_layers
+
+        lens: list[float] = []
+        dp_costs: list[float] = []
+        opt_costs: list[float] = []
+        fb_sync = pp_cost = 0.0
+        for stage_id, strat in enumerate(strategies):
+            start_l, end_l = layer_partition[stage_id], layer_partition[stage_id + 1]
+            r0, r1 = plan.stage_rank_range(stage_id)
+            stage_types = ranks[r0:r1]
+
+            lens.append(self._stage_execution_ms(plan, strat, stage_types, start_l, end_l))
+
+            mbs = plan.gbs // strat.dp // plan.batches
+            if stage_id == plan.num_stages - 1:
+                fb_sync = self._fb_sync_ms(stage_types, strat.tp, mbs) * plan.batches
+            else:
+                pp_cost += self._pp_cost_ms(
+                    self._activation(end_l, mbs, strat.tp),
+                    bandwidth.pp_bandwidth(stage_id))
+
+            stage_params = self.volume.stage_parameter_bytes(strat.tp, start_l, end_l)
+            dp_costs.append(self._dp_cost_ms(
+                stage_params, bandwidth.dp_bandwidth(stage_id, strat), strat.dp))
+
+            opt_type = None if self.options.strict_compat else stage_types[0]
+            opt_costs.append(
+                self._optimizer_ms(opt_type) / strat.tp * (end_l - start_l) / L)
+
+        execution = (plan.batches - 1) * max(lens) + sum(lens)
+        first_stage_type = ranks[0] if ranks else None
+        batch_gen = self._batch_gen_ms(plan.batches, first_stage_type)
+
+        return PlanCost(
+            total_ms=(execution + fb_sync + max(opt_costs) + max(dp_costs)
+                      + pp_cost + batch_gen),
+            execution_ms=execution,
+            fb_sync_ms=fb_sync,
+            optimizer_ms=max(opt_costs),
+            dp_comm_ms=max(dp_costs),
+            pp_comm_ms=pp_cost,
+            batch_gen_ms=batch_gen,
+        )

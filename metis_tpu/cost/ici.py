@@ -1,0 +1,110 @@
+"""ICI/DCN-aware collective cost model — the TPU-native bandwidth layer.
+
+Replaces the reference's two-scalars-per-node convention and slowest-link
+scans (SURVEY.md §2.3 "TPU-native equivalent") with per-collective analytic
+costs over the slice torus: ring all-reduce/all-gather/reduce-scatter along
+mesh axes at ICI ring bandwidth, hop-aware point-to-point for pipeline
+neighbors, DCN for anything crossing a slice boundary.
+
+Bandwidths convert as GB/s -> 1e6 bytes/ms (decimal, the physical unit; the
+reference's 1024*1024 factor is a compat-mode quirk confined to the
+estimator).
+"""
+from __future__ import annotations
+
+from metis_tpu.cluster.tpu import TpuClusterSpec, TpuSliceSpec
+from metis_tpu.core.types import InterStagePlan, Strategy
+
+
+def _bytes_per_ms(bw_gbps: float) -> float:
+    return bw_gbps * 1e6
+
+
+def ring_all_reduce_ms(nbytes: float, group_size: int, bw_gbps: float) -> float:
+    """Bandwidth-optimal ring all-reduce: 2(n-1)/n of the payload crosses the
+    slowest link (reduce-scatter + all-gather)."""
+    if group_size <= 1:
+        return 0.0
+    return 2 * (group_size - 1) / group_size * nbytes / _bytes_per_ms(bw_gbps)
+
+
+def all_gather_ms(nbytes: float, group_size: int, bw_gbps: float) -> float:
+    """Ring all-gather of a full ``nbytes`` result: (n-1)/n crosses each link."""
+    if group_size <= 1:
+        return 0.0
+    return (group_size - 1) / group_size * nbytes / _bytes_per_ms(bw_gbps)
+
+
+reduce_scatter_ms = all_gather_ms  # same wire volume, opposite direction
+
+
+def all_to_all_ms(nbytes: float, group_size: int, bw_gbps: float) -> float:
+    """All-to-all moves (n-1)/n of the payload, but a torus routes it across
+    the bisection; per-chip cost approximated by payload/(n·bw) per peer."""
+    if group_size <= 1:
+        return 0.0
+    return (group_size - 1) / group_size * nbytes / _bytes_per_ms(bw_gbps)
+
+
+def p2p_ms(nbytes: float, bw_gbps: float, hops: int = 1) -> float:
+    """Point-to-point send: store-and-forward hops pipeline, so extra hops add
+    latency, not bandwidth division — modeled as pure bandwidth for large
+    transfers."""
+    del hops  # large activations are bandwidth-bound; hop latency negligible
+    return nbytes / _bytes_per_ms(bw_gbps)
+
+
+class IciDcnBandwidth:
+    """StageBandwidthModel over a TPU slice collection.
+
+    Ranks follow the plan's node-sequence placement (all chips of
+    ``node_sequence[0]``'s generation take the lowest ranks, and so on —
+    the same convention as ``balance.rank_device_types``), so permuted
+    placements cost against the correct hardware.
+    """
+
+    def __init__(self, tpu_cluster: TpuClusterSpec, plan: InterStagePlan):
+        self.tpu_cluster = tpu_cluster
+        self.plan = plan
+        # rank -> slice index, in node-sequence order (stable within a
+        # generation: slices keep their declaration order).
+        self._rank_slice: list[int] = []
+        for generation in plan.node_sequence:
+            for idx, s in enumerate(tpu_cluster.slices):
+                if s.generation == generation:
+                    self._rank_slice.extend([idx] * s.num_chips)
+
+    def _slice_of(self, rank: int) -> int:
+        return self._rank_slice[rank]
+
+    def _slice_ring_bw(self, slice_idx: int) -> float:
+        s: TpuSliceSpec = self.tpu_cluster.slices[slice_idx]
+        return min(s.axis_ring_bw_gbps(a) for a in range(len(s.topology)))
+
+    def _group_bandwidth(self, ranks: list[int]) -> float:
+        slices = {self._slice_of(r) for r in ranks}
+        if len(slices) == 1:
+            return self._slice_ring_bw(next(iter(slices)))
+        # Crossing slices: DCN, shared by the chips of the slowest side.
+        return min(
+            self.tpu_cluster.slices[i].gen.dcn_bw_gbps for i in slices)
+
+    def pp_bandwidth(self, stage_id: int) -> float:
+        """Boundary p2p: ICI if both stages live in one slice, else DCN."""
+        start, _ = self.plan.stage_rank_range(stage_id)
+        groups = self.plan.device_groups
+        end = start + groups[stage_id] + (
+            groups[stage_id + 1] if stage_id + 1 < len(groups) else 0)
+        slices = {self._slice_of(r) for r in range(start, end)}
+        if len(slices) == 1:
+            s = self.tpu_cluster.slices[next(iter(slices))]
+            return s.gen.ici_bw_gbps
+        return min(self.tpu_cluster.slices[i].gen.dcn_bw_gbps for i in slices)
+
+    def dp_bandwidth(self, stage_id: int, strategy: Strategy) -> float:
+        start, end = self.plan.stage_rank_range(stage_id)
+        ranks = list(range(start, end))
+        slowest = float("inf")
+        for d in range(strategy.dp):
+            slowest = min(slowest, self._group_bandwidth(ranks[d::strategy.dp]))
+        return slowest

@@ -1,0 +1,161 @@
+import pytest
+
+from metis_tpu.balance import (
+    DataBalancer,
+    LayerBalancer,
+    StagePerformanceModel,
+    minmax_partition,
+    power_of_two_chunks,
+    proportional_split,
+    rank_device_types,
+    replica_chunks,
+)
+from metis_tpu.cluster import ClusterSpec, DeviceSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.types import InterStagePlan, Strategy
+from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+
+@pytest.fixture(scope="module")
+def profiles():
+    return synthesize_profiles(
+        tiny_test_model(), ["A100", "T4"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return ClusterSpec.of(
+        ("T4", 2, 4), ("A100", 2, 4),
+        overrides={
+            "T4": DeviceSpec("T4", 15, 50, 10),
+            "A100": DeviceSpec("A100", 80, 46, 10),
+        })
+
+
+class TestDataBalancer:
+    def test_power_of_two_chunks(self):
+        assert power_of_two_chunks(11) == [8, 2, 1]
+        assert power_of_two_chunks(16) == [16]
+        assert power_of_two_chunks(0) == []
+
+    def test_proportional_split_conserves_total(self):
+        out = proportional_split([3.0, 1.0], 13)
+        assert sum(out) == 13
+        assert out[0] > out[1]
+
+    def test_largest_remainder_tie_break_is_stable(self):
+        # equal weights, odd total: earlier replicas win the remainder
+        assert proportional_split([1.0, 1.0, 1.0], 4) == [2, 1, 1]
+
+    def test_fast_replica_gets_more(self, profiles):
+        b = DataBalancer(profiles)
+        split = b.partition(["A100"] * 2 + ["T4"] * 2, dp=2, tp=2, batch=16)
+        assert sum(split) == 16
+        assert split[0] > split[1]  # A100 replica outruns T4 replica
+
+    def test_replica_chunks(self):
+        assert replica_chunks(["a", "a", "b", "b"], 2) == [["a", "a"], ["b", "b"]]
+
+
+class TestMinmaxPartition:
+    def test_balanced_even(self):
+        bounds = minmax_partition([1.0] * 10, [1.0, 1.0])
+        assert bounds == (0, 5, 10)
+
+    def test_performance_weighting(self):
+        bounds = minmax_partition([1.0] * 9, [2.0, 1.0])
+        assert bounds is not None
+        first = bounds[1] - bounds[0]
+        assert first == 6  # 6/2 == 3/1 — perfectly balanced
+
+    def test_nonempty_stages(self):
+        bounds = minmax_partition([1.0] * 3, [1.0] * 3)
+        assert bounds == (0, 1, 2, 3)
+        assert minmax_partition([1.0] * 2, [1.0] * 3) is None
+
+    def test_feasibility_veto(self):
+        # stage 0 can hold at most 2 layers
+        bounds = minmax_partition(
+            [1.0] * 10, [1.0, 1.0], feasible=lambda s, i, j: s != 0 or (j - i) <= 2)
+        assert bounds is not None
+        assert bounds[1] <= 2
+
+    def test_optimality_vs_bruteforce(self):
+        import itertools as it
+        weights = [3.0, 1.0, 4.0, 1.0, 5.0, 9.0, 2.0]
+        perf = [1.0, 2.0, 1.5]
+        best = minmax_partition(weights, perf)
+        assert best is not None
+
+        def objective(bounds):
+            return max(
+                sum(weights[bounds[s]:bounds[s + 1]]) / perf[s]
+                for s in range(3))
+
+        brute = min(
+            (objective((0, a, b, 7)), (0, a, b, 7))
+            for a in range(1, 6) for b in range(a + 1, 7))
+        assert objective(best) == pytest.approx(brute[0])
+
+
+class TestStagePerformance:
+    def test_rank_placement_order(self, cluster):
+        ranks = rank_device_types(cluster, ("A100", "T4"))
+        assert ranks[:8] == ["A100"] * 8 and ranks[8:] == ["T4"] * 8
+
+    def test_memory_capacity(self, cluster, profiles):
+        sp = StagePerformanceModel(cluster, profiles)
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        cap = sp.memory_capacity(plan)
+        assert cap == [8 * 15 * 1024, 8 * 80 * 1024]
+
+    def test_compute_performance_normalized_and_ordered(self, cluster, profiles):
+        sp = StagePerformanceModel(cluster, profiles)
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        perf = sp.compute_performance(plan, (Strategy(4, 2), Strategy(4, 2)))
+        assert sum(perf) == pytest.approx(1.0)
+        assert perf[1] > perf[0]  # A100 stage outperforms T4 stage
+
+    def test_hetero_stage_uses_balanced_split(self, cluster, profiles):
+        sp = StagePerformanceModel(cluster, profiles)
+        plan = InterStagePlan(("A100", "T4"), (16,), 8, 128)
+        perf = sp.compute_performance(plan, (Strategy(4, 4),))
+        assert perf == [1.0]
+
+
+class TestLayerBalancer:
+    def _balancer(self, cluster, profiles, **kw):
+        cfg = SearchConfig(gbs=128, **kw)
+        return LayerBalancer(cluster, profiles, cfg)
+
+    def test_feasible_first_attempt(self, cluster, profiles):
+        lb = self._balancer(cluster, profiles)
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        res = lb.partition(plan, (Strategy(4, 2), Strategy(4, 2)),
+                           [0.4, 0.6], [1e9, 1e9])
+        assert res.partition is not None
+        assert res.attempts == 1
+        assert res.partition[0] == 0 and res.partition[-1] == 10
+        assert list(res.partition) == sorted(res.partition)
+
+    def test_memory_pressure_triggers_constrained_pass(self, cluster, profiles):
+        lb = self._balancer(cluster, profiles)
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        strategies = (Strategy(4, 2), Strategy(4, 2))
+        free = lb.partition(plan, strategies, [0.5, 0.5], [1e9, 1e9])
+        assert free.attempts == 1
+        # squeeze stage 0 below its unconstrained demand
+        demand0 = 1e9 - free.memory_state[0]
+        res = lb.partition(plan, strategies, [0.5, 0.5], [demand0 * 0.8, 1e9])
+        if res.partition is not None:
+            assert res.attempts == 2
+            # stage 0 must fit its squeezed capacity
+            assert res.memory_state[0] >= 0
+
+    def test_infeasible_returns_none(self, cluster, profiles):
+        lb = self._balancer(cluster, profiles)
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        res = lb.partition(plan, (Strategy(4, 2), Strategy(4, 2)),
+                           [0.5, 0.5], [1.0, 1.0])
+        assert res.partition is None
+        assert res.attempts == -1

@@ -1,0 +1,174 @@
+import pytest
+
+from metis_tpu.cluster import ClusterSpec, DeviceSpec, TpuClusterSpec, slice_from_name
+from metis_tpu.core.types import InterStagePlan, Strategy, UniformPlan
+from metis_tpu.cost import (
+    EstimatorOptions,
+    HeteroCostEstimator,
+    HeteroScalarBandwidth,
+    HomoScalarBandwidth,
+    IciDcnBandwidth,
+    TransformerVolume,
+    UniformCostEstimator,
+    all_gather_ms,
+    p2p_ms,
+    ring_all_reduce_ms,
+    uniform_layer_split,
+)
+from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_test_model()
+
+
+@pytest.fixture(scope="module")
+def profiles(model):
+    return synthesize_profiles(model, ["A100", "T4"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16])
+
+
+@pytest.fixture(scope="module")
+def volume(model, profiles):
+    return TransformerVolume(model, profiles.model.params_per_layer_bytes)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return ClusterSpec.of(
+        ("T4", 2, 4), ("A100", 2, 4),
+        overrides={
+            "T4": DeviceSpec("T4", 15, 50, 10),
+            "A100": DeviceSpec("A100", 80, 46, 10),
+        })
+
+
+class TestVolume:
+    def test_boundary_activation_native_is_bytes(self, volume, model):
+        native = volume.boundary_activation(4, 2, 2)
+        assert native == 2 * model.sequence_length * model.hidden_size * 2
+
+    def test_boundary_activation_compat_quirk(self, volume, model):
+        # reference sizes the boundary before the LAST layer at vocab/tp elements
+        compat = volume.boundary_activation(model.num_layers - 1, 2, 4, elements=True)
+        assert compat == 2 * model.sequence_length * model.vocab_size / 4
+        plain = volume.boundary_activation(4, 2, 4, elements=True)
+        assert plain == 2 * model.sequence_length * model.hidden_size
+
+    def test_stage_parameter_accounting(self, volume, profiles):
+        p = profiles.model.params_per_layer_bytes
+        full = volume.stage_parameter_bytes(1, 0, volume.num_layers)
+        assert full == pytest.approx(sum(p))
+        mid = volume.stage_parameter_bytes(2, 3, 6)
+        assert mid == pytest.approx(3 * p[1] / 2)
+
+
+class TestCollectiveMath:
+    def test_all_reduce_scaling(self):
+        one_gb = 1e9
+        t = ring_all_reduce_ms(one_gb, 8, 100)
+        # 2*(7/8) GB over 100 GB/s = 17.5 ms
+        assert t == pytest.approx(17.5)
+        assert ring_all_reduce_ms(one_gb, 1, 100) == 0.0
+
+    def test_all_gather_is_half_all_reduce(self):
+        assert all_gather_ms(1e9, 8, 100) == pytest.approx(
+            ring_all_reduce_ms(1e9, 8, 100) / 2)
+
+    def test_p2p(self):
+        assert p2p_ms(1e9, 100) == pytest.approx(10.0)
+
+
+class TestUniformSplit:
+    def test_reference_example(self):
+        # model/utils.py docstring: 10 layers, 4 stages -> [3, 2, 2, 3]
+        assert uniform_layer_split(10, 4) == [3, 2, 2, 3]
+
+    def test_single_stage(self):
+        assert uniform_layer_split(10, 1) == [10]
+
+    def test_conservation(self):
+        for stages in range(1, 8):
+            assert sum(uniform_layer_split(10, stages)) == 10
+
+
+class TestScalarBandwidth:
+    def test_hetero_pp_spans_types(self, cluster):
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        bw = HeteroScalarBandwidth(cluster, plan, strict_compat=True)
+        # stage0∪stage1 spans T4+A100 nodes; compat inter = min intra = 46
+        assert bw.pp_bandwidth(0) == 46
+
+    def test_hetero_dp_same_type_nodes(self, cluster):
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        bw = HeteroScalarBandwidth(cluster, plan, strict_compat=True)
+        # stage 0 = 8 T4 ranks over 2 nodes; dp groups span both T4 nodes
+        assert bw.dp_bandwidth(0, Strategy(4, 2)) == 50
+        assert bw.dp_bandwidth(1, Strategy(4, 2)) == 46
+
+    def test_native_mode_uses_real_inter(self, cluster):
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        bw = HeteroScalarBandwidth(cluster, plan, strict_compat=False)
+        assert bw.pp_bandwidth(0) == 10
+
+    def test_homo_within_node(self, cluster):
+        bw = HomoScalarBandwidth(cluster, strict_compat=True)
+        # pp=4, tp=2, dp=2: each model row spans nodes -> inter(=intra compat)
+        assert bw.pp_bandwidth(4, 2, 0) in (46, 50)
+
+
+class TestIciBandwidth:
+    def test_within_and_across_slices(self):
+        tc = TpuClusterSpec((slice_from_name("v4-32"), slice_from_name("v5e-16")))
+        plan = InterStagePlan(("tpu_v4", "tpu_v5e"), (32, 16), 8, 128)
+        bw = IciDcnBandwidth(tc, plan)
+        assert bw.pp_bandwidth(0) == 25  # boundary crosses slices: DCN
+        assert bw.dp_bandwidth(0, Strategy(8, 4)) == 45  # inside v4 slice: ICI
+        assert bw.dp_bandwidth(1, Strategy(4, 4)) == 90  # v5e 4x4 wrapped ring
+
+
+class TestEstimators:
+    def _options(self, compat=True):
+        return EstimatorOptions(strict_compat=compat)
+
+    def test_uniform_cost_structure(self, cluster, profiles, volume):
+        est = UniformCostEstimator(cluster, profiles, volume, self._options())
+        cost = est.get_cost(UniformPlan(dp=4, pp=1, tp=2, mbs=8, gbs=128), "A100")
+        assert cost.total_ms > 0
+        assert cost.total_ms == pytest.approx(
+            cost.execution_ms + cost.fb_sync_ms + cost.optimizer_ms
+            + cost.dp_comm_ms + cost.pp_comm_ms + cost.batch_gen_ms)
+        assert cost.pp_comm_ms == 0.0  # pp=1: no boundary
+
+    def test_uniform_pp_adds_comm(self, cluster, profiles, volume):
+        est = UniformCostEstimator(cluster, profiles, volume, self._options())
+        c2 = est.get_cost(UniformPlan(dp=2, pp=2, tp=2, mbs=8, gbs=128), "A100")
+        assert c2.pp_comm_ms > 0
+
+    def test_hetero_cost_known_plan(self, cluster, profiles, volume):
+        est = HeteroCostEstimator(cluster, profiles, volume, self._options())
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        cost = est.get_cost(plan, (Strategy(4, 2), Strategy(4, 2)), (0, 4, 10))
+        assert cost.total_ms > 0
+        assert cost.dp_comm_ms > 0 and cost.pp_comm_ms > 0
+
+    def test_more_tp_less_dp_comm(self, cluster, profiles, volume):
+        est = HeteroCostEstimator(cluster, profiles, volume, self._options())
+        plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        c_dp4 = est.get_cost(plan, (Strategy(4, 2), Strategy(4, 2)), (0, 4, 10))
+        c_dp2 = est.get_cost(plan, (Strategy(2, 4), Strategy(2, 4)), (0, 4, 10))
+        assert c_dp2.dp_comm_ms < c_dp4.dp_comm_ms
+
+    def test_ici_factory_pluggable(self, profiles, volume):
+        tc = TpuClusterSpec((slice_from_name("v4-32"), slice_from_name("v5e-16")))
+        cluster = tc.as_cluster_spec()
+        tpu_profiles = synthesize_profiles(
+            tiny_test_model(), ["tpu_v4", "tpu_v5e"], tps=[1, 2, 4],
+            bss=[1, 2, 4, 8, 16])
+        vol = TransformerVolume(tiny_test_model(), tpu_profiles.model.params_per_layer_bytes)
+        est = HeteroCostEstimator(
+            cluster, tpu_profiles, vol, EstimatorOptions(strict_compat=False),
+            bandwidth_factory=lambda plan: IciDcnBandwidth(tc, plan))
+        plan = InterStagePlan(("tpu_v4", "tpu_v5e"), (32, 16), 8, 128)
+        cost = est.get_cost(plan, (Strategy(8, 4), Strategy(4, 4)), (0, 6, 10))
+        assert cost.total_ms > 0
