@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Benchmark: heterogeneous planner search time on the parity workload
+(16 devices, 2 types, GPT-10L, gbs=128 — the same scale as the reference's
+shipped golden run, results/hetero_cost_model:48: 1,124 costed plans; our
+search covers a strict superset; workload defined once in
+metis_tpu.testing.write_parity_fixture, shared with the parity test suite).
+
+Prints ONE JSON line:
+  {"metric": "planner_search_time_s", "value": <ours>, "unit": "s",
+   "vs_baseline": <reference_time / ours>}
+
+vs_baseline > 1 means our planner searches the same workload faster than the
+reference planner.  The reference is timed live when the read-only checkout is
+available (baseline_source "live"); otherwise a recorded constant is used
+(baseline_source "recorded" — measured in-process on the dev machine for the
+commit that introduced it, ~3.3s).
+"""
+from __future__ import annotations
+
+import json
+import tempfile
+import time
+from pathlib import Path
+
+from metis_tpu.testing import (
+    DEFAULT_REFERENCE_ROOT,
+    PARITY_GBS,
+    run_reference_planner,
+    write_parity_fixture,
+)
+
+RECORDED_REFERENCE_S = 3.3
+
+
+def time_ours(tmp: Path) -> tuple[float, int]:
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+
+    cluster = ClusterSpec.from_files(tmp / "hostfile", tmp / "clusterfile.json")
+    store = ProfileStore.from_dir(tmp / "profiles")
+    t0 = time.perf_counter()
+    result = plan_hetero(
+        cluster, store, tiny_test_model(),
+        SearchConfig(gbs=PARITY_GBS, strict_compat=True))
+    return time.perf_counter() - t0, result.num_costed
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_parity_fixture(tmp)
+        ours_s, _num = time_ours(tmp)
+        ref_s = None
+        if DEFAULT_REFERENCE_ROOT.exists():
+            try:
+                ref_s = run_reference_planner(tmp)["elapsed_s"]
+            except Exception:
+                ref_s = None
+    baseline = ref_s if ref_s is not None else RECORDED_REFERENCE_S
+    print(json.dumps({
+        "metric": "planner_search_time_s",
+        "value": round(ours_s, 4),
+        "unit": "s",
+        "vs_baseline": round(baseline / ours_s, 3),
+        "baseline_source": "live" if ref_s is not None else "recorded",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+from metis_tpu.planner.api import (
+    PlannerResult,
+    RankedUniformPlan,
+    UniformPlannerResult,
+    plan_hetero,
+    plan_tpu,
+    plan_uniform,
+)
+
+__all__ = [
+    "PlannerResult",
+    "RankedUniformPlan",
+    "UniformPlannerResult",
+    "plan_hetero",
+    "plan_tpu",
+    "plan_uniform",
+]

@@ -1,0 +1,194 @@
+"""Planner entry points — the library API over search + balance + cost.
+
+≅ reference orchestration layer (``cost_het_cluster.py:20-49``,
+``cost_homo_cluster.py:21-37``) with structured results instead of stdout
+rankings, and a TPU-native entry (``plan_tpu``) that swaps in the ICI/DCN
+bandwidth model.
+
+Fault contract preserved from the reference: any profile miss while costing a
+candidate prunes that candidate (KeyError family, ``cost_het_cluster.py:46-47``)
+— but unlike the reference, misses inside stage-performance evaluation prune
+instead of crashing the whole search.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.cluster.tpu import TpuClusterSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.types import RankedPlan, UniformPlan, PlanCost
+from metis_tpu.profiles.store import ProfileStore
+from metis_tpu.balance.layers import LayerBalancer
+from metis_tpu.balance.stage_perf import StagePerformanceModel
+from metis_tpu.cost.estimator import (
+    EstimatorOptions,
+    HeteroCostEstimator,
+    UniformCostEstimator,
+)
+from metis_tpu.cost.ici import IciDcnBandwidth
+from metis_tpu.cost.volume import TransformerVolume
+from metis_tpu.search.inter_stage import inter_stage_plans
+from metis_tpu.search.intra_stage import intra_stage_plans
+from metis_tpu.search.uniform import uniform_plans
+
+
+@dataclass(frozen=True)
+class PlannerResult:
+    """Ranked plans plus search accounting (the north-star search-time metric
+    lives here, BASELINE.md)."""
+
+    plans: tuple[RankedPlan, ...]  # sorted by total cost, best first
+    num_costed: int
+    num_pruned: int
+    search_seconds: float
+
+    @property
+    def best(self) -> RankedPlan | None:
+        return self.plans[0] if self.plans else None
+
+
+@dataclass(frozen=True)
+class RankedUniformPlan:
+    plan: UniformPlan
+    cost: PlanCost
+    device_type: str
+
+
+@dataclass(frozen=True)
+class UniformPlannerResult:
+    plans: tuple[RankedUniformPlan, ...]
+    num_costed: int          # successfully costed (whether or not OOM-excluded)
+    num_pruned: int          # profile misses — could not be costed at all
+    num_oom_excluded: int    # costed but dropped for predicted OOM
+    search_seconds: float
+
+    @property
+    def best(self) -> RankedUniformPlan | None:
+        return self.plans[0] if self.plans else None
+
+
+def plan_hetero(
+    cluster: ClusterSpec,
+    profiles: ProfileStore,
+    model: ModelSpec,
+    config: SearchConfig,
+    bandwidth_factory=None,
+    top_k: int | None = None,
+) -> PlannerResult:
+    """Full heterogeneous search: inter-stage × intra-stage candidates,
+    costed and ranked (≅ ``cost_het_cluster``)."""
+    t0 = time.perf_counter()
+    volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
+    options = EstimatorOptions.from_config(config)
+    estimator = HeteroCostEstimator(
+        cluster, profiles, volume, options, bandwidth_factory)
+    evaluator = StagePerformanceModel(cluster, profiles)
+    balancer = LayerBalancer(cluster, profiles, config)
+
+    results: list[RankedPlan] = []
+    pruned = 0
+    for inter in inter_stage_plans(
+        cluster.device_types,
+        cluster.total_devices,
+        config.gbs,
+        model.num_layers,
+        variance=config.min_group_scale_variance,
+        max_permute_len=config.max_permute_len,
+    ):
+        try:
+            for intra in intra_stage_plans(
+                inter, evaluator, balancer,
+                max_tp=config.max_profiled_tp, max_bs=config.max_profiled_bs,
+            ):
+                try:
+                    cost = estimator.get_cost(
+                        inter, intra.strategies, intra.layer_partition)
+                except KeyError:
+                    pruned += 1
+                    continue
+                results.append(RankedPlan(inter=inter, intra=intra, cost=cost))
+        except KeyError:
+            # profile miss inside stage evaluation: prune the candidate family
+            pruned += 1
+
+    results.sort(key=lambda r: r.cost.total_ms)
+    num_costed = len(results)
+    if top_k is not None:
+        results = results[:top_k]
+    return PlannerResult(
+        plans=tuple(results),
+        num_costed=num_costed,
+        num_pruned=pruned,
+        search_seconds=time.perf_counter() - t0,
+    )
+
+
+def plan_uniform(
+    cluster: ClusterSpec,
+    profiles: ProfileStore,
+    model: ModelSpec,
+    config: SearchConfig,
+    device_type: str | None = None,
+    include_oom: bool = False,
+    top_k: int | None = None,
+) -> UniformPlannerResult:
+    """Homogeneous Megatron-grid sweep at the configured gbs
+    (≅ ``cost_homo_cluster``)."""
+    t0 = time.perf_counter()
+    dtype = device_type or cluster.device_types[0]
+    volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
+    estimator = UniformCostEstimator(
+        cluster, profiles, volume, EstimatorOptions.from_config(config))
+
+    ranked: list[RankedUniformPlan] = []
+    pruned = 0
+    oom_excluded = 0
+    num_costed = 0
+    for plan in uniform_plans(
+        num_devices=cluster.total_devices,
+        max_tp=config.max_profiled_tp,
+        gbs=config.gbs,
+    ):
+        if plan.mbs > config.max_profiled_bs:
+            continue
+        try:
+            cost = estimator.get_cost(plan, dtype)
+        except KeyError:
+            pruned += 1
+            continue
+        num_costed += 1
+        if cost.oom and not include_oom:
+            oom_excluded += 1
+            continue
+        ranked.append(RankedUniformPlan(plan=plan, cost=cost, device_type=dtype))
+
+    ranked.sort(key=lambda r: r.cost.total_ms)
+    if top_k is not None:
+        ranked = ranked[:top_k]
+    return UniformPlannerResult(
+        plans=tuple(ranked),
+        num_costed=num_costed,
+        num_pruned=pruned,
+        num_oom_excluded=oom_excluded,
+        search_seconds=time.perf_counter() - t0,
+    )
+
+
+def plan_tpu(
+    tpu_cluster: TpuClusterSpec,
+    profiles: ProfileStore,
+    model: ModelSpec,
+    config: SearchConfig,
+    chips_per_node: int = 4,
+    top_k: int | None = None,
+) -> PlannerResult:
+    """Heterogeneous search over TPU slices with the ICI/DCN-aware bandwidth
+    model (the BASELINE.md north-star path: e.g. v4-32 + v5e-16 over DCN)."""
+    cluster = tpu_cluster.as_cluster_spec(chips_per_node)
+    return plan_hetero(
+        cluster, profiles, model, config,
+        bandwidth_factory=lambda plan: IciDcnBandwidth(tpu_cluster, plan),
+        top_k=top_k,
+    )

@@ -1,0 +1,160 @@
+"""Command-line planner — the driver layer.
+
+Replaces the reference's bash env-var scripts + flat argparse
+(``scripts/cost_het_cluster.sh``, ``arguments.py``) with one typed CLI and
+machine-readable JSON output (SURVEY.md §5 "Metrics / logging").
+
+Examples:
+
+  metis-tpu hetero --hostfile hosts --clusterfile cluster.json \\
+      --profile-dir profiles/ --gbs 128 --num-layers 10 --hidden-size 4096 \\
+      --seq-len 1024 --vocab-size 51200 --num-heads 32 --top-k 10
+
+  metis-tpu tpu --slices v4-32,v5e-16 --profile-dir profiles/ --gbs 128 ...
+
+  metis-tpu uniform --hostfile hosts --clusterfile cluster.json ...
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.cluster.tpu import TpuClusterSpec, slice_from_name
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.types import dump_ranked_plans
+from metis_tpu.profiles.store import ProfileStore
+from metis_tpu.planner.api import plan_hetero, plan_tpu, plan_uniform
+
+
+def _add_model_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("model")
+    g.add_argument("--model-name", default="gpt")
+    g.add_argument("--num-layers", type=int, required=True,
+                   help="profiled layers incl. embed + head pseudo-layers")
+    g.add_argument("--hidden-size", type=int, required=True)
+    g.add_argument("--seq-len", type=int, required=True)
+    g.add_argument("--vocab-size", type=int, required=True)
+    g.add_argument("--num-heads", type=int, required=True)
+
+
+def _add_search_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("search")
+    g.add_argument("--gbs", type=int, required=True)
+    g.add_argument("--max-tp", type=int, default=4)
+    g.add_argument("--max-bs", type=int, default=16)
+    g.add_argument("--variance", type=float, default=1.0)
+    g.add_argument("--max-permute-len", type=int, default=6)
+    g.add_argument("--strict-compat", action="store_true",
+                   help="reproduce reference cost-model quirks bit-for-bit")
+    g.add_argument("--top-k", type=int, default=20)
+    g.add_argument("--output", default="-", help="output path ('-' = stdout)")
+
+
+def _add_cluster_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("cluster")
+    g.add_argument("--hostfile", required=True)
+    g.add_argument("--clusterfile", required=True)
+
+
+def _model_from_args(args: argparse.Namespace) -> ModelSpec:
+    return ModelSpec(
+        name=args.model_name,
+        num_layers=args.num_layers,
+        hidden_size=args.hidden_size,
+        sequence_length=args.seq_len,
+        vocab_size=args.vocab_size,
+        num_heads=args.num_heads,
+    )
+
+
+def _config_from_args(args: argparse.Namespace) -> SearchConfig:
+    return SearchConfig(
+        gbs=args.gbs,
+        max_profiled_tp=args.max_tp,
+        max_profiled_bs=args.max_bs,
+        min_group_scale_variance=args.variance,
+        max_permute_len=args.max_permute_len,
+        strict_compat=args.strict_compat,
+    )
+
+
+def _emit(args: argparse.Namespace, payload: str) -> None:
+    if args.output == "-":
+        print(payload)
+    else:
+        with open(args.output, "w") as f:
+            f.write(payload)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="metis-tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_het = sub.add_parser("hetero", help="heterogeneous-cluster plan search")
+    _add_cluster_args(p_het)
+    p_het.add_argument("--profile-dir", required=True)
+    _add_model_args(p_het)
+    _add_search_args(p_het)
+
+    p_tpu = sub.add_parser("tpu", help="TPU multi-slice plan search (ICI/DCN model)")
+    p_tpu.add_argument("--slices", required=True,
+                       help="comma-separated slice names, e.g. v4-32,v5e-16")
+    p_tpu.add_argument("--chips-per-node", type=int, default=4)
+    p_tpu.add_argument("--profile-dir", required=True)
+    _add_model_args(p_tpu)
+    _add_search_args(p_tpu)
+
+    p_uni = sub.add_parser("uniform", help="uniform Megatron-grid sweep")
+    _add_cluster_args(p_uni)
+    p_uni.add_argument("--profile-dir", required=True)
+    p_uni.add_argument("--device-type", default=None)
+    p_uni.add_argument("--include-oom", action="store_true")
+    _add_model_args(p_uni)
+    _add_search_args(p_uni)
+
+    args = parser.parse_args(argv)
+    profiles = ProfileStore.from_dir(args.profile_dir)
+    model = _model_from_args(args)
+    config = _config_from_args(args)
+
+    if args.command == "hetero":
+        cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+        result = plan_hetero(cluster, profiles, model, config, top_k=args.top_k)
+        _emit(args, dump_ranked_plans(result.plans))
+    elif args.command == "tpu":
+        tpu_cluster = TpuClusterSpec(tuple(
+            slice_from_name(s.strip()) for s in args.slices.split(",")))
+        result = plan_tpu(tpu_cluster, profiles, model, config,
+                          chips_per_node=args.chips_per_node, top_k=args.top_k)
+        _emit(args, dump_ranked_plans(result.plans))
+    else:
+        cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+        result = plan_uniform(cluster, profiles, model, config,
+                              device_type=args.device_type,
+                              include_oom=args.include_oom, top_k=args.top_k)
+        payload = json.dumps([
+            {
+                "rank": i + 1,
+                "cost_ms": r.cost.total_ms,
+                "cost_breakdown": dataclasses.asdict(r.cost),
+                "plan": dataclasses.asdict(r.plan),
+                "device_type": r.device_type,
+            }
+            for i, r in enumerate(result.plans)
+        ], indent=2)
+        _emit(args, payload)
+
+    print(
+        f"costed {result.num_costed} plans ({result.num_pruned} pruned) "
+        f"in {result.search_seconds:.2f}s",
+        file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""Shared differential-testing infrastructure.
+
+One definition of the parity workload — the golden-run-shaped topology
+(8xA100 + 8xT4, 4 per node, GPT-10L, gbs=128; ``results/hetero_cost_model``
+inputs) with synthetic two-type profiles — plus an in-process runner for the
+upstream reference planner.  Used by both the pytest parity suite
+(tests/conftest.py) and bench.py so the benchmark's "identical
+fixtures/topology" claim cannot drift from the tests.
+
+The reference checkout is imported read-only at call time, never vendored.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import sys
+import time
+from pathlib import Path
+
+PARITY_GBS = 128
+PARITY_MAX_TP = 4
+PARITY_MAX_BS = 16
+DEFAULT_REFERENCE_ROOT = Path("/root/reference")
+
+
+def write_parity_fixture(target_dir: Path) -> None:
+    """Materialize the parity workload: reference-schema profile JSONs plus
+    hostfile/clusterfile for 2 T4 nodes + 2 A100 nodes, 4 devices each."""
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+    profiles = synthesize_profiles(
+        tiny_test_model(), ["A100", "T4"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16])
+    profiles.dump_to_dir(target_dir / "profiles")
+    (target_dir / "hostfile").write_text(
+        "0.0.0.3 slots=4\n0.0.0.5 slots=4\n0.0.0.4 slots=4\n0.0.0.6 slots=4\n")
+    (target_dir / "clusterfile.json").write_text(json.dumps({
+        ip: {"instance_type": t, "inter_bandwidth": 10,
+             "intra_bandwidth": bw, "memory": mem}
+        for ip, t, bw, mem in [
+            ("0.0.0.3", "T4", 50, 15), ("0.0.0.5", "T4", 50, 15),
+            ("0.0.0.4", "A100", 46, 80), ("0.0.0.6", "A100", 46, 80)]}))
+
+
+def run_reference_planner(
+    fixture_dir: Path,
+    reference_root: Path = DEFAULT_REFERENCE_ROOT,
+    compute_direct: bool = False,
+) -> dict:
+    """Run the upstream hetero planner in-process on the parity fixture.
+
+    Returns a dict with ``costs`` (the reference's recorded candidate tuples),
+    ``elapsed_s`` (wall time of the search loop alone), and — when
+    ``compute_direct`` — ``direct_costs``: each candidate re-evaluated with a
+    *consistent* plan object, sidestepping the upstream num_stage recording
+    corruption (``_find_next_node_sequence`` discards the stage count,
+    ``plan.py:144-148``), plus handles to the reference objects for further
+    differential checks.
+    """
+    import argparse
+
+    sys.path.insert(0, str(reference_root))
+    argv_backup = sys.argv
+    # the reference re-parses argv deep inside the cost loop
+    # (cost_estimator.py:154) — feed it the knobs it expects
+    sys.argv = ["prog", "--max_profiled_batch_size", str(PARITY_MAX_BS),
+                "--max_profiled_tp_degree", str(PARITY_MAX_TP)]
+    try:
+        import cost_het_cluster as ref_main
+        from data_loader import ProfileDataLoader
+        from gpu_cluster import GPUCluster
+        from model.cost_estimator import HeteroCostEstimator as RefHetero
+        from model.activation_parameter import GPTActivationAndParam
+        from model.load_balancer import LayerLoadBalancer
+        from model.device_group import StagePerformance
+        from search_space.plan import InterStagePlan as RefISP
+        from utils import ModelConfig as RefModelConfig
+
+        from metis_tpu.profiles import tiny_test_model
+
+        gpu_cluster = GPUCluster(
+            hostfile_path=str(fixture_dir / "hostfile"),
+            clusterfile_path=str(fixture_dir / "clusterfile.json"))
+        profile_data, _ = ProfileDataLoader(
+            str(fixture_dir / "profiles")).load_profile_data_all()
+        m = tiny_test_model()
+        model_config = RefModelConfig(
+            model_name=m.name, num_layers=m.num_layers,
+            sequence_length=m.sequence_length, vocab_size=m.vocab_size,
+            hidden_size=m.hidden_size, attention_head_size=m.num_heads)
+        model_volume = GPTActivationAndParam(
+            model_config, profile_data["model"]["parameters"])
+        estimator = RefHetero(profile_data, model_config, model_volume, gpu_cluster)
+        balancer = LayerLoadBalancer(gpu_cluster, profile_data, model_config, PARITY_GBS)
+        args = argparse.Namespace(
+            gbs=PARITY_GBS, num_layers=m.num_layers,
+            max_profiled_tp_degree=PARITY_MAX_TP,
+            max_profiled_batch_size=PARITY_MAX_BS,
+            min_group_scale_variance=1, max_permute_len=6)
+        t0 = time.perf_counter()
+        with contextlib.redirect_stdout(io.StringIO()):
+            costs = ref_main.cost_het_cluster(
+                args, gpu_cluster, profile_data, model_config, estimator, balancer)
+        elapsed = time.perf_counter() - t0
+
+        out = {"costs": costs, "elapsed_s": elapsed}
+        if compute_direct:
+            direct_costs = []
+            for (node_seq, device_groups, strategies, batches, partition,
+                 _nrep, _recorded) in costs:
+                ref_plan = RefISP(
+                    ns_idx=0, node_sequence=list(node_seq), dg_idx=0,
+                    device_groups=list(device_groups),
+                    num_stage=len(device_groups), batches=batches, gbs=PARITY_GBS)
+                sp = StagePerformance(
+                    model_config, profile_data, gpu_cluster, ref_plan)
+                with contextlib.redirect_stdout(io.StringIO()):
+                    direct_costs.append(estimator.get_cost(
+                        ref_plan, [tuple(s) for s in strategies],
+                        list(partition), sp.get_device_placement()))
+            out.update(
+                direct_costs=direct_costs,
+                profile_data=profile_data,
+                model_volume=model_volume,
+                model_config=model_config,
+                gpu_cluster=gpu_cluster,
+                estimator=estimator,
+            )
+        return out
+    finally:
+        sys.argv = argv_backup
+        sys.path.remove(str(reference_root))

@@ -31,6 +31,28 @@ def reference_root() -> pathlib.Path:
 
 
 @pytest.fixture(scope="session")
+def parity_fixture_dir(tmp_path_factory):
+    """The shared parity workload (metis_tpu.testing.write_parity_fixture)."""
+    from metis_tpu.testing import write_parity_fixture
+
+    d = tmp_path_factory.mktemp("parity")
+    write_parity_fixture(d)
+    return d
+
+
+@pytest.fixture(scope="session")
+def reference_run(reference_root, parity_fixture_dir):
+    """The upstream planner run in-process on the parity workload, with
+    per-candidate direct re-evaluation (see
+    metis_tpu.testing.run_reference_planner for the upstream-corruption
+    rationale)."""
+    from metis_tpu.testing import run_reference_planner
+
+    return run_reference_planner(
+        parity_fixture_dir, reference_root, compute_direct=True)
+
+
+@pytest.fixture(scope="session")
 def reference_profiles(reference_root):
     """The reference's measured A100 profile fixtures, loaded through OUR
     loader (schema-compat check by construction)."""

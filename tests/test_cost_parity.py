@@ -3,12 +3,12 @@
 The reference's shipped golden logs (``results/hetero_cost_model``) were
 produced with T4 profiles that were never released (only A100 fixtures ship),
 so exact golden-number reproduction is impossible from shipped data.  Instead
-we run the reference planner **in-process** on our synthetic two-type profile
-set and assert that our strict-compat estimator reproduces every cost the
-reference computes, plan by plan.  This is strictly stronger than a static
-golden file: it covers the full plan set, with our fixtures, on every run.
+the ``reference_run`` conftest fixture runs the reference planner
+**in-process** on our synthetic two-type profile set; here we assert that our
+strict-compat estimator reproduces every cost the reference computes, plan by
+plan.  This is strictly stronger than a static golden file: it covers the
+full plan set, with our fixtures, on every run.
 """
-import argparse
 import contextlib
 import io
 import sys
@@ -16,7 +16,6 @@ import sys
 import pytest
 
 from metis_tpu.cluster import ClusterSpec
-from metis_tpu.core.config import SearchConfig
 from metis_tpu.core.types import InterStagePlan, Strategy
 from metis_tpu.cost import (
     EstimatorOptions,
@@ -24,98 +23,19 @@ from metis_tpu.cost import (
     TransformerVolume,
     UniformCostEstimator,
 )
-from metis_tpu.core.types import UniformPlan
-from metis_tpu.profiles import ProfileStore, synthesize_profiles, tiny_test_model
-
-GBS = 128
-MAX_TP = 4
-MAX_BS = 16
-
-
-@pytest.fixture(scope="module")
-def fixture_dir(tmp_path_factory):
-    """Synthetic A100+T4 profiles dumped in reference schema + cluster files
-    mirroring the golden-run topology (8xA100 + 8xT4, 4 per node)."""
-    d = tmp_path_factory.mktemp("parity")
-    profiles = synthesize_profiles(
-        tiny_test_model(), ["A100", "T4"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16])
-    profiles.dump_to_dir(d / "profiles")
-    (d / "hostfile").write_text(
-        "0.0.0.3 slots=4\n0.0.0.3 slots=4\n0.0.0.4 slots=4\n0.0.0.4 slots=4\n"
-        .replace("0.0.0.3 slots=4\n0.0.0.3", "0.0.0.3 slots=4\n0.0.0.5"))
-    # two T4 nodes (distinct ips share a type), two A100 nodes
-    (d / "hostfile").write_text(
-        "0.0.0.3 slots=4\n0.0.0.5 slots=4\n0.0.0.4 slots=4\n0.0.0.6 slots=4\n")
-    (d / "clusterfile.json").write_text("""{
-        "0.0.0.3": {"instance_type": "T4", "inter_bandwidth": 10,
-                    "intra_bandwidth": 50, "memory": 15},
-        "0.0.0.5": {"instance_type": "T4", "inter_bandwidth": 10,
-                    "intra_bandwidth": 50, "memory": 15},
-        "0.0.0.4": {"instance_type": "A100", "inter_bandwidth": 10,
-                    "intra_bandwidth": 46, "memory": 80},
-        "0.0.0.6": {"instance_type": "A100", "inter_bandwidth": 10,
-                    "intra_bandwidth": 46, "memory": 80}
-    }""")
-    return d
+from metis_tpu.profiles import ProfileStore, tiny_test_model
+from metis_tpu.testing import (
+    PARITY_GBS as GBS,
+    PARITY_MAX_BS as MAX_BS,
+    PARITY_MAX_TP as MAX_TP,
+)
 
 
 @pytest.fixture(scope="module")
-def reference_run(reference_root, fixture_dir):
-    """Run the reference hetero planner end-to-end, in-process, capturing
-    every costed (plan, strategies, partition, cost)."""
-    sys.path.insert(0, str(reference_root))
-    argv_backup = sys.argv
-    # the reference re-parses argv deep inside the cost loop
-    # (cost_estimator.py:154) — feed it the knobs it expects
-    sys.argv = ["prog", "--max_profiled_batch_size", str(MAX_BS),
-                "--max_profiled_tp_degree", str(MAX_TP)]
-    try:
-        import cost_het_cluster as ref_main
-        from data_loader import ProfileDataLoader
-        from gpu_cluster import GPUCluster
-        from model.cost_estimator import HeteroCostEstimator as RefHetero
-        from model.activation_parameter import GPTActivationAndParam
-        from model.load_balancer import LayerLoadBalancer
-        from utils import ModelConfig as RefModelConfig
-
-        gpu_cluster = GPUCluster(
-            hostfile_path=str(fixture_dir / "hostfile"),
-            clusterfile_path=str(fixture_dir / "clusterfile.json"))
-        profile_data, _ = ProfileDataLoader(str(fixture_dir / "profiles")).load_profile_data_all()
-        m = tiny_test_model()
-        model_config = RefModelConfig(
-            model_name=m.name, num_layers=m.num_layers,
-            sequence_length=m.sequence_length, vocab_size=m.vocab_size,
-            hidden_size=m.hidden_size, attention_head_size=m.num_heads)
-        model_volume = GPTActivationAndParam(
-            model_config, profile_data["model"]["parameters"])
-        estimator = RefHetero(profile_data, model_config, model_volume, gpu_cluster)
-        balancer = LayerLoadBalancer(gpu_cluster, profile_data, model_config, GBS)
-        args = argparse.Namespace(
-            gbs=GBS, num_layers=m.num_layers,
-            max_profiled_tp_degree=MAX_TP, max_profiled_batch_size=MAX_BS,
-            min_group_scale_variance=1, max_permute_len=6)
-        with contextlib.redirect_stdout(io.StringIO()):
-            costs = ref_main.cost_het_cluster(
-                args, gpu_cluster, profile_data, model_config, estimator, balancer)
-        return {
-            "costs": costs,
-            "profile_data": profile_data,
-            "model_volume": model_volume,
-            "model_config": model_config,
-            "gpu_cluster": gpu_cluster,
-            "estimator": estimator,
-        }
-    finally:
-        sys.argv = argv_backup
-        sys.path.remove(str(reference_root))
-
-
-@pytest.fixture(scope="module")
-def ours(fixture_dir):
+def ours(parity_fixture_dir):
     cluster = ClusterSpec.from_files(
-        fixture_dir / "hostfile", fixture_dir / "clusterfile.json")
-    profiles = ProfileStore.from_dir(fixture_dir / "profiles")
+        parity_fixture_dir / "hostfile", parity_fixture_dir / "clusterfile.json")
+    profiles = ProfileStore.from_dir(parity_fixture_dir / "profiles")
     volume = TransformerVolume(tiny_test_model(), profiles.model.params_per_layer_bytes)
     options = EstimatorOptions(strict_compat=True, max_profiled_bs=MAX_BS)
     return {
@@ -131,99 +51,73 @@ def test_reference_run_is_nontrivial(reference_run):
     assert len(reference_run["costs"]) > 100
 
 
-def test_hetero_estimator_full_parity(reference_run, ours, reference_root):
+def test_upstream_recording_corruption_is_present_but_rare(reference_run):
+    """Pins the documented upstream num_stage corruption (see the
+    reference_run fixture docstring): a few loop-recorded costs differ from
+    direct evaluation of the same candidate."""
+    diffs = sum(
+        1 for rec, direct in zip(reference_run["costs"], reference_run["direct_costs"])
+        if abs(rec[6] - direct) > 1e-6)
+    assert 0 < diffs < len(reference_run["costs"]) * 0.02
+
+
+def test_upstream_balancer_emits_invalid_partitions(reference_run):
+    """Pins a second upstream bug: the greedy balancer's majority-vote
+    collapse (``load_balancer.py:290-308``) can emit partitions with empty
+    stages or dropped layers (boundaries not reaching num_layers), which then
+    get artificially low costs.  Our DP balancer structurally cannot."""
+    from metis_tpu.profiles import tiny_test_model
+
+    L = tiny_test_model().num_layers
+    invalid = [
+        rec[4] for rec in reference_run["costs"]
+        if not (rec[4][0] == 0 and rec[4][-1] == L
+                and all(a < b for a, b in zip(rec[4], rec[4][1:])))
+    ]
+    assert invalid  # present on these fixtures; estimator parity still holds
+
+
+def test_hetero_estimator_full_parity(reference_run, ours):
     """Every candidate the reference's search visited, our strict-compat
-    estimator must cost identically (rel tol 1e-9) to a *direct* reference
-    evaluation of that candidate.
-
-    Direct evaluation, not the loop-recorded cost, because of an upstream
-    state-corruption bug: after a node-sequence advance the reference's
-    generator leaves ``curr.num_stage`` at 1 while ``device_groups`` already
-    holds multi-stage arrangements (``_find_next_node_sequence`` discards the
-    stage count, ``plan.py:144-148``), so the first few recorded costs after
-    each advance were computed over stage 0 only.  Direct evaluation with a
-    consistent plan object is the reference's intended semantics.
-    """
-    sys.path.insert(0, str(reference_root))
-    argv_backup = sys.argv
-    # the reference re-parses argv inside its hetero execution path
-    # (cost_estimator.py:154)
-    sys.argv = ["prog", "--max_profiled_batch_size", str(MAX_BS),
-                "--max_profiled_tp_degree", str(MAX_TP)]
-    try:
-        from search_space.plan import InterStagePlan as RefISP
-        from model.device_group import StagePerformance
-
-        est = ours["hetero"]
-        ref_est = reference_run["estimator"]
-        mc = reference_run["model_config"]
-        gpu_cluster = reference_run["gpu_cluster"]
-        profile_data = reference_run["profile_data"]
-        mismatches = []
-        corrupted = 0
-        for (node_seq, device_groups, strategies, batches, partition,
-             _nrep, recorded_cost) in reference_run["costs"]:
-            ref_plan = RefISP(
-                ns_idx=0, node_sequence=list(node_seq), dg_idx=0,
-                device_groups=list(device_groups),
-                num_stage=len(device_groups), batches=batches, gbs=GBS)
-            sp = StagePerformance(mc, profile_data, gpu_cluster, ref_plan)
-            with contextlib.redirect_stdout(io.StringIO()):
-                ref_cost = ref_est.get_cost(
-                    ref_plan, [tuple(s) for s in strategies], list(partition),
-                    sp.get_device_placement())
-            if abs(ref_cost - recorded_cost) > 1e-6:
-                corrupted += 1
-
-            plan = InterStagePlan(
-                node_sequence=tuple(dt.name for dt in node_seq),
-                device_groups=tuple(device_groups),
-                batches=batches, gbs=GBS)
-            ours_cost = est.get_cost(
-                plan,
-                tuple(Strategy(dp=s[0], tp=s[1]) for s in strategies),
-                tuple(partition))
-            if ours_cost.total_ms != pytest.approx(ref_cost, rel=1e-9):
-                mismatches.append((plan, strategies, partition, ref_cost,
-                                   ours_cost.total_ms))
-        assert not mismatches, (
-            f"{len(mismatches)}/{len(reference_run['costs'])} cost mismatches; "
-            f"first: {mismatches[0]}")
-        # the upstream corruption is real but rare; pin its presence so this
-        # comment stays honest if the fixture changes
-        assert corrupted < len(reference_run["costs"]) * 0.02
-    finally:
-        sys.argv = argv_backup
-        sys.path.remove(str(reference_root))
+    estimator must cost identically (rel tol 1e-9) to the reference's own
+    direct evaluation."""
+    est = ours["hetero"]
+    mismatches = []
+    for (node_seq, device_groups, strategies, batches, partition,
+         _nrep, _recorded), ref_cost in zip(
+            reference_run["costs"], reference_run["direct_costs"]):
+        plan = InterStagePlan(
+            node_sequence=tuple(dt.name for dt in node_seq),
+            device_groups=tuple(device_groups),
+            batches=batches, gbs=GBS)
+        ours_cost = est.get_cost(
+            plan,
+            tuple(Strategy(dp=s[0], tp=s[1]) for s in strategies),
+            tuple(partition))
+        if ours_cost.total_ms != pytest.approx(ref_cost, rel=1e-9):
+            mismatches.append((plan, strategies, partition, ref_cost,
+                               ours_cost.total_ms))
+    assert not mismatches, (
+        f"{len(mismatches)}/{len(reference_run['costs'])} cost mismatches; "
+        f"first: {mismatches[0]}")
 
 
-def test_uniform_estimator_parity(reference_run, ours, reference_root, fixture_dir):
+def test_uniform_estimator_parity(reference_run, ours, reference_root):
     """Differential parity for the uniform (homo) estimator on the same
     fixtures across the whole valid (dp, pp, tp, mbs) grid."""
     sys.path.insert(0, str(reference_root))
     try:
         from model.cost_estimator import HomoCostEstimator as RefHomo
         from search_space.plan import UniformPlan as RefUniformPlan
-        from gpu_cluster import GPUCluster
-        from model.activation_parameter import GPTActivationAndParam
-        from utils import ModelConfig as RefModelConfig
 
-        gpu_cluster = GPUCluster(
-            hostfile_path=str(fixture_dir / "hostfile"),
-            clusterfile_path=str(fixture_dir / "clusterfile.json"))
-        profile_data = reference_run["profile_data"]
-        m = tiny_test_model()
-        model_config = RefModelConfig(
-            model_name=m.name, num_layers=m.num_layers,
-            sequence_length=m.sequence_length, vocab_size=m.vocab_size,
-            hidden_size=m.hidden_size, attention_head_size=m.num_heads)
-        ref_est = RefHomo(profile_data, model_config,
-                          reference_run["model_volume"], gpu_cluster)
+        ref_est = RefHomo(
+            reference_run["profile_data"], reference_run["model_config"],
+            reference_run["model_volume"], reference_run["gpu_cluster"])
 
         from metis_tpu.search import uniform_plans
         checked = 0
         with contextlib.redirect_stdout(io.StringIO()):
-            for plan in uniform_plans(num_devices=16, max_tp=4, gbs=64):
+            for plan in uniform_plans(num_devices=16, max_tp=MAX_TP, gbs=64):
                 if plan.mbs > MAX_BS or not ours["profiles"].has("T4", plan.tp, plan.mbs):
                     continue
                 ref_cost, _mem, ref_oom = ref_est.get_cost(

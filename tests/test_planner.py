@@ -1,0 +1,160 @@
+import json
+
+import pytest
+
+from metis_tpu.cluster import ClusterSpec, DeviceSpec, TpuClusterSpec, slice_from_name
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.planner import plan_hetero, plan_tpu, plan_uniform
+from metis_tpu.planner.cli import main as cli_main
+from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+
+@pytest.fixture(scope="module")
+def small_cluster():
+    return ClusterSpec.of(
+        ("T4", 1, 4), ("A100", 1, 4),
+        overrides={
+            "T4": DeviceSpec("T4", 15, 50, 10),
+            "A100": DeviceSpec("A100", 80, 46, 10),
+        })
+
+
+@pytest.fixture(scope="module")
+def profiles():
+    return synthesize_profiles(
+        tiny_test_model(), ["A100", "T4"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16])
+
+
+class TestPlanHetero:
+    def test_end_to_end(self, small_cluster, profiles):
+        result = plan_hetero(
+            small_cluster, profiles, tiny_test_model(),
+            SearchConfig(gbs=32, strict_compat=True))
+        assert result.num_costed > 10
+        best = result.best
+        assert best is not None
+        # ranked ascending
+        costs = [p.cost.total_ms for p in result.plans]
+        assert costs == sorted(costs)
+        # plan internally consistent
+        assert sum(best.inter.device_groups) == 8
+        assert best.intra.layer_partition[0] == 0
+        assert best.intra.layer_partition[-1] == 10
+        for s, g in zip(best.intra.strategies, best.inter.device_groups):
+            assert s.dp * s.tp == g
+
+    def test_top_k(self, small_cluster, profiles):
+        result = plan_hetero(
+            small_cluster, profiles, tiny_test_model(),
+            SearchConfig(gbs=32, strict_compat=True), top_k=5)
+        assert len(result.plans) == 5
+
+
+class TestPlanUniform:
+    def test_end_to_end(self, small_cluster, profiles):
+        result = plan_uniform(
+            small_cluster, profiles, tiny_test_model(),
+            SearchConfig(gbs=32, strict_compat=True), device_type="A100",
+            include_oom=True)
+        assert result.num_costed > 5
+        for r in result.plans:
+            assert r.plan.dp * r.plan.pp * r.plan.tp == 8
+
+
+class TestPlanTpu:
+    def test_north_star_topology(self):
+        tc = TpuClusterSpec((slice_from_name("v4-32"), slice_from_name("v5e-16")))
+        profiles = synthesize_profiles(
+            tiny_test_model(), ["tpu_v4", "tpu_v5e"], tps=[1, 2, 4],
+            bss=[1, 2, 4, 8, 16])
+        result = plan_tpu(
+            tc, profiles, tiny_test_model(),
+            SearchConfig(gbs=64, min_group_scale_variance=1.0), top_k=10)
+        assert result.best is not None
+        assert sum(result.best.inter.device_groups) == 48
+        # faster chips should end up with more than proportional work or the
+        # plan should at least be feasible and costed
+        assert result.best.cost.total_ms > 0
+
+
+class TestCli:
+    def test_hetero_cli_json(self, tmp_path, profiles, capsys):
+        profiles.dump_to_dir(tmp_path / "profiles")
+        (tmp_path / "hostfile").write_text("h1 slots=4\nh2 slots=4\n")
+        (tmp_path / "cluster.json").write_text(json.dumps({
+            "h1": {"instance_type": "T4", "inter_bandwidth": 10,
+                   "intra_bandwidth": 50, "memory": 15},
+            "h2": {"instance_type": "A100", "inter_bandwidth": 10,
+                   "intra_bandwidth": 46, "memory": 80}}))
+        out = tmp_path / "plans.json"
+        rc = cli_main([
+            "hetero",
+            "--hostfile", str(tmp_path / "hostfile"),
+            "--clusterfile", str(tmp_path / "cluster.json"),
+            "--profile-dir", str(tmp_path / "profiles"),
+            "--gbs", "32", "--num-layers", "10", "--hidden-size", "4096",
+            "--seq-len", "1024", "--vocab-size", "51200", "--num-heads", "32",
+            "--strict-compat", "--top-k", "3",
+            "--output", str(out),
+        ])
+        assert rc == 0
+        plans = json.loads(out.read_text())
+        assert len(plans) == 3
+        assert plans[0]["rank"] == 1
+        assert plans[0]["cost_ms"] <= plans[1]["cost_ms"]
+        assert "strategies" in plans[0] and "layer_partition" in plans[0]
+
+    def test_tpu_cli(self, tmp_path, capsys):
+        profiles = synthesize_profiles(
+            tiny_test_model(), ["tpu_v5e"], tps=[1, 2, 4], bss=[1, 2, 4, 8])
+        profiles.dump_to_dir(tmp_path / "profiles")
+        rc = cli_main([
+            "tpu", "--slices", "v5e-16",
+            "--profile-dir", str(tmp_path / "profiles"),
+            "--gbs", "16", "--num-layers", "10", "--hidden-size", "4096",
+            "--seq-len", "1024", "--vocab-size", "51200", "--num-heads", "32",
+            "--top-k", "2",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert json.loads(out)[0]["rank"] == 1
+
+
+class TestPlannerBeatsReferenceBalancer:
+    """Our DP balancer must never lose to the reference's greedy under the
+    identical (strict-compat) cost semantics."""
+
+    def test_best_cost_not_worse_than_reference(self, reference_run, parity_fixture_dir):
+        from metis_tpu.profiles import ProfileStore
+
+        # Two upstream artifacts are excluded from the comparison:
+        # 1. loop-recorded costs hit by the num_stage corruption — use DIRECT
+        #    evaluations instead (see conftest reference_run docstring);
+        # 2. INVALID partitions from the greedy balancer: its majority-vote
+        #    collapse (load_balancer.py:290-308) can emit empty stages and
+        #    even drop layers entirely (e.g. partition [0,1,...,1,8] on a
+        #    10-layer model — layers 8-9 never costed), producing
+        #    artificially low totals.  Our DP balancer guarantees full
+        #    coverage with non-empty stages, so only structurally valid
+        #    reference candidates are comparable.
+        num_layers = tiny_test_model().num_layers
+
+        def partition_valid(part):
+            return (part[0] == 0 and part[-1] == num_layers
+                    and all(a < b for a, b in zip(part, part[1:])))
+
+        ref_best = min(
+            direct
+            for rec, direct in zip(reference_run["costs"],
+                                   reference_run["direct_costs"])
+            if partition_valid(rec[4]))
+
+        cluster = ClusterSpec.from_files(
+            parity_fixture_dir / "hostfile", parity_fixture_dir / "clusterfile.json")
+        store = ProfileStore.from_dir(parity_fixture_dir / "profiles")
+        ours = plan_hetero(
+            cluster, store, tiny_test_model(),
+            SearchConfig(gbs=128, strict_compat=True))
+        assert ours.best is not None
+        # identical cost semantics + optimal balancer => never worse
+        assert ours.best.cost.total_ms <= ref_best * (1 + 1e-9)
