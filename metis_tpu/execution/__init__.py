@@ -1,0 +1,46 @@
+from metis_tpu.execution.mesh import (
+    DP,
+    PP,
+    SP,
+    TP,
+    PlanArtifact,
+    batch_spec,
+    gpt_param_specs,
+    mesh_dp_tp,
+    mesh_for_uniform_plan,
+    shard_params,
+)
+from metis_tpu.execution.train import (
+    TrainState,
+    build_optimizer,
+    build_train_state,
+    make_forward,
+    make_train_step,
+)
+from metis_tpu.execution.pipeline import (
+    make_pipeline_train_step,
+    microbatch_split,
+    tp_block_forward,
+    tp_embed,
+    tp_head_loss,
+)
+
+__all__ = [
+    "DP", "PP", "SP", "TP",
+    "PlanArtifact",
+    "batch_spec",
+    "gpt_param_specs",
+    "mesh_dp_tp",
+    "mesh_for_uniform_plan",
+    "shard_params",
+    "TrainState",
+    "build_optimizer",
+    "build_train_state",
+    "make_forward",
+    "make_train_step",
+    "make_pipeline_train_step",
+    "microbatch_split",
+    "tp_block_forward",
+    "tp_embed",
+    "tp_head_loss",
+]

@@ -1,0 +1,134 @@
+"""Plan -> jax.sharding.Mesh + NamedSharding emission.
+
+The planner's output becomes an executable artifact here (SURVEY.md §7 step 8
+— replaces the reference's printed Megatron rank tuples,
+``cost_het_cluster.py:43-45``): a uniform plan maps to a ("pp", "dp", "tp")
+device mesh; parameters get Megatron-style PartitionSpecs (column-parallel
+qkv/mlp-in, row-parallel proj/mlp-out, vocab-parallel embedding/head); the
+batch shards over dp.  Everything below is GSPMD-first: specs + sharding
+constraints, XLA inserts the collectives over ICI.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metis_tpu.core.types import UniformPlan
+from metis_tpu.models.gpt import GPTConfig
+
+PP, DP, TP, SP = "pp", "dp", "tp", "sp"
+
+
+def mesh_for_uniform_plan(plan: UniformPlan, devices=None) -> Mesh:
+    """(pp, dp, tp) mesh over the device list (row-major, matching the
+    planner's linear rank placement)."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    need = plan.pp * plan.dp * plan.tp
+    if devs.size < need:
+        raise ValueError(f"plan needs {need} devices, have {devs.size}")
+    grid = devs.flatten()[:need].reshape(plan.pp, plan.dp, plan.tp)
+    return Mesh(grid, (PP, DP, TP))
+
+
+def mesh_dp_tp(dp: int, tp: int, devices=None) -> Mesh:
+    """(dp, tp) mesh for non-pipelined execution."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if devs.size < dp * tp:
+        raise ValueError(f"mesh needs {dp * tp} devices, have {devs.size}")
+    grid = devs.flatten()[: dp * tp].reshape(dp, tp)
+    return Mesh(grid, (DP, TP))
+
+
+def gpt_param_specs(cfg: GPTConfig, tp_axis: str = TP, pp_axis: str | None = None) -> dict:
+    """PartitionSpec tree matching models.gpt.init_params.
+
+    ``pp_axis`` shards the stacked block-layer axis (pipeline stages own
+    contiguous layer slices); requires num_blocks % pp == 0.
+    """
+    t, p = tp_axis, pp_axis
+    return {
+        "embed": {
+            "tok": P(t, None),      # vocab-parallel embedding
+            "pos": P(),
+        },
+        "blocks": {
+            "ln1_scale": P(p, None),
+            "ln1_bias": P(p, None),
+            "qkv": P(p, None, None, t),  # column-parallel (per-head)
+            "qkv_bias": P(p, None, t),
+            "proj": P(p, t, None),      # row-parallel
+            "proj_bias": P(p, None),
+            "ln2_scale": P(p, None),
+            "ln2_bias": P(p, None),
+            "mlp_in": P(p, None, t),    # column-parallel
+            "mlp_in_bias": P(p, t),
+            "mlp_out": P(p, t, None),   # row-parallel
+            "mlp_out_bias": P(p, None),
+        },
+        "head": {
+            "ln_scale": P(),
+            "ln_bias": P(),
+            "out": P(None, t),      # vocab-parallel head
+        },
+    }
+
+
+def batch_spec(dp_axis: str = DP, seq_axis: str | None = None) -> P:
+    """Sharding for [batch, seq] token arrays."""
+    return P(dp_axis, seq_axis)
+
+
+def shard_params(params: dict, mesh: Mesh, specs: dict) -> dict:
+    """Place a parameter pytree onto the mesh with the given specs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+@dataclass(frozen=True)
+class PlanArtifact:
+    """Serializable chosen plan — the bridge from search to execution (and
+    the 'checkpoint' of the search, SURVEY.md §5 Checkpoint/resume)."""
+
+    mesh_axes: tuple[str, ...]
+    mesh_shape: tuple[int, ...]
+    layer_partition: tuple[int, ...]
+    strategies: tuple[dict, ...]
+    gbs: int
+    microbatches: int
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "mesh_axes": list(self.mesh_axes),
+            "mesh_shape": list(self.mesh_shape),
+            "layer_partition": list(self.layer_partition),
+            "strategies": list(self.strategies),
+            "gbs": self.gbs,
+            "microbatches": self.microbatches,
+        }, indent=2)
+
+    @staticmethod
+    def from_json(payload: str) -> "PlanArtifact":
+        d = json.loads(payload)
+        return PlanArtifact(
+            mesh_axes=tuple(d["mesh_axes"]),
+            mesh_shape=tuple(d["mesh_shape"]),
+            layer_partition=tuple(d["layer_partition"]),
+            strategies=tuple(d["strategies"]),
+            gbs=d["gbs"],
+            microbatches=d["microbatches"],
+        )
+
+    @staticmethod
+    def from_uniform_plan(plan: UniformPlan) -> "PlanArtifact":
+        return PlanArtifact(
+            mesh_axes=(PP, DP, TP),
+            mesh_shape=(plan.pp, plan.dp, plan.tp),
+            layer_partition=(),
+            strategies=({"dp": plan.dp, "tp": plan.tp},),
+            gbs=plan.gbs,
+            microbatches=plan.num_microbatches,
+        )

@@ -1,0 +1,245 @@
+"""Pipeline-parallel training: GPipe schedule over a "pp" mesh axis.
+
+JAX has no built-in pipeline parallelism (SURVEY.md §7 "Hard parts") — this
+implements it SPMD-style with ``shard_map``: every device runs the same
+program; the stacked block parameters are sharded along the layer axis over
+"pp" so each pipeline rank physically holds only its stage's layers;
+activations rotate stage-to-stage with ``lax.ppermute`` each tick.  With M
+microbatches and S stages the schedule runs M + S - 1 ticks — exactly the
+GPipe fill-drain the planner's cost model prices as
+``(M - 1) * max_stage + sum(stages)`` (``cost/estimator.py``), closing the
+predicted-vs-executed loop.
+
+Inside ``shard_map`` GSPMD does not apply, so tensor parallelism here is
+explicit Megatron-style SPMD: column-parallel qkv/mlp-in (per-head shards),
+row-parallel proj/mlp-out followed by ``psum`` over "tp", vocab-parallel
+embedding and cross-entropy.  Data parallelism shards the microbatch batch
+dim.  Gradient reductions are NOT manual: with vma checking on (the
+default), autodiff transposes the forward collectives exactly — grads arrive
+reduced over "dp" and correctly replicated over "pp"/"tp" for invariant
+leaves; adding manual psums double-counts (pinned by the grad-parity test).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metis_tpu.execution.mesh import DP, PP, TP, gpt_param_specs, shard_params
+from metis_tpu.models.gpt import GPTConfig, _layer_norm, causal_attention, init_params
+
+# ---------------------------------------------------------------------------
+# Megatron-style manual-collective layers (for use inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def tp_embed(params: dict, tokens: jnp.ndarray, cfg: GPTConfig,
+             tp_axis: str = TP) -> jnp.ndarray:
+    """Vocab-parallel embedding: each tp rank holds a vocab slice, looks up
+    in-range tokens, and the psum assembles full embeddings."""
+    table = params["embed"]["tok"]          # local [V/t, h]
+    v_local = table.shape[0]
+    base = jax.lax.axis_index(tp_axis) * v_local
+    local_ids = jnp.clip(tokens - base, 0, v_local - 1)
+    in_range = (tokens >= base) & (tokens < base + v_local)
+    emb = table.astype(cfg.dtype)[local_ids] * in_range[..., None].astype(cfg.dtype)
+    emb = jax.lax.psum(emb, tp_axis)
+    pos = params["embed"]["pos"].astype(cfg.dtype)[: tokens.shape[1]]
+    return emb + pos[None, :, :]
+
+
+def tp_block_forward(x: jnp.ndarray, layer: dict, cfg: GPTConfig,
+                     tp_axis: str = TP) -> jnp.ndarray:
+    """One transformer block with explicit tensor-parallel collectives.
+    x: [b, s, h] replicated across tp; weight leaves are local tp shards."""
+    dt = cfg.dtype
+    hd = cfg.head_dim
+
+    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    # column-parallel qkv: local out dim h/t = (nh/t) heads
+    qkv = jnp.einsum("bsh,chk->cbsk", y, layer["qkv"].astype(dt),
+                     preferred_element_type=jnp.float32)
+    qkv = (qkv + layer["qkv_bias"][:, None, None, :]).astype(dt)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+
+    def heads(t):
+        b, s, k_local = t.shape
+        return t.reshape(b, s, k_local // hd, hd).transpose(0, 2, 1, 3)
+
+    ctx = causal_attention(heads(q), heads(k), heads(v))
+    b, nh_local, s, _ = ctx.shape
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh_local * hd)
+    # row-parallel proj: partial sums -> psum
+    attn_out = jnp.einsum("bsk,kh->bsh", ctx, layer["proj"].astype(dt),
+                          preferred_element_type=jnp.float32)
+    attn_out = jax.lax.psum(attn_out, tp_axis)
+    x = x + (attn_out + layer["proj_bias"]).astype(dt)
+
+    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    z = jnp.einsum("bsh,hf->bsf", y, layer["mlp_in"].astype(dt),
+                   preferred_element_type=jnp.float32)
+    z = jax.nn.gelu((z + layer["mlp_in_bias"]).astype(jnp.float32)).astype(dt)
+    z = jnp.einsum("bsf,fh->bsh", z, layer["mlp_out"].astype(dt),
+                   preferred_element_type=jnp.float32)
+    z = jax.lax.psum(z, tp_axis)
+    return x + (z + layer["mlp_out_bias"]).astype(dt)
+
+
+def tp_head_loss(params: dict, x: jnp.ndarray, targets: jnp.ndarray,
+                 cfg: GPTConfig, tp_axis: str = TP) -> jnp.ndarray:
+    """Vocab-parallel cross-entropy (Megatron-style): local logits slice,
+    global max via pmax, normalizer and target logit via psum."""
+    y = _layer_norm(x, params["head"]["ln_scale"], params["head"]["ln_bias"])
+    w = params["head"]["out"]               # local [h, V/t]
+    logits = jnp.einsum("bsh,hv->bsv", y, w.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    v_local = logits.shape[-1]
+    base = jax.lax.axis_index(tp_axis) * v_local
+
+    # stability shift only — stop_gradient keeps pmax out of the VJP (it has
+    # no differentiation rule, and the shift cancels in the loss anyway)
+    gmax = jax.lax.stop_gradient(
+        jax.lax.pmax(jax.lax.stop_gradient(logits).max(-1), tp_axis))
+    sumexp = jax.lax.psum(
+        jnp.exp(logits - gmax[..., None]).sum(-1), tp_axis)
+
+    local_t = jnp.clip(targets - base, 0, v_local - 1)
+    in_range = (targets >= base) & (targets < base + v_local)
+    t_logit = jnp.take_along_axis(logits, local_t[..., None], axis=-1)[..., 0]
+    t_logit = jax.lax.psum(jnp.where(in_range, t_logit, 0.0), tp_axis)
+
+    nll = jnp.log(sumexp) + gmax - t_logit
+    return nll.mean()
+
+
+# ---------------------------------------------------------------------------
+# GPipe schedule
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_loss_local(
+    params: dict,
+    tokens_mbs: jnp.ndarray,   # [M, mbs_local, S]
+    targets_mbs: jnp.ndarray,
+    cfg: GPTConfig,
+) -> jnp.ndarray:
+    """Per-device GPipe body (inside shard_map over (pp, dp, tp))."""
+    num_stages = jax.lax.axis_size(PP)
+    stage = jax.lax.axis_index(PP)
+    M = tokens_mbs.shape[0]
+    ticks = M + num_stages - 1
+    mbs_local, seq = tokens_mbs.shape[1], tokens_mbs.shape[2]
+
+    fwd_perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def blocks_local(x):
+        def step(carry, layer):
+            return tp_block_forward(carry, layer, cfg), None
+        out, _ = jax.lax.scan(step, x, params["blocks"])
+        return out
+
+    def tick(carry, t):
+        buf, loss_sum = carry
+        feed_idx = jnp.clip(t, 0, M - 1)
+        tok = jax.lax.dynamic_index_in_dim(tokens_mbs, feed_idx, 0, False)
+        x0 = tp_embed(params, tok, cfg)
+        x_in = jnp.where(stage == 0, x0, buf)
+        x_out = blocks_local(x_in)
+
+        out_idx = jnp.clip(t - (num_stages - 1), 0, M - 1)
+        tgt = jax.lax.dynamic_index_in_dim(targets_mbs, out_idx, 0, False)
+        mb_loss = tp_head_loss(params, x_out, tgt, cfg)
+        is_emitting = (stage == num_stages - 1) & (t >= num_stages - 1)
+        loss_sum = loss_sum + jnp.where(is_emitting, mb_loss, 0.0)
+
+        buf_next = (
+            jax.lax.ppermute(x_out, PP, fwd_perm)
+            if num_stages > 1 else x_out)
+        return (buf_next, loss_sum), None
+
+    # initial carries are replicated values but become device-varying inside
+    # the loop (ppermute over pp, data over dp) — cast them up front so the
+    # scan carry types match under the vma checker
+    buf0 = jax.lax.pcast(
+        jnp.zeros((mbs_local, seq, cfg.hidden), cfg.dtype), (PP, DP), to='varying')
+    loss0 = jax.lax.pcast(jnp.zeros((), jnp.float32), (PP, DP), to='varying')
+    (_, loss_sum), _ = jax.lax.scan(tick, (buf0, loss0), jnp.arange(ticks))
+
+    # loss lives on the last stage; share it, and average over dp shards
+    loss = jax.lax.psum(loss_sum, PP) / M
+    return jax.lax.pmean(loss, DP)
+
+
+def make_pipeline_train_step(
+    cfg: GPTConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    optimizer=None,
+):
+    """Jitted GPipe train step over a (pp, dp, tp) mesh.
+
+    Requires ``cfg.num_blocks %% pp == 0`` (uniform stages — the stacked
+    layer axis shards evenly; non-uniform stages are a planned extension).
+    Returns (init_fn, step_fn): ``init_fn(key) -> (params, opt_state)`` on
+    mesh; ``step_fn(params, opt_state, tokens, targets) -> (params,
+    opt_state, loss)`` with tokens/targets [gbs_local..., seq] already
+    microbatch-major: [M, batch, seq].
+    """
+    pp = mesh.shape[PP]
+    if cfg.num_blocks % pp:
+        raise ValueError(
+            f"num_blocks={cfg.num_blocks} must divide evenly into pp={pp} "
+            "stages for the uniform pipeline")
+    optimizer = optimizer or optax.adamw(1e-4)
+    specs = gpt_param_specs(cfg, tp_axis=TP, pp_axis=PP)
+    data_spec = P(None, DP, None)  # [M, batch, seq]
+
+    loss_local = partial(_pipeline_loss_local, cfg=cfg)
+
+    # With vma checking on, autodiff through the manual collectives (tp
+    # psums, the pp loss psum, the dp pmean) transposes exactly: gradients
+    # arrive correctly reduced over dp and correctly replicated over pp for
+    # the pipeline-replicated embed/head leaves.  No manual grad collectives
+    # — adding them double-counts (caught by the grad-parity test).
+    sharded_step = jax.shard_map(
+        jax.value_and_grad(loss_local), mesh=mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(P(), specs),
+    )
+
+    def step_fn(params, opt_state, tokens_mbs, targets_mbs):
+        if tokens_mbs.shape[0] != num_microbatches:
+            raise ValueError(
+                f"expected {num_microbatches} microbatches, got "
+                f"{tokens_mbs.shape[0]} (use microbatch_split)")
+        loss, grads = sharded_step(params, tokens_mbs, targets_mbs)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    with mesh:
+        jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def init_fn(key):
+        params = shard_params(init_params(key, cfg), mesh, specs)
+        opt_state = optimizer.init(params)
+        return params, opt_state
+
+    def run(params, opt_state, tokens_mbs, targets_mbs):
+        with mesh:
+            return jitted(params, opt_state, tokens_mbs, targets_mbs)
+
+    return init_fn, run
+
+
+def microbatch_split(tokens: jnp.ndarray, num_microbatches: int) -> jnp.ndarray:
+    """[gbs, seq] -> [M, gbs/M, seq] (microbatch-major layout the pipeline
+    step consumes)."""
+    gbs, seq = tokens.shape
+    if gbs % num_microbatches:
+        raise ValueError(f"gbs={gbs} not divisible into {num_microbatches} microbatches")
+    return tokens.reshape(num_microbatches, gbs // num_microbatches, seq)

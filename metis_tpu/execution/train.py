@@ -1,0 +1,113 @@
+"""GSPMD training step: dp x tp (x ring-attention sp) without pipelining.
+
+The single-program path for plans with pp=1 (and the per-stage building block
+for the pipelined path): parameters and batch carry NamedShardings, the loss
+is computed under jit with sharding constraints, and XLA inserts the
+all-reduces (gradients over dp, activations over tp) on the ICI mesh.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metis_tpu.execution.mesh import (
+    DP,
+    TP,
+    batch_spec,
+    gpt_param_specs,
+    shard_params,
+)
+from metis_tpu.models.gpt import GPTConfig, init_params, next_token_loss
+from metis_tpu.ops.ring_attention import make_ring_attention
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: dict
+    opt_state: object
+    step: jnp.ndarray
+
+
+def build_optimizer(lr: float = 1e-4, weight_decay: float = 0.01):
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def build_train_state(
+    key: jax.Array,
+    cfg: GPTConfig,
+    mesh: Mesh,
+    optimizer=None,
+    tp_axis: str = TP,
+) -> tuple[TrainState, dict]:
+    """Initialize params on-mesh (sharded from the start) and the matching
+    optimizer state.  Returns (state, param_specs)."""
+    optimizer = optimizer or build_optimizer()
+    specs = gpt_param_specs(cfg, tp_axis=tp_axis)
+    params = shard_params(init_params(key, cfg), mesh, specs)
+    opt_state = optimizer.init(params)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=jnp.zeros((), jnp.int32)), specs
+
+
+def make_train_step(
+    cfg: GPTConfig,
+    mesh: Mesh,
+    optimizer=None,
+    attn_impl=None,
+    seq_axis: str | None = None,
+    dp_axis: str = DP,
+) -> Callable:
+    """Jitted (state, tokens, targets) -> (state, loss).
+
+    ``seq_axis``: shard the sequence over this mesh axis with ring attention
+    (context parallelism).  Without it, full attention runs locally and tp
+    sharding is handled entirely by GSPMD.
+    """
+    optimizer = optimizer or build_optimizer()
+    if seq_axis is not None and attn_impl is None:
+        attn_impl = make_ring_attention(mesh, seq_axis)
+
+    tok_sharding = NamedSharding(mesh, batch_spec(dp_axis, seq_axis))
+
+    def step(state: TrainState, tokens: jnp.ndarray, targets: jnp.ndarray):
+        tokens = jax.lax.with_sharding_constraint(tokens, tok_sharding.spec)
+        targets = jax.lax.with_sharding_constraint(targets, tok_sharding.spec)
+        loss, grads = jax.value_and_grad(next_token_loss)(
+            state.params, tokens, targets, cfg, attn_impl)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params=params, opt_state=opt_state,
+                          step=state.step + 1), loss
+
+    with mesh:
+        jitted = jax.jit(step, donate_argnums=(0,))
+
+    def run(state, tokens, targets):
+        with mesh:
+            return jitted(state, tokens, targets)
+
+    return run
+
+
+def make_forward(cfg: GPTConfig, mesh: Mesh | None = None, attn_impl=None):
+    """Jittable forward (params, tokens) -> logits for inference checks and
+    the driver's compile entry.  With ``mesh``, compilation runs in that mesh
+    context so sharded params keep their layouts."""
+    from metis_tpu.models.gpt import forward
+
+    fn = jax.jit(partial(forward, cfg=cfg, attn_impl=attn_impl))
+    if mesh is None:
+        return fn
+
+    def run(params, tokens):
+        with mesh:
+            return fn(params, tokens)
+
+    return run

@@ -1,0 +1,17 @@
+from metis_tpu.models.gpt import (
+    GPTConfig,
+    causal_attention,
+    forward,
+    init_params,
+    next_token_loss,
+    param_count,
+)
+
+__all__ = [
+    "GPTConfig",
+    "causal_attention",
+    "forward",
+    "init_params",
+    "next_token_loss",
+    "param_count",
+]

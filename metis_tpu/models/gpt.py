@@ -1,0 +1,243 @@
+"""GPT transformer — the flagship model family, TPU-first.
+
+The reference models GPT analytically but executes nothing (its Megatron
+trainer was never released, SURVEY.md §0).  This is the execution half: a
+functional plain-JAX GPT whose layer structure matches the profile contract —
+``num_layers`` profiled layers = embedding pseudo-layer + ``num_blocks``
+transformer blocks + LM-head pseudo-layer (``profile_data_samples`` layout).
+
+Design choices for the MXU/XLA (SURVEY.md §7 design stance):
+- block parameters are stacked along a leading layer axis so the forward pass
+  is a single ``lax.scan`` — one trace, one compilation, static shapes;
+- activations in bf16, parameters in fp32 (casted per-use), matmuls with
+  ``preferred_element_type=float32`` accumulate in fp32 on the MXU;
+- attention is pluggable (``attn_impl``) so context-parallel ring attention
+  (metis_tpu.ops.ring_attention) slots in without touching the block;
+- no Python control flow on traced values; remat via ``jax.checkpoint`` on
+  the block body trades FLOPs for HBM.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from metis_tpu.core.config import ModelSpec
+
+AttnFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+# (q, k, v) -> context; all [batch, heads, seq, head_dim]
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int
+    seq_len: int
+    hidden: int
+    num_heads: int
+    num_blocks: int
+    ffn_multiplier: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.num_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.hidden * self.ffn_multiplier
+
+    @property
+    def num_profile_layers(self) -> int:
+        """Profiled layer count (embed + blocks + head) — the unit the
+        planner's layer partitions are expressed in."""
+        return self.num_blocks + 2
+
+    @staticmethod
+    def from_model_spec(spec: ModelSpec, **overrides) -> "GPTConfig":
+        cfg = GPTConfig(
+            vocab_size=spec.vocab_size,
+            seq_len=spec.sequence_length,
+            hidden=spec.hidden_size,
+            num_heads=spec.num_heads,
+            num_blocks=spec.num_blocks,
+            ffn_multiplier=spec.ffn_multiplier,
+        )
+        return replace(cfg, **overrides) if overrides else cfg
+
+
+def init_params(key: jax.Array, cfg: GPTConfig) -> dict:
+    """Parameter pytree.  Block leaves are stacked: leading dim = num_blocks."""
+    k_tok, k_pos, k_blocks, k_head = jax.random.split(key, 4)
+    h, f, v = cfg.hidden, cfg.ffn_dim, cfg.vocab_size
+    L = cfg.num_blocks
+    pd = cfg.param_dtype
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape) * scale).astype(pd)
+
+    ks = jax.random.split(k_blocks, 6)
+    scale = 0.02
+    resid_scale = scale / math.sqrt(2 * max(L, 1))
+    params = {
+        "embed": {
+            "tok": normal(k_tok, (v, h), scale),
+            "pos": normal(k_pos, (cfg.seq_len, h), scale),
+        },
+        "blocks": {
+            "ln1_scale": jnp.ones((L, h), pd),
+            "ln1_bias": jnp.zeros((L, h), pd),
+            # (layer, {q,k,v}, in, out): the separate q/k/v axis keeps the
+            # output dim shardable per-head under tensor parallelism (a
+            # concatenated (h, 3h) layout would split q/k/v unevenly).
+            "qkv": normal(ks[0], (L, 3, h, h), scale),
+            "qkv_bias": jnp.zeros((L, 3, h), pd),
+            "proj": normal(ks[1], (L, h, h), resid_scale),
+            "proj_bias": jnp.zeros((L, h), pd),
+            "ln2_scale": jnp.ones((L, h), pd),
+            "ln2_bias": jnp.zeros((L, h), pd),
+            "mlp_in": normal(ks[2], (L, h, f), scale),
+            "mlp_in_bias": jnp.zeros((L, f), pd),
+            "mlp_out": normal(ks[3], (L, f, h), resid_scale),
+            "mlp_out_bias": jnp.zeros((L, h), pd),
+        },
+        "head": {
+            "ln_scale": jnp.ones((h,), pd),
+            "ln_bias": jnp.zeros((h,), pd),
+            "out": normal(k_head, (h, v), scale),
+        },
+    }
+    return params
+
+
+def _layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Baseline full-materialization causal attention.
+    q,k,v: [batch, heads, seq, head_dim]."""
+    seq = q.shape[2]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(q.shape[-1])
+    mask = jnp.tril(jnp.ones((seq, seq), bool))
+    scores = jnp.where(mask, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def block_forward(
+    x: jnp.ndarray, layer: dict, cfg: GPTConfig, attn_impl: AttnFn
+) -> jnp.ndarray:
+    """One transformer block on [batch, seq, hidden] activations."""
+    h, nh, hd = cfg.hidden, cfg.num_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    qkv = jnp.einsum("bsh,chk->cbsk", y, layer["qkv"].astype(dt),
+                     preferred_element_type=jnp.float32)
+    qkv = (qkv + layer["qkv_bias"][:, None, None, :]).astype(dt)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+
+    def heads(t):  # [b, s, h] -> [b, nh, s, hd]
+        b, s, _ = t.shape
+        return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+    ctx = attn_impl(heads(q), heads(k), heads(v))
+    b, _, s, _ = ctx.shape
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+    attn_out = jnp.einsum("bsh,hk->bsk", ctx, layer["proj"].astype(dt),
+                          preferred_element_type=jnp.float32)
+    x = x + (attn_out + layer["proj_bias"]).astype(dt)
+
+    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    z = jnp.einsum("bsh,hf->bsf", y, layer["mlp_in"].astype(dt),
+                   preferred_element_type=jnp.float32)
+    z = jax.nn.gelu((z + layer["mlp_in_bias"]).astype(jnp.float32)).astype(dt)
+    z = jnp.einsum("bsf,fh->bsh", z, layer["mlp_out"].astype(dt),
+                   preferred_element_type=jnp.float32)
+    return x + (z + layer["mlp_out_bias"]).astype(dt)
+
+
+def embed(params: dict, tokens: jnp.ndarray, cfg: GPTConfig) -> jnp.ndarray:
+    """Embedding pseudo-layer (profile layer 0): token + position lookup."""
+    seq = tokens.shape[1]
+    tok = params["embed"]["tok"].astype(cfg.dtype)[tokens]
+    pos = params["embed"]["pos"].astype(cfg.dtype)[:seq]
+    return tok + pos[None, :, :]
+
+
+def run_blocks(
+    params: dict,
+    x: jnp.ndarray,
+    cfg: GPTConfig,
+    attn_impl: AttnFn | None = None,
+    block_slice: tuple[int, int] | None = None,
+) -> jnp.ndarray:
+    """Scan the (optionally sliced) stacked blocks over the activations.
+    ``block_slice`` selects blocks [i, j) — how pipeline stages take their
+    share of the stack."""
+    attn = attn_impl or causal_attention
+    blocks = params["blocks"]
+    if block_slice is not None:
+        i, j = block_slice
+        blocks = jax.tree.map(lambda a: a[i:j], blocks)
+
+    body = partial(block_forward, cfg=cfg, attn_impl=attn)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def step(carry, layer):
+        return body(carry, layer), None
+
+    out, _ = jax.lax.scan(step, x, blocks)
+    return out
+
+
+def head_logits(params: dict, x: jnp.ndarray, cfg: GPTConfig) -> jnp.ndarray:
+    """LM-head pseudo-layer (profile layer N-1): final LN + projection."""
+    y = _layer_norm(x, params["head"]["ln_scale"], params["head"]["ln_bias"])
+    return jnp.einsum(
+        "bsh,hv->bsv", y, params["head"]["out"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32)
+
+
+def forward(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: GPTConfig,
+    attn_impl: AttnFn | None = None,
+) -> jnp.ndarray:
+    """Full forward: tokens [batch, seq] int32 -> logits [batch, seq, vocab]
+    (fp32)."""
+    x = embed(params, tokens, cfg)
+    x = run_blocks(params, x, cfg, attn_impl)
+    return head_logits(params, x, cfg)
+
+
+def next_token_loss(
+    params: dict,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    cfg: GPTConfig,
+    attn_impl: AttnFn | None = None,
+) -> jnp.ndarray:
+    """Mean cross-entropy of next-token prediction (fp32 scalar)."""
+    logits = forward(params, tokens, cfg, attn_impl)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -picked.mean()
+
+
+def param_count(params: dict) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

@@ -1,0 +1,6 @@
+from metis_tpu.ops.ring_attention import (
+    make_ring_attention,
+    ring_attention_local,
+)
+
+__all__ = ["make_ring_attention", "ring_attention_local"]

@@ -1,0 +1,101 @@
+"""Ring attention — context-parallel causal attention over a mesh axis.
+
+Net-new TPU capability (the reference has no sequence/context parallelism
+anywhere — SURVEY.md §2.2/§5 "Long-context"): the sequence dimension is
+sharded across devices on a mesh axis; K/V blocks rotate around the ring via
+``lax.ppermute`` while each device accumulates its queries' attention with a
+flash-style streaming softmax (running max ``m``, normalizer ``l``, output
+``o``).  Communication rides the ICI ring — each step moves only the local
+K/V block, overlapping with the local attention matmuls.
+
+Causality across blocks: with sequence sharded contiguously, the K/V block
+that originated on ring position ``src`` is entirely in the past of queries on
+position ``q_pos`` when ``src < q_pos``, entirely in the future when
+``src > q_pos``, and needs the triangular mask only when ``src == q_pos`` —
+so masking stays block-level and cheap.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attend(q, k, v, mask):
+    """Scores and weighted values of one (q-block, kv-block) pair in fp32.
+    q: [b, h, sq, d]; k, v: [b, h, sk, d]; mask broadcastable to [sq, sk]."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(q.shape[-1])
+    return jnp.where(mask, scores, -jnp.inf)
+
+
+def _online_update(m, l, o, scores, v):
+    """Streaming-softmax accumulate: fold one block of scores/values into the
+    running (max, normalizer, output) triple."""
+    m_new = jnp.maximum(m, scores.max(-1))
+    # guard fully-masked rows: exp(-inf - -inf) would be nan
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_safe))
+    p = jnp.exp(scores - m_safe[..., None])
+    l_new = l * alpha + p.sum(-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention_local(q, k, v, axis_name: str):
+    """The per-device body: causal attention with K/V rotating over
+    ``axis_name``.  Call inside shard_map with q/k/v sequence-sharded on that
+    axis.  q, k, v: [b, h, s_local, d]."""
+    ring = jax.lax.axis_size(axis_name)
+    my_pos = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+
+    q32 = q.astype(jnp.float32)
+    # accumulators start replicated but the scan makes them ring-varying
+    m = jax.lax.pcast(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), (axis_name,), to='varying')
+    l = jax.lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), (axis_name,), to='varying')
+    o = jax.lax.pcast(jnp.zeros(q32.shape, jnp.float32), (axis_name,), to='varying')
+
+    diag_mask = jnp.tril(jnp.ones((s_local, s_local), bool))
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def step(carry, step_idx):
+        m, l, o, k_cur, v_cur = carry
+        src = (my_pos - step_idx) % ring  # ring position this K/V came from
+        # block-level causality: past -> full, self -> triangular, future -> none
+        mask = jnp.where(
+            src < my_pos, jnp.ones((s_local, s_local), bool),
+            jnp.where(src == my_pos, diag_mask,
+                      jnp.zeros((s_local, s_local), bool)))
+        scores = _block_attend(q32, k_cur.astype(jnp.float32),
+                               v_cur.astype(jnp.float32), mask)
+        m, l, o = _online_update(m, l, o, scores, v_cur)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    (m, l, o, _, _), _ = jax.lax.scan(
+        step, (m, l, o, k, v), jnp.arange(ring))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (o / l_safe[..., None]).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, seq_axis: str):
+    """A drop-in AttnFn (q, k, v -> context, [b, h, s, d]) that runs ring
+    attention with the sequence dim sharded over ``seq_axis`` of ``mesh``.
+    Composable under jit: shard_map handles the collectives."""
+    spec = P(None, None, seq_axis, None)
+
+    local = partial(ring_attention_local, axis_name=seq_axis)
+    # Only the sequence axis is manual; every other mesh axis (dp, tp, ...)
+    # stays under GSPMD so batch/head shardings pass straight through instead
+    # of being gathered at the shard_map boundary.
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={seq_axis},
+    )

@@ -10,6 +10,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon TPU-tunnel plugin overrides jax_platforms to "axon,cpu" regardless
+# of the env var; pin it back so tests never touch the real chip.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import pathlib
 import sys
 
