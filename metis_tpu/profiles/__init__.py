@@ -10,6 +10,26 @@ from metis_tpu.profiles.synthetic import (
     tiny_test_model,
 )
 
+
+# The measured profiler imports jax; keep planner-only consumers jax-free by
+# resolving these lazily (PEP 562).
+_LAZY_PROFILER = (
+    "LayerProfiler",
+    "ProfilerConfig",
+    "profile_model",
+    "profile_to_dir",
+    "infer_device_type",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_PROFILER:
+        from metis_tpu.profiles import profiler
+
+        return getattr(profiler, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "LayerProfile",
     "ModelProfileMeta",
@@ -18,4 +38,5 @@ __all__ = [
     "CHIP_PERF",
     "synthesize_profiles",
     "tiny_test_model",
+    *_LAZY_PROFILER,
 ]

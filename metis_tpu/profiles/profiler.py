@@ -1,0 +1,352 @@
+"""Measured profiler — per-layer jitted fwd+bwd timing under TP shardings.
+
+North-star item 1 (SURVEY.md §5 "Tracing / profiling", §7 step 7).  The
+reference *documents* profile collection — PyTorch fwd/bwd hooks with
+``torch.cuda.synchronize`` timing and ``torch.cuda.max_memory_reserved``
+(reference ``README.md:152-172``) — but ships no implementation.  This is the
+JAX-native implementation: each profiled layer (embedding pseudo-layer, one
+transformer block, LM-head pseudo-layer — the layer unit of the profile
+contract, ``profile_data_samples`` layout) is jitted as its own fwd+bwd
+closure under the TP sharding of a (1, tp) mesh, timed on-device with
+``block_until_ready``, with memory taken from XLA's compiled memory analysis.
+
+Output is a :class:`ProfileStore` — the same schema the planner consumes and
+``ProfileStore.dump_to_dir`` writes as reference-compatible
+``DeviceType.{X}_tp{N}_bs{M}.json`` files (reference ``README.md:41-59``).
+
+Design notes (TPU-first):
+- All blocks are structurally identical (stacked ``lax.scan`` leaves), so one
+  block is timed and the measurement is shared by every block row — the
+  per-layer vector still has ``num_layers`` entries to honor the contract.
+- Per-layer times are isolated-closure measurements normalized so their sum
+  equals the measured full-model fwd+bwd time.  Under XLA the whole step is
+  one fused program, so isolated layer timings systematically over-count
+  dispatch and un-fused work; their *ratios* are what's meaningful.  The
+  normalized decomposition keeps the profile contract exact
+  (``forward_backward_time_ms`` = Σ layer times, so the derived ``fb_sync``
+  of ``data_loader.py:33-34`` is 0 — there is no outside-the-graph sync work
+  in a jitted step).
+- Timing uses median-of-k after warmup; first call pays compilation, which is
+  never counted.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from pathlib import Path
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metis_tpu.core.config import ModelSpec
+from metis_tpu.core.errors import MetisError
+from metis_tpu.execution.mesh import DP, TP, gpt_param_specs, shard_params
+from metis_tpu.models.gpt import (
+    GPTConfig,
+    embed,
+    block_forward,
+    causal_attention,
+    head_logits,
+    init_params,
+    next_token_loss,
+)
+from metis_tpu.profiles.store import (
+    DeviceTypeMeta,
+    LayerProfile,
+    ModelProfileMeta,
+    ProfileStore,
+)
+
+_MB = 1024 * 1024
+
+
+@dataclass(frozen=True)
+class ProfilerConfig:
+    """Measurement knobs."""
+
+    warmup: int = 2
+    iters: int = 5
+    seed: int = 0
+
+
+def infer_device_type(device=None) -> str:
+    """Profile-key device type from the JAX device kind (e.g. 'TPU v4' ->
+    'TPUv4', CPU -> 'CPU').  Replaces the reference's closed DeviceType enum
+    (``utils.py:46-57``) with an open string key."""
+    device = device or jax.devices()[0]
+    kind = (device.device_kind or device.platform).replace(" ", "")
+    # Filenames embed this key (DeviceType.{key}_tp..), keep it word-safe.
+    kind = "".join(c for c in kind if c.isalnum() or c == "_")
+    return kind.upper() if kind.lower() == "cpu" else kind
+
+
+def _median_ms(fn: Callable, args: tuple, warmup: int, iters: int) -> float:
+    """Median wall time of ``fn(*args)`` in ms, post-warmup, fully synced."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(samples))
+
+
+def _aot_compile(fn: Callable, args: tuple):
+    """Ahead-of-time compile: one XLA compilation serves both the timing loop
+    and the memory analysis (a jit-cached call plus a separate
+    ``.lower().compile()`` would compile twice — expensive on a real chip)."""
+    return jax.jit(fn).lower(*args).compile()
+
+
+def _compiled_memory_mb(compiled) -> float | None:
+    """Peak-memory estimate from XLA's memory analysis (args + temps +
+    outputs), or None when the backend doesn't report it (CPU often doesn't)."""
+    try:
+        analysis = compiled.memory_analysis()
+        if analysis is None:
+            return None
+        total = (
+            analysis.argument_size_in_bytes
+            + analysis.temp_size_in_bytes
+            + analysis.output_size_in_bytes
+        )
+        return total / _MB
+    except Exception:
+        return None
+
+
+def _analytic_memory_mb(param_bytes: float, act_bytes: float, tp: int) -> float:
+    """Fallback memory model when XLA analysis is unavailable: sharded weights
+    + fp32 Adam state (master + 2 moments over bf16: x6) + live activations."""
+    return (param_bytes / tp * 7.0 + act_bytes) / _MB
+
+
+def _param_bytes(tree) -> int:
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(tree))
+
+
+class LayerProfiler:
+    """Profiles one GPT model shape on the local devices across (tp, bs)."""
+
+    def __init__(
+        self,
+        model: ModelSpec,
+        device_type: str | None = None,
+        devices: Sequence | None = None,
+        config: ProfilerConfig = ProfilerConfig(),
+        dtype=jnp.bfloat16,
+    ):
+        self.model = model
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.device_type = device_type or infer_device_type(self.devices[0])
+        self.config = config
+        self.cfg = GPTConfig.from_model_spec(model, dtype=dtype)
+
+    # -- per-layer closures -------------------------------------------------
+    def _make_layer_fns(self, cfg: GPTConfig):
+        """(embed_fwd_bwd, block_fwd_bwd, head_fwd_bwd) — each takes sharded
+        params + token/activation inputs and runs forward plus parameter+input
+        gradients, mirroring the per-layer fwd+bwd the reference profiles with
+        torch hooks (``README.md:152-163``)."""
+
+        def embed_fb(params, tokens):
+            def f(p):
+                return embed(p, tokens, cfg).astype(jnp.float32).sum()
+
+            return jax.value_and_grad(f)(params)
+
+        def block_fb(layer, x):
+            def f(layer, x):
+                return (
+                    block_forward(x, layer, cfg, causal_attention)
+                    .astype(jnp.float32)
+                    .sum()
+                )
+
+            return jax.value_and_grad(f, argnums=(0, 1))(layer, x)
+
+        def head_fb(params, x, targets):
+            def f(p, x):
+                logits = head_logits(p, x, cfg)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                picked = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+                return -picked.mean()
+
+            return jax.value_and_grad(f, argnums=(0, 1))(params, x)
+
+        return embed_fb, block_fb, head_fb
+
+    def _profile_one(self, tp: int, bs: int) -> LayerProfile:
+        cfg, model = self.cfg, self.model
+        if len(self.devices) < tp:
+            raise MetisError(
+                f"tp={tp} needs {tp} devices, have {len(self.devices)}")
+        mesh = Mesh(np.array(self.devices[:tp]).reshape(1, tp), (DP, TP))
+        specs = gpt_param_specs(cfg)
+
+        key = jax.random.PRNGKey(self.config.seed)
+        with mesh:
+            params = shard_params(init_params(key, cfg), mesh, specs)
+            tokens = jax.device_put(
+                jax.random.randint(key, (bs, cfg.seq_len), 0, cfg.vocab_size),
+                NamedSharding(mesh, P()),
+            )
+            x = jax.device_put(
+                jax.random.normal(key, (bs, cfg.seq_len, cfg.hidden), cfg.dtype),
+                NamedSharding(mesh, P()),
+            )
+            layer0 = jax.tree.map(lambda a: a[0], params["blocks"])
+            embed_fb, block_fb, head_fb = self._make_layer_fns(cfg)
+
+            j_embed = _aot_compile(embed_fb, (params, tokens))
+            j_block = _aot_compile(block_fb, (layer0, x))
+            j_head = _aot_compile(head_fb, (params, x, tokens))
+            w, it = self.config.warmup, self.config.iters
+            embed_ms = _median_ms(j_embed, (params, tokens), w, it)
+            block_ms = _median_ms(j_block, (layer0, x), w, it)
+            head_ms = _median_ms(j_head, (params, x, tokens), w, it)
+
+            # Whole-model fwd+bwd — the ground truth the per-layer
+            # decomposition must sum to (see module docstring).
+            j_full = _aot_compile(
+                jax.value_and_grad(partial(next_token_loss, cfg=cfg)),
+                (params, tokens, tokens),
+            )
+            full_ms = _median_ms(j_full, (params, tokens, tokens), w, it)
+
+            raw = [embed_ms] + [block_ms] * cfg.num_blocks + [head_ms]
+            scale = full_ms / sum(raw)
+            times = [t * scale for t in raw]
+            fb_sync = 0.0
+
+            # Memory: XLA compiled analysis with analytic fallback.
+            s, h, v = cfg.seq_len, cfg.hidden, cfg.vocab_size
+            act_block = 10 * bs * s * h * model.dtype_bytes / tp
+            act_head = bs * s * v * model.dtype_bytes / tp
+            pbytes = self._params_per_layer_bytes(params)
+            mem_embed = _compiled_memory_mb(j_embed)
+            mem_block = _compiled_memory_mb(j_block)
+            mem_head = _compiled_memory_mb(j_head)
+            mems = [
+                mem_embed
+                if mem_embed is not None
+                else _analytic_memory_mb(pbytes[0], act_block, tp)
+            ]
+            mems += [
+                mem_block
+                if mem_block is not None
+                else _analytic_memory_mb(pbytes[1], act_block, tp)
+            ] * cfg.num_blocks
+            mems += [
+                mem_head
+                if mem_head is not None
+                else _analytic_memory_mb(pbytes[-1], act_head, tp)
+            ]
+
+        return LayerProfile(
+            layer_times_ms=tuple(times),
+            layer_memory_mb=tuple(mems),
+            fb_sync_ms=fb_sync,
+        )
+
+    def _params_per_layer_bytes(self, params) -> tuple[int, ...]:
+        """Actual parameter bytes per profiled layer (embed, blocks..., head)
+        — the ``parameters_per_layer_bytes`` contract field."""
+        embed_b = _param_bytes(params["embed"])
+        blocks_b = _param_bytes(params["blocks"]) // self.cfg.num_blocks
+        head_b = _param_bytes(params["head"])
+        return tuple([embed_b] + [blocks_b] * self.cfg.num_blocks + [head_b])
+
+    def _profile_optimizer_ms(self) -> float:
+        """Adam update wall time on full (unsharded-model-size) parameters."""
+        cfg = self.cfg
+        params = init_params(jax.random.PRNGKey(self.config.seed), cfg)
+        opt = optax.adamw(1e-4)
+        opt_state = opt.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+
+        @jax.jit
+        def step(params, opt_state, grads):
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        return _median_ms(
+            step, (params, opt_state, grads), self.config.warmup, self.config.iters)
+
+    def _profile_batch_gen_ms(self, bs: int) -> float:
+        """Host batch synthesis + host->device transfer."""
+        rng = np.random.default_rng(self.config.seed)
+
+        def gen():
+            batch = rng.integers(
+                0, self.cfg.vocab_size, (bs, self.cfg.seq_len), dtype=np.int32)
+            return jax.device_put(batch, self.devices[0])
+
+        return _median_ms(lambda: gen(), (), self.config.warmup, self.config.iters)
+
+    # -- public API ---------------------------------------------------------
+    def run(
+        self, tps: Sequence[int] = (1,), bss: Sequence[int] = (1,)
+    ) -> ProfileStore:
+        """Profile every available (tp, bs) combination into a ProfileStore.
+
+        tp degrees that exceed the local device count (or don't divide the
+        head count) are skipped — profile what the hardware can measure, plan
+        with what was profiled (the reference's ``max_profiled_tp_degree``
+        contract, ``arguments.py:44``).
+        """
+        entries: dict[tuple[str, int, int], LayerProfile] = {}
+        for tp in tps:
+            if tp > len(self.devices) or self.cfg.num_heads % tp != 0:
+                continue
+            for bs in bss:
+                entries[(self.device_type, tp, bs)] = self._profile_one(tp, bs)
+        if not entries:
+            raise MetisError(
+                f"no (tp, bs) combination profileable with {len(self.devices)}"
+                f" device(s); requested tps={list(tps)}")
+
+        params = init_params(jax.random.PRNGKey(self.config.seed), self.cfg)
+        pbytes = self._params_per_layer_bytes(params)
+        opt_ms = self._profile_optimizer_ms()
+        bg_ms = self._profile_batch_gen_ms(max(bss))
+        meta = ModelProfileMeta(
+            num_layers=self.cfg.num_profile_layers,
+            optimizer_time_ms=opt_ms,
+            batch_generator_ms=bg_ms,
+            params_per_layer_bytes=pbytes,
+        )
+        type_meta = {self.device_type: DeviceTypeMeta(opt_ms, bg_ms)}
+        return ProfileStore(entries, meta, type_meta)
+
+
+def profile_model(
+    model: ModelSpec,
+    tps: Sequence[int] = (1,),
+    bss: Sequence[int] = (1,),
+    device_type: str | None = None,
+    devices: Sequence | None = None,
+    config: ProfilerConfig = ProfilerConfig(),
+) -> ProfileStore:
+    """One-call measured profiling (see :class:`LayerProfiler`)."""
+    return LayerProfiler(model, device_type, devices, config).run(tps, bss)
+
+
+def profile_to_dir(
+    model: ModelSpec,
+    out_dir: str | Path,
+    tps: Sequence[int] = (1,),
+    bss: Sequence[int] = (1,),
+    device_type: str | None = None,
+    config: ProfilerConfig = ProfilerConfig(),
+) -> list[Path]:
+    """Profile and write reference-schema JSON files (the end-to-end path:
+    profile on this host -> plan anywhere)."""
+    store = profile_model(model, tps, bss, device_type, config=config)
+    return store.dump_to_dir(out_dir, {"model_name": model.name})

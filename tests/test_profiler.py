@@ -1,0 +1,102 @@
+"""Measured profiler: per-layer timing/memory -> ProfileStore -> planner.
+
+Runs on the virtual CPU mesh (conftest) — the same code path profiles real
+TPU chips; only the device list differs.
+"""
+import jax
+import pytest
+
+from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.profiles import ProfileStore
+from metis_tpu.profiles.profiler import (
+    LayerProfiler,
+    ProfilerConfig,
+    infer_device_type,
+    profile_model,
+)
+
+TINY = ModelSpec(
+    name="gpt-profiler-test",
+    num_layers=4,  # embed + 2 blocks + head
+    hidden_size=64,
+    sequence_length=32,
+    vocab_size=128,
+    num_heads=4,
+)
+FAST = ProfilerConfig(warmup=1, iters=2)
+
+
+@pytest.fixture(scope="module")
+def measured_store() -> ProfileStore:
+    return profile_model(TINY, tps=(1, 2), bss=(1, 2), config=FAST)
+
+
+def test_device_type_is_word_safe():
+    t = infer_device_type(jax.devices()[0])
+    assert t and all(c.isalnum() or c == "_" for c in t)
+
+
+def test_store_covers_requested_grid(measured_store):
+    dtype = measured_store.device_types[0]
+    assert sorted(measured_store.configs()) == sorted(
+        [(dtype, tp, bs) for tp in (1, 2) for bs in (1, 2)])
+
+
+def test_per_layer_vectors_match_contract(measured_store):
+    dtype = measured_store.device_types[0]
+    prof = measured_store.get(dtype, 1, 1)
+    assert prof.num_layers == TINY.num_layers
+    assert all(t > 0 for t in prof.layer_times_ms)
+    assert all(m > 0 for m in prof.layer_memory_mb)
+    # blocks share one measurement (structurally identical scan rows)
+    assert prof.layer_times_ms[1] == prof.layer_times_ms[2]
+    meta = measured_store.model
+    assert meta.num_layers == TINY.num_layers
+    assert meta.optimizer_time_ms > 0
+    assert all(b > 0 for b in meta.params_per_layer_bytes)
+
+
+def test_times_grow_with_batch(measured_store):
+    dtype = measured_store.device_types[0]
+    small = measured_store.get(dtype, 1, 1)
+    # memory must be monotone in bs; time comparisons are too noisy on a
+    # shared CPU for a strict assert at this tiny scale
+    big = measured_store.get(dtype, 1, 2)
+    assert sum(big.layer_memory_mb) >= sum(small.layer_memory_mb)
+
+
+def test_tp_unprofileable_degrees_skipped():
+    store = profile_model(TINY, tps=(1, 3, 64), bss=(1,), config=FAST)
+    tps = {tp for (_, tp, _) in store.configs()}
+    assert tps == {1}  # 3 doesn't divide heads=4, 64 > device count
+
+
+def test_dump_load_roundtrip(measured_store, tmp_path):
+    paths = measured_store.dump_to_dir(tmp_path, {"model_name": TINY.name})
+    assert len(paths) == 4
+    loaded = ProfileStore.from_dir(tmp_path)
+    dtype = measured_store.device_types[0]
+    orig = measured_store.get(dtype, 2, 1)
+    back = loaded.get(dtype, 2, 1)
+    assert back.layer_times_ms == pytest.approx(orig.layer_times_ms)
+    assert back.layer_memory_mb == pytest.approx(orig.layer_memory_mb)
+    assert back.fb_sync_ms == pytest.approx(orig.fb_sync_ms)
+
+
+def test_profiled_store_drives_planner(measured_store):
+    """The e2e slice: measure on this host -> plan a (fake) 8-chip fleet."""
+    from metis_tpu.planner import plan_uniform
+
+    dtype = measured_store.device_types[0]
+    devices = {dtype: DeviceSpec(dtype, memory_gb=8,
+                                 intra_bw_gbps=100, inter_bw_gbps=25)}
+    cluster = ClusterSpec(
+        nodes=tuple(NodeSpec(dtype, 4) for _ in range(2)), devices=devices)
+    result = plan_uniform(
+        cluster, measured_store, TINY,
+        SearchConfig(gbs=8, max_profiled_tp=2, max_profiled_bs=2),
+        include_oom=True)
+    assert result.num_costed > 0
+    assert result.best is not None
+    assert result.best.cost.total_ms > 0

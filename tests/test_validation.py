@@ -1,0 +1,94 @@
+"""Validator: the predicted-vs-measured loop runs on the CPU mesh.
+
+Error magnitude is meaningless on a shared CPU (profiles and execution both
+noisy at toy scale), so the tests pin mechanics: both executor paths run,
+reports are arithmetically consistent, and the planner->validator pipeline
+composes.
+"""
+import pytest
+
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.types import UniformPlan
+from metis_tpu.validation import (
+    ValidationReport,
+    measure_uniform_plan_ms,
+    validate_uniform_plan,
+    validate_planner_choice,
+)
+
+TINY = ModelSpec(
+    name="gpt-validate-test",
+    num_layers=4,  # embed + 2 blocks + head
+    hidden_size=64,
+    sequence_length=32,
+    vocab_size=128,
+    num_heads=4,
+)
+
+
+def test_report_arithmetic():
+    plan = UniformPlan(dp=1, pp=1, tp=1, mbs=2, gbs=2)
+    r = ValidationReport(plan=plan, predicted_ms=110.0, measured_ms=100.0, steps=3)
+    assert r.error_pct == pytest.approx(10.0)
+    assert r.abs_error_pct == pytest.approx(10.0)
+    assert r.within(10.0) and not r.within(9.9)
+    assert r.to_json_dict()["plan"]["mbs"] == 2
+
+
+def test_measures_gspmd_path():
+    import jax
+
+    plan = UniformPlan(dp=2, pp=1, tp=2, mbs=2, gbs=4)
+    ms = measure_uniform_plan_ms(
+        plan, TINY, jax.devices("cpu")[:4], steps=2, warmup=1)
+    assert ms > 0
+
+
+def test_measures_pipeline_path():
+    import jax
+
+    plan = UniformPlan(dp=2, pp=2, tp=1, mbs=1, gbs=4)
+    assert plan.num_microbatches == 2
+    ms = measure_uniform_plan_ms(
+        plan, TINY, jax.devices("cpu")[:4], steps=2, warmup=1)
+    assert ms > 0
+
+
+def test_rejects_undersized_device_list():
+    import jax
+
+    from metis_tpu.core.errors import MetisError
+
+    plan = UniformPlan(dp=8, pp=2, tp=1, mbs=1, gbs=16)
+    with pytest.raises(MetisError):
+        measure_uniform_plan_ms(plan, TINY, jax.devices("cpu"), steps=1)
+
+
+def test_planner_to_validator_composes():
+    """Plan with measured profiles, then validate the chosen plan — the
+    complete north-star loop on one host."""
+    import jax
+
+    from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+    from metis_tpu.planner import plan_uniform
+    from metis_tpu.profiles.profiler import ProfilerConfig, profile_model
+
+    store = profile_model(TINY, tps=(1, 2), bss=(1, 2),
+                          config=ProfilerConfig(warmup=1, iters=2))
+    dtype = store.device_types[0]
+    cluster = ClusterSpec(
+        nodes=(NodeSpec(dtype, 4),),
+        devices={dtype: DeviceSpec(dtype, 8, 100, 25)})
+    result = plan_uniform(
+        cluster, store, TINY,
+        SearchConfig(gbs=8, max_profiled_tp=2, max_profiled_bs=2),
+        include_oom=True)
+    assert result.best is not None
+    reports = validate_planner_choice(
+        result.plans, TINY, jax.devices("cpu"), top_k=1, steps=2, warmup=1)
+    (report,) = reports
+    assert report.measured_ms > 0
+    assert report.predicted_ms == pytest.approx(result.best.cost.total_ms)
+    # both sides describe the same workload; on CPU we only sanity-bound the
+    # ratio to catch unit errors (ms vs s, per-microbatch vs per-step)
+    assert 0.001 < report.predicted_ms / report.measured_ms < 1000
