@@ -32,6 +32,7 @@ from metis_tpu.core.types import InterStagePlan, Strategy
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
 from metis_tpu.balance.stage_perf import rank_device_types
+from metis_tpu.cost.context_parallel import ActivationSplitModel
 from metis_tpu.search.intra_stage import PartitionResult
 
 
@@ -103,6 +104,7 @@ class LayerBalancer:
         self.profiles = profiles
         self.config = config
         self.data_balancer = DataBalancer(profiles)
+        self.act_split = ActivationSplitModel(profiles)
         self._prefix_cache: dict[tuple, list[float]] = {}
         # Normalized per-layer durations from the tp1_bs1 profile of the first
         # device type (≅ load_balancer.py:22-27, made deterministic).
@@ -111,39 +113,43 @@ class LayerBalancer:
         self.layer_weights = tuple(t / total for t in base.layer_times_ms)
 
     # -- memory model ------------------------------------------------------
-    def _stage_memory_profiles(
+    def _stage_memory_rows(
         self,
         plan: InterStagePlan,
         strategy: Strategy,
         stage_types: Sequence[str],
         all_types: Sequence[str],
-    ) -> list:
-        """The LayerProfile set whose per-layer memory sums give this stage's
-        demand (homo: one entry at the stage batch; hetero: one per replica
-        power-of-two batch chunk).  Depends only on the stage, not on the
-        layer range — resolved once and reused across all O(L²) DP probes."""
+    ) -> list[tuple[float, ...]]:
+        """Per-layer memory rows whose sums give this stage's demand (homo:
+        one row at the stage batch; hetero: one per replica power-of-two batch
+        chunk).  Depends only on the stage, not on the layer range — resolved
+        once and reused across all O(L²) DP probes.  Context parallelism
+        (strategy.cp > 1, homo stages only) divides the activation component
+        of the row via the profile-fit split model."""
         compat = self.config.strict_compat
         if len(set(stage_types)) == 1:
             bs = plan.gbs // plan.batches // strategy.dp
             mem_type = all_types[0] if compat else stage_types[0]
-            return [self.profiles.get(mem_type, strategy.tp, bs)]
+            if strategy.cp > 1 and not compat:
+                return [self.act_split.layer_memory_with_cp(
+                    mem_type, strategy.tp, bs, strategy.cp)]
+            return [self.profiles.get(mem_type, strategy.tp, bs).layer_memory_mb]
         split_types = list(all_types) if compat else list(stage_types)
         split = self.data_balancer.partition(
             split_types, strategy.dp, strategy.tp, plan.gbs // plan.batches)
         chunks = replica_chunks(stage_types, strategy.dp)
-        profs = []
+        rows = []
         for replica_id, h_bs in enumerate(split):
             mem_type = all_types[0] if compat else chunks[replica_id][0]
             for c in power_of_two_chunks(h_bs):
-                profs.append(self.profiles.get(mem_type, strategy.tp, c))
-        return profs
+                rows.append(self.profiles.get(mem_type, strategy.tp, c).layer_memory_mb)
+        return rows
 
-    def _memory_prefix(self, prof) -> list[float]:
-        key = prof.layer_memory_mb
-        cached = self._prefix_cache.get(key)
+    def _memory_prefix(self, row: tuple[float, ...]) -> list[float]:
+        cached = self._prefix_cache.get(row)
         if cached is None:
-            cached = list(itertools.accumulate(key, initial=0.0))
-            self._prefix_cache[key] = cached
+            cached = list(itertools.accumulate(row, initial=0.0))
+            self._prefix_cache[row] = cached
         return cached
 
     def stage_memory_demand(
@@ -157,9 +163,9 @@ class LayerBalancer:
     ) -> float:
         """Projected stage memory (MB) for layers [start, end)
         (≅ ``_get_stage_memory_demand``, mem_coef included)."""
-        profs = self._stage_memory_profiles(plan, strategy, stage_types, all_types)
+        rows = self._stage_memory_rows(plan, strategy, stage_types, all_types)
         return 0.001 + self.config.mem_coef * sum(
-            p.memory_slice(start, end) for p in profs)
+            sum(row[start:end]) for row in rows)
 
     # -- partitioning ------------------------------------------------------
     def partition(
@@ -178,7 +184,7 @@ class LayerBalancer:
         # then O(#chunks) prefix-sum lookups across all DP probes.
         try:
             stage_prefixes = [
-                [self._memory_prefix(p) for p in self._stage_memory_profiles(
+                [self._memory_prefix(row) for row in self._stage_memory_rows(
                     plan, strategies[s], stage_types[s], ranks)]
                 for s in range(plan.num_stages)
             ]

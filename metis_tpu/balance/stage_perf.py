@@ -69,7 +69,9 @@ class StagePerformanceModel:
             types = ranks[start:end]
             bs = plan.gbs // plan.batches // strat.dp
             if len(set(types)) == 1:
-                t = self.profiles.get(types[0], strat.tp, bs).total_time_ms
+                # Context parallelism shards the sequence: per-device compute
+                # scales ~1/cp (metis_tpu.cost.context_parallel docstring).
+                t = self.profiles.get(types[0], strat.tp, bs).total_time_ms / strat.cp
                 raw.append(1.0 / t)
             else:
                 split = self.data_balancer.partition(

@@ -117,6 +117,7 @@ class PlanCost:
     dp_comm_ms: float = 0.0
     pp_comm_ms: float = 0.0
     batch_gen_ms: float = 0.0
+    cp_comm_ms: float = 0.0  # ring-attention K/V rotation (inside execution_ms)
     oom: bool = False
 
 

@@ -27,6 +27,19 @@ from metis_tpu.core.types import InterStagePlan, Strategy
 from metis_tpu.balance.stage_perf import node_device_types
 
 
+def cp_ring_groups(start: int, strategy: Strategy) -> list[list[int]]:
+    """Rank groups of every context-parallel ring in a stage whose ranks
+    begin at ``start``, laid out (dp, cp, tp) row-major — the single source of
+    truth for the planner's cp rank layout (shared by all bandwidth models
+    and, once cp meshes are emitted, the execution layer)."""
+    width = strategy.cp * strategy.tp
+    return [
+        [start + d * width + c * strategy.tp + t for c in range(strategy.cp)]
+        for d in range(strategy.dp)
+        for t in range(strategy.tp)
+    ]
+
+
 class StageBandwidthModel(Protocol):
     """What the hetero estimator needs: slowest link for a stage's pipeline
     boundary and for its DP rings, in GB/s."""
@@ -34,6 +47,12 @@ class StageBandwidthModel(Protocol):
     def pp_bandwidth(self, stage_id: int) -> float: ...
 
     def dp_bandwidth(self, stage_id: int, strategy: Strategy) -> float: ...
+
+    def cp_bandwidth(self, stage_id: int, strategy: Strategy) -> float:
+        """Slowest link of any ring-attention (context-parallel) ring.  Stage
+        rank layout is (dp, cp, tp) row-major: replica d's cp ring at tp slot t
+        is ranks ``start + d*cp*tp + c*tp + t``."""
+        ...
 
 
 class HeteroScalarBandwidth:
@@ -79,6 +98,12 @@ class HeteroScalarBandwidth:
         for d in range(strategy.dp):
             slowest = min(slowest, self._group_bandwidth(ranks[d::strategy.dp]))
         return slowest
+
+    def cp_bandwidth(self, stage_id: int, strategy: Strategy) -> float:
+        start, _ = self.plan.stage_rank_range(stage_id)
+        return min(
+            self._group_bandwidth(ring)
+            for ring in cp_ring_groups(start, strategy))
 
 
 class HomoScalarBandwidth:

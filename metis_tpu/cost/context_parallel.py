@@ -1,0 +1,163 @@
+"""Context-parallel (ring attention) planning model — net-new TPU capability.
+
+The reference has **no** long-context support: sequence length is a scalar in
+its activation math and no CP/ring/Ulysses variant exists anywhere
+(SURVEY.md §5 "Long-context / sequence parallelism").  This module adds the
+cost and memory model for a context-parallel plan axis: each stage may shard
+the *sequence* dimension over ``Strategy.cp`` devices running ring attention
+(execution counterpart: :mod:`metis_tpu.ops.ring_attention`).
+
+Modeling assumptions (validated against the execution layer, documented here
+because the planner must predict what the executed plan does):
+
+- **Compute** scales ~1/cp.  FFN/projection FLOPs are linear in local sequence
+  length; ring attention computes the full causal attention in ``cp`` block
+  steps of (S/cp x S/cp) scores, so per-device attention FLOPs are also S²/cp.
+- **Ring traffic**: each device rotates its K/V block (2 tensors of
+  ``mbs x S/cp x hidden/tp``) ``cp-1`` times forward; backward re-runs the ring
+  carrying K/V plus accumulated dK/dV — 2 rotations' worth.  Total per layer
+  per microbatch = ``(cp-1) * 3 * kv_block_bytes``.  We charge it un-overlapped
+  (conservative; on real slices XLA/pallas overlap most of it with the block
+  matmuls — the validator's predicted-vs-measured loop is where this constant
+  gets calibrated).
+- **Memory**: sequence sharding divides *activation* memory by cp but leaves
+  weights/optimizer state whole.  Profiles report one per-layer total, so we
+  recover the split from the store's batch-size sweep: per-layer memory is
+  affine in bs (``mem(bs) ~ static + bs * act_slope``) because activations are
+  the only bs-dependent term.  A least-squares fit over the profiled bs points
+  gives (static, slope) per layer; cp memory = ``static + bs * slope / cp``.
+  With fewer than two bs points the split is unidentifiable and we
+  conservatively model **no** memory relief (cp=1 memory), never an optimistic
+  guess.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from metis_tpu.core.config import ModelSpec
+from metis_tpu.profiles.store import ProfileStore
+
+# Ring rotations of the K/V block: 1 forward, ~2 backward (K/V again + dK/dV).
+RING_ROTATIONS = 3
+
+
+def ring_comm_bytes_per_layer(
+    model: ModelSpec, mbs: int, cp: int, tp: int
+) -> float:
+    """Un-overlapped ring-attention wire bytes one device moves per
+    transformer layer per microbatch."""
+    if cp <= 1:
+        return 0.0
+    kv_block = (
+        2  # K and V
+        * mbs
+        * (model.sequence_length // cp)
+        * (model.hidden_size // tp)
+        * model.dtype_bytes
+    )
+    return (cp - 1) * RING_ROTATIONS * kv_block
+
+
+def cp_ring_ms(
+    model: ModelSpec,
+    mbs: int,
+    cp: int,
+    tp: int,
+    num_attn_layers: int,
+    bw_gbps: float,
+) -> float:
+    """Ring-attention comm time (ms) for one microbatch across a stage's
+    attention layers at ``bw_gbps`` per-link ring bandwidth."""
+    if cp <= 1 or num_attn_layers <= 0:
+        return 0.0
+    nbytes = ring_comm_bytes_per_layer(model, mbs, cp, tp) * num_attn_layers
+    return nbytes / (bw_gbps * 1e6)
+
+
+def attention_layer_range(model: ModelSpec, start: int, end: int) -> int:
+    """How many layers in [start, end) are transformer blocks (ring attention
+    runs only there; the embed (0) and head (L-1) pseudo-layers carry none)."""
+    lo = max(start, 1)
+    hi = min(end, model.num_layers - 1)
+    return max(0, hi - lo)
+
+
+class ActivationSplitModel:
+    """Per-layer (static, bs-slope) memory decomposition fit from a profile
+    store's batch-size sweep, cached per (device_type, tp)."""
+
+    def __init__(self, profiles: ProfileStore):
+        self.profiles = profiles
+        self._cache: dict[tuple[str, int], tuple[tuple[float, ...], tuple[float, ...]] | None] = {}
+
+    def split(
+        self, device_type: str, tp: int
+    ) -> tuple[tuple[float, ...], tuple[float, ...]] | None:
+        """(static_mb, act_slope_mb_per_bs) per layer, or None when the store
+        has <2 batch points for this (type, tp) and the split is
+        unidentifiable."""
+        key = (device_type, tp)
+        if key not in self._cache:
+            self._cache[key] = self._fit(device_type, tp)
+        return self._cache[key]
+
+    def _fit(self, device_type: str, tp: int):
+        points = sorted(
+            (bs, self.profiles.get(device_type, tp, bs).layer_memory_mb)
+            for (t, p, bs) in self.profiles.configs(device_type)
+            if t == device_type and p == tp
+        )
+        if len(points) < 2:
+            return None
+        xs = [float(bs) for bs, _ in points]
+        n = len(xs)
+        mean_x = sum(xs) / n
+        var_x = sum((x - mean_x) ** 2 for x in xs)
+        if var_x == 0:
+            return None
+        num_layers = len(points[0][1])
+        static: list[float] = []
+        slope: list[float] = []
+        for layer in range(num_layers):
+            ys = [mem[layer] for _, mem in points]
+            mean_y = sum(ys) / n
+            b = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, ys)) / var_x
+            a = mean_y - b * mean_x
+            # Physical clamps: activations can't be negative; static memory
+            # can't exceed the smallest observed total.
+            b = max(b, 0.0)
+            a = max(min(a, min(ys)), 0.0)
+            static.append(a)
+            slope.append(b)
+        return tuple(static), tuple(slope)
+
+    def layer_memory_with_cp(
+        self, device_type: str, tp: int, bs: int, cp: int
+    ) -> tuple[float, ...]:
+        """Per-layer memory row (MB) under sequence sharding by ``cp``.
+
+        Falls back to the measured cp=1 row (no relief) when the
+        static/activation split cannot be identified.
+        """
+        base = self.profiles.get(device_type, tp, bs).layer_memory_mb
+        if cp <= 1:
+            return base
+        fitted = self.split(device_type, tp)
+        if fitted is None:
+            return base
+        static, slope = fitted
+        return tuple(
+            min(s + bs * m / cp, full)  # never above the measured cp=1 row
+            for s, m, full in zip(static, slope, base)
+        )
+
+
+def cp_candidates(max_cp_degree: int, sequence_length: int) -> list[int]:
+    """Power-of-two cp degrees to search: cp must divide the sequence."""
+    out = []
+    cp = 2
+    while cp <= max_cp_degree:
+        if sequence_length % cp == 0:
+            out.append(cp)
+        cp *= 2
+    return out

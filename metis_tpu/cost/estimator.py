@@ -33,6 +33,7 @@ from metis_tpu.cost.bandwidth import (
     HomoScalarBandwidth,
     StageBandwidthModel,
 )
+from metis_tpu.cost.context_parallel import attention_layer_range, cp_ring_ms
 from metis_tpu.cost.volume import TransformerVolume
 
 
@@ -198,7 +199,10 @@ class HeteroCostEstimator(_EstimatorBase):
         dp, tp = strategy.dp, strategy.tp
         if len(set(stage_types)) == 1:
             bs = plan.gbs // dp // plan.batches
-            return self.profiles.get(stage_types[0], tp, bs).time_slice(start, end)
+            # cp shards the sequence: per-device compute scales ~1/cp (ring
+            # comm is charged separately in get_cost).
+            return (self.profiles.get(stage_types[0], tp, bs)
+                    .time_slice(start, end) / strategy.cp)
         split = self.data_balancer.partition(
             stage_types, dp, tp, plan.gbs // plan.batches)
         chunks = replica_chunks(stage_types, dp)
@@ -230,6 +234,7 @@ class HeteroCostEstimator(_EstimatorBase):
         L = self.volume.num_layers
 
         lens: list[float] = []
+        ring_by_stage: list[float] = []
         dp_costs: list[float] = []
         opt_costs: list[float] = []
         fb_sync = pp_cost = 0.0
@@ -238,25 +243,53 @@ class HeteroCostEstimator(_EstimatorBase):
             r0, r1 = plan.stage_rank_range(stage_id)
             stage_types = ranks[r0:r1]
 
-            lens.append(self._stage_execution_ms(plan, strat, stage_types, start_l, end_l))
-
+            stage_ms = self._stage_execution_ms(
+                plan, strat, stage_types, start_l, end_l)
             mbs = plan.gbs // strat.dp // plan.batches
+            cp_bw = None
+            ring_ms = 0.0
+            if strat.cp > 1:
+                # Ring-attention K/V rotation extends the stage's critical
+                # path (un-overlapped model, cost/context_parallel.py).
+                cp_bw_fn = getattr(bandwidth, "cp_bandwidth", None)
+                cp_bw = (cp_bw_fn(stage_id, strat) if cp_bw_fn is not None
+                         else bandwidth.dp_bandwidth(stage_id, strat))
+                ring_ms = cp_ring_ms(
+                    self.volume.model, mbs, strat.cp, strat.tp,
+                    attention_layer_range(self.volume.model, start_l, end_l),
+                    cp_bw)
+                stage_ms += ring_ms
+            ring_by_stage.append(ring_ms)
+            lens.append(stage_ms)
+
             if stage_id == plan.num_stages - 1:
                 fb_sync = self._fb_sync_ms(stage_types, strat.tp, mbs) * plan.batches
             else:
                 pp_cost += self._pp_cost_ms(
-                    self._activation(end_l, mbs, strat.tp),
+                    self._activation(end_l, mbs, strat.tp) / strat.cp,
                     bandwidth.pp_bandwidth(stage_id))
 
             stage_params = self.volume.stage_parameter_bytes(strat.tp, start_l, end_l)
-            dp_costs.append(self._dp_cost_ms(
-                stage_params, bandwidth.dp_bandwidth(stage_id, strat), strat.dp))
+            # Weights are replicated across cp (ring attention shards only the
+            # sequence), so the gradient all-reduce spans dp*cp ranks; its ring
+            # crosses both the dp and cp group links.
+            sync_degree = strat.dp * strat.cp
+            dp_bw = bandwidth.dp_bandwidth(stage_id, strat)
+            if cp_bw is not None:
+                dp_bw = min(dp_bw, cp_bw)
+            dp_costs.append(self._dp_cost_ms(stage_params, dp_bw, sync_degree))
 
             opt_type = None if self.options.strict_compat else stage_types[0]
             opt_costs.append(
                 self._optimizer_ms(opt_type) / strat.tp * (end_l - start_l) / L)
 
         execution = (plan.batches - 1) * max(lens) + sum(lens)
+        # cp_comm_ms reports exactly the ring traffic's contribution to the
+        # GPipe execution total (the with-ring minus without-ring delta), so
+        # the breakdown fields reconcile for the validator.
+        lens_noring = [l - r for l, r in zip(lens, ring_by_stage)]
+        cp_cost = execution - (
+            (plan.batches - 1) * max(lens_noring) + sum(lens_noring))
         first_stage_type = ranks[0] if ranks else None
         batch_gen = self._batch_gen_ms(plan.batches, first_stage_type)
 
@@ -269,4 +302,5 @@ class HeteroCostEstimator(_EstimatorBase):
             dp_comm_ms=max(dp_costs),
             pp_comm_ms=pp_cost,
             batch_gen_ms=batch_gen,
+            cp_comm_ms=cp_cost,
         )

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from metis_tpu.cluster.tpu import TpuClusterSpec, TpuSliceSpec
 from metis_tpu.core.types import InterStagePlan, Strategy
+from metis_tpu.cost.bandwidth import cp_ring_groups
 
 
 def _bytes_per_ms(bw_gbps: float) -> float:
@@ -108,3 +109,10 @@ class IciDcnBandwidth:
         for d in range(strategy.dp):
             slowest = min(slowest, self._group_bandwidth(ranks[d::strategy.dp]))
         return slowest
+
+    def cp_bandwidth(self, stage_id: int, strategy: Strategy) -> float:
+        """Ring-attention ring bandwidth (rank layout: cp_ring_groups)."""
+        start, _ = self.plan.stage_rank_range(stage_id)
+        return min(
+            self._group_bandwidth(ring)
+            for ring in cp_ring_groups(start, strategy))

@@ -21,12 +21,13 @@ from metis_tpu.core.config import ModelSpec, SearchConfig
 from metis_tpu.core.types import RankedPlan, UniformPlan, PlanCost
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.layers import LayerBalancer
-from metis_tpu.balance.stage_perf import StagePerformanceModel
+from metis_tpu.balance.stage_perf import StagePerformanceModel, rank_device_types
 from metis_tpu.cost.estimator import (
     EstimatorOptions,
     HeteroCostEstimator,
     UniformCostEstimator,
 )
+from metis_tpu.cost.context_parallel import cp_candidates
 from metis_tpu.cost.ici import IciDcnBandwidth
 from metis_tpu.cost.volume import TransformerVolume
 from metis_tpu.search.inter_stage import inter_stage_plans
@@ -87,6 +88,13 @@ def plan_hetero(
     evaluator = StagePerformanceModel(cluster, profiles)
     balancer = LayerBalancer(cluster, profiles, config)
 
+    # Context-parallel families (net-new vs the reference, SURVEY.md §5):
+    # cp=1 is always searched; higher powers of two up to max_cp_degree join
+    # when enabled and the sequence divides evenly.
+    cp_degrees: list[int] = [1]
+    if config.enable_cp and not config.strict_compat:
+        cp_degrees += cp_candidates(config.max_cp_degree, model.sequence_length)
+
     results: list[RankedPlan] = []
     pruned = 0
     for inter in inter_stage_plans(
@@ -97,10 +105,20 @@ def plan_hetero(
         variance=config.min_group_scale_variance,
         max_permute_len=config.max_permute_len,
     ):
+        cp_eligible = None
+        if len(cp_degrees) > 1:
+            # Ring attention needs uniform block timing: only homogeneous
+            # stages take the cp axis.  One placement resolve per inter plan.
+            ranks = rank_device_types(cluster, inter.node_sequence)
+            cp_eligible = [
+                len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
+                for s in range(inter.num_stages)
+            ]
         try:
             for intra in intra_stage_plans(
                 inter, evaluator, balancer,
                 max_tp=config.max_profiled_tp, max_bs=config.max_profiled_bs,
+                cp_degrees=cp_degrees, cp_eligible=cp_eligible,
             ):
                 try:
                     cost = estimator.get_cost(

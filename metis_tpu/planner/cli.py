@@ -49,6 +49,10 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--max-permute-len", type=int, default=6)
     g.add_argument("--strict-compat", action="store_true",
                    help="reproduce reference cost-model quirks bit-for-bit")
+    g.add_argument("--enable-cp", action="store_true",
+                   help="search context-parallel (ring attention) plan families")
+    g.add_argument("--max-cp", type=int, default=4,
+                   help="largest context-parallel degree to search")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
 
@@ -78,6 +82,8 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         min_group_scale_variance=args.variance,
         max_permute_len=args.max_permute_len,
         strict_compat=args.strict_compat,
+        enable_cp=args.enable_cp,
+        max_cp_degree=args.max_cp,
     )
 
 

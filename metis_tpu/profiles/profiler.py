@@ -155,11 +155,16 @@ class LayerProfiler:
         gradients, mirroring the per-layer fwd+bwd the reference profiles with
         torch hooks (``README.md:152-163``)."""
 
-        def embed_fb(params, tokens):
-            def f(p):
-                return embed(p, tokens, cfg).astype(jnp.float32).sum()
+        def embed_fb(embed_params, tokens):
+            # Close over ONLY the embed subtree: differentiating the full
+            # params tree would count every block's parameters as compiled-
+            # program arguments plus a whole-model-sized zero gradient tree in
+            # XLA's memory analysis, inflating this layer's memory row by
+            # ~2x total model bytes.
+            def f(ep):
+                return embed({"embed": ep}, tokens, cfg).astype(jnp.float32).sum()
 
-            return jax.value_and_grad(f)(params)
+            return jax.value_and_grad(f)(embed_params)
 
         def block_fb(layer, x):
             def f(layer, x):
@@ -171,14 +176,15 @@ class LayerProfiler:
 
             return jax.value_and_grad(f, argnums=(0, 1))(layer, x)
 
-        def head_fb(params, x, targets):
-            def f(p, x):
-                logits = head_logits(p, x, cfg)
+        def head_fb(head_params, x, targets):
+            # Same subtree isolation as embed_fb.
+            def f(hp, x):
+                logits = head_logits({"head": hp}, x, cfg)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 picked = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
                 return -picked.mean()
 
-            return jax.value_and_grad(f, argnums=(0, 1))(params, x)
+            return jax.value_and_grad(f, argnums=(0, 1))(head_params, x)
 
         return embed_fb, block_fb, head_fb
 
@@ -204,13 +210,14 @@ class LayerProfiler:
             layer0 = jax.tree.map(lambda a: a[0], params["blocks"])
             embed_fb, block_fb, head_fb = self._make_layer_fns(cfg)
 
-            j_embed = _aot_compile(embed_fb, (params, tokens))
+            embed_p, head_p = params["embed"], params["head"]
+            j_embed = _aot_compile(embed_fb, (embed_p, tokens))
             j_block = _aot_compile(block_fb, (layer0, x))
-            j_head = _aot_compile(head_fb, (params, x, tokens))
+            j_head = _aot_compile(head_fb, (head_p, x, tokens))
             w, it = self.config.warmup, self.config.iters
-            embed_ms = _median_ms(j_embed, (params, tokens), w, it)
+            embed_ms = _median_ms(j_embed, (embed_p, tokens), w, it)
             block_ms = _median_ms(j_block, (layer0, x), w, it)
-            head_ms = _median_ms(j_head, (params, x, tokens), w, it)
+            head_ms = _median_ms(j_head, (head_p, x, tokens), w, it)
 
             # Whole-model fwd+bwd — the ground truth the per-layer
             # decomposition must sum to (see module docstring).

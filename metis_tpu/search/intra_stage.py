@@ -58,9 +58,29 @@ class LayerPartitioner(Protocol):
     ) -> PartitionResult: ...
 
 
-def initial_strategies(plan: InterStagePlan) -> tuple[Strategy, ...]:
-    """Every stage starts fully data-parallel (``plan.py:231-236``)."""
-    return tuple(Strategy(dp=g, tp=1) for g in plan.device_groups)
+def initial_strategies(
+    plan: InterStagePlan, cp: int = 1, cp_eligible: Sequence[bool] | None = None
+) -> tuple[Strategy, ...] | None:
+    """Every stage starts fully data-parallel (``plan.py:231-236``).
+
+    With ``cp > 1`` each eligible stage dedicates a cp-sized sub-axis to ring
+    attention (dp = group/cp, tp = 1); ineligible stages (heterogeneous device
+    mix — ring attention needs uniform block timing) stay cp=1.  Returns None
+    when no stage can actually take the cp axis (degenerate family — identical
+    to the cp=1 search).
+    """
+    if cp <= 1:
+        return tuple(Strategy(dp=g, tp=1) for g in plan.device_groups)
+    out = []
+    any_cp = False
+    for stage_id, g in enumerate(plan.device_groups):
+        eligible = cp_eligible is None or cp_eligible[stage_id]
+        if eligible and g % cp == 0 and g >= cp:
+            out.append(Strategy(dp=g // cp, tp=1, cp=cp))
+            any_cp = True
+        else:
+            out.append(Strategy(dp=g, tp=1))
+    return tuple(out) if any_cp else None
 
 
 def strategies_valid(
@@ -105,24 +125,33 @@ def intra_stage_plans(
     partitioner: LayerPartitioner,
     max_tp: int,
     max_bs: int,
+    cp_degrees: Sequence[int] = (1,),
+    cp_eligible: Sequence[bool] | None = None,
 ) -> Iterator[IntraStagePlan]:
-    """Yield feasible intra-stage plans for one inter-stage candidate."""
-    strategies: tuple[Strategy, ...] | None = initial_strategies(plan)
-    memory_state: tuple[float, ...] | None = None
+    """Yield feasible intra-stage plans for one inter-stage candidate.
 
-    while strategies is not None:
-        if strategies_valid(plan, strategies, max_tp, max_bs):
-            capacity = evaluator.memory_capacity(plan)
-            performance = evaluator.compute_performance(plan, strategies)
-            result = partitioner.partition(plan, strategies, performance, capacity)
-            memory_state = result.memory_state
-            if result.partition is not None:
-                yield IntraStagePlan(
-                    strategies=strategies,
-                    layer_partition=result.partition,
-                    memory_state=result.memory_state or (),
-                    num_repartition=result.attempts,
-                )
-                if result.attempts == 1:
-                    return
-        strategies = escalate_dp_to_tp(strategies, memory_state)
+    ``cp_degrees`` extends the reference's (dp, tp) space with context-parallel
+    families (net-new, SURVEY.md §5): for each degree the same escalation runs
+    with a cp axis carved out of every eligible stage.  The cost estimator
+    ranks the families against each other.
+    """
+    for cp in cp_degrees:
+        strategies = initial_strategies(plan, cp, cp_eligible)
+        memory_state: tuple[float, ...] | None = None
+
+        while strategies is not None:
+            if strategies_valid(plan, strategies, max_tp, max_bs):
+                capacity = evaluator.memory_capacity(plan)
+                performance = evaluator.compute_performance(plan, strategies)
+                result = partitioner.partition(plan, strategies, performance, capacity)
+                memory_state = result.memory_state
+                if result.partition is not None:
+                    yield IntraStagePlan(
+                        strategies=strategies,
+                        layer_partition=result.partition,
+                        memory_state=result.memory_state or (),
+                        num_repartition=result.attempts,
+                    )
+                    if result.attempts == 1:
+                        break  # this cp family is satisfied; try the next
+            strategies = escalate_dp_to_tp(strategies, memory_state)

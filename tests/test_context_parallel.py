@@ -1,0 +1,201 @@
+"""Context-parallel (ring attention) planning — cost model, memory split,
+search integration.  Net-new TPU capability (SURVEY.md §5: the reference has
+no long-context support of any kind)."""
+import pytest
+
+from metis_tpu.cluster import ClusterSpec, DeviceSpec, TpuClusterSpec, slice_from_name
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.types import InterStagePlan, Strategy
+from metis_tpu.cost.context_parallel import (
+    ActivationSplitModel,
+    attention_layer_range,
+    cp_candidates,
+    cp_ring_ms,
+    ring_comm_bytes_per_layer,
+)
+from metis_tpu.cost import (
+    EstimatorOptions,
+    HeteroCostEstimator,
+    HeteroScalarBandwidth,
+    IciDcnBandwidth,
+    TransformerVolume,
+)
+from metis_tpu.planner import plan_hetero, plan_tpu
+from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_test_model()
+
+
+@pytest.fixture(scope="module")
+def profiles(model):
+    return synthesize_profiles(
+        model, ["tpu_v5e"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return ClusterSpec.of(
+        ("tpu_v5e", 2, 4),
+        overrides={"tpu_v5e": DeviceSpec("tpu_v5e", 16, 90, 25)})
+
+
+class TestRingCommModel:
+    def test_cp1_is_free(self, model):
+        assert ring_comm_bytes_per_layer(model, 4, 1, 1) == 0.0
+        assert cp_ring_ms(model, 4, 1, 1, 8, 90.0) == 0.0
+
+    def test_volume_formula(self, model):
+        # cp=4, tp=2: K/V block = 2 * mbs * (S/4) * (H/2) * dtype; 3 rotations
+        # per of the cp-1 steps.
+        got = ring_comm_bytes_per_layer(model, mbs=2, cp=4, tp=2)
+        kv = 2 * 2 * (model.sequence_length // 4) * (model.hidden_size // 2) * 2
+        assert got == 3 * 3 * kv
+
+    def test_ring_time_scales_inverse_bandwidth(self, model):
+        slow = cp_ring_ms(model, 2, 2, 1, 8, 45.0)
+        fast = cp_ring_ms(model, 2, 2, 1, 8, 90.0)
+        assert slow == pytest.approx(2 * fast)
+
+    def test_attention_layer_range_excludes_embed_head(self, model):
+        L = model.num_layers
+        assert attention_layer_range(model, 0, L) == L - 2
+        assert attention_layer_range(model, 0, 1) == 0     # embed only
+        assert attention_layer_range(model, L - 1, L) == 0  # head only
+        assert attention_layer_range(model, 1, 3) == 2
+
+    def test_cp_candidates_divide_sequence(self):
+        assert cp_candidates(8, 1024) == [2, 4, 8]
+        assert cp_candidates(8, 6) == [2]   # 4 does not divide 6
+        assert cp_candidates(1, 1024) == []
+
+
+class TestActivationSplit:
+    def test_fit_recovers_affine_memory(self, profiles, model):
+        # synthetic profiles are exactly affine in bs at fixed (type, tp)
+        split = ActivationSplitModel(profiles).split("tpu_v5e", 1)
+        assert split is not None
+        static, slope = split
+        m1 = profiles.get("tpu_v5e", 1, 1).layer_memory_mb
+        m4 = profiles.get("tpu_v5e", 1, 4).layer_memory_mb
+        for layer in range(model.num_layers):
+            assert static[layer] + slope[layer] == pytest.approx(m1[layer], rel=1e-6)
+            assert static[layer] + 4 * slope[layer] == pytest.approx(m4[layer], rel=1e-6)
+
+    def test_cp_memory_between_static_and_full(self, profiles):
+        asm = ActivationSplitModel(profiles)
+        full = profiles.get("tpu_v5e", 1, 8).layer_memory_mb
+        halved = asm.layer_memory_with_cp("tpu_v5e", 1, 8, 2)
+        static, slope = asm.split("tpu_v5e", 1)
+        for layer in range(len(full)):
+            assert halved[layer] <= full[layer] + 1e-9
+            assert halved[layer] == pytest.approx(
+                static[layer] + 8 * slope[layer] / 2, rel=1e-6)
+
+    def test_single_bs_point_falls_back_to_no_relief(self, model):
+        lone = synthesize_profiles(model, ["tpu_v5e"], tps=[1], bss=[4])
+        asm = ActivationSplitModel(lone)
+        assert asm.split("tpu_v5e", 1) is None
+        assert asm.layer_memory_with_cp("tpu_v5e", 1, 4, 4) == \
+            lone.get("tpu_v5e", 1, 4).layer_memory_mb
+
+
+class TestCpCostEstimation:
+    def _cost(self, cluster, profiles, model, strategies, bandwidth=None):
+        volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
+        est = HeteroCostEstimator(
+            cluster, profiles, volume, EstimatorOptions(), bandwidth)
+        plan = InterStagePlan(
+            node_sequence=("tpu_v5e",), device_groups=(8,), batches=4, gbs=32)
+        return est.get_cost(plan, strategies, (0, model.num_layers))
+
+    def test_cp_halves_compute_adds_ring(self, cluster, profiles, model):
+        base = self._cost(cluster, profiles, model, (Strategy(dp=8, tp=1),))
+        cp2 = self._cost(cluster, profiles, model, (Strategy(dp=4, tp=1, cp=2),))
+        assert cp2.cp_comm_ms > 0
+        assert base.cp_comm_ms == 0
+        assert cp2.execution_ms < base.execution_ms
+        # exact decomposition: single stage, 4 microbatches => execution =
+        # 4 * (profiled_compute(mbs=2) / cp + ring); cp_comm_ms is the ring's
+        # share of that total.
+        compute = profiles.get("tpu_v5e", 1, 2).total_time_ms
+        assert cp2.execution_ms == pytest.approx(
+            4 * compute / 2 + cp2.cp_comm_ms, rel=1e-9)
+
+    def test_cp_gradient_sync_spans_cp_axis(self, cluster, profiles, model):
+        # dp=1, cp=8: weights replicated across all 8 ranks => gradient
+        # all-reduce must NOT be free.
+        cp8 = self._cost(cluster, profiles, model, (Strategy(dp=1, tp=1, cp=8),))
+        assert cp8.dp_comm_ms > 0
+        # exact: ring all-reduce over 8 ranks at the cp ring's bandwidth
+        # (the 8-rank ring spans both 4-chip nodes => inter bw = 25 GB/s)
+        volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
+        params = volume.stage_parameter_bytes(1, 0, model.num_layers)
+        assert cp8.dp_comm_ms == pytest.approx(
+            2 * 7 / 8 * params / (25 * 1e6), rel=1e-9)
+
+    def test_cp_on_tpu_ici_model(self, profiles, model):
+        tpu = TpuClusterSpec(slices=(slice_from_name("v5e-8"),))
+        plan = InterStagePlan(
+            node_sequence=("tpu_v5e",), device_groups=(8,), batches=4, gbs=32)
+        bw = IciDcnBandwidth(tpu, plan)
+        assert bw.cp_bandwidth(0, Strategy(dp=4, tp=1, cp=2)) > 0
+        cluster = tpu.as_cluster_spec(chips_per_node=4)
+        cost = self._cost(
+            cluster, profiles, model, (Strategy(dp=4, tp=1, cp=2),),
+            bandwidth=lambda p: IciDcnBandwidth(tpu, p))
+        assert cost.cp_comm_ms > 0
+
+
+class TestCpSearch:
+    def test_enable_cp_yields_cp_families(self, cluster, profiles, model):
+        cfg = SearchConfig(gbs=32, enable_cp=True, max_cp_degree=4)
+        result = plan_hetero(cluster, profiles, model, cfg)
+        cps = {s.cp for p in result.plans for s in p.intra.strategies}
+        assert 1 in cps
+        assert any(c > 1 for c in cps), "cp families missing from search"
+        # every plan's stage device counts still cover the group
+        for p in result.plans:
+            for g, s in zip(p.inter.device_groups, p.intra.strategies):
+                assert s.dp * s.tp * s.cp == g
+
+    def test_cp_disabled_by_default(self, cluster, profiles, model):
+        result = plan_hetero(cluster, profiles, model, SearchConfig(gbs=32))
+        assert all(
+            s.cp == 1 for p in result.plans for s in p.intra.strategies)
+
+    def test_cp_search_on_tpu_cluster(self, profiles, model):
+        tpu = TpuClusterSpec(slices=(slice_from_name("v5e-8"),))
+        cfg = SearchConfig(gbs=32, enable_cp=True, max_cp_degree=2)
+        result = plan_tpu(tpu, profiles, model, cfg)
+        assert result.num_costed > 0
+        cps = {s.cp for p in result.plans for s in p.intra.strategies}
+        assert any(c > 1 for c in cps)
+
+    def test_hetero_stages_stay_cp1(self, model):
+        profiles = synthesize_profiles(
+            model, ["tpu_v5e", "tpu_v4"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16])
+        cluster = ClusterSpec.of(
+            ("tpu_v5e", 1, 4), ("tpu_v4", 1, 4),
+            overrides={
+                "tpu_v5e": DeviceSpec("tpu_v5e", 16, 90, 25),
+                "tpu_v4": DeviceSpec("tpu_v4", 32, 90, 25),
+            })
+        cfg = SearchConfig(gbs=32, enable_cp=True, max_cp_degree=4)
+        result = plan_hetero(cluster, profiles, model, cfg)
+        for p in result.plans:
+            for stage_id, strat in enumerate(p.intra.strategies):
+                r0, r1 = p.inter.stage_rank_range(stage_id)
+                # mixed-type stage => no cp
+                types = set()
+                acc = 0
+                for t in p.inter.node_sequence:
+                    n = 4
+                    for r in range(acc, acc + n):
+                        if r0 <= r < r1:
+                            types.add(t)
+                    acc += n
+                if len(types) > 1:
+                    assert strat.cp == 1
