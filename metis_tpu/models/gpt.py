@@ -43,6 +43,9 @@ class GPTConfig:
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     remat: bool = False
+    # default attention when no attn_impl is passed: "dense" (materialized
+    # scores) or "flash" (pallas blockwise kernel, metis_tpu.ops.flash_attention)
+    attn: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -136,6 +139,16 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndar
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
+def default_attention(cfg: GPTConfig) -> AttnFn:
+    """Resolve ``cfg.attn`` to an AttnFn."""
+    if cfg.attn == "flash":
+        from metis_tpu.ops.flash_attention import flash_attn_fn
+        return flash_attn_fn()
+    if cfg.attn != "dense":
+        raise ValueError(f"unknown GPTConfig.attn: {cfg.attn!r}")
+    return causal_attention
+
+
 def block_forward(
     x: jnp.ndarray, layer: dict, cfg: GPTConfig, attn_impl: AttnFn
 ) -> jnp.ndarray:
@@ -187,7 +200,7 @@ def run_blocks(
     """Scan the (optionally sliced) stacked blocks over the activations.
     ``block_slice`` selects blocks [i, j) — how pipeline stages take their
     share of the stack."""
-    attn = attn_impl or causal_attention
+    attn = attn_impl or default_attention(cfg)
     blocks = params["blocks"]
     if block_slice is not None:
         i, j = block_slice

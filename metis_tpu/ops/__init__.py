@@ -1,6 +1,23 @@
+from metis_tpu.ops.flash_attention import (
+    dense_causal_attention,
+    finalize_stats,
+    flash_attention,
+    flash_attention_stats,
+    flash_attn_fn,
+    merge_stats,
+)
 from metis_tpu.ops.ring_attention import (
     make_ring_attention,
     ring_attention_local,
 )
 
-__all__ = ["make_ring_attention", "ring_attention_local"]
+__all__ = [
+    "dense_causal_attention",
+    "finalize_stats",
+    "flash_attention",
+    "flash_attention_stats",
+    "flash_attn_fn",
+    "merge_stats",
+    "make_ring_attention",
+    "ring_attention_local",
+]

@@ -1,0 +1,270 @@
+"""Pallas TPU flash attention — blockwise causal attention for the MXU.
+
+Net-new TPU capability (the reference executes nothing — SURVEY.md §0; its
+attention exists only as profiled milliseconds).  This is the hot-op kernel for
+the execution layer: O(seq) HBM traffic instead of materializing the
+[seq, seq] score matrix, with the streaming-softmax accumulators living in
+VMEM scratch across the KV-block grid dimension.
+
+Kernel shape (canonical TPU flash attention):
+- grid = (batch*heads, q_blocks, kv_blocks); the last grid dimension iterates
+  fastest and sequentially on TPU, so (m, l, acc) scratch carries across KV
+  blocks of one Q block;
+- causal skip: KV blocks entirely in the future of a Q block are predicated
+  off with ``pl.when`` — ~2x fewer MXU passes at long sequence;
+- scores/accumulation in fp32 (``preferred_element_type``), inputs may be
+  bf16; output cast back to the query dtype.
+
+Differentiation: ``flash_attention`` carries a ``jax.custom_vjp`` whose
+backward recomputes attention with the dense jnp reference and differentiates
+that — numerically consistent with the forward to fp32 rounding, O(seq^2)
+memory only inside the backward of one head-batch.  A fully-blockwise pallas
+backward is a later optimization; the forward is where inference and
+activation-recompute training spend their time.
+
+``flash_attention_stats`` returns the *unnormalized* accumulator plus the
+running (m, l) softmax state, which makes the kernel composable into ring
+attention: two KV-shards' states merge with the same online-softmax algebra
+(see ``merge_stats`` and tests/test_flash_attention.py).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative mask value; -inf would make exp(m-m) = nan
+
+
+def _pick_block(size: int, target: int) -> int | None:
+    """Largest divisor of ``size`` that is <= target and a multiple of 8
+    (fp32 sublane tile), or None if none exists (caller falls back)."""
+    for b in range(min(target, size), 7, -1):
+        if size % b == 0 and b % 8 == 0:
+            return b
+    return None
+
+
+def dense_causal_attention(q, k, v):
+    """Reference dense causal attention ([b, h, s, d]); also the recompute
+    body of the flash backward pass."""
+    seq_q, seq_k = q.shape[2], k.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(q.shape[-1])
+    mask = jnp.tril(jnp.ones((seq_q, seq_k), bool))
+    scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(q.dtype), v)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+               m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q,
+               block_kv, kv_steps, normalize):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    # causal block skip: KV block strictly in the future of every row of the
+    # Q block contributes nothing
+    run = (ki * block_kv < (qi + 1) * block_q) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:]                               # [bq, LANES] lane-replicated
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(ki == kv_steps - 1)
+    def _finalize():
+        if normalize:
+            l = l_scr[:, :1]
+            o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+                o_ref.dtype)
+        else:
+            o_ref[0] = acc_scr[:].astype(o_ref.dtype)
+        if m_out_ref is not None:
+            m_out_ref[0] = m_scr[:, :1].T   # [bq, 1] -> [1, bq] row
+            l_out_ref[0] = l_scr[:, :1].T
+
+
+_LANES = 128  # lane-replicated scratch width for the (m, l) running stats
+
+
+def _fa_call(q, k, v, causal, block_q, block_kv, interpret, normalize,
+             return_stats):
+    """q, k, v: [bh, s, d] (heads already folded into the leading dim)."""
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    kv_steps = s_kv // block_kv
+    grid = (bh, s_q // block_q, kv_steps)
+
+    kernel = partial(
+        _fa_kernel, sm_scale=1.0 / math.sqrt(d), causal=causal,
+        block_q=block_q, block_kv=block_kv, kv_steps=kv_steps,
+        normalize=normalize)
+    if not return_stats:
+        kernel = lambda qr, kr, vr, orf, ms, ls, accs: _fa_kernel(  # noqa: E731
+            qr, kr, vr, orf, None, None, ms, ls, accs,
+            sm_scale=1.0 / math.sqrt(d), causal=causal, block_q=block_q,
+            block_kv=block_kv, kv_steps=kv_steps, normalize=normalize)
+
+    out_shape = [jax.ShapeDtypeStruct((bh, s_q, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
+    if return_stats:
+        # stats laid out [bh, q_blocks, block_q]: one lane-aligned row per
+        # finalized Q block
+        stat_shape = jax.ShapeDtypeStruct(
+            (bh, s_q // block_q, block_q), jnp.float32)
+        out_shape += [stat_shape, stat_shape]
+        out_specs += [pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, i, 0))] * 2
+
+    res = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return res if return_stats else res[0]
+
+
+def _shapes_supported(q, k, block_q, block_kv):
+    bh_q, s_q, d = q.shape[0] * q.shape[1], q.shape[2], q.shape[3]
+    s_kv = k.shape[2]
+    bq = _pick_block(s_q, block_q)
+    bkv = _pick_block(s_kv, block_kv)
+    if bq is None or bkv is None or d % 8 != 0:
+        return None
+    return bq, bkv
+
+
+def _fold(t):  # [b, h, s, d] -> [b*h, s, d]
+    b, h, s, d = t.shape
+    return t.reshape(b * h, s, d)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, bq, bkv, interpret):
+    b, h = q.shape[:2]
+    out = _fa_call(_fold(q), _fold(k), _fold(v), causal, bq, bkv,
+                   interpret, normalize=True, return_stats=False)
+    return out.reshape(b, h, *out.shape[1:])
+
+
+def _flash_fwd(q, k, v, causal, bq, bkv, interpret):
+    return _flash(q, k, v, causal, bq, bkv, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, bq, bkv, interpret, residuals, g):
+    q, k, v = residuals
+    ref = dense_causal_attention if causal else _dense_full_attention
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+def _dense_full_attention(q, k, v):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(q.shape[-1])
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(q.dtype), v)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, block_q=128, block_kv=128,
+                    interpret=False):
+    """Blockwise attention on [b, h, s, d] inputs; differentiable.
+
+    Falls back to the dense jnp path when shapes don't tile (seq without a
+    multiple-of-8 divisor, or head_dim not a multiple of 8) so callers can use
+    it unconditionally as an ``AttnFn``.
+    """
+    blocks = _shapes_supported(q, k, block_q, block_kv)
+    if blocks is None:
+        return dense_causal_attention(q, k, v) if causal else \
+            _dense_full_attention(q, k, v)
+    return _flash(q, k, v, causal, blocks[0], blocks[1], interpret)
+
+
+def flash_attention_stats(q, k, v, *, causal=False, block_q=128,
+                          block_kv=128, interpret=False):
+    """Forward-only blockwise attention returning the raw online-softmax
+    state ``(acc, m, l)``: acc [b, h, s, d] fp32 *unnormalized*, m and l
+    [b, h, s] fp32.  States from disjoint KV shards merge with
+    ``merge_stats`` — the building block for a pallas ring attention.
+    """
+    blocks = _shapes_supported(q, k, block_q, block_kv)
+    if blocks is None:
+        raise ValueError(f"shapes not tileable for pallas: {q.shape}")
+    bq, bkv = blocks
+    b, h = q.shape[:2]
+    acc, m, l = _fa_call(_fold(q), _fold(k), _fold(v), causal, bq, bkv,
+                         interpret, normalize=False, return_stats=True)
+    acc = acc.astype(jnp.float32).reshape(b, h, *acc.shape[1:])
+    m = m.reshape(b, h, -1)
+    l = l.reshape(b, h, -1)
+    return acc, m, l
+
+
+def merge_stats(state_a, state_b):
+    """Fold two online-softmax states (acc, m, l) over disjoint KV sets into
+    one — the associative combine of blockwise attention."""
+    acc_a, m_a, l_a = state_a
+    acc_b, m_b, l_b = state_b
+    m = jnp.maximum(m_a, m_b)
+    wa, wb = jnp.exp(m_a - m), jnp.exp(m_b - m)
+    acc = acc_a * wa[..., None] + acc_b * wb[..., None]
+    return acc, m, l_a * wa + l_b * wb
+
+
+def finalize_stats(state):
+    """(acc, m, l) -> normalized attention output."""
+    acc, _, l = state
+    return acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+
+
+def flash_attn_fn(*, interpret=False, block_q=128, block_kv=128):
+    """An ``AttnFn`` (q, k, v -> context) for models.gpt, causal."""
+    def attn(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=block_q,
+                               block_kv=block_kv, interpret=interpret)
+    return attn

@@ -114,22 +114,27 @@ def plan_hetero(
                 len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
                 for s in range(inter.num_stages)
             ]
-        try:
-            for intra in intra_stage_plans(
-                inter, evaluator, balancer,
-                max_tp=config.max_profiled_tp, max_bs=config.max_profiled_bs,
-                cp_degrees=cp_degrees, cp_eligible=cp_eligible,
-            ):
-                try:
-                    cost = estimator.get_cost(
-                        inter, intra.strategies, intra.layer_partition)
-                except KeyError:
-                    pruned += 1
-                    continue
-                results.append(RankedPlan(inter=inter, intra=intra, cost=cost))
-        except KeyError:
-            # profile miss inside stage evaluation: prune the candidate family
-            pruned += 1
+        # one try-block per cp family: a profile miss mid-generation prunes
+        # only that family, not the sibling cp degrees of this inter plan
+        for cp in cp_degrees:
+            try:
+                for intra in intra_stage_plans(
+                    inter, evaluator, balancer,
+                    max_tp=config.max_profiled_tp,
+                    max_bs=config.max_profiled_bs,
+                    cp_degrees=(cp,), cp_eligible=cp_eligible,
+                ):
+                    try:
+                        cost = estimator.get_cost(
+                            inter, intra.strategies, intra.layer_partition)
+                    except KeyError:
+                        pruned += 1
+                        continue
+                    results.append(
+                        RankedPlan(inter=inter, intra=intra, cost=cost))
+            except KeyError:
+                # profile miss inside stage evaluation: prune this family
+                pruned += 1
 
     results.sort(key=lambda r: r.cost.total_ms)
     num_costed = len(results)

@@ -75,7 +75,7 @@ def initial_strategies(
     any_cp = False
     for stage_id, g in enumerate(plan.device_groups):
         eligible = cp_eligible is None or cp_eligible[stage_id]
-        if eligible and g % cp == 0 and g >= cp:
+        if eligible and g % cp == 0:
             out.append(Strategy(dp=g // cp, tp=1, cp=cp))
             any_cp = True
         else:
