@@ -26,13 +26,14 @@ import itertools
 from typing import Callable, Sequence
 
 from metis_tpu.cluster.spec import ClusterSpec
-from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.config import ModelSpec, SearchConfig
 from metis_tpu.core.errors import ProfileMissError
 from metis_tpu.core.types import InterStagePlan, Strategy
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
 from metis_tpu.balance.stage_perf import rank_device_types
 from metis_tpu.cost.context_parallel import ActivationSplitModel
+from metis_tpu.cost.expert_parallel import layer_memory_with_ep
 from metis_tpu.search.intra_stage import PartitionResult
 
 
@@ -99,10 +100,14 @@ class LayerBalancer:
         cluster: ClusterSpec,
         profiles: ProfileStore,
         config: SearchConfig,
+        model: ModelSpec | None = None,
     ):
         self.cluster = cluster
         self.profiles = profiles
         self.config = config
+        # ModelSpec is only needed for expert-parallel memory relief
+        # (expert fraction is analytic); without it ep plans get no relief.
+        self.model = model
         self.data_balancer = DataBalancer(profiles)
         self.act_split = ActivationSplitModel(profiles)
         self._prefix_cache: dict[tuple, list[float]] = {}
@@ -130,6 +135,10 @@ class LayerBalancer:
         if len(set(stage_types)) == 1:
             bs = plan.gbs // plan.batches // strategy.dp
             mem_type = all_types[0] if compat else stage_types[0]
+            if strategy.ep > 1 and not compat and self.model is not None:
+                return [layer_memory_with_ep(
+                    self.act_split, self.model, mem_type, strategy.tp, bs,
+                    strategy.ep, strategy.cp)]
             if strategy.cp > 1 and not compat:
                 return [self.act_split.layer_memory_with_cp(
                     mem_type, strategy.tp, bs, strategy.cp)]

@@ -26,12 +26,20 @@ class ModelSpec:
     num_heads: int
     ffn_multiplier: int = 4
     dtype_bytes: int = 2  # bf16 activations — the TPU-native default
+    # MoE shape (0 experts = dense model; no reference counterpart —
+    # SURVEY.md §2.2 "EP — Absent"):
+    num_experts: int = 0
+    expert_top_k: int = 1
 
     def __post_init__(self) -> None:
         if self.num_layers < 3:
             raise ValueError("num_layers must include embed + >=1 block + head")
         if self.hidden_size % self.num_heads != 0:
             raise ValueError("num_heads must divide hidden_size evenly")
+        if self.num_experts < 0 or self.expert_top_k < 1:
+            raise ValueError("invalid MoE shape")
+        if self.num_experts > 0 and self.expert_top_k > self.num_experts:
+            raise ValueError("expert_top_k cannot exceed num_experts")
 
     @property
     def head_dim(self) -> int:
@@ -73,6 +81,8 @@ class SearchConfig:
     enable_sp: bool = False  # add sequence-parallel variants to the plan space
     enable_cp: bool = False  # add context-parallel (ring attention) variants
     max_cp_degree: int = 1
+    enable_ep: bool = False  # add expert-parallel (MoE) variants
+    max_ep_degree: int = 1
 
     def __post_init__(self) -> None:
         if self.gbs < 1:

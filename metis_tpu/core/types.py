@@ -22,11 +22,13 @@ from typing import Iterator, Sequence
 class Strategy:
     """Intra-stage parallelization of one pipeline stage.
 
-    ``dp * tp`` must equal the stage's device-group size.  ``sp`` is
+    ``dp * tp * cp`` must equal the stage's device-group size.  ``sp`` is
     Megatron-style sequence parallelism riding the tp axis (degree shared with
     tp); ``cp`` is context parallelism (ring attention) over a dedicated mesh
-    axis; ``ep`` is expert parallelism.  The reference plans only (dp, tp)
-    tuples (``plan.py:34``).
+    axis; ``ep`` is Megatron-style expert parallelism riding *inside* the data
+    ranks — experts shard over ep-sized sub-groups of the dp*cp axis, so ep
+    must divide dp and consumes no extra devices.  The reference plans only
+    (dp, tp) tuples (``plan.py:34``).
     """
 
     dp: int
@@ -37,7 +39,7 @@ class Strategy:
 
     @property
     def devices(self) -> int:
-        return self.dp * self.tp * self.cp * self.ep
+        return self.dp * self.tp * self.cp
 
     def as_tuple(self) -> tuple[int, int]:
         return (self.dp, self.tp)
@@ -118,6 +120,7 @@ class PlanCost:
     pp_comm_ms: float = 0.0
     batch_gen_ms: float = 0.0
     cp_comm_ms: float = 0.0  # ring-attention K/V rotation (inside execution_ms)
+    ep_comm_ms: float = 0.0  # MoE all-to-all dispatch/combine (inside execution_ms)
     oom: bool = False
 
 

@@ -131,25 +131,38 @@ class ActivationSplitModel:
             slope.append(b)
         return tuple(static), tuple(slope)
 
-    def layer_memory_with_cp(
-        self, device_type: str, tp: int, bs: int, cp: int
+    def layer_memory(
+        self,
+        device_type: str,
+        tp: int,
+        bs: int,
+        act_divisor: float = 1.0,
+        static_scale: Sequence[float] | None = None,
     ) -> tuple[float, ...]:
-        """Per-layer memory row (MB) under sequence sharding by ``cp``.
-
-        Falls back to the measured cp=1 row (no relief) when the
-        static/activation split cannot be identified.
-        """
+        """Per-layer memory row (MB) with the activation component divided by
+        ``act_divisor`` (sequence/context sharding) and the static component
+        scaled per layer by ``static_scale`` (weight sharding, e.g. expert
+        parallelism).  Falls back to the measured full row (no relief) when
+        the static/activation split cannot be identified — conservative,
+        never optimistic."""
         base = self.profiles.get(device_type, tp, bs).layer_memory_mb
-        if cp <= 1:
+        if act_divisor <= 1 and static_scale is None:
             return base
         fitted = self.split(device_type, tp)
         if fitted is None:
             return base
         static, slope = fitted
+        scales = static_scale if static_scale is not None else [1.0] * len(base)
         return tuple(
-            min(s + bs * m / cp, full)  # never above the measured cp=1 row
-            for s, m, full in zip(static, slope, base)
+            min(s * sc + bs * m / act_divisor, full)  # never above measured
+            for s, m, sc, full in zip(static, slope, scales, base)
         )
+
+    def layer_memory_with_cp(
+        self, device_type: str, tp: int, bs: int, cp: int
+    ) -> tuple[float, ...]:
+        """Per-layer memory row (MB) under sequence sharding by ``cp``."""
+        return self.layer_memory(device_type, tp, bs, act_divisor=cp)
 
 
 def cp_candidates(max_cp_degree: int, sequence_length: int) -> list[int]:

@@ -34,6 +34,11 @@ from metis_tpu.cost.bandwidth import (
     StageBandwidthModel,
 )
 from metis_tpu.cost.context_parallel import attention_layer_range, cp_ring_ms
+from metis_tpu.cost.expert_parallel import (
+    ep_a2a_ms,
+    expert_param_fraction,
+    moe_layer_range,
+)
 from metis_tpu.cost.volume import TransformerVolume
 
 
@@ -234,7 +239,8 @@ class HeteroCostEstimator(_EstimatorBase):
         L = self.volume.num_layers
 
         lens: list[float] = []
-        ring_by_stage: list[float] = []
+        comm_by_stage: list[float] = []  # ring + a2a, for breakdown reconcile
+        ring_total = a2a_total = 0.0
         dp_costs: list[float] = []
         opt_costs: list[float] = []
         fb_sync = pp_cost = 0.0
@@ -247,7 +253,7 @@ class HeteroCostEstimator(_EstimatorBase):
                 plan, strat, stage_types, start_l, end_l)
             mbs = plan.gbs // strat.dp // plan.batches
             cp_bw = None
-            ring_ms = 0.0
+            ring_ms = a2a_ms = 0.0
             if strat.cp > 1:
                 # Ring-attention K/V rotation extends the stage's critical
                 # path (un-overlapped model, cost/context_parallel.py).
@@ -259,7 +265,18 @@ class HeteroCostEstimator(_EstimatorBase):
                     attention_layer_range(self.volume.model, start_l, end_l),
                     cp_bw)
                 stage_ms += ring_ms
-            ring_by_stage.append(ring_ms)
+            if strat.ep > 1:
+                # MoE token all-to-all rides the links of the dp sub-group
+                # the ep axis is carved from (un-overlapped model,
+                # cost/expert_parallel.py).
+                a2a_ms = ep_a2a_ms(
+                    self.volume.model, mbs, strat.ep,
+                    moe_layer_range(self.volume.model, start_l, end_l),
+                    bandwidth.dp_bandwidth(stage_id, strat), cp=strat.cp)
+                stage_ms += a2a_ms
+            comm_by_stage.append(ring_ms + a2a_ms)
+            ring_total += ring_ms
+            a2a_total += a2a_ms
             lens.append(stage_ms)
 
             if stage_id == plan.num_stages - 1:
@@ -277,19 +294,37 @@ class HeteroCostEstimator(_EstimatorBase):
             dp_bw = bandwidth.dp_bandwidth(stage_id, strat)
             if cp_bw is not None:
                 dp_bw = min(dp_bw, cp_bw)
-            dp_costs.append(self._dp_cost_ms(stage_params, dp_bw, sync_degree))
+            if strat.ep > 1:
+                # Expert weights shard 1/ep: each shard all-reduces over the
+                # dp*cp/ep replicas that hold it; dense weights over dp*cp.
+                block_params = self.volume.stage_parameter_bytes(
+                    strat.tp, max(start_l, 1), min(end_l, L - 1))
+                expert_bytes = (block_params
+                                * expert_param_fraction(self.volume.model)
+                                / strat.ep)
+                dp_costs.append(
+                    self._dp_cost_ms(stage_params - expert_bytes * strat.ep,
+                                     dp_bw, sync_degree)
+                    + self._dp_cost_ms(expert_bytes, dp_bw,
+                                       sync_degree // strat.ep))
+            else:
+                dp_costs.append(self._dp_cost_ms(stage_params, dp_bw, sync_degree))
 
             opt_type = None if self.options.strict_compat else stage_types[0]
             opt_costs.append(
                 self._optimizer_ms(opt_type) / strat.tp * (end_l - start_l) / L)
 
         execution = (plan.batches - 1) * max(lens) + sum(lens)
-        # cp_comm_ms reports exactly the ring traffic's contribution to the
-        # GPipe execution total (the with-ring minus without-ring delta), so
-        # the breakdown fields reconcile for the validator.
-        lens_noring = [l - r for l, r in zip(lens, ring_by_stage)]
-        cp_cost = execution - (
-            (plan.batches - 1) * max(lens_noring) + sum(lens_noring))
+        # cp_comm_ms / ep_comm_ms report exactly the ring / all-to-all
+        # traffic's contribution to the GPipe execution total (the with-comm
+        # minus without-comm delta, split pro rata), so the breakdown fields
+        # reconcile for the validator.
+        lens_nocomm = [l - c for l, c in zip(lens, comm_by_stage)]
+        comm_delta = execution - (
+            (plan.batches - 1) * max(lens_nocomm) + sum(lens_nocomm))
+        comm_total = ring_total + a2a_total
+        cp_cost = comm_delta * ring_total / comm_total if comm_total else 0.0
+        ep_cost = comm_delta * a2a_total / comm_total if comm_total else 0.0
         first_stage_type = ranks[0] if ranks else None
         batch_gen = self._batch_gen_ms(plan.batches, first_stage_type)
 
@@ -303,4 +338,5 @@ class HeteroCostEstimator(_EstimatorBase):
             pp_comm_ms=pp_cost,
             batch_gen_ms=batch_gen,
             cp_comm_ms=cp_cost,
+            ep_comm_ms=ep_cost,
         )

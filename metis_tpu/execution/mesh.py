@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from metis_tpu.core.types import UniformPlan
 from metis_tpu.models.gpt import GPTConfig
 
-PP, DP, TP, SP = "pp", "dp", "tp", "sp"
+PP, DP, TP, SP, EP = "pp", "dp", "tp", "sp", "ep"
 
 
 def mesh_for_uniform_plan(plan: UniformPlan, devices=None) -> Mesh:
@@ -75,6 +75,31 @@ def gpt_param_specs(cfg: GPTConfig, tp_axis: str = TP, pp_axis: str | None = Non
             "out": P(None, t),      # vocab-parallel head
         },
     }
+
+
+def moe_param_specs(
+    cfg, tp_axis: str = TP, ep_axis: str = EP, pp_axis: str | None = None
+) -> dict:
+    """PartitionSpec tree matching models.moe.init_moe_params.
+
+    Expert weights shard their leading num_experts axis over ``ep_axis`` —
+    GSPMD then inserts the token all-to-alls around the expert einsums
+    (models.moe docstring); dense weights follow the Megatron TP layout of
+    ``gpt_param_specs``.
+    """
+    t, p, e = tp_axis, pp_axis, ep_axis
+    specs = gpt_param_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
+    blocks = dict(specs["blocks"])
+    for key in ("mlp_in", "mlp_in_bias", "mlp_out", "mlp_out_bias"):
+        del blocks[key]
+    blocks.update({
+        "router": P(p, None, None),
+        "expert_in": P(p, e, None, t),       # column-parallel within expert
+        "expert_in_bias": P(p, e, t),
+        "expert_out": P(p, e, t, None),      # row-parallel within expert
+        "expert_out_bias": P(p, e, None),
+    })
+    return {**specs, "blocks": blocks}
 
 
 def batch_spec(dp_axis: str = DP, seq_axis: str | None = None) -> P:

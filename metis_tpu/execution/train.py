@@ -18,13 +18,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metis_tpu.execution.mesh import (
     DP,
+    EP,
     TP,
     batch_spec,
     gpt_param_specs,
+    moe_param_specs,
     shard_params,
 )
 from metis_tpu.models.gpt import GPTConfig, init_params, next_token_loss
+from metis_tpu.models.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_next_token_loss,
+)
 from metis_tpu.ops.ring_attention import make_ring_attention
+
+
+def param_specs_for(cfg: GPTConfig, tp_axis: str = TP, ep_axis: str = EP,
+                    pp_axis: str | None = None) -> dict:
+    """Model-family dispatch: MoE configs get expert sharding specs."""
+    if isinstance(cfg, MoEConfig):
+        return moe_param_specs(cfg, tp_axis=tp_axis, ep_axis=ep_axis,
+                               pp_axis=pp_axis)
+    return gpt_param_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
+
+
+def init_params_for(key: jax.Array, cfg: GPTConfig) -> dict:
+    return (init_moe_params(key, cfg) if isinstance(cfg, MoEConfig)
+            else init_params(key, cfg))
+
+
+def loss_fn_for(cfg: GPTConfig):
+    return (moe_next_token_loss if isinstance(cfg, MoEConfig)
+            else next_token_loss)
 
 
 @jax.tree_util.register_dataclass
@@ -45,12 +71,14 @@ def build_train_state(
     mesh: Mesh,
     optimizer=None,
     tp_axis: str = TP,
+    ep_axis: str | None = None,
 ) -> tuple[TrainState, dict]:
     """Initialize params on-mesh (sharded from the start) and the matching
-    optimizer state.  Returns (state, param_specs)."""
+    optimizer state.  Returns (state, param_specs).  ``ep_axis`` shards MoE
+    expert weights (ignored for dense configs; None replicates experts)."""
     optimizer = optimizer or build_optimizer()
-    specs = gpt_param_specs(cfg, tp_axis=tp_axis)
-    params = shard_params(init_params(key, cfg), mesh, specs)
+    specs = param_specs_for(cfg, tp_axis=tp_axis, ep_axis=ep_axis)
+    params = shard_params(init_params_for(key, cfg), mesh, specs)
     opt_state = optimizer.init(params)
     return TrainState(params=params, opt_state=opt_state,
                       step=jnp.zeros((), jnp.int32)), specs
@@ -76,10 +104,12 @@ def make_train_step(
 
     tok_sharding = NamedSharding(mesh, batch_spec(dp_axis, seq_axis))
 
+    loss_fn = loss_fn_for(cfg)
+
     def step(state: TrainState, tokens: jnp.ndarray, targets: jnp.ndarray):
         tokens = jax.lax.with_sharding_constraint(tokens, tok_sharding.spec)
         targets = jax.lax.with_sharding_constraint(targets, tok_sharding.spec)
-        loss, grads = jax.value_and_grad(next_token_loss)(
+        loss, grads = jax.value_and_grad(loss_fn)(
             state.params, tokens, targets, cfg, attn_impl)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

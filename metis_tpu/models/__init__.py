@@ -6,6 +6,20 @@ from metis_tpu.models.gpt import (
     next_token_loss,
     param_count,
 )
+from metis_tpu.models.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_next_token_loss,
+)
+
+
+def config_for_model_spec(spec, **overrides):
+    """Dispatch a planner ModelSpec to the executable config of its model
+    family: MoEConfig when the spec declares experts, GPTConfig otherwise."""
+    if spec.num_experts > 0:
+        return MoEConfig.from_model_spec(spec, **overrides)
+    return GPTConfig.from_model_spec(spec, **overrides)
 
 __all__ = [
     "GPTConfig",
@@ -14,4 +28,9 @@ __all__ = [
     "init_params",
     "next_token_loss",
     "param_count",
+    "MoEConfig",
+    "config_for_model_spec",
+    "init_moe_params",
+    "moe_forward",
+    "moe_next_token_loss",
 ]

@@ -1,0 +1,262 @@
+"""Mixture-of-Experts GPT — the MoE model family, TPU-first.
+
+Net-new capability (the reference has no MoE/EP anywhere — SURVEY.md §2.2
+"EP — Absent").  Each transformer block replaces the dense FFN with
+``num_experts`` expert FFNs behind a top-k token-choice router, GShard/Switch
+style: dispatch and combine are expressed as one-hot einsums so the whole
+layer is MXU matmuls with static shapes — no gather/scatter, no dynamic
+shapes, nothing XLA can't tile.
+
+Expert parallelism falls out of sharding, not code: expert weights carry a
+leading ``num_experts`` axis that ``execution.mesh.moe_param_specs`` shards
+over the ``ep`` mesh axis, and GSPMD inserts the dispatch/combine all-to-alls
+over ICI.  The same forward runs unsharded on one chip.
+
+Capacity discipline: every expert processes exactly ``capacity`` token slots
+(overflow tokens are dropped from the expert update and pass through the
+residual; underflow slots compute zeros).  This is the standard TPU MoE
+trade — static shapes for the MXU over exact routing.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from metis_tpu.core.config import ModelSpec
+from metis_tpu.models.gpt import (
+    AttnFn,
+    GPTConfig,
+    _layer_norm,
+    causal_attention,
+    default_attention,
+    embed,
+    head_logits,
+)
+
+
+@dataclass(frozen=True)
+class MoEConfig(GPTConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    # weight of the load-balancing auxiliary loss (Switch Transformer default)
+    aux_loss_coef: float = 0.01
+
+    @staticmethod
+    def from_model_spec(spec: ModelSpec, **overrides) -> "MoEConfig":
+        if spec.num_experts < 1:
+            raise ValueError(
+                "MoEConfig.from_model_spec needs a spec with num_experts >= 1 "
+                "(use models.config_for_model_spec to dispatch dense vs MoE)")
+        cfg = MoEConfig(
+            vocab_size=spec.vocab_size,
+            seq_len=spec.sequence_length,
+            hidden=spec.hidden_size,
+            num_heads=spec.num_heads,
+            num_blocks=spec.num_blocks,
+            ffn_multiplier=spec.ffn_multiplier,
+            num_experts=spec.num_experts,
+            top_k=spec.expert_top_k,
+        )
+        from dataclasses import replace
+        return replace(cfg, **overrides) if overrides else cfg
+
+
+def expert_capacity(cfg: MoEConfig, tokens: int) -> int:
+    """Per-expert token slots for a batch of ``tokens`` routed top_k ways."""
+    return max(1, math.ceil(
+        tokens * cfg.top_k * cfg.capacity_factor / cfg.num_experts))
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig) -> dict:
+    """Like gpt.init_params but blocks carry a router plus stacked expert FFN
+    weights (leading dims [num_blocks, num_experts, ...])."""
+    k_tok, k_pos, k_blocks, k_head = jax.random.split(key, 4)
+    h, f, v, E = cfg.hidden, cfg.ffn_dim, cfg.vocab_size, cfg.num_experts
+    L = cfg.num_blocks
+    pd = cfg.param_dtype
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape) * scale).astype(pd)
+
+    ks = jax.random.split(k_blocks, 6)
+    scale = 0.02
+    resid_scale = scale / math.sqrt(2 * max(L, 1))
+    return {
+        "embed": {
+            "tok": normal(k_tok, (v, h), scale),
+            "pos": normal(k_pos, (cfg.seq_len, h), scale),
+        },
+        "blocks": {
+            "ln1_scale": jnp.ones((L, h), pd),
+            "ln1_bias": jnp.zeros((L, h), pd),
+            "qkv": normal(ks[0], (L, 3, h, h), scale),
+            "qkv_bias": jnp.zeros((L, 3, h), pd),
+            "proj": normal(ks[1], (L, h, h), resid_scale),
+            "proj_bias": jnp.zeros((L, h), pd),
+            "ln2_scale": jnp.ones((L, h), pd),
+            "ln2_bias": jnp.zeros((L, h), pd),
+            "router": normal(ks[2], (L, h, E), scale),
+            "expert_in": normal(ks[3], (L, E, h, f), scale),
+            "expert_in_bias": jnp.zeros((L, E, f), pd),
+            "expert_out": normal(ks[4], (L, E, f, h), resid_scale),
+            "expert_out_bias": jnp.zeros((L, E, h), pd),
+        },
+        "head": {
+            "ln_scale": jnp.ones((h,), pd),
+            "ln_bias": jnp.zeros((h,), pd),
+            "out": normal(k_head, (h, v), scale),
+        },
+    }
+
+
+def moe_ffn(
+    x: jnp.ndarray, layer: dict, cfg: MoEConfig
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k routed expert FFN on [b, s, h].  Returns (output, aux_loss).
+
+    Dispatch/combine are dense one-hot einsums ([T, E, C] tensors) — the
+    GShard formulation that keeps every step a static-shape matmul.
+    """
+    b, s, h = x.shape
+    E, k, dt = cfg.num_experts, cfg.top_k, cfg.dtype
+    tokens = x.reshape(b * s, h)
+    T = b * s
+    C = expert_capacity(cfg, T)
+
+    logits = jnp.einsum(
+        "th,he->te", tokens.astype(jnp.float32),
+        layer["router"].astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                     # [T, E]
+
+    # top-k expert choice per token
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)             # [T, k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)                 # renormalize
+
+    # Position of each (token, choice) in its expert's capacity buffer:
+    # cumulative count of prior assignments to the same expert, counting
+    # choice slots in priority order (k=0 first).
+    choice_onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T,k,E]
+    flat = choice_onehot.transpose(1, 0, 2).reshape(k * T, E)   # priority-major
+    pos_flat = jnp.cumsum(flat, axis=0) - flat                  # [k*T, E]
+    position = (pos_flat.reshape(k, T, E) * choice_onehot.transpose(1, 0, 2)) \
+        .sum(-1).transpose(1, 0)                                # [T, k]
+    position = position.astype(jnp.int32)
+    keep = position < C                                         # capacity drop
+
+    # dispatch [T, E, C] and combine [T, E, C] tensors
+    pos_onehot = jax.nn.one_hot(position, C, dtype=jnp.float32)  # [T, k, C]
+    dispatch = jnp.einsum(
+        "tke,tkc->tec", choice_onehot * keep[..., None], pos_onehot)
+    combine = jnp.einsum(
+        "tke,tkc->tec",
+        choice_onehot * (gate_vals * keep)[..., None], pos_onehot)
+
+    expert_in = jnp.einsum(
+        "tec,th->ech", dispatch.astype(dt), tokens,
+        preferred_element_type=jnp.float32).astype(dt)          # [E, C, h]
+    z = jnp.einsum(
+        "ech,ehf->ecf", expert_in, layer["expert_in"].astype(dt),
+        preferred_element_type=jnp.float32)
+    z = jax.nn.gelu(z + layer["expert_in_bias"][:, None, :].astype(jnp.float32))
+    z = jnp.einsum(
+        "ecf,efh->ech", z.astype(dt), layer["expert_out"].astype(dt),
+        preferred_element_type=jnp.float32)
+    z = (z + layer["expert_out_bias"][:, None, :]).astype(dt)    # [E, C, h]
+
+    out = jnp.einsum(
+        "tec,ech->th", combine.astype(dt), z,
+        preferred_element_type=jnp.float32).astype(dt)
+
+    # Switch-style load-balance loss: E * sum_e mean(router prob) * frac(tokens)
+    assign_frac = choice_onehot[:, 0, :].mean(0)                # top-1 counts
+    aux = E * jnp.sum(probs.mean(0) * assign_frac)
+
+    return out.reshape(b, s, h), aux
+
+
+def moe_block_forward(
+    x: jnp.ndarray, layer: dict, cfg: MoEConfig, attn_impl: AttnFn
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One MoE transformer block; returns (activations, aux_loss)."""
+    h, nh, hd = cfg.hidden, cfg.num_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    qkv = jnp.einsum("bsh,chk->cbsk", y, layer["qkv"].astype(dt),
+                     preferred_element_type=jnp.float32)
+    qkv = (qkv + layer["qkv_bias"][:, None, None, :]).astype(dt)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+
+    def heads(t):
+        b, s, _ = t.shape
+        return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+    ctx = attn_impl(heads(q), heads(k), heads(v))
+    b, _, s, _ = ctx.shape
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+    attn_out = jnp.einsum("bsh,hk->bsk", ctx, layer["proj"].astype(dt),
+                          preferred_element_type=jnp.float32)
+    x = x + (attn_out + layer["proj_bias"]).astype(dt)
+
+    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    z, aux = moe_ffn(y, layer, cfg)
+    return x + z, aux
+
+
+def moe_run_blocks(
+    params: dict,
+    x: jnp.ndarray,
+    cfg: MoEConfig,
+    attn_impl: AttnFn | None = None,
+    block_slice: tuple[int, int] | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scan the stacked MoE blocks; returns (activations, mean aux loss)."""
+    attn = attn_impl or default_attention(cfg)
+    blocks = params["blocks"]
+    if block_slice is not None:
+        i, j = block_slice
+        blocks = jax.tree.map(lambda a: a[i:j], blocks)
+
+    body = partial(moe_block_forward, cfg=cfg, attn_impl=attn)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def step(carry, layer):
+        out, aux = body(carry, layer)
+        return out, aux
+
+    out, auxes = jax.lax.scan(step, x, blocks)
+    return out, auxes.mean()
+
+
+def moe_forward(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: MoEConfig,
+    attn_impl: AttnFn | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [b, s] -> (logits [b, s, v] fp32, aux loss scalar)."""
+    x = embed(params, tokens, cfg)
+    x, aux = moe_run_blocks(params, x, cfg, attn_impl)
+    return head_logits(params, x, cfg), aux
+
+
+def moe_next_token_loss(
+    params: dict,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    cfg: MoEConfig,
+    attn_impl: AttnFn | None = None,
+) -> jnp.ndarray:
+    """Cross-entropy + load-balance auxiliary (fp32 scalar)."""
+    logits, aux = moe_forward(params, tokens, cfg, attn_impl)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -picked.mean() + cfg.aux_loss_coef * aux

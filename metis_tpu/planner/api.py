@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from itertools import product
 
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.cluster.tpu import TpuClusterSpec
@@ -28,6 +29,7 @@ from metis_tpu.cost.estimator import (
     UniformCostEstimator,
 )
 from metis_tpu.cost.context_parallel import cp_candidates
+from metis_tpu.cost.expert_parallel import ep_candidates
 from metis_tpu.cost.ici import IciDcnBandwidth
 from metis_tpu.cost.volume import TransformerVolume
 from metis_tpu.search.inter_stage import inter_stage_plans
@@ -86,14 +88,18 @@ def plan_hetero(
     estimator = HeteroCostEstimator(
         cluster, profiles, volume, options, bandwidth_factory)
     evaluator = StagePerformanceModel(cluster, profiles)
-    balancer = LayerBalancer(cluster, profiles, config)
+    balancer = LayerBalancer(cluster, profiles, config, model=model)
 
-    # Context-parallel families (net-new vs the reference, SURVEY.md §5):
-    # cp=1 is always searched; higher powers of two up to max_cp_degree join
-    # when enabled and the sequence divides evenly.
+    # Context-/expert-parallel families (net-new vs the reference,
+    # SURVEY.md §5): degree 1 is always searched; higher powers of two join
+    # when enabled and the sequence/expert count divides evenly.
     cp_degrees: list[int] = [1]
     if config.enable_cp and not config.strict_compat:
         cp_degrees += cp_candidates(config.max_cp_degree, model.sequence_length)
+    ep_degrees: list[int] = [1]
+    if config.enable_ep and not config.strict_compat:
+        ep_degrees += ep_candidates(config.max_ep_degree, model.num_experts)
+    families = list(product(cp_degrees, ep_degrees))
 
     results: list[RankedPlan] = []
     pruned = 0
@@ -114,15 +120,16 @@ def plan_hetero(
                 len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
                 for s in range(inter.num_stages)
             ]
-        # one try-block per cp family: a profile miss mid-generation prunes
-        # only that family, not the sibling cp degrees of this inter plan
-        for cp in cp_degrees:
+        # one try-block per (cp, ep) family: a profile miss mid-generation
+        # prunes only that family, not its siblings on this inter plan
+        for cp, ep in families:
             try:
                 for intra in intra_stage_plans(
                     inter, evaluator, balancer,
                     max_tp=config.max_profiled_tp,
                     max_bs=config.max_profiled_bs,
                     cp_degrees=(cp,), cp_eligible=cp_eligible,
+                    ep_degrees=(ep,),
                 ):
                     try:
                         cost = estimator.get_cost(

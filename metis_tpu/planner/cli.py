@@ -38,6 +38,9 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--seq-len", type=int, required=True)
     g.add_argument("--vocab-size", type=int, required=True)
     g.add_argument("--num-heads", type=int, required=True)
+    g.add_argument("--num-experts", type=int, default=0,
+                   help="MoE expert count (0 = dense model)")
+    g.add_argument("--expert-top-k", type=int, default=1)
 
 
 def _add_search_args(p: argparse.ArgumentParser) -> None:
@@ -53,6 +56,10 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
                    help="search context-parallel (ring attention) plan families")
     g.add_argument("--max-cp", type=int, default=4,
                    help="largest context-parallel degree to search")
+    g.add_argument("--enable-ep", action="store_true",
+                   help="search expert-parallel (MoE) plan families")
+    g.add_argument("--max-ep", type=int, default=8,
+                   help="largest expert-parallel degree to search")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
 
@@ -71,6 +78,8 @@ def _model_from_args(args: argparse.Namespace) -> ModelSpec:
         sequence_length=args.seq_len,
         vocab_size=args.vocab_size,
         num_heads=args.num_heads,
+        num_experts=args.num_experts,
+        expert_top_k=args.expert_top_k,
     )
 
 
@@ -84,6 +93,8 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         strict_compat=args.strict_compat,
         enable_cp=args.enable_cp,
         max_cp_degree=args.max_cp,
+        enable_ep=args.enable_ep,
+        max_ep_degree=args.max_ep,
     )
 
 

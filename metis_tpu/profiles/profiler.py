@@ -45,16 +45,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metis_tpu.core.config import ModelSpec
 from metis_tpu.core.errors import MetisError
-from metis_tpu.execution.mesh import DP, TP, gpt_param_specs, shard_params
+from metis_tpu.execution.mesh import DP, TP, shard_params
+from metis_tpu.execution.train import (
+    init_params_for,
+    loss_fn_for,
+    param_specs_for,
+)
+from metis_tpu.models import config_for_model_spec
 from metis_tpu.models.gpt import (
     GPTConfig,
     embed,
     block_forward,
     causal_attention,
     head_logits,
-    init_params,
-    next_token_loss,
 )
+from metis_tpu.models.moe import MoEConfig, moe_block_forward
 from metis_tpu.profiles.store import (
     DeviceTypeMeta,
     LayerProfile,
@@ -146,7 +151,7 @@ class LayerProfiler:
         self.devices = list(devices if devices is not None else jax.devices())
         self.device_type = device_type or infer_device_type(self.devices[0])
         self.config = config
-        self.cfg = GPTConfig.from_model_spec(model, dtype=dtype)
+        self.cfg = config_for_model_spec(model, dtype=dtype)
 
     # -- per-layer closures -------------------------------------------------
     def _make_layer_fns(self, cfg: GPTConfig):
@@ -168,6 +173,10 @@ class LayerProfiler:
 
         def block_fb(layer, x):
             def f(layer, x):
+                if isinstance(cfg, MoEConfig):
+                    out, aux = moe_block_forward(x, layer, cfg, causal_attention)
+                    # aux keeps the router's softmax/stats in the measured graph
+                    return out.astype(jnp.float32).sum() + aux
                 return (
                     block_forward(x, layer, cfg, causal_attention)
                     .astype(jnp.float32)
@@ -194,11 +203,11 @@ class LayerProfiler:
             raise MetisError(
                 f"tp={tp} needs {tp} devices, have {len(self.devices)}")
         mesh = Mesh(np.array(self.devices[:tp]).reshape(1, tp), (DP, TP))
-        specs = gpt_param_specs(cfg)
+        specs = param_specs_for(cfg, ep_axis=None)
 
         key = jax.random.PRNGKey(self.config.seed)
         with mesh:
-            params = shard_params(init_params(key, cfg), mesh, specs)
+            params = shard_params(init_params_for(key, cfg), mesh, specs)
             tokens = jax.device_put(
                 jax.random.randint(key, (bs, cfg.seq_len), 0, cfg.vocab_size),
                 NamedSharding(mesh, P()),
@@ -222,7 +231,7 @@ class LayerProfiler:
             # Whole-model fwd+bwd — the ground truth the per-layer
             # decomposition must sum to (see module docstring).
             j_full = _aot_compile(
-                jax.value_and_grad(partial(next_token_loss, cfg=cfg)),
+                jax.value_and_grad(partial(loss_fn_for(cfg), cfg=cfg)),
                 (params, tokens, tokens),
             )
             full_ms = _median_ms(j_full, (params, tokens, tokens), w, it)
@@ -273,7 +282,7 @@ class LayerProfiler:
     def _profile_optimizer_ms(self) -> float:
         """Adam update wall time on full (unsharded-model-size) parameters."""
         cfg = self.cfg
-        params = init_params(jax.random.PRNGKey(self.config.seed), cfg)
+        params = init_params_for(jax.random.PRNGKey(self.config.seed), cfg)
         opt = optax.adamw(1e-4)
         opt_state = opt.init(params)
         grads = jax.tree.map(jnp.ones_like, params)
@@ -319,7 +328,7 @@ class LayerProfiler:
                 f"no (tp, bs) combination profileable with {len(self.devices)}"
                 f" device(s); requested tps={list(tps)}")
 
-        params = init_params(jax.random.PRNGKey(self.config.seed), self.cfg)
+        params = init_params_for(jax.random.PRNGKey(self.config.seed), self.cfg)
         pbytes = self._params_per_layer_bytes(params)
         opt_ms = self._profile_optimizer_ms()
         bg_ms = self._profile_batch_gen_ms(max(bss))

@@ -61,8 +61,15 @@ _BWD_FLOP_FACTOR = 2.0     # backward ≈ 2x forward FLOPs
 
 def _params_per_layer(model: ModelSpec) -> tuple[int, ...]:
     h, v = model.hidden_size, model.vocab_size
+    f = h * model.ffn_multiplier
     embed = v * h + model.sequence_length * h          # token + position tables
-    block = 12 * h * h + 13 * h                        # qkvo + mlp + norms
+    attn = 4 * h * h + 13 * h                          # qkv + proj + norms/bias
+    if model.num_experts > 0:
+        # MoE block: router + num_experts expert FFNs replace the dense FFN
+        ffn = h * model.num_experts + model.num_experts * 2 * h * f
+    else:
+        ffn = 2 * h * f
+    block = attn + ffn
     head = v * h                                       # untied LM head
     layers = [embed] + [block] * model.num_blocks + [head]
     return tuple(p * model.dtype_bytes for p in layers)
@@ -70,9 +77,14 @@ def _params_per_layer(model: ModelSpec) -> tuple[int, ...]:
 
 def _block_flops(model: ModelSpec, bs: int) -> float:
     h, s = model.hidden_size, model.sequence_length
-    matmul = 24 * bs * s * h * h       # qkv + proj + 2 mlp matmuls
-    attn = 4 * bs * s * s * h          # scores + context
-    return (matmul + attn) * (1 + _BWD_FLOP_FACTOR)
+    f = h * model.ffn_multiplier
+    attn_mm = 8 * bs * s * h * h       # qkv + proj matmuls
+    ffn_mm = 4 * bs * s * h * f        # 2 FFN matmuls
+    if model.num_experts > 0:
+        # each token runs top_k expert FFNs, plus the router matmul
+        ffn_mm = ffn_mm * model.expert_top_k + 2 * bs * s * h * model.num_experts
+    attn_sc = 4 * bs * s * s * h       # scores + context
+    return (attn_mm + ffn_mm + attn_sc) * (1 + _BWD_FLOP_FACTOR)
 
 
 def _head_flops(model: ModelSpec, bs: int) -> float:

@@ -20,6 +20,7 @@ Policy parity notes (each mirrors a reference behavior):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from itertools import product
 from typing import Iterator, Protocol, Sequence
 
 from metis_tpu.core.types import InterStagePlan, IntraStagePlan, Strategy
@@ -59,28 +60,36 @@ class LayerPartitioner(Protocol):
 
 
 def initial_strategies(
-    plan: InterStagePlan, cp: int = 1, cp_eligible: Sequence[bool] | None = None
+    plan: InterStagePlan,
+    cp: int = 1,
+    cp_eligible: Sequence[bool] | None = None,
+    ep: int = 1,
 ) -> tuple[Strategy, ...] | None:
     """Every stage starts fully data-parallel (``plan.py:231-236``).
 
     With ``cp > 1`` each eligible stage dedicates a cp-sized sub-axis to ring
     attention (dp = group/cp, tp = 1); ineligible stages (heterogeneous device
-    mix — ring attention needs uniform block timing) stay cp=1.  Returns None
-    when no stage can actually take the cp axis (degenerate family — identical
-    to the cp=1 search).
+    mix — ring attention needs uniform block timing) stay cp=1.  With
+    ``ep > 1`` each stage whose dp divides evenly shards experts over ep-sized
+    sub-groups of its data ranks (Strategy docstring: ep rides inside dp).
+    Returns None when no stage can actually take the requested axis
+    (degenerate family — identical to a lower-degree search).
     """
-    if cp <= 1:
-        return tuple(Strategy(dp=g, tp=1) for g in plan.device_groups)
     out = []
-    any_cp = False
+    any_cp, any_ep = False, False
     for stage_id, g in enumerate(plan.device_groups):
         eligible = cp_eligible is None or cp_eligible[stage_id]
-        if eligible and g % cp == 0:
-            out.append(Strategy(dp=g // cp, tp=1, cp=cp))
-            any_cp = True
-        else:
-            out.append(Strategy(dp=g, tp=1))
-    return tuple(out) if any_cp else None
+        stage_cp = cp if (cp > 1 and eligible and g % cp == 0) else 1
+        any_cp |= stage_cp > 1
+        dp = g // stage_cp
+        stage_ep = ep if (ep > 1 and dp % ep == 0) else 1
+        any_ep |= stage_ep > 1
+        out.append(Strategy(dp=dp, tp=1, cp=stage_cp, ep=stage_ep))
+    if cp > 1 and not any_cp:
+        return None
+    if ep > 1 and not any_ep:
+        return None
+    return tuple(out)
 
 
 def strategies_valid(
@@ -113,7 +122,8 @@ def escalate_dp_to_tp(
     out = list(strategies)
     for stage_id in order:
         s = out[stage_id]
-        if s.dp != 1:
+        # ep must keep dividing dp after the halving (ep rides inside dp)
+        if s.dp != 1 and (s.ep <= 1 or (s.dp // 2) % s.ep == 0):
             out[stage_id] = Strategy(dp=s.dp // 2, tp=s.tp * 2, sp=s.sp, cp=s.cp, ep=s.ep)
             return tuple(out)
     return None
@@ -127,16 +137,18 @@ def intra_stage_plans(
     max_bs: int,
     cp_degrees: Sequence[int] = (1,),
     cp_eligible: Sequence[bool] | None = None,
+    ep_degrees: Sequence[int] = (1,),
 ) -> Iterator[IntraStagePlan]:
     """Yield feasible intra-stage plans for one inter-stage candidate.
 
-    ``cp_degrees`` extends the reference's (dp, tp) space with context-parallel
-    families (net-new, SURVEY.md §5): for each degree the same escalation runs
-    with a cp axis carved out of every eligible stage.  The cost estimator
-    ranks the families against each other.
+    ``cp_degrees`` x ``ep_degrees`` extend the reference's (dp, tp) space with
+    context-parallel and expert-parallel families (net-new, SURVEY.md §5): for
+    each (cp, ep) pair the same escalation runs with the extra axes carved out
+    of every eligible stage.  The cost estimator ranks the families against
+    each other.
     """
-    for cp in cp_degrees:
-        strategies = initial_strategies(plan, cp, cp_eligible)
+    for cp, ep in product(cp_degrees, ep_degrees):
+        strategies = initial_strategies(plan, cp, cp_eligible, ep)
         memory_state: tuple[float, ...] | None = None
 
         while strategies is not None:
@@ -153,5 +165,5 @@ def intra_stage_plans(
                         num_repartition=result.attempts,
                     )
                     if result.attempts == 1:
-                        break  # this cp family is satisfied; try the next
+                        break  # this (cp, ep) family is satisfied; next
             strategies = escalate_dp_to_tp(strategies, memory_state)

@@ -84,13 +84,13 @@ def measure_uniform_plan_ms(
         microbatch_split,
     )
     from metis_tpu.execution.train import build_train_state, make_train_step
-    from metis_tpu.models.gpt import GPTConfig
+    from metis_tpu.models import config_for_model_spec
 
     devs = list(devices if devices is not None else jax.devices())
     need = plan.dp * plan.pp * plan.tp
     if len(devs) < need:
         raise MetisError(f"plan needs {need} devices, have {len(devs)}")
-    cfg = GPTConfig.from_model_spec(
+    cfg = config_for_model_spec(
         model, **({"dtype": dtype} if dtype is not None else {}))
     if cfg.num_blocks % plan.pp:
         raise MetisError(

@@ -1,0 +1,248 @@
+"""MoE model family + expert-parallel axis: model correctness, routing
+invariants, sharded dp x ep training, and planner ep families."""
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metis_tpu.models.moe import (
+    MoEConfig,
+    expert_capacity,
+    init_moe_params,
+    moe_ffn,
+    moe_forward,
+    moe_next_token_loss,
+)
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                num_blocks=2, ffn_multiplier=2, num_experts=4, top_k=2,
+                dtype=jnp.float32)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+class TestMoEModel:
+    def test_forward_shapes_and_finite(self):
+        cfg = tiny_cfg()
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+        logits, aux = moe_forward(params, tokens, cfg)
+        assert logits.shape == (2, 16, 128)
+        assert np.isfinite(np.asarray(logits)).all()
+        assert np.isfinite(float(aux))
+
+    def test_loss_decreases_under_sgd(self):
+        cfg = tiny_cfg()
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+
+        @jax.jit
+        def step(p):
+            loss, g = jax.value_and_grad(moe_next_token_loss)(
+                p, tokens, tokens, cfg)
+            return loss, jax.tree.map(lambda w, gw: w - 0.1 * gw, p, g)
+
+        losses = []
+        for _ in range(8):
+            loss, params = step(params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_capacity(self):
+        cfg = tiny_cfg(capacity_factor=1.0)
+        # 64 tokens, top_k 2, 4 experts -> 32 slots each
+        assert expert_capacity(cfg, 64) == 32
+
+    def test_router_gates_sum_to_one(self):
+        """Combine weights of kept tokens sum to ~1 per token (renormalized
+        top-k), so the expert output magnitude matches a dense FFN."""
+        cfg = tiny_cfg(capacity_factor=8.0)  # big capacity: no drops
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        layer = jax.tree.map(lambda a: a[0], params["blocks"])
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 32))
+        out, aux = moe_ffn(x, layer, cfg)
+        assert out.shape == x.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_top1_routing_matches_manual(self):
+        """With top_k=1 and ample capacity, each token's output equals its
+        chosen expert's FFN applied to it."""
+        cfg = tiny_cfg(top_k=1, capacity_factor=8.0)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        layer = jax.tree.map(lambda a: a[0], params["blocks"])
+        x = jax.random.normal(jax.random.PRNGKey(3), (1, 16, 32))
+
+        out, _ = moe_ffn(x, layer, cfg)
+
+        tokens = x.reshape(-1, 32)
+        logits = tokens @ layer["router"]
+        choice = jnp.argmax(logits, -1)
+        expected = []
+        for t in range(tokens.shape[0]):
+            e = int(choice[t])
+            z = jax.nn.gelu(tokens[t] @ layer["expert_in"][e]
+                            + layer["expert_in_bias"][e])
+            expected.append(z @ layer["expert_out"][e]
+                            + layer["expert_out_bias"][e])
+        np.testing.assert_allclose(
+            np.asarray(out.reshape(-1, 32)), np.asarray(jnp.stack(expected)),
+            rtol=1e-4, atol=1e-4)
+
+
+class TestExpertParallelExecution:
+    def test_dp_ep_sharded_step_matches_single_device(self):
+        """Loss of a dp x ep sharded train step == unsharded loss (GSPMD
+        inserts the all-to-alls; numerics must not change)."""
+        import numpy as onp
+        from jax.sharding import Mesh
+        from metis_tpu.execution import (
+            DP, EP, build_train_state, make_train_step)
+
+        cfg = tiny_cfg()
+        devs = onp.array(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, (DP, EP))
+        state, _ = build_train_state(
+            jax.random.PRNGKey(0), cfg, mesh, tp_axis=None, ep_axis=EP)
+        step = make_train_step(cfg, mesh, dp_axis=(DP, EP))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 128)
+        _, loss = step(state, tokens, tokens)
+
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        want = moe_next_token_loss(params, tokens, tokens, cfg)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+
+
+class TestPlannerEpFamilies:
+    @pytest.fixture(scope="class")
+    def setup(self, tmp_path_factory):
+        from metis_tpu.cluster import ClusterSpec
+        from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+        model = replace(tiny_test_model(), num_experts=8, expert_top_k=2)
+        store = synthesize_profiles(model, ["A100"], tps=[1, 2, 4],
+                                    bss=[1, 2, 4, 8, 16])
+        cluster = ClusterSpec.homogeneous("A100", num_nodes=2,
+                                          devices_per_node=4)
+        return model, store, cluster
+
+    def test_ep_families_searched_and_costed(self, setup):
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_hetero
+
+        model, store, cluster = setup
+        cfg = SearchConfig(gbs=64, enable_ep=True, max_ep_degree=4)
+        result = plan_hetero(cluster, store, model, cfg)
+        eps = {s.ep for r in result.plans for s in r.intra.strategies}
+        assert eps >= {1, 2, 4}, f"ep degrees missing: {eps}"
+        ep_plans = [r for r in result.plans
+                    if any(s.ep > 1 for s in r.intra.strategies)]
+        assert ep_plans
+        # a2a traffic must be charged on ep plans that keep dp > ep
+        charged = [r for r in ep_plans if r.cost.ep_comm_ms > 0]
+        assert charged
+
+    def test_ep_dense_model_yields_no_ep_plans(self, setup):
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_hetero
+        from metis_tpu.profiles import tiny_test_model
+
+        _, store, cluster = setup
+        cfg = SearchConfig(gbs=64, enable_ep=True, max_ep_degree=4)
+        result = plan_hetero(cluster, store, tiny_test_model(), cfg)
+        assert all(
+            s.ep == 1 for r in result.plans for s in r.intra.strategies)
+
+    def test_ep_breakdown_reconciles(self, setup):
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_hetero
+
+        model, store, cluster = setup
+        cfg = SearchConfig(gbs=64, enable_ep=True, max_ep_degree=4)
+        result = plan_hetero(cluster, store, model, cfg)
+        for r in result.plans[:20]:
+            c = r.cost
+            total = (c.execution_ms + c.fb_sync_ms + c.optimizer_ms
+                     + c.dp_comm_ms + c.pp_comm_ms + c.batch_gen_ms)
+            assert abs(total - c.total_ms) < 1e-6
+            assert c.ep_comm_ms <= c.execution_ms + 1e-9
+
+
+class TestEpCostModel:
+    def test_a2a_bytes(self):
+        from metis_tpu.cost.expert_parallel import a2a_bytes_per_layer
+        from metis_tpu.profiles import tiny_test_model
+
+        model = replace(tiny_test_model(), num_experts=8, expert_top_k=2)
+        assert a2a_bytes_per_layer(model, mbs=2, ep=1) == 0.0
+        got = a2a_bytes_per_layer(model, mbs=2, ep=4)
+        want = 4 * (2 * 1024 * 2 * 4096 * 2) * 3 / 4
+        assert got == pytest.approx(want)
+
+    def test_expert_fraction_bounds(self):
+        from metis_tpu.cost.expert_parallel import expert_param_fraction
+        from metis_tpu.profiles import tiny_test_model
+
+        dense = tiny_test_model()
+        assert expert_param_fraction(dense) == 0.0
+        moe = replace(dense, num_experts=8, expert_top_k=2)
+        f = expert_param_fraction(moe)
+        assert 0.5 < f < 1.0  # 8 expert FFNs dwarf the attention weights
+
+    def test_memory_relief_monotone_in_ep(self):
+        from metis_tpu.cost.context_parallel import ActivationSplitModel
+        from metis_tpu.cost.expert_parallel import layer_memory_with_ep
+        from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+        model = replace(tiny_test_model(), num_experts=8, expert_top_k=2)
+        store = synthesize_profiles(model, ["A100"], tps=[1],
+                                    bss=[1, 2, 4, 8])
+        split = ActivationSplitModel(store)
+        rows = [layer_memory_with_ep(split, model, "A100", 1, 4, ep)
+                for ep in (1, 2, 4, 8)]
+        blocks = [sum(r[1:-1]) for r in rows]
+        assert blocks[0] > blocks[1] > blocks[2] > blocks[3]
+        # embed/head rows carry no experts: no relief there
+        assert all(r[0] == rows[0][0] and r[-1] == rows[0][-1] for r in rows)
+
+    def test_ep_candidates(self):
+        from metis_tpu.cost.expert_parallel import ep_candidates
+
+        assert ep_candidates(8, 8) == [2, 4, 8]
+        assert ep_candidates(8, 6) == [2]
+        assert ep_candidates(1, 8) == []
+        assert ep_candidates(8, 0) == []
+
+    def test_synthetic_profiles_carry_expert_weights(self):
+        """An MoE spec must synthesize bigger/slower block profiles than its
+        dense twin — the profile is of the MoE model, not a dense stand-in."""
+        from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+        dense = tiny_test_model()
+        moe = replace(dense, num_experts=8, expert_top_k=2)
+        p_dense = synthesize_profiles(dense, ["A100"], tps=[1], bss=[1])
+        p_moe = synthesize_profiles(moe, ["A100"], tps=[1], bss=[1])
+        d, m = p_dense.get("A100", 1, 1), p_moe.get("A100", 1, 1)
+        assert m.layer_memory_mb[1] > 2 * d.layer_memory_mb[1]
+        assert m.layer_times_ms[1] > d.layer_times_ms[1]
+        # embed/head rows are expert-free and identical
+        assert m.layer_memory_mb[0] == d.layer_memory_mb[0]
+
+    def test_cp_ep_a2a_interaction(self):
+        """Combined (cp, ep) families dispatch 1/cp of the tokens."""
+        from metis_tpu.cost.expert_parallel import a2a_bytes_per_layer
+        from metis_tpu.profiles import tiny_test_model
+
+        model = replace(tiny_test_model(), num_experts=8, expert_top_k=2)
+        full = a2a_bytes_per_layer(model, mbs=2, ep=4)
+        quarter = a2a_bytes_per_layer(model, mbs=2, ep=4, cp=4)
+        assert quarter == pytest.approx(full / 4)
+
+    def test_moe_config_from_dense_spec_raises(self):
+        from metis_tpu.profiles import tiny_test_model
+
+        with pytest.raises(ValueError):
+            MoEConfig.from_model_spec(tiny_test_model())
