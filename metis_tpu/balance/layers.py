@@ -33,7 +33,11 @@ from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
 from metis_tpu.balance.stage_perf import rank_device_types
 from metis_tpu.cost.context_parallel import ActivationSplitModel
-from metis_tpu.cost.expert_parallel import layer_memory_with_ep
+from metis_tpu.cost.expert_parallel import (
+    expert_param_fraction,
+    expert_static_scale,
+)
+from metis_tpu.cost.zero import zero_static_reduction_mb
 from metis_tpu.search.intra_stage import PartitionResult
 
 
@@ -135,13 +139,9 @@ class LayerBalancer:
         if len(set(stage_types)) == 1:
             bs = plan.gbs // plan.batches // strategy.dp
             mem_type = all_types[0] if compat else stage_types[0]
-            if strategy.ep > 1 and not compat and self.model is not None:
-                return [layer_memory_with_ep(
-                    self.act_split, self.model, mem_type, strategy.tp, bs,
-                    strategy.ep, strategy.cp)]
-            if strategy.cp > 1 and not compat:
-                return [self.act_split.layer_memory_with_cp(
-                    mem_type, strategy.tp, bs, strategy.cp)]
+            sharded = strategy.cp > 1 or strategy.ep > 1 or strategy.zero > 0
+            if sharded and not compat:
+                return [self._sharded_memory_row(mem_type, bs, strategy)]
             return [self.profiles.get(mem_type, strategy.tp, bs).layer_memory_mb]
         split_types = list(all_types) if compat else list(stage_types)
         split = self.data_balancer.partition(
@@ -153,6 +153,29 @@ class LayerBalancer:
             for c in power_of_two_chunks(h_bs):
                 rows.append(self.profiles.get(mem_type, strategy.tp, c).layer_memory_mb)
         return rows
+
+    def _sharded_memory_row(
+        self, mem_type: str, bs: int, strategy: Strategy
+    ) -> tuple[float, ...]:
+        """One homo-stage memory row composing every sharded-state relief:
+        cp divides activations, ep scales the expert share of static memory,
+        ZeRO subtracts sharded optimizer/grad/param state (cost modules own
+        the per-axis math; the split model owns the fit/clamp mechanics)."""
+        n = self.profiles.model.num_layers
+        static_scale = None
+        expert_frac = 0.0
+        if strategy.ep > 1 and self.model is not None:
+            static_scale = expert_static_scale(self.model, n, strategy.ep)
+            if static_scale is not None:
+                expert_frac = expert_param_fraction(self.model)
+        reduction = zero_static_reduction_mb(
+            self.profiles.model.params_per_layer_bytes,
+            strategy.zero, strategy.data_ranks, tp=strategy.tp,
+            dtype_bytes=self.model.dtype_bytes if self.model else 2,
+            expert_frac=expert_frac, ep=strategy.ep)
+        return self.act_split.layer_memory(
+            mem_type, strategy.tp, bs, act_divisor=strategy.cp,
+            static_scale=static_scale, static_reduction_mb=reduction)
 
     def _memory_prefix(self, row: tuple[float, ...]) -> list[float]:
         cached = self._prefix_cache.get(row)

@@ -83,6 +83,7 @@ class SearchConfig:
     max_cp_degree: int = 1
     enable_ep: bool = False  # add expert-parallel (MoE) variants
     max_ep_degree: int = 1
+    enable_zero: bool = False  # add ZeRO-1/2/3 sharded-state variants
 
     def __post_init__(self) -> None:
         if self.gbs < 1:

@@ -36,10 +36,19 @@ class Strategy:
     sp: bool = False
     cp: int = 1
     ep: int = 1
+    # ZeRO stage (0 = replicated state, 1 = sharded optimizer, 2 = +grads,
+    # 3 = +params/FSDP); state shards over the dp*cp data ranks (cost/zero.py)
+    zero: int = 0
 
     @property
     def devices(self) -> int:
         return self.dp * self.tp * self.cp
+
+    @property
+    def data_ranks(self) -> int:
+        """Ranks holding a full data shard — the gradient-sync group and the
+        ZeRO sharding degree."""
+        return self.dp * self.cp
 
     def as_tuple(self) -> tuple[int, int]:
         return (self.dp, self.tp)
@@ -141,10 +150,7 @@ class RankedPlan:
             "num_stages": self.inter.num_stages,
             "batches": self.inter.batches,
             "gbs": self.inter.gbs,
-            "strategies": [
-                {"dp": s.dp, "tp": s.tp, "sp": s.sp, "cp": s.cp, "ep": s.ep}
-                for s in self.intra.strategies
-            ],
+            "strategies": [asdict(s) for s in self.intra.strategies],
             "layer_partition": list(self.intra.layer_partition),
             "num_repartition": self.intra.num_repartition,
         }

@@ -138,24 +138,29 @@ class ActivationSplitModel:
         bs: int,
         act_divisor: float = 1.0,
         static_scale: Sequence[float] | None = None,
+        static_reduction_mb: Sequence[float] | None = None,
     ) -> tuple[float, ...]:
         """Per-layer memory row (MB) with the activation component divided by
-        ``act_divisor`` (sequence/context sharding) and the static component
+        ``act_divisor`` (sequence/context sharding), the static component
         scaled per layer by ``static_scale`` (weight sharding, e.g. expert
-        parallelism).  Falls back to the measured full row (no relief) when
-        the static/activation split cannot be identified — conservative,
-        never optimistic."""
+        parallelism), then reduced by ``static_reduction_mb`` (absolute
+        sharded-state relief, e.g. ZeRO; clamped at zero).  Falls back to the
+        measured full row (no relief) when the static/activation split cannot
+        be identified — conservative, never optimistic."""
         base = self.profiles.get(device_type, tp, bs).layer_memory_mb
-        if act_divisor <= 1 and static_scale is None:
+        if (act_divisor <= 1 and static_scale is None
+                and static_reduction_mb is None):
             return base
         fitted = self.split(device_type, tp)
         if fitted is None:
             return base
         static, slope = fitted
         scales = static_scale if static_scale is not None else [1.0] * len(base)
+        cuts = (static_reduction_mb if static_reduction_mb is not None
+                else [0.0] * len(base))
         return tuple(
-            min(s * sc + bs * m / act_divisor, full)  # never above measured
-            for s, m, sc, full in zip(static, slope, scales, base)
+            min(max(s * sc - cut, 0.0) + bs * m / act_divisor, full)
+            for s, m, sc, cut, full in zip(static, slope, scales, cuts, base)
         )
 
     def layer_memory_with_cp(

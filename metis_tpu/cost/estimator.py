@@ -39,6 +39,7 @@ from metis_tpu.cost.expert_parallel import (
     expert_param_fraction,
     moe_layer_range,
 )
+from metis_tpu.cost.zero import zero_dp_factor
 from metis_tpu.cost.volume import TransformerVolume
 
 
@@ -294,6 +295,9 @@ class HeteroCostEstimator(_EstimatorBase):
             dp_bw = bandwidth.dp_bandwidth(stage_id, strat)
             if cp_bw is not None:
                 dp_bw = min(dp_bw, cp_bw)
+            # ZeRO-3 adds the backward parameter all-gather to the gradient
+            # sync volume (cost/zero.py).
+            zfac = zero_dp_factor(strat.zero)
             if strat.ep > 1:
                 # Expert weights shard 1/ep: each shard all-reduces over the
                 # dp*cp/ep replicas that hold it; dense weights over dp*cp.
@@ -302,17 +306,21 @@ class HeteroCostEstimator(_EstimatorBase):
                 expert_bytes = (block_params
                                 * expert_param_fraction(self.volume.model)
                                 / strat.ep)
-                dp_costs.append(
+                dp_costs.append(zfac * (
                     self._dp_cost_ms(stage_params - expert_bytes * strat.ep,
                                      dp_bw, sync_degree)
                     + self._dp_cost_ms(expert_bytes, dp_bw,
-                                       sync_degree // strat.ep))
+                                       sync_degree // strat.ep)))
             else:
-                dp_costs.append(self._dp_cost_ms(stage_params, dp_bw, sync_degree))
+                dp_costs.append(
+                    zfac * self._dp_cost_ms(stage_params, dp_bw, sync_degree))
 
             opt_type = None if self.options.strict_compat else stage_types[0]
+            # ZeRO >=1 shards the optimizer step itself over the data ranks.
+            opt_shard = strat.data_ranks if strat.zero >= 1 else 1
             opt_costs.append(
-                self._optimizer_ms(opt_type) / strat.tp * (end_l - start_l) / L)
+                self._optimizer_ms(opt_type) / strat.tp / opt_shard
+                * (end_l - start_l) / L)
 
         execution = (plan.batches - 1) * max(lens) + sum(lens)
         # cp_comm_ms / ep_comm_ms report exactly the ring / all-to-all

@@ -102,6 +102,20 @@ def expert_param_fraction(model: ModelSpec) -> float:
     return expert / (expert + router + attn)
 
 
+def expert_static_scale(
+    model: ModelSpec, n_layers: int, ep: int
+) -> list[float] | None:
+    """Per-layer multiplier on static memory under ep-way expert sharding
+    (None when nothing shards).  Block layers keep the dense fraction plus
+    1/ep of the expert fraction; the embed/head pseudo-layers carry no
+    experts."""
+    if ep <= 1 or model.num_experts <= 1:
+        return None
+    frac = expert_param_fraction(model)
+    block_scale = (1 - frac) + frac / ep
+    return [1.0] + [block_scale] * (n_layers - 2) + [1.0]
+
+
 def layer_memory_with_ep(
     split_model: ActivationSplitModel,
     model: ModelSpec,
@@ -120,11 +134,6 @@ def layer_memory_with_ep(
     mechanics, which the cp path shares).
     """
     n = len(split_model.profiles.get(device_type, tp, bs).layer_memory_mb)
-    static_scale = None
-    if ep > 1 and model.num_experts > 1:
-        frac = expert_param_fraction(model)
-        block_scale = (1 - frac) + frac / ep
-        # embed (first) and head (last) pseudo-layers carry no experts
-        static_scale = [1.0] + [block_scale] * (n - 2) + [1.0]
     return split_model.layer_memory(
-        device_type, tp, bs, act_divisor=cp, static_scale=static_scale)
+        device_type, tp, bs, act_divisor=cp,
+        static_scale=expert_static_scale(model, n, ep))

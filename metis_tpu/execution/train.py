@@ -48,6 +48,33 @@ def init_params_for(key: jax.Array, cfg: GPTConfig) -> dict:
             else init_params(key, cfg))
 
 
+def fsdp_wrap_specs(specs: dict, params: dict, dp_axis: str = DP,
+                    axis_size: int = 1) -> dict:
+    """ZeRO-3/FSDP on TPU is a sharding, not a wrapper: shard each >=2D
+    parameter's largest still-unsharded dim over ``dp_axis``.  Optimizer
+    state mirrors the param pytree, so optax state (and the fp32 Adam
+    moments — the bulk of training memory) shards with it; GSPMD inserts the
+    forward/backward all-gathers (planning model: cost/zero.py).  Only dims
+    divisible by ``axis_size`` are eligible (XLA rejects uneven named
+    shardings at placement); 1D leaves and leaves with no eligible dim stay
+    replicated — negligible bytes for biases/norms.
+    """
+    def wrap(spec: P, leaf) -> P:
+        shape = leaf.shape
+        if len(shape) < 2:
+            return spec
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        free = [i for i in range(len(shape))
+                if parts[i] is None and shape[i] % max(axis_size, 1) == 0]
+        if not free:
+            return spec
+        parts[max(free, key=lambda j: shape[j])] = dp_axis
+        return P(*parts)
+
+    return jax.tree.map(wrap, specs, params,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def loss_fn_for(cfg: GPTConfig):
     return (moe_next_token_loss if isinstance(cfg, MoEConfig)
             else next_token_loss)
@@ -72,13 +99,20 @@ def build_train_state(
     optimizer=None,
     tp_axis: str = TP,
     ep_axis: str | None = None,
+    fsdp_axis: str | None = None,
 ) -> tuple[TrainState, dict]:
     """Initialize params on-mesh (sharded from the start) and the matching
     optimizer state.  Returns (state, param_specs).  ``ep_axis`` shards MoE
-    expert weights (ignored for dense configs; None replicates experts)."""
+    expert weights (ignored for dense configs; None replicates experts);
+    ``fsdp_axis`` additionally shards params + optimizer state ZeRO-3 style
+    (usually the dp axis)."""
     optimizer = optimizer or build_optimizer()
     specs = param_specs_for(cfg, tp_axis=tp_axis, ep_axis=ep_axis)
-    params = shard_params(init_params_for(key, cfg), mesh, specs)
+    host_params = init_params_for(key, cfg)
+    if fsdp_axis is not None:
+        specs = fsdp_wrap_specs(specs, host_params, fsdp_axis,
+                                axis_size=mesh.shape[fsdp_axis])
+    params = shard_params(host_params, mesh, specs)
     opt_state = optimizer.init(params)
     return TrainState(params=params, opt_state=opt_state,
                       step=jnp.zeros((), jnp.int32)), specs

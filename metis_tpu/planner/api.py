@@ -30,6 +30,7 @@ from metis_tpu.cost.estimator import (
 )
 from metis_tpu.cost.context_parallel import cp_candidates
 from metis_tpu.cost.expert_parallel import ep_candidates
+from metis_tpu.cost.zero import zero_candidates
 from metis_tpu.cost.ici import IciDcnBandwidth
 from metis_tpu.cost.volume import TransformerVolume
 from metis_tpu.search.inter_stage import inter_stage_plans
@@ -99,7 +100,9 @@ def plan_hetero(
     ep_degrees: list[int] = [1]
     if config.enable_ep and not config.strict_compat:
         ep_degrees += ep_candidates(config.max_ep_degree, model.num_experts)
-    families = list(product(cp_degrees, ep_degrees))
+    zero_stages = zero_candidates(
+        config.enable_zero and not config.strict_compat)
+    families = list(product(cp_degrees, ep_degrees, zero_stages))
 
     results: list[RankedPlan] = []
     pruned = 0
@@ -120,16 +123,16 @@ def plan_hetero(
                 len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
                 for s in range(inter.num_stages)
             ]
-        # one try-block per (cp, ep) family: a profile miss mid-generation
-        # prunes only that family, not its siblings on this inter plan
-        for cp, ep in families:
+        # one try-block per (cp, ep, zero) family: a profile miss
+        # mid-generation prunes only that family, not its siblings
+        for cp, ep, zero in families:
             try:
                 for intra in intra_stage_plans(
                     inter, evaluator, balancer,
                     max_tp=config.max_profiled_tp,
                     max_bs=config.max_profiled_bs,
                     cp_degrees=(cp,), cp_eligible=cp_eligible,
-                    ep_degrees=(ep,),
+                    ep_degrees=(ep,), zero_stages=(zero,),
                 ):
                     try:
                         cost = estimator.get_cost(

@@ -60,6 +60,8 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
                    help="search expert-parallel (MoE) plan families")
     g.add_argument("--max-ep", type=int, default=8,
                    help="largest expert-parallel degree to search")
+    g.add_argument("--enable-zero", action="store_true",
+                   help="search ZeRO-1/2/3 sharded-state plan families")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
 
@@ -95,6 +97,7 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         max_cp_degree=args.max_cp,
         enable_ep=args.enable_ep,
         max_ep_degree=args.max_ep,
+        enable_zero=args.enable_zero,
     )
 
 

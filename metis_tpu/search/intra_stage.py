@@ -64,6 +64,7 @@ def initial_strategies(
     cp: int = 1,
     cp_eligible: Sequence[bool] | None = None,
     ep: int = 1,
+    zero: int = 0,
 ) -> tuple[Strategy, ...] | None:
     """Every stage starts fully data-parallel (``plan.py:231-236``).
 
@@ -76,7 +77,7 @@ def initial_strategies(
     (degenerate family — identical to a lower-degree search).
     """
     out = []
-    any_cp, any_ep = False, False
+    any_cp, any_ep, any_zero = False, False, False
     for stage_id, g in enumerate(plan.device_groups):
         eligible = cp_eligible is None or cp_eligible[stage_id]
         stage_cp = cp if (cp > 1 and eligible and g % cp == 0) else 1
@@ -84,10 +85,16 @@ def initial_strategies(
         dp = g // stage_cp
         stage_ep = ep if (ep > 1 and dp % ep == 0) else 1
         any_ep |= stage_ep > 1
-        out.append(Strategy(dp=dp, tp=1, cp=stage_cp, ep=stage_ep))
+        # ZeRO needs >1 data rank to shard over
+        stage_zero = zero if dp * stage_cp > 1 else 0
+        any_zero |= stage_zero > 0
+        out.append(Strategy(dp=dp, tp=1, cp=stage_cp, ep=stage_ep,
+                            zero=stage_zero))
     if cp > 1 and not any_cp:
         return None
     if ep > 1 and not any_ep:
+        return None
+    if zero > 0 and not any_zero:
         return None
     return tuple(out)
 
@@ -124,7 +131,10 @@ def escalate_dp_to_tp(
         s = out[stage_id]
         # ep must keep dividing dp after the halving (ep rides inside dp)
         if s.dp != 1 and (s.ep <= 1 or (s.dp // 2) % s.ep == 0):
-            out[stage_id] = Strategy(dp=s.dp // 2, tp=s.tp * 2, sp=s.sp, cp=s.cp, ep=s.ep)
+            # zero degenerates to 0 when no data ranks remain to shard over
+            new_zero = s.zero if (s.dp // 2) * s.cp > 1 else 0
+            out[stage_id] = Strategy(dp=s.dp // 2, tp=s.tp * 2, sp=s.sp,
+                                     cp=s.cp, ep=s.ep, zero=new_zero)
             return tuple(out)
     return None
 
@@ -138,17 +148,18 @@ def intra_stage_plans(
     cp_degrees: Sequence[int] = (1,),
     cp_eligible: Sequence[bool] | None = None,
     ep_degrees: Sequence[int] = (1,),
+    zero_stages: Sequence[int] = (0,),
 ) -> Iterator[IntraStagePlan]:
     """Yield feasible intra-stage plans for one inter-stage candidate.
 
-    ``cp_degrees`` x ``ep_degrees`` extend the reference's (dp, tp) space with
-    context-parallel and expert-parallel families (net-new, SURVEY.md §5): for
-    each (cp, ep) pair the same escalation runs with the extra axes carved out
-    of every eligible stage.  The cost estimator ranks the families against
-    each other.
+    ``cp_degrees`` x ``ep_degrees`` x ``zero_stages`` extend the reference's
+    (dp, tp) space with context-parallel, expert-parallel, and ZeRO families
+    (net-new, SURVEY.md §5): for each combination the same escalation runs
+    with the extra axes carved out of every eligible stage.  The cost
+    estimator ranks the families against each other.
     """
-    for cp, ep in product(cp_degrees, ep_degrees):
-        strategies = initial_strategies(plan, cp, cp_eligible, ep)
+    for cp, ep, zero in product(cp_degrees, ep_degrees, zero_stages):
+        strategies = initial_strategies(plan, cp, cp_eligible, ep, zero)
         memory_state: tuple[float, ...] | None = None
 
         while strategies is not None:
@@ -165,5 +176,5 @@ def intra_stage_plans(
                         num_repartition=result.attempts,
                     )
                     if result.attempts == 1:
-                        break  # this (cp, ep) family is satisfied; next
+                        break  # this (cp, ep, zero) family is satisfied; next
             strategies = escalate_dp_to_tp(strategies, memory_state)

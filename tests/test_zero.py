@@ -1,0 +1,228 @@
+"""ZeRO/FSDP plan axis: cost/memory model, planner families, and the
+FSDP-sharded execution path."""
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metis_tpu.cost.zero import (
+    shardable_bytes_per_param_byte,
+    zero_candidates,
+    zero_dp_factor,
+    zero_static_reduction_mb,
+)
+
+
+class TestZeroCostModel:
+    def test_candidates(self):
+        assert zero_candidates(False) == [0]
+        assert zero_candidates(True) == [0, 1, 2, 3]
+
+    def test_dp_factor(self):
+        assert zero_dp_factor(0) == 1.0
+        assert zero_dp_factor(1) == 1.0
+        assert zero_dp_factor(2) == 1.0
+        assert zero_dp_factor(3) == 1.5
+
+    def test_shardable_bytes_progression(self):
+        # bf16 params: Adam fp32 state = 12B per 2B stored -> 6x at stage 1
+        assert shardable_bytes_per_param_byte(2, 0) == 0.0
+        assert shardable_bytes_per_param_byte(2, 1) == 6.0
+        assert shardable_bytes_per_param_byte(2, 2) == 7.0
+        assert shardable_bytes_per_param_byte(2, 3) == 8.0
+
+    def test_reduction_scaling(self):
+        params = (1024 * 1024, 2 * 1024 * 1024)
+        # stage 3, 4 ranks, tp=1: 8x param bytes, 3/4 sharded away
+        got = zero_static_reduction_mb(params, 3, 4, tp=1, dtype_bytes=2)
+        assert got == pytest.approx((8 * 0.75, 16 * 0.75))
+        # tp halves the per-rank stored params
+        got_tp2 = zero_static_reduction_mb(params, 3, 4, tp=2, dtype_bytes=2)
+        assert got_tp2 == pytest.approx((4 * 0.75, 8 * 0.75))
+        assert zero_static_reduction_mb(params, 0, 4) is None
+        assert zero_static_reduction_mb(params, 3, 1) is None
+
+    def test_reduction_with_experts_never_optimistic(self):
+        """Expert state replicates over only d/ep ranks — ZeRO recovers
+        (1 - ep/d) of it, and nothing when d == ep."""
+        params = (1024 * 1024, 1024 * 1024, 1024 * 1024)  # embed/block/head
+        d, ep, frac = 8, 4, 0.5
+        got = zero_static_reduction_mb(params, 1, d, dtype_bytes=2,
+                                       expert_frac=frac, ep=ep)
+        per_byte = 6.0
+        dense_f, exp_f = 1 - 1 / d, 1 - 1 / (d // ep)
+        want_block = per_byte * ((1 - frac) * dense_f + frac / ep * exp_f)
+        assert got[1] == pytest.approx(want_block)
+        # embed/head are expert-free: plain dense relief
+        assert got[0] == got[2] == pytest.approx(per_byte * dense_f)
+        # d == ep: expert state cannot shard further
+        got_eq = zero_static_reduction_mb(params, 1, 4, dtype_bytes=2,
+                                          expert_frac=frac, ep=4)
+        assert got_eq[1] == pytest.approx(
+            per_byte * (1 - frac) * (1 - 1 / 4))
+
+    def test_escalation_drops_degenerate_zero(self):
+        from metis_tpu.core.types import Strategy
+        from metis_tpu.search.intra_stage import escalate_dp_to_tp
+
+        s = (Strategy(dp=2, tp=2, zero=3),)
+        out = escalate_dp_to_tp(s, None)
+        assert out[0].dp == 1 and out[0].zero == 0
+
+
+@pytest.fixture(scope="module")
+def planner_setup():
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+    model = tiny_test_model()
+    store = synthesize_profiles(model, ["A100"], tps=[1, 2, 4],
+                                bss=[1, 2, 4, 8, 16])
+    cluster = ClusterSpec.homogeneous("A100", num_nodes=2, devices_per_node=4)
+    return model, store, cluster
+
+
+class TestPlannerZeroFamilies:
+    def test_zero_families_searched(self, planner_setup):
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_hetero
+
+        model, store, cluster = planner_setup
+        result = plan_hetero(cluster, store, model,
+                             SearchConfig(gbs=64, enable_zero=True))
+        zeros = {s.zero for r in result.plans for s in r.intra.strategies}
+        assert zeros == {0, 1, 2, 3}, f"zero stages missing: {zeros}"
+
+    def test_zero_cuts_optimizer_cost(self, planner_setup):
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_hetero
+
+        model, store, cluster = planner_setup
+        result = plan_hetero(cluster, store, model,
+                             SearchConfig(gbs=64, enable_zero=True))
+
+        def best(pred):
+            ms = [r for r in result.plans
+                  if all(pred(s) for s in r.intra.strategies)
+                  and all(s.dp * s.cp > 1 for s in r.intra.strategies)]
+            return ms[0] if ms else None
+
+        z0 = best(lambda s: s.zero == 0)
+        z1 = best(lambda s: s.zero == 1)
+        assert z0 is not None and z1 is not None
+        # same-shape plans exist in both families; the zero-1 family's best
+        # optimizer cost must undercut the replicated one
+        assert z1.cost.optimizer_ms < z0.cost.optimizer_ms
+
+    def test_zero3_charges_gather_traffic(self, planner_setup):
+        """Same (inter, strategies) plan at zero 2 vs 3: dp comm is 1.5x."""
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_hetero
+
+        model, store, cluster = planner_setup
+        result = plan_hetero(cluster, store, model,
+                             SearchConfig(gbs=64, enable_zero=True))
+        by_key = {}
+        for r in result.plans:
+            zset = {s.zero for s in r.intra.strategies}
+            if len(zset) != 1:
+                continue
+            key = (r.inter, tuple((s.dp, s.tp, s.cp) for s in r.intra.strategies),
+                   r.intra.layer_partition)
+            by_key.setdefault(key, {})[zset.pop()] = r
+        pairs = [v for v in by_key.values() if 2 in v and 3 in v
+                 and v[2].cost.dp_comm_ms > 0]
+        assert pairs
+        for v in pairs[:5]:
+            assert v[3].cost.dp_comm_ms == pytest.approx(
+                1.5 * v[2].cost.dp_comm_ms)
+
+
+class TestZeroMemoryRelief:
+    def test_memory_row_monotone_in_stage(self, planner_setup):
+        from metis_tpu.balance.layers import LayerBalancer
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.core.types import Strategy
+
+        model, store, cluster = planner_setup
+        bal = LayerBalancer(cluster, store, SearchConfig(gbs=64), model=model)
+        rows = [
+            bal._sharded_memory_row("A100", 4, Strategy(dp=4, tp=1, zero=z))
+            for z in (0, 1, 2, 3)
+        ]
+        totals = [sum(r) for r in rows]
+        assert totals[0] > totals[1] > totals[2] > totals[3]
+
+
+class TestFsdpExecution:
+    def test_fsdp_specs_shard_large_params(self):
+        from jax.sharding import PartitionSpec as P
+        from metis_tpu.execution import fsdp_wrap_specs, param_specs_for
+        from metis_tpu.models import GPTConfig, init_params
+
+        cfg = GPTConfig(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                        num_blocks=2, dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        specs = fsdp_wrap_specs(
+            param_specs_for(cfg, tp_axis=None), params, dp_axis="dp")
+        # vocab dim (largest) of the embedding shards over dp
+        assert specs["embed"]["tok"] == P("dp", None)
+        # stacked qkv [L, 3, h, h]: one h dim takes dp
+        assert "dp" in tuple(specs["blocks"]["qkv"])
+        # truly-1D leaves (unstacked head norms) stay replicated
+        assert specs["head"]["ln_scale"] == P()
+
+    def test_fsdp_specs_respect_divisibility(self):
+        """Dims not divisible by the dp axis size fall to the next largest
+        divisible dim, or stay replicated."""
+        from jax.sharding import PartitionSpec as P
+        from metis_tpu.execution import fsdp_wrap_specs, param_specs_for
+        from metis_tpu.models import GPTConfig, init_params
+
+        cfg = GPTConfig(vocab_size=131, seq_len=16, hidden=32, num_heads=2,
+                        num_blocks=2, dtype=jnp.float32)  # prime vocab
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        specs = fsdp_wrap_specs(param_specs_for(cfg, tp_axis=None), params,
+                                dp_axis="dp", axis_size=8)
+        # vocab 131 % 8 != 0: embedding shards its hidden dim instead
+        assert specs["embed"]["tok"] == P(None, "dp")
+        # head out [h=32, v=131]: hidden shards
+        assert specs["head"]["out"] == P("dp", None)
+
+    def test_fsdp_step_matches_unsharded(self):
+        import numpy as onp
+        from jax.sharding import Mesh
+        from metis_tpu.execution import (
+            DP, build_train_state, make_train_step)
+        from metis_tpu.models import GPTConfig, init_params
+        from metis_tpu.models.gpt import next_token_loss
+
+        cfg = GPTConfig(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                        num_blocks=2, dtype=jnp.float32)
+        mesh = Mesh(onp.array(jax.devices()[:8]).reshape(8), (DP,))
+        state, specs = build_train_state(
+            jax.random.PRNGKey(0), cfg, mesh, tp_axis=None, fsdp_axis=DP)
+        step = make_train_step(cfg, mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 128)
+        _, loss = step(state, tokens, tokens)
+
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        want = next_token_loss(params, tokens, tokens, cfg)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+
+    def test_fsdp_opt_state_is_sharded(self):
+        import numpy as onp
+        from jax.sharding import Mesh
+        from metis_tpu.execution import DP, build_train_state
+        from metis_tpu.models import GPTConfig
+
+        cfg = GPTConfig(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                        num_blocks=2, dtype=jnp.float32)
+        mesh = Mesh(onp.array(jax.devices()[:8]).reshape(8), (DP,))
+        state, _ = build_train_state(
+            jax.random.PRNGKey(0), cfg, mesh, tp_axis=None, fsdp_axis=DP)
+        # Adam mu for the embedding must carry the dp sharding
+        mu_tok = state.opt_state[0].mu["embed"]["tok"]
+        assert "dp" in str(mu_tok.sharding.spec)
