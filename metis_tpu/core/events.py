@@ -1,0 +1,54 @@
+"""Structured JSONL event log.
+
+SURVEY.md §5 "Metrics / logging": the reference observes itself with bare
+``print()`` calls redirected to a log file by its bash wrapper.  This is the
+machine-readable replacement: one JSON object per line, wall-clock stamped,
+safe to tail.  A disabled log (no sink) is a no-op so call sites never guard.
+"""
+from __future__ import annotations
+
+import io
+import json
+import time
+from pathlib import Path
+from typing import IO, Any
+
+
+class EventLog:
+    """Append-only JSONL sink.  ``EventLog(path)`` writes to a file,
+    ``EventLog(stream=...)`` to any text stream, ``EventLog()`` discards."""
+
+    def __init__(self, path: str | Path | None = None,
+                 stream: IO[str] | None = None):
+        self._stream: IO[str] | None = stream
+        self._path = Path(path) if path is not None else None
+        if self._path is not None and stream is not None:
+            raise ValueError("pass either path or stream, not both")
+
+    @property
+    def enabled(self) -> bool:
+        return self._path is not None or self._stream is not None
+
+    def emit(self, event: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        record = {"ts": time.time(), "event": event, **fields}
+        line = json.dumps(record, default=str) + "\n"
+        if self._stream is not None:
+            self._stream.write(line)
+            self._stream.flush()
+        else:
+            with open(self._path, "a") as f:
+                f.write(line)
+
+
+NULL_LOG = EventLog()
+
+
+def read_events(path: str | Path) -> list[dict]:
+    """Parse a JSONL event file back into dicts."""
+    out = []
+    for line in Path(path).read_text().splitlines():
+        if line.strip():
+            out.append(json.loads(line))
+    return out

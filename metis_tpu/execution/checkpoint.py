@@ -1,0 +1,158 @@
+"""Checkpoint / resume for training state and plan artifacts.
+
+The reference has no checkpointing at all (SURVEY.md §5 "Checkpoint / resume —
+Absent"; its planner output is an ephemeral stdout ranking).  Two durable
+artifacts live here:
+
+1. **The chosen plan** — ``PlanArtifact`` (execution.mesh) serialized next to
+   the weights.  A plan is the "checkpoint of the search": re-planning is
+   cheap, but the artifact pins exactly which mesh/shardings a run used, so
+   resume never silently retrains under a different layout.
+2. **Training state** — params + optax state + step via orbax, the TPU-native
+   checkpointer: sharded arrays are written per-shard (each host/device
+   writes its own slice — no gather through host 0) and restored directly
+   onto the target ``NamedSharding``s, so a checkpoint written on one mesh
+   restores onto another (e.g. elastic re-plan after a topology change,
+   planner/replan.py) without a resharding pass through host memory.
+"""
+from __future__ import annotations
+
+import json
+import shutil
+from dataclasses import dataclass
+from pathlib import Path
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding
+
+from metis_tpu.execution.mesh import PlanArtifact
+from metis_tpu.execution.train import TrainState
+
+_STATE_DIR = "state"
+_PLAN_FILE = "plan.json"
+_META_FILE = "meta.json"
+
+
+@dataclass(frozen=True)
+class CheckpointMeta:
+    """Sidecar metadata — enough to sanity-check a resume."""
+
+    step: int
+    mesh_axes: tuple[str, ...]
+    mesh_shape: tuple[int, ...]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "step": self.step,
+            "mesh_axes": list(self.mesh_axes),
+            "mesh_shape": list(self.mesh_shape),
+        }, indent=2)
+
+    @staticmethod
+    def from_json(payload: str) -> "CheckpointMeta":
+        d = json.loads(payload)
+        return CheckpointMeta(
+            step=d["step"],
+            mesh_axes=tuple(d["mesh_axes"]),
+            mesh_shape=tuple(d["mesh_shape"]),
+        )
+
+
+def save_checkpoint(
+    directory: str | Path,
+    state: TrainState,
+    mesh: Mesh,
+    plan: PlanArtifact | None = None,
+) -> Path:
+    """Write state (+ optional plan artifact) under ``directory``.
+
+    Crash-safe overwrite: the new checkpoint is fully written into a ``.tmp``
+    sibling first, the previous checkpoint is parked at ``.prev`` during the
+    swap, and ``restore_checkpoint``/``load_meta`` fall back to ``.prev`` if a
+    crash leaves the primary missing — at every instant one complete
+    checkpoint is on disk.  Synchronous — returns when the swap is done."""
+    directory = Path(directory).absolute()
+    tmp = directory.with_name(directory.name + ".tmp")
+    prev = directory.with_name(directory.name + ".prev")
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+
+    tree = {"params": state.params, "opt_state": state.opt_state,
+            "step": state.step}
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(tmp / _STATE_DIR, tree, force=True)
+    meta = CheckpointMeta(
+        step=int(state.step),
+        mesh_axes=tuple(mesh.axis_names),
+        mesh_shape=tuple(mesh.devices.shape),
+    )
+    (tmp / _META_FILE).write_text(meta.to_json())
+    if plan is not None:
+        (tmp / _PLAN_FILE).write_text(plan.to_json())
+
+    if prev.exists():
+        shutil.rmtree(prev)
+    if directory.exists():
+        directory.rename(prev)
+    tmp.rename(directory)
+    if prev.exists():
+        shutil.rmtree(prev)
+    return directory
+
+
+def _resolve_dir(directory: str | Path) -> Path:
+    """The primary checkpoint dir, or its ``.prev`` backup if a crash
+    interrupted the last save mid-swap."""
+    directory = Path(directory).absolute()
+    if directory.exists():
+        return directory
+    prev = directory.with_name(directory.name + ".prev")
+    if prev.exists():
+        return prev
+    return directory
+
+
+def load_meta(directory: str | Path) -> CheckpointMeta:
+    return CheckpointMeta.from_json(
+        (_resolve_dir(directory) / _META_FILE).read_text())
+
+
+def load_plan(directory: str | Path) -> PlanArtifact | None:
+    p = _resolve_dir(directory) / _PLAN_FILE
+    return PlanArtifact.from_json(p.read_text()) if p.exists() else None
+
+
+def restore_checkpoint(
+    directory: str | Path,
+    reference_state: TrainState,
+    mesh: Mesh | None = None,
+) -> TrainState:
+    """Restore a TrainState shaped/sharded like ``reference_state`` (built
+    with ``build_train_state`` on the *target* mesh — which may differ from
+    the mesh the checkpoint was written on; orbax reshards on read)."""
+    directory = _resolve_dir(directory)
+    ref = {"params": reference_state.params,
+           "opt_state": reference_state.opt_state,
+           "step": reference_state.step}
+
+    def as_restore(leaf):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding") and \
+                isinstance(leaf.sharding, NamedSharding):
+            return ocp.ArrayRestoreArgs(
+                sharding=leaf.sharding, global_shape=leaf.shape,
+                dtype=leaf.dtype)
+        return ocp.RestoreArgs()
+
+    restore_args = jax.tree.map(as_restore, ref)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.restore(
+            directory / _STATE_DIR,
+            args=ocp.args.PyTreeRestore(item=ref, restore_args=restore_args))
+    step = tree["step"]
+    if not isinstance(step, jax.Array):
+        step = jax.numpy.asarray(np.asarray(step))
+    return TrainState(params=tree["params"], opt_state=tree["opt_state"],
+                      step=step)

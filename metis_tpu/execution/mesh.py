@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass
+from pathlib import Path
 
 import jax
 import numpy as np
@@ -23,24 +24,25 @@ from metis_tpu.models.gpt import GPTConfig
 PP, DP, TP, SP, EP = "pp", "dp", "tp", "sp", "ep"
 
 
-def mesh_for_uniform_plan(plan: UniformPlan, devices=None) -> Mesh:
-    """(pp, dp, tp) mesh over the device list (row-major, matching the
-    planner's linear rank placement)."""
+def _grid(shape: tuple[int, ...], axes: tuple[str, ...], devices=None) -> Mesh:
+    """Shared mesh construction: default device list, size check, row-major
+    reshape (matching the planner's linear rank placement)."""
     devs = np.asarray(devices if devices is not None else jax.devices())
-    need = plan.pp * plan.dp * plan.tp
+    need = int(np.prod(shape))
     if devs.size < need:
-        raise ValueError(f"plan needs {need} devices, have {devs.size}")
-    grid = devs.flatten()[:need].reshape(plan.pp, plan.dp, plan.tp)
-    return Mesh(grid, (PP, DP, TP))
+        raise ValueError(
+            f"mesh {axes}={shape} needs {need} devices, have {devs.size}")
+    return Mesh(devs.flatten()[:need].reshape(shape), axes)
+
+
+def mesh_for_uniform_plan(plan: UniformPlan, devices=None) -> Mesh:
+    """(pp, dp, tp) mesh over the device list."""
+    return _grid((plan.pp, plan.dp, plan.tp), (PP, DP, TP), devices)
 
 
 def mesh_dp_tp(dp: int, tp: int, devices=None) -> Mesh:
     """(dp, tp) mesh for non-pipelined execution."""
-    devs = np.asarray(devices if devices is not None else jax.devices())
-    if devs.size < dp * tp:
-        raise ValueError(f"mesh needs {dp * tp} devices, have {devs.size}")
-    grid = devs.flatten()[: dp * tp].reshape(dp, tp)
-    return Mesh(grid, (DP, TP))
+    return _grid((dp, tp), (DP, TP), devices)
 
 
 def gpt_param_specs(cfg: GPTConfig, tp_axis: str = TP, pp_axis: str | None = None) -> dict:
@@ -124,6 +126,10 @@ class PlanArtifact:
     strategies: tuple[dict, ...]
     gbs: int
     microbatches: int
+    # hetero extras (empty for uniform plans): device-type placement order and
+    # per-stage device counts — non-rectangular stages don't form one mesh
+    node_sequence: tuple[str, ...] = ()
+    device_groups: tuple[int, ...] = ()
 
     def to_json(self) -> str:
         return json.dumps({
@@ -133,6 +139,8 @@ class PlanArtifact:
             "strategies": list(self.strategies),
             "gbs": self.gbs,
             "microbatches": self.microbatches,
+            "node_sequence": list(self.node_sequence),
+            "device_groups": list(self.device_groups),
         }, indent=2)
 
     @staticmethod
@@ -145,7 +153,24 @@ class PlanArtifact:
             strategies=tuple(d["strategies"]),
             gbs=d["gbs"],
             microbatches=d["microbatches"],
+            node_sequence=tuple(d.get("node_sequence", ())),
+            device_groups=tuple(d.get("device_groups", ())),
         )
+
+    def save(self, path) -> None:
+        Path(path).write_text(self.to_json())
+
+    @staticmethod
+    def load(path) -> "PlanArtifact":
+        return PlanArtifact.from_json(Path(path).read_text())
+
+    def build_mesh(self, devices=None) -> Mesh:
+        """Reconstruct the mesh for a rectangular (uniform-stage) artifact."""
+        if not self.mesh_shape:
+            raise ValueError(
+                "artifact has non-uniform stages; build per-stage meshes from "
+                "device_groups/strategies instead")
+        return _grid(self.mesh_shape, self.mesh_axes, devices)
 
     @staticmethod
     def from_uniform_plan(plan: UniformPlan) -> "PlanArtifact":
@@ -156,4 +181,33 @@ class PlanArtifact:
             strategies=({"dp": plan.dp, "tp": plan.tp},),
             gbs=plan.gbs,
             microbatches=plan.num_microbatches,
+        )
+
+    @staticmethod
+    def from_ranked_plan(ranked) -> "PlanArtifact":
+        """Capture a hetero planner result (planner.api.RankedPlan).  When
+        every stage shares one strategy shape the artifact is rectangular
+        with every plan axis named honestly — (pp, dp, ep, sp, tp), trivial
+        axes kept at size 1 — so consumers shard the batch over (dp, ep),
+        run ring attention over sp, and shard experts over ep, exactly as
+        costed.  Otherwise mesh fields stay empty and per-stage data drives
+        execution."""
+        from dataclasses import asdict
+
+        inter, intra = ranked.inter, ranked.intra
+        strategies = tuple(asdict(s) for s in intra.strategies)
+        uniform = len(
+            {(s.dp, s.tp, s.cp, s.ep) for s in intra.strategies}) == 1
+        s0 = intra.strategies[0]
+        return PlanArtifact(
+            mesh_axes=(PP, DP, EP, SP, TP) if uniform else (),
+            mesh_shape=(
+                (inter.num_stages, s0.dp // s0.ep, s0.ep, s0.cp, s0.tp)
+                if uniform else ()),
+            layer_partition=tuple(intra.layer_partition),
+            strategies=strategies,
+            gbs=inter.gbs,
+            microbatches=inter.batches,
+            node_sequence=tuple(inter.node_sequence),
+            device_groups=tuple(inter.device_groups),
         )

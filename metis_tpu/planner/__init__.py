@@ -15,3 +15,6 @@ __all__ = [
     "plan_tpu",
     "plan_uniform",
 ]
+from metis_tpu.planner.replan import ClusterDelta, ReplanReport, replan
+
+__all__ += ["ClusterDelta", "ReplanReport", "replan"]

@@ -19,6 +19,7 @@ from itertools import product
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.cluster.tpu import TpuClusterSpec
 from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.types import RankedPlan, UniformPlan, PlanCost
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.layers import LayerBalancer
@@ -80,6 +81,7 @@ def plan_hetero(
     config: SearchConfig,
     bandwidth_factory=None,
     top_k: int | None = None,
+    events: EventLog = NULL_LOG,
 ) -> PlannerResult:
     """Full heterogeneous search: inter-stage × intra-stage candidates,
     costed and ranked (≅ ``cost_het_cluster``)."""
@@ -103,6 +105,10 @@ def plan_hetero(
     zero_stages = zero_candidates(
         config.enable_zero and not config.strict_compat)
     families = list(product(cp_degrees, ep_degrees, zero_stages))
+    events.emit(
+        "search_started", mode="hetero", devices=cluster.total_devices,
+        device_types=list(cluster.device_types), gbs=config.gbs,
+        num_families=len(families), model=model.name)
 
     results: list[RankedPlan] = []
     pruned = 0
@@ -150,11 +156,16 @@ def plan_hetero(
     num_costed = len(results)
     if top_k is not None:
         results = results[:top_k]
+    elapsed = time.perf_counter() - t0
+    events.emit(
+        "search_finished", mode="hetero", num_costed=num_costed,
+        num_pruned=pruned, seconds=round(elapsed, 4),
+        best_cost_ms=results[0].cost.total_ms if results else None)
     return PlannerResult(
         plans=tuple(results),
         num_costed=num_costed,
         num_pruned=pruned,
-        search_seconds=time.perf_counter() - t0,
+        search_seconds=elapsed,
     )
 
 
@@ -166,11 +177,15 @@ def plan_uniform(
     device_type: str | None = None,
     include_oom: bool = False,
     top_k: int | None = None,
+    events: EventLog = NULL_LOG,
 ) -> UniformPlannerResult:
     """Homogeneous Megatron-grid sweep at the configured gbs
     (≅ ``cost_homo_cluster``)."""
     t0 = time.perf_counter()
     dtype = device_type or cluster.device_types[0]
+    events.emit(
+        "search_started", mode="uniform", devices=cluster.total_devices,
+        device_types=[dtype], gbs=config.gbs, model=model.name)
     volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
     estimator = UniformCostEstimator(
         cluster, profiles, volume, EstimatorOptions.from_config(config))
@@ -200,12 +215,17 @@ def plan_uniform(
     ranked.sort(key=lambda r: r.cost.total_ms)
     if top_k is not None:
         ranked = ranked[:top_k]
+    elapsed = time.perf_counter() - t0
+    events.emit(
+        "search_finished", mode="uniform", num_costed=num_costed,
+        num_pruned=pruned, seconds=round(elapsed, 4),
+        best_cost_ms=ranked[0].cost.total_ms if ranked else None)
     return UniformPlannerResult(
         plans=tuple(ranked),
         num_costed=num_costed,
         num_pruned=pruned,
         num_oom_excluded=oom_excluded,
-        search_seconds=time.perf_counter() - t0,
+        search_seconds=elapsed,
     )
 
 
@@ -216,6 +236,7 @@ def plan_tpu(
     config: SearchConfig,
     chips_per_node: int = 4,
     top_k: int | None = None,
+    events: EventLog = NULL_LOG,
 ) -> PlannerResult:
     """Heterogeneous search over TPU slices with the ICI/DCN-aware bandwidth
     model (the BASELINE.md north-star path: e.g. v4-32 + v5e-16 over DCN)."""
@@ -224,4 +245,5 @@ def plan_tpu(
         cluster, profiles, model, config,
         bandwidth_factory=lambda plan: IciDcnBandwidth(tpu_cluster, plan),
         top_k=top_k,
+        events=events,
     )

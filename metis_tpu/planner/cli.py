@@ -24,6 +24,7 @@ import sys
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.cluster.tpu import TpuClusterSpec, slice_from_name
 from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.types import dump_ranked_plans
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.planner.api import plan_hetero, plan_tpu, plan_uniform
@@ -64,6 +65,8 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
                    help="search ZeRO-1/2/3 sharded-state plan families")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
+    g.add_argument("--events", default=None,
+                   help="append structured JSONL search events to this file")
 
 
 def _add_cluster_args(p: argparse.ArgumentParser) -> None:
@@ -142,21 +145,26 @@ def main(argv: list[str] | None = None) -> int:
     model = _model_from_args(args)
     config = _config_from_args(args)
 
+    events = EventLog(args.events) if args.events else NULL_LOG
+
     if args.command == "hetero":
         cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
-        result = plan_hetero(cluster, profiles, model, config, top_k=args.top_k)
+        result = plan_hetero(cluster, profiles, model, config, top_k=args.top_k,
+                             events=events)
         _emit(args, dump_ranked_plans(result.plans))
     elif args.command == "tpu":
         tpu_cluster = TpuClusterSpec(tuple(
             slice_from_name(s.strip()) for s in args.slices.split(",")))
         result = plan_tpu(tpu_cluster, profiles, model, config,
-                          chips_per_node=args.chips_per_node, top_k=args.top_k)
+                          chips_per_node=args.chips_per_node, top_k=args.top_k,
+                          events=events)
         _emit(args, dump_ranked_plans(result.plans))
     else:
         cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
         result = plan_uniform(cluster, profiles, model, config,
                               device_type=args.device_type,
-                              include_oom=args.include_oom, top_k=args.top_k)
+                              include_oom=args.include_oom, top_k=args.top_k,
+                              events=events)
         payload = json.dumps([
             {
                 "rank": i + 1,

@@ -1,0 +1,99 @@
+"""Elastic re-planning on topology change.
+
+SURVEY.md §5 ("Failure detection / elastic recovery"): the reference's only
+fault posture is per-plan pruning; its natural recovery mechanism — re-running
+the planner against an edited cluster file — is manual.  This module makes it
+a first-class API: diff two cluster descriptions, re-plan on the survivor
+topology, and report what changed, so an orchestrator can drop a failed slice,
+re-plan in seconds, and resume from the last checkpoint
+(execution.checkpoint restores onto the new mesh).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+
+from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.planner.api import PlannerResult, plan_hetero
+from metis_tpu.profiles.store import ProfileStore
+
+
+@dataclass(frozen=True)
+class ClusterDelta:
+    """Device-count changes by type between two cluster descriptions."""
+
+    added: dict[str, int]
+    removed: dict[str, int]
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.added and not self.removed
+
+    @staticmethod
+    def between(old: ClusterSpec, new: ClusterSpec) -> "ClusterDelta":
+        old_counts = Counter()
+        new_counts = Counter()
+        for node in old.nodes:
+            old_counts[node.device_type] += node.num_devices
+        for node in new.nodes:
+            new_counts[node.device_type] += node.num_devices
+        added = {t: new_counts[t] - old_counts[t]
+                 for t in new_counts if new_counts[t] > old_counts.get(t, 0)}
+        removed = {t: old_counts[t] - new_counts[t]
+                   for t in old_counts if old_counts[t] > new_counts.get(t, 0)}
+        return ClusterDelta(added=added, removed=removed)
+
+
+@dataclass(frozen=True)
+class ReplanReport:
+    """Outcome of an elastic re-plan."""
+
+    delta: ClusterDelta
+    result: PlannerResult
+    old_best_cost_ms: float | None
+    new_best_cost_ms: float | None
+    plan_changed: bool
+
+    @property
+    def cost_ratio(self) -> float | None:
+        """New best step time relative to the old one (>1 = slower — the
+        price of the lost capacity)."""
+        if self.old_best_cost_ms and self.new_best_cost_ms:
+            return self.new_best_cost_ms / self.old_best_cost_ms
+        return None
+
+
+def replan(
+    old_cluster: ClusterSpec,
+    new_cluster: ClusterSpec,
+    profiles: ProfileStore,
+    model: ModelSpec,
+    config: SearchConfig,
+    old_result: PlannerResult | None = None,
+    **plan_kwargs,
+) -> ReplanReport:
+    """Re-plan against ``new_cluster`` and report the topology delta and cost
+    movement.  ``old_result`` (if available) supplies the previous best cost
+    and plan identity; otherwise the old cluster is re-planned too."""
+    delta = ClusterDelta.between(old_cluster, new_cluster)
+    if old_result is None:
+        old_result = plan_hetero(old_cluster, profiles, model, config,
+                                 **plan_kwargs)
+    new_result = plan_hetero(new_cluster, profiles, model, config,
+                             **plan_kwargs)
+
+    old_best, new_best = old_result.best, new_result.best
+    changed = (
+        old_best is None or new_best is None
+        or old_best.inter != new_best.inter
+        or old_best.intra.strategies != new_best.intra.strategies
+        or old_best.intra.layer_partition != new_best.intra.layer_partition
+    )
+    return ReplanReport(
+        delta=delta,
+        result=new_result,
+        old_best_cost_ms=old_best.cost.total_ms if old_best else None,
+        new_best_cost_ms=new_best.cost.total_ms if new_best else None,
+        plan_changed=changed,
+    )

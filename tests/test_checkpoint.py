@@ -1,0 +1,200 @@
+"""Checkpoint/resume: exact resume equivalence, cross-mesh restore, and plan
+artifact round-trips."""
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from metis_tpu.execution import (
+    DP,
+    TP,
+    PlanArtifact,
+    build_train_state,
+    load_meta,
+    load_plan,
+    make_train_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from metis_tpu.models import GPTConfig
+
+
+def tiny_cfg():
+    return GPTConfig(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                     num_blocks=2, dtype=jnp.float32)
+
+
+def dp_tp_mesh(dp, tp):
+    return Mesh(onp.array(jax.devices()[:dp * tp]).reshape(dp, tp), (DP, TP))
+
+
+def batch(key, n=8):
+    return jax.random.randint(key, (n, 16), 0, 128)
+
+
+class TestTrainStateCheckpoint:
+    def test_resume_is_bit_identical(self, tmp_path):
+        """2 steps + save + restore + 2 steps == 4 uninterrupted steps."""
+        cfg = tiny_cfg()
+        mesh = dp_tp_mesh(4, 2)
+        step = make_train_step(cfg, mesh)
+        toks = [batch(jax.random.PRNGKey(i)) for i in range(4)]
+
+        # uninterrupted
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        for t in toks:
+            state, loss_ref = step(state, t, t)
+
+        # interrupted at step 2
+        state2, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        for t in toks[:2]:
+            state2, _ = step(state2, t, t)
+        save_checkpoint(tmp_path / "ckpt", state2, mesh)
+
+        fresh, _ = build_train_state(jax.random.PRNGKey(1), cfg, mesh)
+        resumed = restore_checkpoint(tmp_path / "ckpt", fresh)
+        assert int(resumed.step) == 2
+        for t in toks[2:]:
+            resumed, loss_res = step(resumed, t, t)
+
+        assert int(resumed.step) == int(state.step) == 4
+        np.testing.assert_array_equal(np.asarray(loss_res),
+                                      np.asarray(loss_ref))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            state.params, resumed.params)
+
+    def test_restore_onto_different_mesh(self, tmp_path):
+        """A checkpoint written on (4, 2) restores onto (2, 4) — the elastic
+        re-plan path: orbax reshards onto the target NamedShardings."""
+        cfg = tiny_cfg()
+        mesh_a = dp_tp_mesh(4, 2)
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh_a)
+        step = make_train_step(cfg, mesh_a)
+        t = batch(jax.random.PRNGKey(9))
+        state, _ = step(state, t, t)
+        save_checkpoint(tmp_path / "ckpt", state, mesh_a)
+
+        mesh_b = dp_tp_mesh(2, 4)
+        fresh, _ = build_train_state(jax.random.PRNGKey(1), cfg, mesh_b)
+        resumed = restore_checkpoint(tmp_path / "ckpt", fresh, mesh_b)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            state.params, resumed.params)
+        # restored leaves carry mesh_b shardings, ready to step there
+        tok_emb = resumed.params["embed"]["tok"]
+        assert tok_emb.sharding.mesh.devices.shape == (2, 4)
+        step_b = make_train_step(cfg, mesh_b)
+        resumed, loss = step_b(resumed, t, t)
+        assert np.isfinite(float(loss))
+
+    def test_overwrite_cycle_and_prev_fallback(self, tmp_path):
+        """Repeated saves to one dir never lose the prior checkpoint: a
+        'crash' that leaves only the .prev backup still restores."""
+        import shutil
+
+        cfg = tiny_cfg()
+        mesh = dp_tp_mesh(4, 2)
+        step = make_train_step(cfg, mesh)
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        t = batch(jax.random.PRNGKey(0))
+        save_checkpoint(tmp_path / "ckpt", state, mesh)
+        state, _ = step(state, t, t)
+        save_checkpoint(tmp_path / "ckpt", state, mesh)  # overwrite
+        assert load_meta(tmp_path / "ckpt").step == 1
+
+        # simulate a crash window: primary gone, .prev holds the last good
+        (tmp_path / "ckpt").rename(tmp_path / "ckpt.prev")
+        assert load_meta(tmp_path / "ckpt").step == 1
+        fresh, _ = build_train_state(jax.random.PRNGKey(1), cfg, mesh)
+        resumed = restore_checkpoint(tmp_path / "ckpt", fresh)
+        assert int(resumed.step) == 1
+        shutil.rmtree(tmp_path / "ckpt.prev")
+
+    def test_meta_sidecar(self, tmp_path):
+        cfg = tiny_cfg()
+        mesh = dp_tp_mesh(4, 2)
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        save_checkpoint(tmp_path / "ckpt", state, mesh)
+        meta = load_meta(tmp_path / "ckpt")
+        assert meta.step == 0
+        assert meta.mesh_axes == (DP, TP)
+        assert meta.mesh_shape == (4, 2)
+
+
+class TestPlanArtifact:
+    def _hetero_result(self):
+        from metis_tpu.cluster import ClusterSpec
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_hetero
+        from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+        model = tiny_test_model()
+        store = synthesize_profiles(model, ["A100"], tps=[1, 2, 4],
+                                    bss=[1, 2, 4, 8, 16])
+        cluster = ClusterSpec.homogeneous("A100", 2, 4)
+        return plan_hetero(cluster, store, model, SearchConfig(gbs=64))
+
+    def test_ranked_plan_roundtrip(self, tmp_path):
+        result = self._hetero_result()
+        art = PlanArtifact.from_ranked_plan(result.best)
+        art.save(tmp_path / "plan.json")
+        back = PlanArtifact.load(tmp_path / "plan.json")
+        assert back == art
+        assert back.layer_partition == result.best.intra.layer_partition
+        assert back.device_groups == result.best.inter.device_groups
+
+    def test_uniform_stage_artifact_builds_mesh(self):
+        result = self._hetero_result()
+        # find a plan whose stages share one strategy shape
+        for r in result.plans:
+            art = PlanArtifact.from_ranked_plan(r)
+            if art.mesh_shape:
+                break
+        else:
+            pytest.skip("no rectangular plan found")
+        need = int(onp.prod(art.mesh_shape))
+        if need <= len(jax.devices()):
+            mesh = art.build_mesh()
+            assert mesh.axis_names == art.mesh_axes
+
+    def test_artifact_names_every_plan_axis(self):
+        """cp/ep plans get dedicated mesh axes — cp must NOT fold into dp
+        (a consumer would shard the batch instead of the sequence)."""
+        from types import SimpleNamespace
+        from metis_tpu.core.types import InterStagePlan, IntraStagePlan, Strategy
+
+        inter = InterStagePlan(node_sequence=("A100",), device_groups=(8,),
+                               batches=4, gbs=64)
+        intra = IntraStagePlan(
+            strategies=(Strategy(dp=2, tp=1, cp=2, ep=2, zero=1),),
+            layer_partition=(0, 10), memory_state=(), num_repartition=1)
+        art = PlanArtifact.from_ranked_plan(
+            SimpleNamespace(inter=inter, intra=intra))
+        assert art.mesh_axes == ("pp", "dp", "ep", "sp", "tp")
+        assert art.mesh_shape == (1, 1, 2, 2, 1)  # dp/ep=1, ep=2, sp(cp)=2
+        assert art.strategies[0]["zero"] == 1
+
+    def test_nonuniform_artifact_refuses_mesh(self):
+        art = PlanArtifact(
+            mesh_axes=(), mesh_shape=(), layer_partition=(0, 5, 10),
+            strategies=({"dp": 4, "tp": 1}, {"dp": 2, "tp": 2}),
+            gbs=64, microbatches=4,
+            node_sequence=("A100",), device_groups=(4, 4))
+        with pytest.raises(ValueError, match="non-uniform"):
+            art.build_mesh()
+
+    def test_checkpoint_carries_plan(self, tmp_path):
+        cfg = tiny_cfg()
+        mesh = dp_tp_mesh(4, 2)
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        result = self._hetero_result()
+        art = PlanArtifact.from_ranked_plan(result.best)
+        save_checkpoint(tmp_path / "ckpt", state, mesh, plan=art)
+        assert load_plan(tmp_path / "ckpt") == art
+        assert load_plan(tmp_path / "no-such-ckpt") is None

@@ -1,0 +1,122 @@
+"""Elastic re-plan on topology delta + structured event log."""
+import json
+
+import pytest
+
+from metis_tpu.cluster import ClusterSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.events import EventLog, read_events
+from metis_tpu.planner import ClusterDelta, plan_hetero, replan
+from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = tiny_test_model()
+    store = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2, 4],
+                                bss=[1, 2, 4, 8, 16])
+    return model, store
+
+
+class TestClusterDelta:
+    def test_between(self):
+        old = ClusterSpec.of(("A100", 2, 4), ("T4", 2, 4))
+        new = ClusterSpec.of(("A100", 2, 4), ("T4", 1, 4))
+        d = ClusterDelta.between(old, new)
+        assert d.removed == {"T4": 4}
+        assert d.added == {}
+        assert not d.is_empty
+
+    def test_empty(self):
+        c = ClusterSpec.of(("A100", 2, 4))
+        assert ClusterDelta.between(c, c).is_empty
+
+
+class TestReplan:
+    def test_lost_node_replans_slower(self, setup):
+        """Dropping half the cluster re-plans successfully at higher cost."""
+        model, store = setup
+        old = ClusterSpec.of(("A100", 2, 4))
+        new = ClusterSpec.of(("A100", 1, 4))
+        cfg = SearchConfig(gbs=64)
+        old_result = plan_hetero(old, store, model, cfg)
+        report = replan(old, new, store, model, cfg, old_result=old_result)
+        assert report.delta.removed == {"A100": 4}
+        assert report.plan_changed
+        assert report.result.best is not None
+        assert report.cost_ratio is not None and report.cost_ratio > 1.0
+
+    def test_no_change_keeps_plan(self, setup):
+        model, store = setup
+        c = ClusterSpec.of(("A100", 2, 4))
+        cfg = SearchConfig(gbs=64)
+        report = replan(c, c, store, model, cfg)
+        assert report.delta.is_empty
+        assert not report.plan_changed
+        assert report.cost_ratio == pytest.approx(1.0)
+
+    def test_added_capacity(self, setup):
+        model, store = setup
+        old = ClusterSpec.of(("A100", 1, 4))
+        new = ClusterSpec.of(("A100", 1, 4), ("T4", 1, 4))
+        report = replan(old, new, store, model, SearchConfig(gbs=64))
+        assert report.delta.added == {"T4": 4}
+
+
+class TestEventLog:
+    def test_planner_emits_events(self, setup, tmp_path):
+        model, store = setup
+        cluster = ClusterSpec.of(("A100", 2, 4))
+        log_path = tmp_path / "events.jsonl"
+        plan_hetero(cluster, store, model, SearchConfig(gbs=64),
+                    events=EventLog(log_path))
+        events = read_events(log_path)
+        kinds = [e["event"] for e in events]
+        assert kinds == ["search_started", "search_finished"]
+        assert events[0]["devices"] == 8
+        assert events[1]["num_costed"] > 0
+        assert events[1]["best_cost_ms"] > 0
+
+    def test_uniform_planner_emits_events(self, setup, tmp_path):
+        from metis_tpu.planner import plan_uniform
+
+        model, store = setup
+        cluster = ClusterSpec.of(("A100", 2, 4))
+        log_path = tmp_path / "uniform.jsonl"
+        plan_uniform(cluster, store, model, SearchConfig(gbs=64),
+                     events=EventLog(log_path))
+        kinds = [e["event"] for e in read_events(log_path)]
+        assert kinds == ["search_started", "search_finished"]
+
+    def test_disabled_log_is_noop(self, setup):
+        log = EventLog()
+        assert not log.enabled
+        log.emit("anything", x=1)  # must not raise
+
+    def test_stream_sink(self):
+        import io
+
+        buf = io.StringIO()
+        log = EventLog(stream=buf)
+        log.emit("hello", n=2)
+        rec = json.loads(buf.getvalue())
+        assert rec["event"] == "hello" and rec["n"] == 2 and "ts" in rec
+
+    def test_cli_events_flag(self, setup, tmp_path):
+        from metis_tpu.planner.cli import main as cli_main
+        from metis_tpu.testing import write_parity_fixture
+
+        write_parity_fixture(tmp_path)
+        out = tmp_path / "plans.json"
+        ev = tmp_path / "ev.jsonl"
+        rc = cli_main([
+            "hetero", "--hostfile", str(tmp_path / "hostfile"),
+            "--clusterfile", str(tmp_path / "clusterfile.json"),
+            "--profile-dir", str(tmp_path / "profiles"),
+            "--gbs", "128", "--num-layers", "10", "--hidden-size", "4096",
+            "--seq-len", "1024", "--vocab-size", "51200", "--num-heads", "32",
+            "--top-k", "1", "--output", str(out), "--events", str(ev),
+        ])
+        assert rc == 0
+        assert [e["event"] for e in read_events(ev)] == [
+            "search_started", "search_finished"]
