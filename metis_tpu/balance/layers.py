@@ -22,8 +22,9 @@ split is computed over the full cluster device list instead of the stage's.
 """
 from __future__ import annotations
 
-import itertools
 from typing import Callable, Sequence
+
+import numpy as np
 
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.core.config import ModelSpec, SearchConfig
@@ -38,59 +39,70 @@ from metis_tpu.cost.expert_parallel import (
     expert_static_scale,
 )
 from metis_tpu.cost.zero import zero_static_reduction_mb
+from metis_tpu.native import minmax_partition_native, native_available
 from metis_tpu.search.intra_stage import PartitionResult
 
 
 def minmax_partition(
     weights: Sequence[float],
     performance: Sequence[float],
-    feasible: Callable[[int, int, int], bool] | None = None,
+    feasible: Callable[[int, int, int], bool] | np.ndarray | None = None,
 ) -> tuple[int, ...] | None:
     """Optimal contiguous partition of ``weights`` into ``len(performance)``
     non-empty stages minimizing the max of stage-weight / stage-performance.
 
-    ``feasible(s, i, j)`` may veto assigning layers [i, j) to stage s.
+    ``feasible`` may veto assigning layers [i, j) to stage s — either a
+    callable ``(s, i, j) -> bool`` or a precomputed boolean array
+    ``[S, L+1, L+1]`` (the hot path: the balancer passes capacity masks built
+    from prefix sums, keeping the whole DP in numpy).
     Returns S+1 cumulative boundaries, or None if no feasible partition exists.
     """
     num_layers = len(weights)
     num_stages = len(performance)
     if num_stages > num_layers:
         return None
-    prefix = list(itertools.accumulate(weights, initial=0.0))
+    prefix = np.concatenate(
+        ([0.0], np.cumsum(np.asarray(weights, dtype=np.float64))))
+    span = prefix[None, :] - prefix[:, None]        # span[i, j] = w[i:j)
+    jgrid = np.arange(num_layers + 1)
+    empty = jgrid[None, :] <= jgrid[:, None]        # j <= i: no layers
 
-    def stage_cost(s: int, i: int, j: int) -> float:
-        perf = performance[s]
-        if perf <= 0:
-            return float("inf")
-        return (prefix[j] - prefix[i]) / perf
+    if callable(feasible):
+        F = np.ones((num_stages, num_layers + 1, num_layers + 1), bool)
+        for s in range(num_stages):
+            for i in range(num_layers):
+                for j in range(i + 1, num_layers + 1):
+                    F[s, i, j] = feasible(s, i, j)
+    else:
+        F = feasible
 
-    INF = float("inf")
-    # best[s][j]: minimal bottleneck for layers [0, j) on stages [0, s]
-    best = [[INF] * (num_layers + 1) for _ in range(num_stages)]
-    choice = [[-1] * (num_layers + 1) for _ in range(num_stages)]
+    INF = np.inf
+    choice = np.full((num_stages, num_layers + 1), -1, np.int64)
+    # best[j]: minimal bottleneck for layers [0, j) on stages [0, s]
+    perf0 = performance[0]
+    best = span[0] / perf0 if perf0 > 0 else np.full(num_layers + 1, INF)
+    best = np.where(jgrid >= 1, best, INF)
+    if F is not None:
+        best = np.where(F[0, 0], best, INF)
+    choice[0] = np.where(np.isfinite(best), 0, -1)
 
-    for j in range(1, num_layers + 1):
-        if feasible is None or feasible(0, 0, j):
-            best[0][j] = stage_cost(0, 0, j)
-            choice[0][j] = 0
     for s in range(1, num_stages):
-        for j in range(s + 1, num_layers + 1):
-            for i in range(s, j):
-                if best[s - 1][i] == INF:
-                    continue
-                if feasible is not None and not feasible(s, i, j):
-                    continue
-                cand = max(best[s - 1][i], stage_cost(s, i, j))
-                if cand < best[s][j]:
-                    best[s][j] = cand
-                    choice[s][j] = i
+        perf = performance[s]
+        cost = span / perf if perf > 0 else np.full_like(span, INF)
+        cand = np.maximum(best[:, None], cost)      # cand[i, j]
+        cand = np.where(empty, INF, cand)
+        if F is not None:
+            cand = np.where(F[s], cand, INF)
+        idx = np.argmin(cand, axis=0)               # first minimal i, like
+        best = cand[idx, jgrid]                     # the scalar DP's < test
+        choice[s] = np.where(np.isfinite(best), idx, -1)
 
-    if best[num_stages - 1][num_layers] == INF:
+    if not np.isfinite(best[num_layers]):
         return None
     bounds = [num_layers]
     j = num_layers
     for s in range(num_stages - 1, -1, -1):
-        i = choice[s][j]
+        i = int(choice[s, j])
         bounds.append(i)
         j = i
     return tuple(reversed(bounds))
@@ -120,6 +132,8 @@ class LayerBalancer:
         base = profiles.get(profiles.device_types[0], 1, 1)
         total = base.total_time_ms
         self.layer_weights = tuple(t / total for t in base.layer_times_ms)
+        self._wprefix = np.concatenate(
+            ([0.0], np.cumsum(np.asarray(self.layer_weights, np.float64))))
 
     # -- memory model ------------------------------------------------------
     def _stage_memory_rows(
@@ -177,11 +191,16 @@ class LayerBalancer:
             mem_type, strategy.tp, bs, act_divisor=strategy.cp,
             static_scale=static_scale, static_reduction_mb=reduction)
 
-    def _memory_prefix(self, row: tuple[float, ...]) -> list[float]:
-        cached = self._prefix_cache.get(row)
+    def _memory_prefix(self, rows: Sequence[tuple[float, ...]]) -> np.ndarray:
+        """Combined prefix over a stage's memory rows: element j is the total
+        MB of layers [0, j) summed across all replica-chunk rows (their sum is
+        all the demand model needs, so one array replaces len(rows) prefixes)."""
+        key = tuple(rows)
+        cached = self._prefix_cache.get(key)
         if cached is None:
-            cached = list(itertools.accumulate(row, initial=0.0))
-            self._prefix_cache[row] = cached
+            combined = np.sum(np.asarray(rows, dtype=np.float64), axis=0)
+            cached = np.concatenate(([0.0], np.cumsum(combined)))
+            self._prefix_cache[key] = cached
         return cached
 
     def stage_memory_demand(
@@ -212,46 +231,54 @@ class LayerBalancer:
             ranks[slice(*plan.stage_rank_range(s))] for s in range(plan.num_stages)
         ]
 
-        # Resolve each stage's memory-profile set once; demand(s, i, j) is
-        # then O(#chunks) prefix-sum lookups across all DP probes.
+        # Resolve each stage's memory-profile set once, collapsed to a single
+        # combined prefix array: demand(s, i, j) is one subtraction, and the
+        # whole feasibility mask for the DP is a numpy broadcast.
         try:
-            stage_prefixes = [
-                [self._memory_prefix(row) for row in self._stage_memory_rows(
-                    plan, strategies[s], stage_types[s], ranks)]
+            stage_prefix = np.stack([
+                self._memory_prefix(self._stage_memory_rows(
+                    plan, strategies[s], stage_types[s], ranks))
                 for s in range(plan.num_stages)
-            ]
+            ])  # [S, L+1]
         except ProfileMissError:
             return PartitionResult(None, -1, None)
         coef = self.config.mem_coef
 
-        def demand(s: int, i: int, j: int) -> float:
-            return 0.001 + coef * sum(
-                pref[j] - pref[i] for pref in stage_prefixes[s])
+        def stage_demands(bounds: Sequence[int]) -> np.ndarray:
+            lo = stage_prefix[np.arange(plan.num_stages), bounds[:-1]]
+            hi = stage_prefix[np.arange(plan.num_stages), bounds[1:]]
+            return 0.001 + coef * (hi - lo)
+
+        cap = np.asarray(memory_capacity, dtype=np.float64)
+        use_native = native_available()
 
         # Pass 1: compute-optimal, ignore memory.
-        unconstrained = minmax_partition(self.layer_weights, compute_performance)
+        if use_native:
+            unconstrained = minmax_partition_native(
+                self._wprefix, compute_performance)
+        else:
+            unconstrained = minmax_partition(
+                self.layer_weights, compute_performance)
         if unconstrained is None:
             return PartitionResult(None, -1, None)
-        demands = [
-            demand(s, unconstrained[s], unconstrained[s + 1])
-            for s in range(plan.num_stages)
-        ]
-        state = tuple(c - d for c, d in zip(memory_capacity, demands))
+        state = tuple((cap - stage_demands(np.asarray(unconstrained))).tolist())
         if min(state) >= 0:
             return PartitionResult(unconstrained, 1, state)
 
         # Pass 2: memory-constrained DP (replaces the reference's iterative
         # capacity-reweighting repair, load_balancer.py:71-107).
-        def feasible(s: int, i: int, j: int) -> bool:
-            return demand(s, i, j) <= memory_capacity[s]
-
-        constrained = minmax_partition(
-            self.layer_weights, compute_performance, feasible)
+        if use_native:
+            constrained = minmax_partition_native(
+                self._wprefix, compute_performance, stage_prefix, cap,
+                coef=coef)
+        else:
+            # demand D[s, i, j] = 0.001 + coef * (prefix[s, j] - prefix[s, i])
+            demand_mat = 0.001 + coef * (
+                stage_prefix[:, None, :] - stage_prefix[:, :, None])
+            constrained = minmax_partition(
+                self.layer_weights, compute_performance,
+                demand_mat <= cap[:, None, None])
         if constrained is None:
             return PartitionResult(None, -1, state)
-        demands = [
-            demand(s, constrained[s], constrained[s + 1])
-            for s in range(plan.num_stages)
-        ]
-        state = tuple(c - d for c, d in zip(memory_capacity, demands))
+        state = tuple((cap - stage_demands(np.asarray(constrained))).tolist())
         return PartitionResult(constrained, 2, state)

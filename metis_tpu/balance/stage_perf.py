@@ -15,13 +15,24 @@ from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
 
 
-def rank_device_types(cluster: ClusterSpec, node_sequence: Sequence[str]) -> list[str]:
+def rank_device_types(
+    cluster: ClusterSpec, node_sequence: Sequence[str]
+) -> tuple[str, ...]:
     """Device type of each rank under a node-sequence placement: all devices
     of ``node_sequence[0]`` take the lowest ranks, and so on
-    (≅ ``device_group.py:22-32``)."""
-    out: list[str] = []
-    for device_type in node_sequence:
-        out.extend([device_type] * cluster.num_devices_by_type(device_type))
+    (≅ ``device_group.py:22-32``).  Memoized per cluster — the planner
+    resolves the same few node sequences millions of times in the hot loop;
+    the cached value is an immutable tuple so no caller can poison it."""
+    cache = cluster.__dict__.setdefault("_rank_types_cache", {})
+    key = tuple(node_sequence)
+    out = cache.get(key)
+    if out is None:
+        ranks: list[str] = []
+        for device_type in node_sequence:
+            ranks.extend(
+                [device_type] * cluster.num_devices_by_type(device_type))
+        out = tuple(ranks)
+        cache[key] = out
     return out
 
 

@@ -93,9 +93,12 @@ def save_checkpoint(
     if plan is not None:
         (tmp / _PLAN_FILE).write_text(plan.to_json())
 
-    if prev.exists():
-        shutil.rmtree(prev)
+    # Ordering invariant: never delete the only complete checkpoint — .prev
+    # is cleared early only when the primary exists (to make room for the
+    # park), and cleared finally only after the new primary is in place.
     if directory.exists():
+        if prev.exists():
+            shutil.rmtree(prev)
         directory.rename(prev)
     tmp.rename(directory)
     if prev.exists():
@@ -128,7 +131,6 @@ def load_plan(directory: str | Path) -> PlanArtifact | None:
 def restore_checkpoint(
     directory: str | Path,
     reference_state: TrainState,
-    mesh: Mesh | None = None,
 ) -> TrainState:
     """Restore a TrainState shaped/sharded like ``reference_state`` (built
     with ``build_train_state`` on the *target* mesh — which may differ from

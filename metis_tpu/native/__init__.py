@@ -1,0 +1,106 @@
+"""Native (C++) planner kernels, built on demand with the system toolchain.
+
+The reference is pure Python end to end (SURVEY.md §2: zero native
+components), so nothing here is a port — these are the TPU framework's own
+runtime accelerators for the planner's hot loops, compiled once per checkout
+with ``g++ -O3`` and loaded via ctypes (no pybind11/pip dependency).  Every
+native entry point has a pure-Python twin it is differentially tested
+against (tests/test_native.py), and callers fall back to the Python path
+when no C++ toolchain is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).parent
+_SRC = _DIR / "minmax.cpp"
+_SO = _DIR / "_libminmax.so"
+
+
+def _build() -> bool:
+    """(Re)compile the shared library when missing or stale.  Returns False
+    when no working compiler is available."""
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return True
+    try:
+        # Write to a temp name then rename: parallel test workers may race.
+        with tempfile.NamedTemporaryFile(
+                dir=_DIR, suffix=".so", delete=False) as tmp:
+            tmp_path = Path(tmp.name)
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp_path), str(_SRC)],
+            check=True, capture_output=True)
+        os.replace(tmp_path, _SO)
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    if not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+    except OSError:
+        return None
+    fn = lib.metis_minmax_partition
+    fn.restype = ctypes.c_int
+    fn.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int,   # wprefix, L
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int,   # perf, S
+        ctypes.POINTER(ctypes.c_double),                 # mem_prefix | NULL
+        ctypes.POINTER(ctypes.c_double),                 # cap | NULL
+        ctypes.c_double, ctypes.c_double,                # base, coef
+        ctypes.POINTER(ctypes.c_int),                    # out_bounds
+    ]
+    return lib
+
+
+_LIB = _load()
+_DP = ctypes.POINTER(ctypes.c_double)
+_IP = ctypes.POINTER(ctypes.c_int)
+_NULL_D = ctypes.cast(None, _DP)
+
+
+def native_available() -> bool:
+    return _LIB is not None
+
+
+def minmax_partition_native(
+    wprefix: np.ndarray,
+    performance,
+    mem_prefix: np.ndarray | None = None,
+    capacity: np.ndarray | None = None,
+    base: float = 0.001,
+    coef: float = 1.0,
+) -> tuple[int, ...] | None:
+    """ctypes wrapper over the C++ DP.  ``wprefix`` is the L+1 weight prefix;
+    ``mem_prefix`` [S, L+1] + ``capacity`` [S] enable the memory constraint.
+    Returns S+1 boundaries or None (infeasible).  Raises RuntimeError if the
+    native library is unavailable (callers check ``native_available``)."""
+    if _LIB is None:
+        raise RuntimeError("native minmax library not built")
+    L = len(wprefix) - 1
+    perf = np.ascontiguousarray(performance, dtype=np.float64)
+    S = len(perf)
+    out = (ctypes.c_int * (S + 1))()
+    if mem_prefix is not None:
+        mp = np.ascontiguousarray(mem_prefix, dtype=np.float64) \
+            .ctypes.data_as(_DP)
+        cp = np.ascontiguousarray(capacity, dtype=np.float64) \
+            .ctypes.data_as(_DP)
+    else:
+        mp = cp = _NULL_D
+    rc = _LIB.metis_minmax_partition(
+        wprefix.ctypes.data_as(_DP), L,
+        perf.ctypes.data_as(_DP), S,
+        mp, cp, base, coef, out)
+    if rc != 0:
+        return None
+    return tuple(out)

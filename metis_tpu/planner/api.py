@@ -154,13 +154,14 @@ def plan_hetero(
 
     results.sort(key=lambda r: r.cost.total_ms)
     num_costed = len(results)
+    best_cost = results[0].cost.total_ms if results else None
     if top_k is not None:
         results = results[:top_k]
     elapsed = time.perf_counter() - t0
     events.emit(
         "search_finished", mode="hetero", num_costed=num_costed,
         num_pruned=pruned, seconds=round(elapsed, 4),
-        best_cost_ms=results[0].cost.total_ms if results else None)
+        best_cost_ms=best_cost)
     return PlannerResult(
         plans=tuple(results),
         num_costed=num_costed,
@@ -213,13 +214,14 @@ def plan_uniform(
         ranked.append(RankedUniformPlan(plan=plan, cost=cost, device_type=dtype))
 
     ranked.sort(key=lambda r: r.cost.total_ms)
+    best_cost = ranked[0].cost.total_ms if ranked else None
     if top_k is not None:
         ranked = ranked[:top_k]
     elapsed = time.perf_counter() - t0
     events.emit(
         "search_finished", mode="uniform", num_costed=num_costed,
         num_pruned=pruned, seconds=round(elapsed, 4),
-        best_cost_ms=ranked[0].cost.total_ms if ranked else None)
+        best_cost_ms=best_cost)
     return UniformPlannerResult(
         plans=tuple(ranked),
         num_costed=num_costed,

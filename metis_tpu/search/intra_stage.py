@@ -158,13 +158,15 @@ def intra_stage_plans(
     with the extra axes carved out of every eligible stage.  The cost
     estimator ranks the families against each other.
     """
+    capacity: list[float] | None = None  # strategy-independent; resolve once
     for cp, ep, zero in product(cp_degrees, ep_degrees, zero_stages):
         strategies = initial_strategies(plan, cp, cp_eligible, ep, zero)
         memory_state: tuple[float, ...] | None = None
 
         while strategies is not None:
             if strategies_valid(plan, strategies, max_tp, max_bs):
-                capacity = evaluator.memory_capacity(plan)
+                if capacity is None:
+                    capacity = evaluator.memory_capacity(plan)
                 performance = evaluator.compute_performance(plan, strategies)
                 result = partitioner.partition(plan, strategies, performance, capacity)
                 memory_state = result.memory_state

@@ -101,7 +101,7 @@ class TestMinmaxPartition:
 class TestStagePerformance:
     def test_rank_placement_order(self, cluster):
         ranks = rank_device_types(cluster, ("A100", "T4"))
-        assert ranks[:8] == ["A100"] * 8 and ranks[8:] == ["T4"] * 8
+        assert ranks[:8] == ("A100",) * 8 and ranks[8:] == ("T4",) * 8
 
     def test_memory_capacity(self, cluster, profiles):
         sp = StagePerformanceModel(cluster, profiles)

@@ -81,7 +81,7 @@ class TestTrainStateCheckpoint:
 
         mesh_b = dp_tp_mesh(2, 4)
         fresh, _ = build_train_state(jax.random.PRNGKey(1), cfg, mesh_b)
-        resumed = restore_checkpoint(tmp_path / "ckpt", fresh, mesh_b)
+        resumed = restore_checkpoint(tmp_path / "ckpt", fresh)
         jax.tree.map(
             lambda a, b: np.testing.assert_array_equal(
                 np.asarray(a), np.asarray(b)),

@@ -1,0 +1,83 @@
+"""Differential tests: the C++ minmax-partition kernel against the pure
+Python DP it replaces (metis_tpu.balance.layers.minmax_partition)."""
+import numpy as np
+import pytest
+
+from metis_tpu.balance.layers import minmax_partition
+from metis_tpu.native import minmax_partition_native, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain")
+
+
+def _prefix(w):
+    return np.concatenate(([0.0], np.cumsum(np.asarray(w, np.float64))))
+
+
+def test_unconstrained_matches_python_randomized():
+    rng = np.random.default_rng(0)
+    for trial in range(200):
+        L = int(rng.integers(2, 14))
+        S = int(rng.integers(1, min(L, 8) + 1))
+        w = rng.uniform(0.1, 5.0, L)
+        perf = rng.uniform(0.2, 4.0, S)
+        want = minmax_partition(tuple(w), tuple(perf))
+        got = minmax_partition_native(_prefix(w), perf)
+        assert got == want, f"trial {trial}: {got} != {want}"
+
+
+def test_constrained_matches_python_randomized():
+    rng = np.random.default_rng(1)
+    for trial in range(200):
+        L = int(rng.integers(2, 12))
+        S = int(rng.integers(1, min(L, 6) + 1))
+        w = rng.uniform(0.1, 5.0, L)
+        perf = rng.uniform(0.2, 4.0, S)
+        mem = rng.uniform(0.5, 3.0, (S, L))
+        cap = rng.uniform(1.0, 2.5, S) * L / S
+        coef = 5.0
+        mem_prefix = np.concatenate(
+            [np.zeros((S, 1)), np.cumsum(mem, axis=1)], axis=1)
+        demand_mat = 0.001 + coef * (
+            mem_prefix[:, None, :] - mem_prefix[:, :, None])
+        feasible = demand_mat <= cap[:, None, None]
+        want = minmax_partition(tuple(w), tuple(perf), feasible)
+        got = minmax_partition_native(_prefix(w), perf, mem_prefix, cap,
+                                      coef=coef)
+        assert got == want, f"trial {trial}: {got} != {want}"
+
+
+def test_zero_performance_stage():
+    w = [1.0, 1.0, 1.0, 1.0]
+    assert minmax_partition_native(_prefix(w), [1.0, 0.0]) == \
+        minmax_partition(w, [1.0, 0.0])
+
+
+def test_more_stages_than_layers():
+    assert minmax_partition_native(_prefix([1.0]), [1.0, 1.0]) is None
+
+
+def test_planner_end_to_end_native_vs_python(monkeypatch, tmp_path):
+    """Full hetero search result must be identical with the native DP off."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+    import metis_tpu.balance.layers as layers_mod
+
+    model = tiny_test_model()
+    store = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2],
+                                bss=[1, 2, 4, 8])
+    cluster = ClusterSpec.of(("A100", 1, 4), ("T4", 1, 4))
+    cfg = SearchConfig(gbs=32)
+
+    with_native = plan_hetero(cluster, store, model, cfg)
+    monkeypatch.setattr(layers_mod, "native_available", lambda: False)
+    without = plan_hetero(cluster, store, model, cfg)
+
+    assert with_native.num_costed == without.num_costed
+    for a, b in zip(with_native.plans, without.plans):
+        assert a.inter == b.inter
+        assert a.intra.strategies == b.intra.strategies
+        assert a.intra.layer_partition == b.intra.layer_partition
+        assert a.cost.total_ms == pytest.approx(b.cost.total_ms)
