@@ -38,6 +38,7 @@ from metis_tpu.cost.expert_parallel import (
     expert_param_fraction,
     expert_static_scale,
 )
+from metis_tpu.cost.sequence_parallel import SequenceParallelModel
 from metis_tpu.cost.zero import zero_static_reduction_mb
 from metis_tpu.native import minmax_partition_native, native_available
 from metis_tpu.search.intra_stage import PartitionResult
@@ -126,6 +127,7 @@ class LayerBalancer:
         self.model = model
         self.data_balancer = DataBalancer(profiles)
         self.act_split = ActivationSplitModel(profiles)
+        self.sp_model = SequenceParallelModel(self.act_split)
         self._prefix_cache: dict[tuple, list[float]] = {}
         # Normalized per-layer durations from the tp1_bs1 profile of the first
         # device type (≅ load_balancer.py:22-27, made deterministic).
@@ -153,7 +155,9 @@ class LayerBalancer:
         if len(set(stage_types)) == 1:
             bs = plan.gbs // plan.batches // strategy.dp
             mem_type = all_types[0] if compat else stage_types[0]
-            sharded = strategy.cp > 1 or strategy.ep > 1 or strategy.zero > 0
+            sharded = (strategy.cp > 1 or strategy.ep > 1
+                       or strategy.zero > 0
+                       or (strategy.sp and strategy.tp > 1))
             if sharded and not compat:
                 return [self._sharded_memory_row(mem_type, bs, strategy)]
             return [self.profiles.get(mem_type, strategy.tp, bs).layer_memory_mb]
@@ -187,9 +191,12 @@ class LayerBalancer:
             strategy.zero, strategy.data_ranks, tp=strategy.tp,
             dtype_bytes=self.model.dtype_bytes if self.model else 2,
             expert_frac=expert_frac, ep=strategy.ep)
+        act_scale = (self.sp_model.act_scale(mem_type, strategy.tp)
+                     if strategy.sp else None)
         return self.act_split.layer_memory(
             mem_type, strategy.tp, bs, act_divisor=strategy.cp,
-            static_scale=static_scale, static_reduction_mb=reduction)
+            static_scale=static_scale, static_reduction_mb=reduction,
+            act_scale=act_scale)
 
     def _memory_prefix(self, rows: Sequence[tuple[float, ...]]) -> np.ndarray:
         """Combined prefix over a stage's memory rows: element j is the total

@@ -82,6 +82,32 @@ def attention_layer_range(model: ModelSpec, start: int, end: int) -> int:
     return max(0, hi - lo)
 
 
+def linear_fit_per_layer(
+    xs: Sequence[float], rows: Sequence[Sequence[float]]
+) -> tuple[list[float], list[float]] | None:
+    """Per-layer least squares y = a + b*x over points (xs[k], rows[k][layer]).
+    Returns (intercepts, slopes) unclamped, or None when under-determined
+    (<2 points or zero variance).  Shared by the activation-split and
+    sequence-parallel fits — one copy of the numerics."""
+    n = len(xs)
+    if n < 2:
+        return None
+    mean_x = sum(xs) / n
+    var_x = sum((x - mean_x) ** 2 for x in xs)
+    if var_x == 0:
+        return None
+    num_layers = len(rows[0])
+    intercepts: list[float] = []
+    slopes: list[float] = []
+    for layer in range(num_layers):
+        ys = [row[layer] for row in rows]
+        mean_y = sum(ys) / n
+        b = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, ys)) / var_x
+        intercepts.append(mean_y - b * mean_x)
+        slopes.append(b)
+    return intercepts, slopes
+
+
 class ActivationSplitModel:
     """Per-layer (static, bs-slope) memory decomposition fit from a profile
     store's batch-size sweep, cached per (device_type, tp)."""
@@ -139,28 +165,34 @@ class ActivationSplitModel:
         act_divisor: float = 1.0,
         static_scale: Sequence[float] | None = None,
         static_reduction_mb: Sequence[float] | None = None,
+        act_scale: Sequence[float] | None = None,
     ) -> tuple[float, ...]:
         """Per-layer memory row (MB) with the activation component divided by
-        ``act_divisor`` (sequence/context sharding), the static component
-        scaled per layer by ``static_scale`` (weight sharding, e.g. expert
-        parallelism), then reduced by ``static_reduction_mb`` (absolute
-        sharded-state relief, e.g. ZeRO; clamped at zero).  Falls back to the
-        measured full row (no relief) when the static/activation split cannot
-        be identified — conservative, never optimistic."""
+        ``act_divisor`` (sequence/context sharding) and scaled per layer by
+        ``act_scale`` (partial activation sharding, e.g. Megatron sp), the
+        static component scaled per layer by ``static_scale`` (weight
+        sharding, e.g. expert parallelism), then reduced by
+        ``static_reduction_mb`` (absolute sharded-state relief, e.g. ZeRO;
+        clamped at zero).  Falls back to the measured full row (no relief)
+        when the static/activation split cannot be identified — conservative,
+        never optimistic."""
         base = self.profiles.get(device_type, tp, bs).layer_memory_mb
         if (act_divisor <= 1 and static_scale is None
-                and static_reduction_mb is None):
+                and static_reduction_mb is None and act_scale is None):
             return base
         fitted = self.split(device_type, tp)
         if fitted is None:
             return base
+        n = len(base)
         static, slope = fitted
-        scales = static_scale if static_scale is not None else [1.0] * len(base)
+        scales = static_scale if static_scale is not None else [1.0] * n
         cuts = (static_reduction_mb if static_reduction_mb is not None
-                else [0.0] * len(base))
+                else [0.0] * n)
+        ascales = act_scale if act_scale is not None else [1.0] * n
         return tuple(
-            min(max(s * sc - cut, 0.0) + bs * m / act_divisor, full)
-            for s, m, sc, cut, full in zip(static, slope, scales, cuts, base)
+            min(max(s * sc - cut, 0.0) + bs * m * asc / act_divisor, full)
+            for s, m, sc, cut, asc, full
+            in zip(static, slope, scales, cuts, ascales, base)
         )
 
     def layer_memory_with_cp(

@@ -283,8 +283,12 @@ class HeteroCostEstimator(_EstimatorBase):
             if stage_id == plan.num_stages - 1:
                 fb_sync = self._fb_sync_ms(stage_types, strat.tp, mbs) * plan.batches
             else:
+                # cp shards the boundary activation by sequence; Megatron sp
+                # additionally sequence-shards it over the tp group, so each
+                # rank's p2p volume divides by tp too.
+                sp_div = strat.tp if strat.sp else 1
                 pp_cost += self._pp_cost_ms(
-                    self._activation(end_l, mbs, strat.tp) / strat.cp,
+                    self._activation(end_l, mbs, strat.tp) / strat.cp / sp_div,
                     bandwidth.pp_bandwidth(stage_id))
 
             stage_params = self.volume.stage_parameter_bytes(strat.tp, start_l, end_l)

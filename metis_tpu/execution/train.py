@@ -125,12 +125,17 @@ def make_train_step(
     attn_impl=None,
     seq_axis: str | None = None,
     dp_axis: str = DP,
+    megatron_sp: bool = False,
+    tp_axis: str = TP,
 ) -> Callable:
     """Jitted (state, tokens, targets) -> (state, loss).
 
     ``seq_axis``: shard the sequence over this mesh axis with ring attention
     (context parallelism).  Without it, full attention runs locally and tp
-    sharding is handled entirely by GSPMD.
+    sharding is handled entirely by GSPMD.  ``megatron_sp`` sequence-shards
+    the residual stream over ``tp_axis`` (Megatron sequence parallelism: the
+    non-matmul regions' activations divide by tp; XLA turns the TP
+    all-reduces into reduce-scatter + all-gather pairs around them).
     """
     optimizer = optimizer or build_optimizer()
     if seq_axis is not None and attn_impl is None:
@@ -138,13 +143,23 @@ def make_train_step(
 
     tok_sharding = NamedSharding(mesh, batch_spec(dp_axis, seq_axis))
 
+    resid_fn = None
+    if megatron_sp:
+        # [batch, seq, hidden]; with context parallelism active the seq dim
+        # is already sharded over seq_axis — sp subdivides it by tp rather
+        # than replacing it (dropping seq_axis here would all-gather the cp
+        # shards at every block and blow the planned activation budget)
+        seq_shard = (seq_axis, tp_axis) if seq_axis is not None else tp_axis
+        resid_spec = P(dp_axis, seq_shard, None)
+        resid_fn = lambda x: jax.lax.with_sharding_constraint(x, resid_spec)  # noqa: E731
+
     loss_fn = loss_fn_for(cfg)
 
     def step(state: TrainState, tokens: jnp.ndarray, targets: jnp.ndarray):
         tokens = jax.lax.with_sharding_constraint(tokens, tok_sharding.spec)
         targets = jax.lax.with_sharding_constraint(targets, tok_sharding.spec)
         loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, tokens, targets, cfg, attn_impl)
+            state.params, tokens, targets, cfg, attn_impl, resid_fn)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params=params, opt_state=opt_state,

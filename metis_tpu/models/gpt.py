@@ -196,10 +196,13 @@ def run_blocks(
     cfg: GPTConfig,
     attn_impl: AttnFn | None = None,
     block_slice: tuple[int, int] | None = None,
+    resid_fn: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
 ) -> jnp.ndarray:
     """Scan the (optionally sliced) stacked blocks over the activations.
     ``block_slice`` selects blocks [i, j) — how pipeline stages take their
-    share of the stack."""
+    share of the stack.  ``resid_fn`` hooks the residual stream at each block
+    entry — how Megatron sequence parallelism applies its sequence-sharding
+    constraint (execution.train.make_train_step(megatron_sp=True))."""
     attn = attn_impl or default_attention(cfg)
     blocks = params["blocks"]
     if block_slice is not None:
@@ -211,6 +214,8 @@ def run_blocks(
         body = jax.checkpoint(body)
 
     def step(carry, layer):
+        if resid_fn is not None:
+            carry = resid_fn(carry)
         return body(carry, layer), None
 
     out, _ = jax.lax.scan(step, x, blocks)
@@ -230,11 +235,12 @@ def forward(
     tokens: jnp.ndarray,
     cfg: GPTConfig,
     attn_impl: AttnFn | None = None,
+    resid_fn=None,
 ) -> jnp.ndarray:
     """Full forward: tokens [batch, seq] int32 -> logits [batch, seq, vocab]
     (fp32)."""
     x = embed(params, tokens, cfg)
-    x = run_blocks(params, x, cfg, attn_impl)
+    x = run_blocks(params, x, cfg, attn_impl, resid_fn=resid_fn)
     return head_logits(params, x, cfg)
 
 
@@ -244,9 +250,10 @@ def next_token_loss(
     targets: jnp.ndarray,
     cfg: GPTConfig,
     attn_impl: AttnFn | None = None,
+    resid_fn=None,
 ) -> jnp.ndarray:
     """Mean cross-entropy of next-token prediction (fp32 scalar)."""
-    logits = forward(params, tokens, cfg, attn_impl)
+    logits = forward(params, tokens, cfg, attn_impl, resid_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -picked.mean()

@@ -216,8 +216,10 @@ def moe_run_blocks(
     cfg: MoEConfig,
     attn_impl: AttnFn | None = None,
     block_slice: tuple[int, int] | None = None,
+    resid_fn=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Scan the stacked MoE blocks; returns (activations, mean aux loss)."""
+    """Scan the stacked MoE blocks; returns (activations, mean aux loss).
+    ``resid_fn`` hooks the residual stream per block (gpt.run_blocks)."""
     attn = attn_impl or default_attention(cfg)
     blocks = params["blocks"]
     if block_slice is not None:
@@ -229,6 +231,8 @@ def moe_run_blocks(
         body = jax.checkpoint(body)
 
     def step(carry, layer):
+        if resid_fn is not None:
+            carry = resid_fn(carry)
         out, aux = body(carry, layer)
         return out, aux
 
@@ -241,10 +245,11 @@ def moe_forward(
     tokens: jnp.ndarray,
     cfg: MoEConfig,
     attn_impl: AttnFn | None = None,
+    resid_fn=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """tokens [b, s] -> (logits [b, s, v] fp32, aux loss scalar)."""
     x = embed(params, tokens, cfg)
-    x, aux = moe_run_blocks(params, x, cfg, attn_impl)
+    x, aux = moe_run_blocks(params, x, cfg, attn_impl, resid_fn=resid_fn)
     return head_logits(params, x, cfg), aux
 
 
@@ -254,9 +259,10 @@ def moe_next_token_loss(
     targets: jnp.ndarray,
     cfg: MoEConfig,
     attn_impl: AttnFn | None = None,
+    resid_fn=None,
 ) -> jnp.ndarray:
     """Cross-entropy + load-balance auxiliary (fp32 scalar)."""
-    logits, aux = moe_forward(params, tokens, cfg, attn_impl)
+    logits, aux = moe_forward(params, tokens, cfg, attn_impl, resid_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -picked.mean() + cfg.aux_loss_coef * aux

@@ -104,7 +104,10 @@ def plan_hetero(
         ep_degrees += ep_candidates(config.max_ep_degree, model.num_experts)
     zero_stages = zero_candidates(
         config.enable_zero and not config.strict_compat)
-    families = list(product(cp_degrees, ep_degrees, zero_stages))
+    sp_variants = ((False, True)
+                   if config.enable_sp and not config.strict_compat
+                   else (False,))
+    families = list(product(cp_degrees, ep_degrees, zero_stages, sp_variants))
     events.emit(
         "search_started", mode="hetero", devices=cluster.total_devices,
         device_types=list(cluster.device_types), gbs=config.gbs,
@@ -129,9 +132,9 @@ def plan_hetero(
                 len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
                 for s in range(inter.num_stages)
             ]
-        # one try-block per (cp, ep, zero) family: a profile miss
+        # one try-block per (cp, ep, zero, sp) family: a profile miss
         # mid-generation prunes only that family, not its siblings
-        for cp, ep, zero in families:
+        for cp, ep, zero, sp in families:
             try:
                 for intra in intra_stage_plans(
                     inter, evaluator, balancer,
@@ -139,6 +142,7 @@ def plan_hetero(
                     max_bs=config.max_profiled_bs,
                     cp_degrees=(cp,), cp_eligible=cp_eligible,
                     ep_degrees=(ep,), zero_stages=(zero,),
+                    sp_variants=(sp,),
                 ):
                     try:
                         cost = estimator.get_cost(

@@ -63,6 +63,8 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
                    help="largest expert-parallel degree to search")
     g.add_argument("--enable-zero", action="store_true",
                    help="search ZeRO-1/2/3 sharded-state plan families")
+    g.add_argument("--enable-sp", action="store_true",
+                   help="search Megatron sequence-parallel plan families")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
     g.add_argument("--events", default=None,
@@ -101,6 +103,7 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         enable_ep=args.enable_ep,
         max_ep_degree=args.max_ep,
         enable_zero=args.enable_zero,
+        enable_sp=args.enable_sp,
     )
 
 

@@ -65,6 +65,7 @@ def initial_strategies(
     cp_eligible: Sequence[bool] | None = None,
     ep: int = 1,
     zero: int = 0,
+    sp: bool = False,
 ) -> tuple[Strategy, ...] | None:
     """Every stage starts fully data-parallel (``plan.py:231-236``).
 
@@ -88,7 +89,7 @@ def initial_strategies(
         # ZeRO needs >1 data rank to shard over
         stage_zero = zero if dp * stage_cp > 1 else 0
         any_zero |= stage_zero > 0
-        out.append(Strategy(dp=dp, tp=1, cp=stage_cp, ep=stage_ep,
+        out.append(Strategy(dp=dp, tp=1, sp=sp, cp=stage_cp, ep=stage_ep,
                             zero=stage_zero))
     if cp > 1 and not any_cp:
         return None
@@ -149,18 +150,23 @@ def intra_stage_plans(
     cp_eligible: Sequence[bool] | None = None,
     ep_degrees: Sequence[int] = (1,),
     zero_stages: Sequence[int] = (0,),
+    sp_variants: Sequence[bool] = (False,),
 ) -> Iterator[IntraStagePlan]:
     """Yield feasible intra-stage plans for one inter-stage candidate.
 
-    ``cp_degrees`` x ``ep_degrees`` x ``zero_stages`` extend the reference's
-    (dp, tp) space with context-parallel, expert-parallel, and ZeRO families
-    (net-new, SURVEY.md §5): for each combination the same escalation runs
-    with the extra axes carved out of every eligible stage.  The cost
-    estimator ranks the families against each other.
+    ``cp_degrees`` x ``ep_degrees`` x ``zero_stages`` x ``sp_variants``
+    extend the reference's (dp, tp) space with context-parallel,
+    expert-parallel, ZeRO, and sequence-parallel families (net-new,
+    SURVEY.md §5): for each combination the same escalation runs with the
+    extra axes carved out of every eligible stage.  The cost estimator ranks
+    the families against each other.  sp is a no-op at tp=1, so the sp=True
+    family suppresses tp=1 yields (duplicates of the sp=False family) and
+    keeps escalating toward tp>1 shapes where sp actually pays.
     """
     capacity: list[float] | None = None  # strategy-independent; resolve once
-    for cp, ep, zero in product(cp_degrees, ep_degrees, zero_stages):
-        strategies = initial_strategies(plan, cp, cp_eligible, ep, zero)
+    for cp, ep, zero, sp in product(cp_degrees, ep_degrees, zero_stages,
+                                    sp_variants):
+        strategies = initial_strategies(plan, cp, cp_eligible, ep, zero, sp)
         memory_state: tuple[float, ...] | None = None
 
         while strategies is not None:
@@ -170,7 +176,8 @@ def intra_stage_plans(
                 performance = evaluator.compute_performance(plan, strategies)
                 result = partitioner.partition(plan, strategies, performance, capacity)
                 memory_state = result.memory_state
-                if result.partition is not None:
+                degenerate_sp = sp and all(s.tp == 1 for s in strategies)
+                if result.partition is not None and not degenerate_sp:
                     yield IntraStagePlan(
                         strategies=strategies,
                         layer_partition=result.partition,
@@ -178,5 +185,5 @@ def intra_stage_plans(
                         num_repartition=result.attempts,
                     )
                     if result.attempts == 1:
-                        break  # this (cp, ep, zero) family is satisfied; next
+                        break  # this family is satisfied; next
             strategies = escalate_dp_to_tp(strategies, memory_state)
