@@ -1,0 +1,197 @@
+"""PlanArtifact -> executable training step: the plan-to-execution contract.
+
+One entry point, ``build_executable``, routes a chosen plan to the execution
+path that realizes it (the reference prints plan tuples and stops,
+``cost_het_cluster.py:73-77``; here the artifact runs):
+
+- **GSPMD single-program** (``execution.train``) for pp=1 rectangular plans —
+  dp/ep batch sharding, tp via GSPMD, cp via ring attention over the "sp"
+  mesh axis, Megatron SP via residual constraints, ZeRO via state sharding;
+- **shard_map GPipe** (``execution.pipeline``) for pp>1 rectangular plans
+  with one (dp, tp) strategy, even layer split, and zero=0 — the fastest
+  single-program pipeline;
+- **multi-mesh per-stage** (``execution.hetero``) for everything else a
+  hetero planner emits: non-uniform layer partitions, per-stage strategies,
+  uneven hetero-DP microbatches, ZeRO under pipelining (each stage is a
+  GSPMD program, so state sharding composes per stage — the configuration
+  the ADVICE r1 medium finding flagged as cost-model-only).
+
+Every path is normalized to ``(init, step)`` with
+``init(key) -> state`` and ``step(state, tokens, targets) -> (state, loss)``
+on full-batch ``[gbs, seq]`` token arrays (microbatch splitting happens
+inside, per the plan's microbatch count).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import jax
+
+from metis_tpu.execution.hetero import (
+    make_hetero_train_step,
+    plan_replica_rows,
+    stage_specs_from_plan,
+)
+from metis_tpu.execution.mesh import DP, EP, PP, SP, TP, PlanArtifact
+from metis_tpu.execution.pipeline import (
+    make_pipeline_train_step,
+    microbatch_split,
+)
+from metis_tpu.execution.train import build_train_state, make_train_step
+from metis_tpu.models.gpt import GPTConfig
+from metis_tpu.models.moe import MoEConfig
+
+
+@dataclass(frozen=True)
+class Executable:
+    """A plan realized: which path runs it, plus the normalized step API."""
+
+    kind: str  # "gspmd" | "pipeline" | "hetero"
+    init: Callable
+    step: Callable
+
+
+def _uniform_block_split(artifact: PlanArtifact, cfg: GPTConfig,
+                         pp: int) -> bool:
+    """True when the layer partition gives every stage the same block count
+    (the shard_map pipeline's contract: the stacked layer axis shards
+    evenly over pp)."""
+    bounds = artifact.layer_partition
+    if not bounds:
+        return cfg.num_blocks % max(pp, 1) == 0
+    counts = {bounds[i + 1] - bounds[i] for i in range(len(bounds) - 1)}
+    if len(counts) != 1:
+        return False
+    # profile-layer counts equal; block counts still differ for the
+    # embed/head stages unless the partition is the canonical even split
+    blocks = []
+    for i in range(len(bounds) - 1):
+        lo, hi = bounds[i], bounds[i + 1]
+        blocks.append(min(hi - 1, cfg.num_blocks) - max(lo - 1, 0))
+    return len(set(blocks)) == 1 and cfg.num_blocks % len(blocks) == 0
+
+
+def build_executable(
+    cfg: GPTConfig,
+    artifact: PlanArtifact,
+    devices: Sequence | None = None,
+    optimizer=None,
+    cluster=None,
+    profiles=None,
+) -> Executable:
+    """Route ``artifact`` to the execution path that realizes it.
+
+    ``cluster`` + ``profiles`` (optional) enable the data balancer's uneven
+    per-replica microbatches on mixed-type hetero stages."""
+    strategies = [dict(s) for s in artifact.strategies]
+    for s in strategies:
+        s.setdefault("cp", 1)
+        s.setdefault("ep", 1)
+        s.setdefault("zero", 0)
+        s.setdefault("sp", False)
+    # uniform artifacts carry ONE strategy with pp encoded in the mesh shape
+    # (PlanArtifact.from_uniform_plan); hetero artifacts carry one per stage
+    if artifact.mesh_shape and PP in artifact.mesh_axes:
+        pp = artifact.mesh_shape[artifact.mesh_axes.index(PP)]
+    else:
+        pp = len(strategies)
+    if len(strategies) == 1 and pp > 1:
+        strategies = strategies * pp
+    uniform = len({(s["dp"], s["tp"], s["cp"], s["ep"], s["zero"], s["sp"])
+                   for s in strategies}) == 1
+    s0 = strategies[0]
+
+    if artifact.mesh_shape and pp == 1:
+        return _gspmd_executable(cfg, artifact, s0, devices, optimizer)
+
+    if (artifact.mesh_shape and uniform and s0["zero"] == 0
+            and not s0["sp"] and s0["cp"] == 1 and s0["ep"] == 1
+            and _uniform_block_split(artifact, cfg, pp)):
+        return _pipeline_executable(cfg, artifact, s0, pp, devices, optimizer)
+
+    if any(s["cp"] > 1 or s["ep"] > 1 for s in strategies):
+        raise NotImplementedError(
+            "cp/ep under pipeline parallelism has no execution path yet "
+            "(cp/ep run on the pp=1 GSPMD path; dp x tp [x zero] stages run "
+            "on the pipeline paths)")
+
+    return _hetero_executable(
+        cfg, artifact, strategies, devices, optimizer, cluster, profiles)
+
+
+def _gspmd_executable(cfg, artifact, s0, devices, optimizer) -> Executable:
+    mesh = artifact.build_mesh(devices)
+    is_moe = isinstance(cfg, MoEConfig)
+    seq_axis = SP if s0["cp"] > 1 else None
+    dp_axis = (DP, EP) if s0["ep"] > 1 else DP
+
+    def init(key):
+        state, _ = build_train_state(
+            key, cfg, mesh, optimizer=optimizer, tp_axis=TP,
+            ep_axis=EP if is_moe else None,
+            zero=s0["zero"], zero_axis=DP)
+        return state
+
+    step = make_train_step(
+        cfg, mesh, optimizer=optimizer, seq_axis=seq_axis, dp_axis=dp_axis,
+        megatron_sp=bool(s0["sp"]), tp_axis=TP)
+    return Executable(kind="gspmd", init=init, step=step)
+
+
+def _pipeline_executable(cfg, artifact, s0, pp, devices,
+                         optimizer) -> Executable:
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    need = pp * s0["dp"] * s0["tp"]
+    if len(devs) < need:
+        raise ValueError(f"plan needs {need} devices, have {len(devs)}")
+    mesh = Mesh(
+        np.array(devs[:need]).reshape(pp, s0["dp"], s0["tp"]), (PP, DP, TP))
+    init_fn, raw_step = make_pipeline_train_step(
+        cfg, mesh, artifact.microbatches, optimizer=optimizer)
+
+    def init(key):
+        return init_fn(key)
+
+    def step(state, tokens, targets):
+        params, opt_state = state
+        tok = microbatch_split(tokens, artifact.microbatches)
+        tgt = microbatch_split(targets, artifact.microbatches)
+        params, opt_state, loss = raw_step(params, opt_state, tok, tgt)
+        return (params, opt_state), loss
+
+    return Executable(kind="pipeline", init=init, step=step)
+
+
+def _hetero_executable(cfg, artifact, strategies, devices, optimizer, cluster,
+                       profiles) -> Executable:
+    pp = len(strategies)
+    rows = None
+    if cluster is not None and profiles is not None and artifact.node_sequence:
+        from metis_tpu.core.types import InterStagePlan, Strategy
+
+        inter = InterStagePlan(
+            node_sequence=tuple(artifact.node_sequence),
+            device_groups=tuple(artifact.device_groups),
+            batches=artifact.microbatches, gbs=artifact.gbs)
+        strats = [Strategy(dp=s["dp"], tp=s["tp"]) for s in strategies]
+        rows = plan_replica_rows(inter, strats, cluster, profiles)
+    bounds = artifact.layer_partition
+    if not bounds:
+        # rectangular artifacts drop the canonical even split; rebuild it
+        per = cfg.num_profile_layers // pp
+        bounds = tuple(per * i for i in range(pp)) + (cfg.num_profile_layers,)
+    stages = stage_specs_from_plan(
+        bounds, strategies, cfg, stage_replica_rows=rows)
+    init_fn, raw_step = make_hetero_train_step(
+        cfg, stages, devices=devices, optimizer=optimizer)
+
+    def step(state, tokens, targets):
+        tok = microbatch_split(tokens, artifact.microbatches)
+        tgt = microbatch_split(targets, artifact.microbatches)
+        return raw_step(state, tok, tgt)
+
+    return Executable(kind="hetero", init=init_fn, step=step)

@@ -1,0 +1,433 @@
+"""Non-uniform hetero plan execution: one GSPMD program per pipeline stage.
+
+The planner's flagship output — hetero plans with non-uniform layer
+partitions and per-stage ``(dp, tp)`` strategies (≅ the reference's printed
+plan tuple, ``cost_het_cluster.py:43-45``) — cannot run as one SPMD program:
+stages differ in layer count, mesh shape, and (on real clusters) hardware
+platform, so one ``shard_map`` cannot express them.  This executor is the
+TPU-native answer (SURVEY.md §7 "Heterogeneous multi-slice execution"):
+
+- **one mesh + one jitted program per stage** — each stage is a plain GSPMD
+  ``(dp, tp)`` program over its own device slice; XLA inserts the TP
+  collectives per stage, exactly as the per-stage cost terms price them;
+- **boundary activations move between meshes with ``jax.device_put``** — on
+  a real deployment that transfer rides DCN between slices, matching the
+  cost model's inter-stage p2p term;
+- **backward is stitched manually across stages** with per-stage
+  ``jax.vjp`` closures: each stage's backward *recomputes its forward*
+  (stage-granular rematerialization — the standard TPU memory/FLOPs trade),
+  so only boundary activations are stored between the forward and backward
+  passes, the GPipe activation footprint the planner's memory model charges;
+- **uneven hetero-DP microbatches** (Metis's signature feature, reference
+  ``load_balancer.py:155-179``): a stage whose replicas get unequal row
+  counts pads each replica to the max count with a static gather, shards the
+  padded batch over dp, and inverse-gathers back to the canonical row order
+  at the stage boundary.  Transformer blocks mix nothing across batch rows,
+  so pad rows contribute exactly zero gradient — the padding is invisible to
+  the math and the boundary contract stays canonical.
+
+Losses average per-microbatch means; gradients accumulate across
+microbatches on each stage's mesh and divide by the microbatch count at the
+optimizer step, so the result is identical to the single-program global-mean
+loss (pinned by the parity test).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metis_tpu.execution.mesh import DP, TP, gpt_param_specs
+from metis_tpu.execution.train import build_optimizer, fsdp_wrap_specs
+from metis_tpu.models.gpt import (
+    GPTConfig,
+    default_attention,
+    embed,
+    head_logits,
+    init_params,
+    run_blocks,
+)
+
+
+@dataclass(frozen=True)
+class StageSpec:
+    """One pipeline stage of a hetero plan, execution-ready.
+
+    ``blocks`` is the [lo, hi) transformer-block range (converted from the
+    planner's profile-layer boundaries — profile layer 0 is the embedding
+    pseudo-layer, layer ``num_blocks + 1`` the LM head, matching
+    ``GPTConfig.num_profile_layers``).  ``replica_rows`` carries the uneven
+    per-replica microbatch rows from the data balancer (None = even split).
+    """
+
+    blocks: tuple[int, int]
+    has_embed: bool
+    has_head: bool
+    dp: int
+    tp: int
+    zero: int = 0
+    replica_rows: tuple[int, ...] | None = None
+
+    @property
+    def devices(self) -> int:
+        return self.dp * self.tp
+
+
+def stage_specs_from_plan(
+    layer_partition: Sequence[int],
+    strategies: Sequence,
+    cfg: GPTConfig,
+    stage_replica_rows: Sequence[Sequence[int] | None] | None = None,
+) -> tuple[StageSpec, ...]:
+    """Convert planner output (profile-layer boundaries + per-stage
+    strategies) into executable StageSpecs.
+
+    ``strategies`` entries may be ``core.types.Strategy`` objects or the
+    dicts a ``PlanArtifact`` stores.
+    """
+    bounds = list(layer_partition)
+    n_profile = cfg.num_profile_layers
+    if bounds[0] != 0 or bounds[-1] != n_profile:
+        raise ValueError(
+            f"layer_partition {bounds} must span [0, {n_profile}] "
+            f"(= num_blocks + embed + head profile layers)")
+    if len(bounds) != len(strategies) + 1:
+        raise ValueError(
+            f"{len(strategies)} strategies need {len(strategies) + 1} "
+            f"partition boundaries, got {len(bounds)}")
+
+    out = []
+    for s, strat in enumerate(strategies):
+        if isinstance(strat, dict):
+            dp, tp = strat["dp"], strat["tp"]
+            zero = strat.get("zero", 0)
+            cp, ep = strat.get("cp", 1), strat.get("ep", 1)
+        else:
+            dp, tp, zero = strat.dp, strat.tp, strat.zero
+            cp, ep = strat.cp, strat.ep
+        if cp > 1 or ep > 1:
+            raise NotImplementedError(
+                f"stage {s}: cp={cp}/ep={ep} strategies run on the "
+                "single-program paths (execution.train with seq/ep axes); "
+                "the per-stage hetero executor covers dp x tp stages")
+        lo, hi = bounds[s], bounds[s + 1]
+        rows = None
+        if stage_replica_rows is not None and stage_replica_rows[s] is not None:
+            rows = tuple(stage_replica_rows[s])
+            if len(rows) != dp:
+                raise ValueError(
+                    f"stage {s}: {len(rows)} replica rows for dp={dp}")
+        out.append(StageSpec(
+            blocks=(max(lo - 1, 0), min(hi - 1, cfg.num_blocks)),
+            has_embed=lo == 0,
+            has_head=hi == n_profile,
+            dp=dp, tp=tp, zero=zero, replica_rows=rows))
+    return tuple(out)
+
+
+def _slice_stage_params(params: dict, spec: StageSpec) -> dict:
+    lo, hi = spec.blocks
+    out = {"blocks": jax.tree.map(lambda a: a[lo:hi], params["blocks"])}
+    if spec.has_embed:
+        out["embed"] = params["embed"]
+    if spec.has_head:
+        out["head"] = params["head"]
+    return out
+
+
+def _stage_param_specs(spec: StageSpec, cfg: GPTConfig) -> dict:
+    full = gpt_param_specs(cfg, tp_axis=TP, pp_axis=None)
+    out = {"blocks": full["blocks"]}
+    if spec.has_embed:
+        out["embed"] = full["embed"]
+    if spec.has_head:
+        out["head"] = full["head"]
+    return out
+
+
+def _pad_maps(replica_rows: Sequence[int]) -> tuple[np.ndarray, np.ndarray]:
+    """Static gather maps realizing an uneven per-replica split.
+
+    Returns ``(to_padded, to_canonical)``: ``x[to_padded]`` lays the
+    canonical batch out as ``dp * max_rows`` rows (each replica's share
+    padded with duplicates of row 0 — masked out by the inverse gather), and
+    ``padded[to_canonical]`` restores canonical order.
+    """
+    mx = max(replica_rows)
+    to_padded, to_canonical = [], []
+    start = 0
+    for r in replica_rows:
+        slot0 = len(to_padded)
+        to_padded += list(range(start, start + r)) + [0] * (mx - r)
+        to_canonical += list(range(slot0, slot0 + r))
+        start += r
+    return np.asarray(to_padded, np.int32), np.asarray(to_canonical, np.int32)
+
+
+def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl):
+    """The stage's pure forward: params + boundary input -> boundary output
+    (or loss, on the last stage).  Signature varies by role:
+
+    - first stage:        f(params, tokens)            -> x
+    - middle stage:       f(params, x)                 -> x
+    - last stage:         f(params, x, targets)        -> loss
+    - single-stage plan:  f(params, tokens, targets)   -> loss
+    """
+    pad = spec.replica_rows is not None and len(set(spec.replica_rows)) > 1
+    if pad:
+        to_padded, to_canonical = _pad_maps(spec.replica_rows)
+
+    batch_sharded = P(DP, None, None)
+
+    def run(params, first_in, targets=None):
+        x_or_tok = first_in
+        if pad:
+            x_or_tok = x_or_tok[to_padded]
+        if spec.has_embed:
+            x = embed(params, x_or_tok, cfg)
+        else:
+            x = x_or_tok
+        x = jax.lax.with_sharding_constraint(x, batch_sharded)
+        x = run_blocks(params, x, cfg, attn_impl)
+        if pad:
+            x = x[to_canonical]
+        if not spec.has_head:
+            return x
+        logits = head_logits(params, x, cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -picked.mean()
+
+    return run
+
+
+def make_hetero_train_step(
+    cfg: GPTConfig,
+    stages: Sequence[StageSpec],
+    devices: Sequence | None = None,
+    optimizer=None,
+    attn_impl=None,
+):
+    """Build the multi-mesh executor for a non-uniform hetero plan.
+
+    Returns ``(init_fn, step_fn)``:
+
+    - ``init_fn(key) -> state`` — a list of per-stage ``(params, opt_state)``
+      pairs, each placed on its stage's mesh (params sliced from one full
+      ``init_params`` call so results match the single-device model bit-for-
+      bit at fp32);
+    - ``step_fn(state, tokens_mbs, targets_mbs) -> (state, loss)`` with
+      tokens/targets microbatch-major ``[M, rows, seq]``; runs all forward
+      microbatches (storing only boundary activations), then the stitched
+      backward, then one optimizer step per stage.
+    """
+    stages = tuple(stages)
+    devs = list(devices if devices is not None else jax.devices())
+    need = sum(s.devices for s in stages)
+    if len(devs) < need:
+        raise ValueError(f"plan needs {need} devices, have {len(devs)}")
+    optimizer = optimizer or build_optimizer()
+    attn = attn_impl or default_attention(cfg)
+
+    meshes: list[Mesh] = []
+    off = 0
+    for s in stages:
+        grid = np.array(devs[off:off + s.devices]).reshape(s.dp, s.tp)
+        meshes.append(Mesh(grid, (DP, TP)))
+        off += s.devices
+
+    S = len(stages)
+    fns = [_make_stage_fn(s, cfg, attn) for s in stages]
+
+    def _in_mesh(mesh: Mesh, fn):
+        # bare-PartitionSpec constraints inside the stage programs resolve
+        # against the mesh context at trace time, so every call enters the
+        # stage's mesh
+        def run(*args):
+            with mesh:
+                return fn(*args)
+        return run
+
+    # per-stage jitted programs, run in the stage's mesh context
+    fwd, bwd, lossgrad, add_grads, apply_upd = [], [], [], [], []
+    for s in range(S):
+        spec, mesh, f = stages[s], meshes[s], fns[s]
+        is_first, is_last = s == 0, s == S - 1
+
+        if is_last:
+            if is_first:  # single-stage plan: loss of (params, tokens)
+                def lg(params, tok, tgt, _f=f):
+                    return jax.value_and_grad(_f)(params, tok, tgt)
+            else:
+                def lg(params, x_in, tgt, _f=f):
+                    # d(loss)/d(params), d(loss)/d(boundary input)
+                    (loss, grads) = jax.value_and_grad(
+                        _f, argnums=(0, 1))(params, x_in, tgt)
+                    return loss, grads[0], grads[1]
+            lossgrad.append(_in_mesh(mesh, jax.jit(lg)))
+            fwd.append(None)
+            bwd.append(None)
+        else:
+            fwd.append(_in_mesh(mesh, jax.jit(f)))
+            if is_first:
+                def bw(params, tok, ct, _f=f):
+                    # tokens are ints — pull back to params only
+                    _, pull = jax.vjp(lambda p: _f(p, tok), params)
+                    return pull(ct)[0]
+            else:
+                def bw(params, x_in, ct, _f=f):
+                    _, pull = jax.vjp(_f, params, x_in)
+                    return pull(ct)
+            bwd.append(_in_mesh(mesh, jax.jit(bw)))
+            lossgrad.append(None)
+
+        add_grads.append(_in_mesh(mesh, jax.jit(
+            lambda acc, g: jax.tree.map(jnp.add, acc, g),
+            donate_argnums=(0,))))
+
+        def upd(params, opt_state, acc, M, _opt=optimizer):
+            grads = jax.tree.map(lambda g: g / M, acc)
+            updates, opt_state = _opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+        apply_upd.append(_in_mesh(mesh, jax.jit(
+            upd, static_argnums=(3,), donate_argnums=(0, 1, 2))))
+
+    def _put(x, s: int, spec: P):
+        return jax.device_put(x, NamedSharding(meshes[s], spec))
+
+    def _boundary_spec(s: int, rows: int) -> P:
+        # activations shard over dp when rows divide evenly, else replicate
+        # (the in-stage pad/gather re-shards anyway)
+        return (P(DP, None, None) if rows % stages[s].dp == 0
+                else P(None, None, None))
+
+    def init_fn(key):
+        full = init_params(key, cfg)
+        state = []
+        for s, (spec, mesh) in enumerate(zip(stages, meshes)):
+            specs = _stage_param_specs(spec, cfg)
+            sliced = _slice_stage_params(full, spec)
+            if spec.zero >= 3:
+                specs = fsdp_wrap_specs(specs, sliced, DP,
+                                        axis_size=mesh.shape[DP])
+            params = jax.tree.map(
+                lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+                sliced, specs)
+            with mesh:
+                opt_state = optimizer.init(params)
+            if spec.zero in (1, 2):
+                # ZeRO-1/2 per stage: optimizer state shards over the
+                # stage's dp ranks, params stay replicated across them
+                from metis_tpu.execution.train import opt_state_specs_by_shape
+
+                wrapped = fsdp_wrap_specs(specs, sliced, DP,
+                                          axis_size=mesh.shape[DP])
+                opt_specs = opt_state_specs_by_shape(
+                    opt_state, sliced, wrapped)
+                opt_state = jax.tree.map(
+                    lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+                    opt_state, opt_specs)
+            state.append([params, opt_state])
+        return state
+
+    def step_fn(state, tokens_mbs, targets_mbs):
+        M, rows = tokens_mbs.shape[0], tokens_mbs.shape[1]
+        for spec in stages:
+            if spec.replica_rows is not None and sum(spec.replica_rows) != rows:
+                raise ValueError(
+                    f"replica_rows {spec.replica_rows} must sum to the "
+                    f"microbatch size {rows}")
+
+        # ---- forward fill: store only boundary inputs per (stage, mb)
+        toks = [_put(tokens_mbs[m], 0, P(None, None)) for m in range(M)]
+        tgts = [_put(targets_mbs[m], S - 1, P(None, None)) for m in range(M)]
+        x_in = [[None] * M for _ in range(S)]  # boundary input of stage s
+        for m in range(M):
+            x = None
+            for s in range(S - 1):
+                src = toks[m] if s == 0 else x
+                x = fwd[s](state[s][0], src)
+                x_in[s + 1][m] = x = _put(x, s + 1, _boundary_spec(s + 1, rows))
+
+        # ---- backward drain: per-stage grad accumulation across mbs
+        accs = [None] * S
+        losses = []
+        for m in reversed(range(M)):
+            if S == 1:
+                loss, g = lossgrad[-1](state[0][0], toks[m], tgts[m])
+                ct = None
+            else:
+                loss, g, ct = lossgrad[-1](state[-1][0], x_in[-1][m], tgts[m])
+            losses.append(loss)
+            accs[-1] = g if accs[-1] is None else add_grads[-1](accs[-1], g)
+            for s in range(S - 2, -1, -1):
+                ct = _put(ct, s, _boundary_spec(s, rows))
+                if s == 0:
+                    g = bwd[0](state[0][0], toks[m], ct)
+                else:
+                    g, ct = bwd[s](state[s][0], x_in[s][m], ct)
+                accs[s] = g if accs[s] is None else add_grads[s](accs[s], g)
+
+        # ---- optimizer step per stage
+        for s in range(S):
+            params, opt_state = apply_upd[s](
+                state[s][0], state[s][1], accs[s], M)
+            state[s] = [params, opt_state]
+        loss = float(np.mean([jax.device_get(l) for l in losses]))
+        return state, loss
+
+    return init_fn, step_fn
+
+
+def plan_replica_rows(
+    inter,
+    strategies: Sequence,
+    cluster,
+    profiles,
+) -> list[tuple[int, ...] | None]:
+    """Per-stage uneven replica row counts from the data balancer — the
+    execution-side consumer of Metis's signature feature (reference
+    ``partition_data``, ``load_balancer.py:155-179``).  Homogeneous stages
+    return None (even GSPMD sharding needs no padding)."""
+    from metis_tpu.balance.data import DataBalancer
+    from metis_tpu.balance.stage_perf import rank_device_types
+
+    balancer = DataBalancer(profiles)
+    ranks = rank_device_types(cluster, inter.node_sequence)
+    mb = inter.gbs // inter.batches
+    out: list[tuple[int, ...] | None] = []
+    for stage_id, strat in enumerate(strategies):
+        start, end = inter.stage_rank_range(stage_id)
+        types = ranks[start:end]
+        if len(set(types)) == 1:
+            out.append(None)
+        else:
+            out.append(tuple(balancer.partition(types, strat.dp, strat.tp, mb)))
+    return out
+
+
+def make_hetero_train_step_from_artifact(
+    cfg: GPTConfig,
+    artifact,
+    devices: Sequence | None = None,
+    optimizer=None,
+    stage_replica_rows: Sequence[Sequence[int] | None] | None = None,
+):
+    """PlanArtifact -> executable hetero step (the plan-to-execution bridge
+    for non-rectangular plans; rectangular plans may still prefer the
+    single-program paths in execution.train / execution.pipeline)."""
+    stages = stage_specs_from_plan(
+        artifact.layer_partition, artifact.strategies, cfg,
+        stage_replica_rows=stage_replica_rows)
+    groups = tuple(artifact.device_groups)
+    if groups and groups != tuple(s.devices for s in stages):
+        raise ValueError(
+            f"device_groups {groups} disagree with strategies "
+            f"{tuple(s.devices for s in stages)}")
+    return make_hetero_train_step(
+        cfg, stages, devices=devices, optimizer=optimizer)

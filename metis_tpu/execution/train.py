@@ -75,6 +75,33 @@ def fsdp_wrap_specs(specs: dict, params: dict, dp_axis: str = DP,
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def opt_state_specs_by_shape(opt_state, params, wrapped_specs) -> object:
+    """PartitionSpec tree for an optax state, by shape/dtype-matching its
+    leaves to the parameter leaves.
+
+    Optimizer moments (Adam mu/nu) mirror the param tree leaf-for-leaf but
+    live inside optax NamedTuples whose structure differs from the param
+    pytree, so specs can't be tree-mapped across directly.  Leaves whose
+    (shape, dtype) matches a parameter take that parameter's wrapped spec;
+    scalars and unmatched leaves replicate; ambiguous shapes (two params of
+    equal shape with different wrapped specs) fall back to replicated rather
+    than guessing."""
+    shape_to_spec: dict = {}
+    p_leaves = jax.tree.leaves(params)
+    s_leaves = jax.tree.leaves(wrapped_specs,
+                               is_leaf=lambda x: isinstance(x, P))
+    for leaf, spec in zip(p_leaves, s_leaves):
+        key = (tuple(leaf.shape), jnp.dtype(leaf.dtype))
+        if key in shape_to_spec and shape_to_spec[key] != spec:
+            shape_to_spec[key] = P()
+        else:
+            shape_to_spec[key] = spec
+    return jax.tree.map(
+        lambda l: shape_to_spec.get(
+            (tuple(l.shape), jnp.dtype(l.dtype)), P()),
+        opt_state)
+
+
 def loss_fn_for(cfg: GPTConfig):
     return (moe_next_token_loss if isinstance(cfg, MoEConfig)
             else next_token_loss)
@@ -100,13 +127,25 @@ def build_train_state(
     tp_axis: str = TP,
     ep_axis: str | None = None,
     fsdp_axis: str | None = None,
+    zero: int = 0,
+    zero_axis: str = DP,
 ) -> tuple[TrainState, dict]:
     """Initialize params on-mesh (sharded from the start) and the matching
     optimizer state.  Returns (state, param_specs).  ``ep_axis`` shards MoE
     expert weights (ignored for dense configs; None replicates experts);
     ``fsdp_axis`` additionally shards params + optimizer state ZeRO-3 style
-    (usually the dp axis)."""
+    (usually the dp axis).
+
+    ``zero`` consumes the planner's ``Strategy.zero`` field directly (the
+    cost model's memory-relief claim, ``cost/zero.py``, is now delivered by
+    execution): 1/2 shard the optimizer state over ``zero_axis`` while
+    params stay replicated over data ranks (gradient sharding within the
+    update is XLA's to schedule — on TPU there is no separate "ZeRO-2"
+    persistent-grad buffer to shard); 3 shards params + state FSDP-style
+    (same as passing ``fsdp_axis``)."""
     optimizer = optimizer or build_optimizer()
+    if zero >= 3 and fsdp_axis is None:
+        fsdp_axis = zero_axis
     specs = param_specs_for(cfg, tp_axis=tp_axis, ep_axis=ep_axis)
     host_params = init_params_for(key, cfg)
     if fsdp_axis is not None:
@@ -114,6 +153,13 @@ def build_train_state(
                                 axis_size=mesh.shape[fsdp_axis])
     params = shard_params(host_params, mesh, specs)
     opt_state = optimizer.init(params)
+    if zero in (1, 2) and fsdp_axis is None:
+        wrapped = fsdp_wrap_specs(specs, host_params, zero_axis,
+                                  axis_size=mesh.shape[zero_axis])
+        opt_specs = opt_state_specs_by_shape(opt_state, host_params, wrapped)
+        opt_state = jax.tree.map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+            opt_state, opt_specs)
     return TrainState(params=params, opt_state=opt_state,
                       step=jnp.zeros((), jnp.int32)), specs
 

