@@ -11,6 +11,14 @@ from metis_tpu.cost.ici import (
     reduce_scatter_ms,
     all_to_all_ms,
     p2p_ms,
+    sub_torus_eff_bw_gbps,
+)
+from metis_tpu.cost.calibration import (
+    CollectiveCalibration,
+    LinearFit,
+    fit_samples,
+    microbenchmark_collectives,
+    microbenchmark_chip,
 )
 from metis_tpu.cost.estimator import (
     EstimatorOptions,
@@ -30,6 +38,12 @@ __all__ = [
     "reduce_scatter_ms",
     "all_to_all_ms",
     "p2p_ms",
+    "sub_torus_eff_bw_gbps",
+    "CollectiveCalibration",
+    "LinearFit",
+    "fit_samples",
+    "microbenchmark_collectives",
+    "microbenchmark_chip",
     "EstimatorOptions",
     "UniformCostEstimator",
     "HeteroCostEstimator",

@@ -14,7 +14,12 @@ Reference semantics reproduced exactly (differential-tested):
 - "one node" is literal: two same-type nodes still count as spanning
   (``cluster_bandwidth.py:172-177`` keys on distinct node ids);
 - hetero DP groups are built round-robin, tp-major (``:148-156``), i.e. group
-  d holds stage ranks ``d::dp``.
+  d holds stage ranks ``d::dp`` — note this is the *reference's* grouping
+  quirk reproduced for differential parity: it scans by replica, the
+  transpose of the (dp, cp, tp) gradient-sync layout that
+  ``cp_ring_groups`` declares and the ICI model costs
+  (``ici.IciDcnBandwidth.dp_bandwidth``).  For the scalar model both scans
+  touch the same node set in almost all layouts, so parity wins here.
 """
 from __future__ import annotations
 

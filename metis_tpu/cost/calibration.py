@@ -1,0 +1,334 @@
+"""Collective microbenchmarks + calibration for the ICI/DCN cost model.
+
+SURVEY.md §7 hard part #1: the torus cost model must predict XLA collective
+latencies, which "needs microbenchmark calibration (own profiler)".  The
+reference has nothing comparable (its bandwidth layer is two scalars per
+node, ``README.md:203-230``); this module closes the loop the TPU-native
+way:
+
+1. ``microbenchmark_collectives`` times the real XLA collectives — psum,
+   all_gather, psum_scatter, all_to_all, ppermute — under ``shard_map`` over
+   a 1-D device mesh at several payload sizes;
+2. ``fit_samples`` fits each collective to the two-parameter wire model
+   ``time_ms = latency_ms + nbytes * ms_per_byte`` by least squares —
+   exactly the alpha/beta decomposition the analytic formulas in
+   :mod:`metis_tpu.cost.ici` assume;
+3. the resulting :class:`CollectiveCalibration` is a JSON artifact
+   (committed per deployment under ``calibration/``) that
+   :class:`metis_tpu.cost.ici.IciDcnBandwidth` consumes: measured effective
+   bandwidth replaces the published per-generation link constants whenever
+   the calibration's platform matches the slice being costed.
+
+The harness runs identically on the CPU fake backend (the 8-device virtual
+mesh used across the test suite) and on real TPU slices — the planner core
+stays runnable with zero TPUs (SURVEY.md §4) while a deployment with a real
+slice gets real constants from the same entry point.
+"""
+from __future__ import annotations
+
+import json
+import time
+import warnings
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Sequence
+
+COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+               "ppermute")
+
+
+@dataclass(frozen=True)
+class CollectiveSample:
+    """One timed collective: ``nbytes`` is the logical payload the analytic
+    formula charges (the full gradient/buffer size, not the wire volume)."""
+
+    collective: str
+    group_size: int
+    nbytes: int
+    time_ms: float
+
+
+@dataclass(frozen=True)
+class LinearFit:
+    """``time_ms = latency_ms + nbytes * ms_per_byte`` (alpha-beta model)."""
+
+    latency_ms: float
+    ms_per_byte: float
+    r2: float
+    n_samples: int
+
+    def predict_ms(self, nbytes: float) -> float:
+        return self.latency_ms + nbytes * self.ms_per_byte
+
+    @property
+    def effective_bw_gbps(self) -> float:
+        """Asymptotic (large-payload) bandwidth in GB/s (1 GB/s = 1e6 B/ms)."""
+        if self.ms_per_byte <= 0:
+            return float("inf")
+        return 1.0 / (self.ms_per_byte * 1e6)
+
+
+@dataclass(frozen=True)
+class CollectiveCalibration:
+    """Fitted wire model per collective for one (platform, group size)."""
+
+    platform: str
+    device_kind: str
+    group_size: int
+    fits: dict[str, LinearFit]
+    samples: tuple[CollectiveSample, ...] = field(default=(), repr=False)
+
+    # -- persistence -------------------------------------------------------
+    def to_json_dict(self) -> dict:
+        return {
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "group_size": self.group_size,
+            "fits": {
+                name: {"latency_ms": f.latency_ms,
+                       "ms_per_byte": f.ms_per_byte,
+                       "r2": f.r2, "n_samples": f.n_samples,
+                       "effective_bw_gbps": f.effective_bw_gbps}
+                for name, f in self.fits.items()
+            },
+            "samples": [
+                {"collective": s.collective, "group_size": s.group_size,
+                 "nbytes": s.nbytes, "time_ms": s.time_ms}
+                for s in self.samples
+            ],
+        }
+
+    def dump(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self.to_json_dict(), indent=1))
+
+    @classmethod
+    def from_json_dict(cls, d: dict) -> "CollectiveCalibration":
+        fits = {
+            name: LinearFit(f["latency_ms"], f["ms_per_byte"], f["r2"],
+                            f["n_samples"])
+            for name, f in d["fits"].items()
+        }
+        samples = tuple(
+            CollectiveSample(s["collective"], s["group_size"], s["nbytes"],
+                             s["time_ms"])
+            for s in d.get("samples", ()))
+        return cls(d["platform"], d["device_kind"], d["group_size"], fits,
+                   samples)
+
+    @classmethod
+    def load(cls, path: str | Path) -> "CollectiveCalibration":
+        return cls.from_json_dict(json.loads(Path(path).read_text()))
+
+    # -- application -------------------------------------------------------
+    def bw_gbps(self, collective: str) -> float | None:
+        fit = self.fits.get(collective)
+        return None if fit is None else fit.effective_bw_gbps
+
+    def latency_ms(self, collective: str) -> float:
+        fit = self.fits.get(collective)
+        return 0.0 if fit is None else max(fit.latency_ms, 0.0)
+
+
+def fit_samples(samples: Sequence[CollectiveSample]) -> dict[str, LinearFit]:
+    """Least-squares alpha-beta fit per collective (clamped to latency >= 0:
+    a tiny negative intercept is measurement noise, not physics)."""
+    import numpy as np
+
+    by_name: dict[str, list[CollectiveSample]] = {}
+    for s in samples:
+        by_name.setdefault(s.collective, []).append(s)
+
+    fits = {}
+    for name, group in by_name.items():
+        x = np.array([s.nbytes for s in group], dtype=np.float64)
+        y = np.array([s.time_ms for s in group], dtype=np.float64)
+        if len(group) >= 2 and np.ptp(x) > 0:
+            slope, intercept = np.polyfit(x, y, 1)
+            slope = max(float(slope), 0.0)
+            intercept = max(float(intercept), 0.0)
+            pred = intercept + slope * x
+            ss_res = float(((y - pred) ** 2).sum())
+            ss_tot = float(((y - y.mean()) ** 2).sum())
+            r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 1.0
+        else:
+            slope, intercept, r2 = 0.0, float(y.mean()), 1.0
+        fits[name] = LinearFit(intercept, slope, r2, len(group))
+    return fits
+
+
+def _collective_fns(axis: str):
+    """name -> (local_fn, logical_payload_fn(local_shape_bytes, n)).
+
+    Local arrays are [rows, cols] sharded over rows; the payload reported is
+    the quantity the analytic formulas charge:
+
+    - all_reduce: the full reduced buffer (every device ends with it);
+    - all_gather: the full gathered result;
+    - reduce_scatter: the full pre-reduction buffer;
+    - all_to_all: each device's full send buffer;
+    - ppermute: the block one neighbor sends.
+    """
+    import jax
+
+    def all_reduce(x):
+        return jax.lax.psum(x, axis)
+
+    def all_gather(x):
+        return jax.lax.all_gather(x, axis, tiled=True)
+
+    def reduce_scatter(x):
+        return jax.lax.psum_scatter(x, axis, tiled=True)
+
+    def all_to_all(x):
+        return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    def make_ppermute(n):
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def ppermute(x):
+            return jax.lax.ppermute(x, axis, perm)
+        return ppermute
+
+    return {
+        "all_reduce": (all_reduce, lambda local, n: local),
+        "all_gather": (all_gather, lambda local, n: local * n),
+        "reduce_scatter": (reduce_scatter, lambda local, n: local),
+        "all_to_all": (all_to_all, lambda local, n: local),
+        "ppermute": (None, lambda local, n: local),  # built per-n below
+        "_make_ppermute": make_ppermute,
+    }
+
+
+def microbenchmark_collectives(
+    devices: Sequence | None = None,
+    payload_kb: Sequence[int] = (64, 256, 1024, 4096),
+    iters: int = 10,
+    warmup: int = 2,
+    collectives: Sequence[str] = COLLECTIVES,
+) -> CollectiveCalibration:
+    """Time XLA collectives over a 1-D mesh of ``devices`` and fit the wire
+    model.  ``payload_kb`` are *local shard* sizes; logical payloads are
+    derived per collective (see ``_collective_fns``)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if n < 2:
+        raise ValueError("collective microbenchmark needs >= 2 devices")
+    mesh = Mesh(np.array(devs), ("x",))
+    fns = _collective_fns("x")
+
+    samples: list[CollectiveSample] = []
+    # local shard rows: a multiple of n (all_to_all's tiled split of axis 0
+    # requires n | local rows on any mesh size) that is also >= 8
+    rows = n * max(8 // n, 1)
+    for kb in payload_kb:
+        cols = max(kb * 1024 // 4 // rows, 8)  # fp32
+        local_bytes = rows * cols * 4
+        host = np.zeros((n * rows, cols), np.float32)
+        x = jax.device_put(
+            host, NamedSharding(mesh, P("x", None)))
+        for name in collectives:
+            fn = fns[name][0] if name != "ppermute" else fns["_make_ppermute"](n)
+            payload = fns[name][1](local_bytes, n)
+            # out_specs are P("x", None) for every collective: all_gather's
+            # per-device copy is emitted as a varying value (global shape
+            # n*rows) rather than asking shard_map to prove replication.
+            shard = jax.shard_map(
+                fn, mesh=mesh, in_specs=P("x", None), out_specs=P("x", None))
+            jitted = jax.jit(shard)
+            try:
+                out = jitted(x)
+                jax.block_until_ready(out)
+                for _ in range(warmup - 1):
+                    jax.block_until_ready(jitted(x))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = jitted(x)
+                jax.block_until_ready(out)
+                ms = (time.perf_counter() - t0) / iters * 1e3
+            except Exception as e:  # pragma: no cover - backend-specific
+                warnings.warn(
+                    f"collective microbenchmark skipped {name} at "
+                    f"{kb} KB: {type(e).__name__}: {e}", stacklevel=2)
+                continue
+            samples.append(CollectiveSample(name, n, payload, ms))
+
+    dev0 = devs[0]
+    return CollectiveCalibration(
+        platform=dev0.platform,
+        device_kind=getattr(dev0, "device_kind", dev0.platform),
+        group_size=n,
+        fits=fit_samples(samples),
+        samples=tuple(samples),
+    )
+
+
+# ---------------------------------------------------------------------------
+# single-chip roofline calibration (compute side)
+# ---------------------------------------------------------------------------
+
+
+def microbenchmark_chip(device=None, iters: int = 10) -> dict:
+    """Measure one chip's achievable matmul TFLOP/s and HBM read bandwidth —
+    the two roofline constants the synthetic profile generator
+    (``profiles/synthetic.py``) and MFU accounting key on.  Returns a plain
+    dict artifact (committed next to the collective calibration)."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = device if device is not None else jax.devices()[0]
+    out: dict = {"platform": dev.platform,
+                 "device_kind": getattr(dev, "device_kind", dev.platform)}
+
+    def timed(fn, *args) -> float:
+        """Seconds per chained iteration.  The whole chain runs inside ONE
+        jitted fori_loop with a data dependency between iterations (so XLA
+        cannot overlap them) and completion is forced with ``device_get`` of
+        a scalar — plain ``block_until_ready`` returns before remote
+        execution finishes under the axon TPU tunnel."""
+        jitted = jax.jit(fn, static_argnums=(0,))
+
+        def run(n) -> float:
+            t0 = time.perf_counter()
+            float(jax.device_get(jnp.sum(jitted(n, *args))))
+            return time.perf_counter() - t0
+
+        run(iters), run(2 * iters)  # compile + warm both loop lengths
+        # two-point measurement cancels the fixed dispatch/transfer overhead
+        # (tens of ms per call through the remote-TPU tunnel)
+        t1 = min(run(iters) for _ in range(2))
+        t2 = min(run(2 * iters) for _ in range(2))
+        return max(t2 - t1, 1e-9) / iters
+
+    with jax.default_device(dev):
+        # matmul peak: bf16 k^3 keeps the MXU busy ~ms per iteration; each
+        # loop step feeds the previous product back in (scaled back to ~1)
+        k = 2048 if dev.platform == "cpu" else 8192
+        a = jnp.ones((k, k), jnp.bfloat16)
+        b = jnp.ones((k, k), jnp.bfloat16)
+
+        def mm_chain(n, a, b):
+            body = lambda _, x: ((x @ b) * (1.0 / k)).astype(x.dtype)  # noqa: E731
+            return jax.lax.fori_loop(0, n, body, a)
+
+        dt = timed(mm_chain, a, b)
+        out["matmul_tflops"] = round(2 * k**3 / dt / 1e12, 1)
+
+        # HBM streaming bandwidth: each iteration reads + writes the buffer
+        # (2x volume), dependent on the previous iteration's output
+        m = (64 if dev.platform == "cpu" else 256) * 1024 * 1024 // 4
+        big = jnp.ones((m,), jnp.float32)
+
+        def scale_chain(n, x):
+            body = lambda _, v: v * 1.0000001  # noqa: E731
+            return jax.lax.fori_loop(0, n, body, x)
+
+        dt = timed(scale_chain, big)
+        out["hbm_stream_gbps"] = round(2 * m * 4 / dt / 1e9, 1)
+    return out

@@ -299,6 +299,11 @@ class HeteroCostEstimator(_EstimatorBase):
             dp_bw = bandwidth.dp_bandwidth(stage_id, strat)
             if cp_bw is not None:
                 dp_bw = min(dp_bw, cp_bw)
+            # Measured latency floor (calibrated bandwidth models only):
+            # additive per gradient-sync ring, rescaled to this ring's steps.
+            lat_fn = getattr(bandwidth, "collective_latency_ms", None)
+            dp_latency = (lat_fn("all_reduce", sync_degree)
+                          if lat_fn is not None else 0.0)
             # ZeRO-3 adds the backward parameter all-gather to the gradient
             # sync volume (cost/zero.py).
             zfac = zero_dp_factor(strat.zero)
@@ -314,10 +319,11 @@ class HeteroCostEstimator(_EstimatorBase):
                     self._dp_cost_ms(stage_params - expert_bytes * strat.ep,
                                      dp_bw, sync_degree)
                     + self._dp_cost_ms(expert_bytes, dp_bw,
-                                       sync_degree // strat.ep)))
+                                       sync_degree // strat.ep)) + dp_latency)
             else:
                 dp_costs.append(
-                    zfac * self._dp_cost_ms(stage_params, dp_bw, sync_degree))
+                    zfac * self._dp_cost_ms(stage_params, dp_bw, sync_degree)
+                    + dp_latency)
 
             opt_type = None if self.options.strict_compat else stage_types[0]
             # ZeRO >=1 shards the optimizer step itself over the data ranks.

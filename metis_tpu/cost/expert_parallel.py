@@ -28,6 +28,12 @@ model captures:
   the static part.
 - **Gradient sync**: expert parameters all-reduce over ``dp*cp/ep`` ranks
   (the replicas of each expert shard); non-expert parameters over ``dp*cp``.
+- **Dispatch/combine activation memory**: ``models.moe`` routes tokens in
+  fixed-size groups (``MoEConfig.route_group_size``), so the one-hot
+  dispatch/combine tensors are *linear* in tokens — which is exactly the
+  affine-in-bs activation model the profile bs-sweep fit
+  (``ActivationSplitModel``) assumes.  (With global routing they were
+  O(T^2·top_k) and the fit under-predicted large batches — ADVICE r1.)
 """
 from __future__ import annotations
 

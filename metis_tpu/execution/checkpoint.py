@@ -76,33 +76,57 @@ def save_checkpoint(
     directory = Path(directory).absolute()
     tmp = directory.with_name(directory.name + ".tmp")
     prev = directory.with_name(directory.name + ".prev")
-    if tmp.exists():
-        shutil.rmtree(tmp)
-    tmp.mkdir(parents=True)
+    # Multi-host: the tmp-dir (re)creation and the meta/plan writes are plain
+    # filesystem surgery on the shared directory — one host performs them,
+    # fenced so no host enters the orbax save (which writes shards into tmp
+    # from every host) before the directory exists.
+    multi_host = jax.process_count() > 1
+    if jax.process_index() == 0:
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+    if multi_host:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("metis_ckpt_tmp_ready")
 
     tree = {"params": state.params, "opt_state": state.opt_state,
             "step": state.step}
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp / _STATE_DIR, tree, force=True)
-    meta = CheckpointMeta(
-        step=int(state.step),
-        mesh_axes=tuple(mesh.axis_names),
-        mesh_shape=tuple(mesh.devices.shape),
-    )
-    (tmp / _META_FILE).write_text(meta.to_json())
-    if plan is not None:
-        (tmp / _PLAN_FILE).write_text(plan.to_json())
+    if jax.process_index() == 0:
+        meta = CheckpointMeta(
+            step=int(state.step),
+            mesh_axes=tuple(mesh.axis_names),
+            mesh_shape=tuple(mesh.devices.shape),
+        )
+        (tmp / _META_FILE).write_text(meta.to_json())
+        if plan is not None:
+            (tmp / _PLAN_FILE).write_text(plan.to_json())
 
     # Ordering invariant: never delete the only complete checkpoint — .prev
     # is cleared early only when the primary exists (to make room for the
     # park), and cleared finally only after the new primary is in place.
-    if directory.exists():
+    # Multi-host: orbax's save above is multi-host coordinated, but the swap
+    # is plain filesystem surgery on a shared directory — exactly one host
+    # performs it, fenced by barriers so no host returns (and possibly
+    # restores) mid-swap.
+    if multi_host:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("metis_ckpt_pre_swap")
+    if jax.process_index() == 0:
+        if directory.exists():
+            if prev.exists():
+                shutil.rmtree(prev)
+            directory.rename(prev)
+        tmp.rename(directory)
         if prev.exists():
             shutil.rmtree(prev)
-        directory.rename(prev)
-    tmp.rename(directory)
-    if prev.exists():
-        shutil.rmtree(prev)
+    if multi_host:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("metis_ckpt_post_swap")
     return directory
 
 

@@ -45,6 +45,12 @@ class MoEConfig(GPTConfig):
     capacity_factor: float = 1.25
     # weight of the load-balancing auxiliary loss (Switch Transformer default)
     aux_loss_coef: float = 0.01
+    # GShard-style routing group size: dispatch/combine one-hot tensors are
+    # built per fixed-size token group ([G, g, E, C_g]), so their memory and
+    # einsum FLOPs scale linearly in tokens instead of O(T^2 * top_k)
+    # (ADVICE r1: the global-batch formulation dominated the expert matmuls
+    # at realistic batch*seq).  Capacity is enforced per group.
+    route_group_size: int = 4096
 
     @staticmethod
     def from_model_spec(spec: ModelSpec, **overrides) -> "MoEConfig":
@@ -114,18 +120,40 @@ def init_moe_params(key: jax.Array, cfg: MoEConfig) -> dict:
     }
 
 
+def _route_group_len(tokens: int, target: int) -> int:
+    """Largest divisor of ``tokens`` that is <= ``target`` (group length)."""
+    for g in range(min(target, tokens), 0, -1):
+        if tokens % g == 0:
+            return g
+    return tokens
+
+
 def moe_ffn(
     x: jnp.ndarray, layer: dict, cfg: MoEConfig
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k routed expert FFN on [b, s, h].  Returns (output, aux_loss).
 
-    Dispatch/combine are dense one-hot einsums ([T, E, C] tensors) — the
-    GShard formulation that keeps every step a static-shape matmul.
-    """
+    Dispatch/combine are dense one-hot einsums — the GShard formulation that
+    keeps every step a static-shape matmul.  Tokens are routed in fixed-size
+    groups (``cfg.route_group_size``): the one-hot tensors are
+    [G, g, E, C_g], linear in total tokens, and every expert processes
+    ``C_g`` slots per group (capacity discipline per group, as GShard)."""
     b, s, h = x.shape
-    E, k, dt = cfg.num_experts, cfg.top_k, cfg.dtype
-    tokens = x.reshape(b * s, h)
     T = b * s
+    tokens = x.reshape(T, h)
+    g = _route_group_len(T, cfg.route_group_size)
+    grouped = tokens.reshape(T // g, g, h)
+    out, aux = jax.vmap(lambda t: _route_tokens(t, layer, cfg))(grouped)
+    return out.reshape(b, s, h), aux.mean()
+
+
+def _route_tokens(
+    tokens: jnp.ndarray, layer: dict, cfg: MoEConfig
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Route one token group [T, h] through the experts; returns
+    ([T, h] mixed output, aux loss scalar)."""
+    T, h = tokens.shape
+    E, k, dt = cfg.num_experts, cfg.top_k, cfg.dtype
     C = expert_capacity(cfg, T)
 
     logits = jnp.einsum(
@@ -178,7 +206,7 @@ def moe_ffn(
     assign_frac = choice_onehot[:, 0, :].mean(0)                # top-1 counts
     aux = E * jnp.sum(probs.mean(0) * assign_frac)
 
-    return out.reshape(b, s, h), aux
+    return out, aux
 
 
 def moe_block_forward(

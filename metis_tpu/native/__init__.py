@@ -86,6 +86,7 @@ def minmax_partition_native(
     native library is unavailable (callers check ``native_available``)."""
     if _LIB is None:
         raise RuntimeError("native minmax library not built")
+    wprefix = np.ascontiguousarray(wprefix, dtype=np.float64)
     L = len(wprefix) - 1
     perf = np.ascontiguousarray(performance, dtype=np.float64)
     S = len(perf)

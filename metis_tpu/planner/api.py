@@ -243,13 +243,19 @@ def plan_tpu(
     chips_per_node: int = 4,
     top_k: int | None = None,
     events: EventLog = NULL_LOG,
+    calibration=None,
 ) -> PlannerResult:
     """Heterogeneous search over TPU slices with the ICI/DCN-aware bandwidth
-    model (the BASELINE.md north-star path: e.g. v4-32 + v5e-16 over DCN)."""
+    model (the BASELINE.md north-star path: e.g. v4-32 + v5e-16 over DCN).
+
+    ``calibration``: an optional ``cost.CollectiveCalibration`` from
+    ``microbenchmark_collectives`` — measured wire constants override the
+    published per-generation link bandwidths for matching slices."""
     cluster = tpu_cluster.as_cluster_spec(chips_per_node)
     return plan_hetero(
         cluster, profiles, model, config,
-        bandwidth_factory=lambda plan: IciDcnBandwidth(tpu_cluster, plan),
+        bandwidth_factory=lambda plan: IciDcnBandwidth(
+            tpu_cluster, plan, calibration=calibration),
         top_k=top_k,
         events=events,
     )

@@ -1,0 +1,157 @@
+"""Collective microbenchmark calibration (SURVEY.md §7 hard part #1).
+
+Runs the real microbenchmark harness on the 8-device virtual CPU mesh —
+the same entry point a TPU deployment calibrates with — and checks the
+alpha-beta fits close the predicted-vs-measured loop on their own mesh.
+"""
+import math
+
+import pytest
+
+from metis_tpu.cluster.tpu import TpuClusterSpec, slice_from_name
+from metis_tpu.core.types import InterStagePlan, Strategy
+from metis_tpu.cost import (
+    CollectiveCalibration,
+    IciDcnBandwidth,
+    LinearFit,
+    all_to_all_ms,
+    fit_samples,
+    microbenchmark_collectives,
+    ring_all_reduce_ms,
+    sub_torus_eff_bw_gbps,
+)
+from metis_tpu.cost.calibration import CollectiveSample
+
+
+class TestFit:
+    def test_recovers_known_model(self):
+        # t = 0.05 ms + nbytes / (10 GB/s) exactly
+        samples = [
+            CollectiveSample("all_reduce", 8, nb, 0.05 + nb / 10e6)
+            for nb in (1e5, 1e6, 1e7)
+        ]
+        fit = fit_samples(samples)["all_reduce"]
+        assert fit.latency_ms == pytest.approx(0.05, rel=1e-6)
+        assert fit.effective_bw_gbps == pytest.approx(10.0, rel=1e-6)
+        assert fit.r2 > 0.999
+
+    def test_constant_time_collective(self):
+        samples = [CollectiveSample("ppermute", 4, 1000, 0.2)]
+        fit = fit_samples(samples)["ppermute"]
+        assert fit.predict_ms(5000) == pytest.approx(0.2)
+        assert math.isinf(fit.effective_bw_gbps)
+
+
+class TestMicrobenchmark:
+    @pytest.fixture(scope="class")
+    def cal(self):
+        import jax
+
+        return microbenchmark_collectives(
+            jax.devices()[:8], payload_kb=(64, 512, 2048), iters=5, warmup=2)
+
+    def test_all_collectives_fit(self, cal):
+        assert cal.platform == "cpu"
+        assert cal.group_size == 8
+        for name in ("all_reduce", "all_gather", "reduce_scatter",
+                     "all_to_all", "ppermute"):
+            fit = cal.fits[name]
+            assert fit.n_samples == 3
+            assert fit.predict_ms(1e6) > 0
+
+    def test_self_prediction_closes(self, cal):
+        """North-star closure on the calibration's own mesh: the fitted model
+        reproduces its measured points.  Mean relative error over the
+        samples must be small (the <10% SURVEY target is for TPU ICI, which
+        is far less noisy than CPU memcpy timing — allow 35% here)."""
+        errs = []
+        for s in cal.samples:
+            pred = cal.fits[s.collective].predict_ms(s.nbytes)
+            errs.append(abs(pred - s.time_ms) / s.time_ms)
+        assert sum(errs) / len(errs) < 0.35
+
+    def test_json_round_trip(self, cal, tmp_path):
+        p = tmp_path / "cal.json"
+        cal.dump(p)
+        back = CollectiveCalibration.load(p)
+        assert back.platform == cal.platform
+        assert back.group_size == cal.group_size
+        assert back.fits == cal.fits
+        assert back.samples == cal.samples
+
+
+class TestTorusEffBw:
+    def test_full_wrapped_axis_gets_both_directions(self):
+        v5e16 = slice_from_name("v5e-16")  # 4x4, both axes wrap
+        assert sub_torus_eff_bw_gbps(v5e16, [0, 4, 8, 12]) == pytest.approx(90)
+        assert sub_torus_eff_bw_gbps(v5e16, [0, 1, 2, 3]) == pytest.approx(90)
+
+    def test_sub_block_phases_sum(self):
+        v5e16 = slice_from_name("v5e-16")
+        # 2x2 corner block: two e=2 phases at single-direction link bw
+        eff = sub_torus_eff_bw_gbps(v5e16, [0, 1, 4, 5])
+        denom = 2 * (2 - 1) / 2 / 45 * 2
+        assert eff == pytest.approx(2 * 3 / 4 / denom)
+
+    def test_strided_groups_share_links(self):
+        v5e16 = slice_from_name("v5e-16")
+        # every other chip of one row: stride 2 halves the link share
+        eff = sub_torus_eff_bw_gbps(v5e16, [0, 2])
+        assert eff == pytest.approx(22.5)
+
+    def test_single_chip_infinite(self):
+        v5e16 = slice_from_name("v5e-16")
+        assert math.isinf(sub_torus_eff_bw_gbps(v5e16, [3]))
+
+
+class TestAllToAll:
+    def test_ring_model_cheaper_than_gather_at_small_n(self):
+        from metis_tpu.cost import all_gather_ms
+
+        # n=4 bidirectional ring: a2a moves n*V/8 per link vs ag (n-1)/n*V
+        assert all_to_all_ms(1e9, 4, 100) == pytest.approx(5.0)
+        assert all_to_all_ms(1e9, 4, 100) < all_gather_ms(1e9, 4, 100)
+
+    def test_grows_with_group_size(self):
+        assert all_to_all_ms(1e9, 32, 100) > all_to_all_ms(1e9, 8, 100)
+
+    def test_line_doubles(self):
+        assert all_to_all_ms(1e9, 4, 100, wrap=False) == pytest.approx(10.0)
+
+
+class TestCalibratedBandwidth:
+    def _cal(self, bw_gbps: float, group: int = 8) -> CollectiveCalibration:
+        n = group
+        fits = {
+            "all_reduce": LinearFit(0.0, 1 / (bw_gbps * 1e6), 1.0, 3),
+            "ppermute": LinearFit(0.0, 1 / (bw_gbps * 1e6), 1.0, 3),
+        }
+        return CollectiveCalibration("cpu", "cpu", n, fits)
+
+    def test_calibration_overrides_link_constant(self):
+        tc = TpuClusterSpec((slice_from_name("v5e-16"),))
+        plan = InterStagePlan(("tpu_v5e",), (16,), 8, 128)
+        base = IciDcnBandwidth(tc, plan)
+        # measured effective 10 GB/s at n=8 -> wire link = 10 * 2*7/8 = 17.5
+        cal = IciDcnBandwidth(tc, plan, calibration=self._cal(10.0))
+        s = Strategy(4, 4)
+        assert cal.dp_bandwidth(0, s) < base.dp_bandwidth(0, s)
+        # dp ring rides a full wrapped axis: eff = 2 * link
+        assert cal.dp_bandwidth(0, s) == pytest.approx(2 * 17.5)
+
+    def test_mismatched_platform_ignored(self):
+        tc = TpuClusterSpec((slice_from_name("v5e-16"),))
+        plan = InterStagePlan(("tpu_v5e",), (16,), 8, 128)
+        cal = self._cal(10.0)
+        object.__setattr__(cal, "platform", "tpu")
+        object.__setattr__(cal, "device_kind", "TPU v4")
+        bw = IciDcnBandwidth(tc, plan, calibration=cal)
+        assert bw.dp_bandwidth(0, Strategy(4, 4)) == 90
+
+    def test_generation_mapping(self):
+        from metis_tpu.cost.ici import generation_of_device_kind
+
+        assert generation_of_device_kind("TPU v5 lite") == "tpu_v5e"
+        assert generation_of_device_kind("TPU v4") == "tpu_v4"
+        assert generation_of_device_kind("TPU v5p") == "tpu_v5p"
+        assert generation_of_device_kind("Quantum QPU") is None

@@ -123,7 +123,9 @@ class TestIciBandwidth:
         plan = InterStagePlan(("tpu_v4", "tpu_v5e"), (32, 16), 8, 128)
         bw = IciDcnBandwidth(tc, plan)
         assert bw.pp_bandwidth(0) == 25  # boundary crosses slices: DCN
-        assert bw.dp_bandwidth(0, Strategy(8, 4)) == 45  # inside v4 slice: ICI
+        # v4 4x4x2 stage, dp=8/tp=4 sync groups stride the torus: x-axis full
+        # ring (2x45) + y-axis stride-2 phase (45/2) -> phase-sum eff bw
+        assert bw.dp_bandwidth(0, Strategy(8, 4)) == pytest.approx(28.64, abs=0.01)
         assert bw.dp_bandwidth(1, Strategy(4, 4)) == 90  # v5e 4x4 wrapped ring
 
 
