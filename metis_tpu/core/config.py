@@ -30,6 +30,11 @@ class ModelSpec:
     # SURVEY.md §2.2 "EP — Absent"):
     num_experts: int = 0
     expert_top_k: int = 1
+    # model family: "gpt" (learned positions, GELU MLP) or "llama"
+    # (RMSNorm/RoPE/GQA/SwiGLU — models.llama); the reference knows only the
+    # GPT shape (``arguments.py:23-28``)
+    family: str = "gpt"
+    num_kv_heads: int = 0  # GQA KV heads for family="llama"; 0 -> num_heads
 
     def __post_init__(self) -> None:
         if self.num_layers < 3:
@@ -40,6 +45,10 @@ class ModelSpec:
             raise ValueError("invalid MoE shape")
         if self.num_experts > 0 and self.expert_top_k > self.num_experts:
             raise ValueError("expert_top_k cannot exceed num_experts")
+        if self.family not in ("gpt", "llama"):
+            raise ValueError(f"unknown model family {self.family!r}")
+        if self.num_kv_heads and self.num_heads % self.num_kv_heads != 0:
+            raise ValueError("num_kv_heads must divide num_heads")
 
     @property
     def head_dim(self) -> int:

@@ -1,0 +1,59 @@
+"""Device timing under asynchronous dispatch — one shared implementation.
+
+JAX dispatch is async everywhere, and under a remote-TPU tunnel even
+``block_until_ready`` can return before device execution finishes; the only
+reliable fence is materializing a value on the host (``device_get``).  That
+fence costs a round trip (tens of ms through a tunnel), which would swamp
+sub-ms measurements if paid per sample.  The pattern every measurement site
+in this repo uses (profiler, validator, calibration, bench):
+
+1. **queue** n invocations — the device executes queued programs FIFO, so
+   wall time is queue-overhead + n * t;
+2. fence ONCE with a host transfer;
+3. repeat with 2n and take the difference — the fixed overhead cancels:
+   ``t = (T(2n) - T(n)) / n``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+
+def forced_scalar(leaf) -> float:
+    """Materialize one element of ``leaf`` on the host — the full fence."""
+    import jax
+    import jax.numpy as jnp
+
+    return float(jax.device_get(
+        jax.jit(lambda x: jnp.ravel(x)[:1].astype(jnp.float32).sum())(leaf)))
+
+
+def two_point_queue_ms(
+    enqueue_n: Callable[[int], Any],
+    iters: int,
+    sync: Callable[[Any], None] | None = None,
+    repeats: int = 2,
+) -> float:
+    """Per-iteration wall time (ms) of ``enqueue_n`` via the two-point form.
+
+    ``enqueue_n(n)`` must queue n invocations (chained or identical — FIFO
+    execution makes both sequential) and return something ``sync`` can
+    fence on; ``sync`` defaults to ``forced_scalar`` of the first pytree
+    leaf.  Both queue lengths are warmed once (compilation, caches), then
+    timed ``repeats`` times taking minima to reject scheduler noise.
+    """
+    import jax
+
+    if sync is None:
+        def sync(out):
+            forced_scalar(jax.tree.leaves(out)[0])
+
+    def run(n: int) -> float:
+        t0 = time.perf_counter()
+        sync(enqueue_n(n))
+        return time.perf_counter() - t0
+
+    run(iters), run(2 * iters)  # warm both queue lengths
+    t1 = min(run(iters) for _ in range(repeats))
+    t2 = min(run(2 * iters) for _ in range(repeats))
+    return max(t2 - t1, 1e-9) / iters * 1e3

@@ -286,25 +286,16 @@ def microbenchmark_chip(device=None, iters: int = 10) -> dict:
     out: dict = {"platform": dev.platform,
                  "device_kind": getattr(dev, "device_kind", dev.platform)}
 
+    from metis_tpu.core.timing import two_point_queue_ms
+
     def timed(fn, *args) -> float:
-        """Seconds per chained iteration.  The whole chain runs inside ONE
-        jitted fori_loop with a data dependency between iterations (so XLA
-        cannot overlap them) and completion is forced with ``device_get`` of
-        a scalar — plain ``block_until_ready`` returns before remote
-        execution finishes under the axon TPU tunnel."""
+        """Seconds per chained iteration.  The chain runs inside ONE jitted
+        fori_loop with a data dependency between iterations (so XLA cannot
+        overlap them); the shared two-point fence cancels the fixed
+        dispatch/transfer overhead of the remote-TPU tunnel."""
         jitted = jax.jit(fn, static_argnums=(0,))
-
-        def run(n) -> float:
-            t0 = time.perf_counter()
-            float(jax.device_get(jnp.sum(jitted(n, *args))))
-            return time.perf_counter() - t0
-
-        run(iters), run(2 * iters)  # compile + warm both loop lengths
-        # two-point measurement cancels the fixed dispatch/transfer overhead
-        # (tens of ms per call through the remote-TPU tunnel)
-        t1 = min(run(iters) for _ in range(2))
-        t2 = min(run(2 * iters) for _ in range(2))
-        return max(t2 - t1, 1e-9) / iters
+        return two_point_queue_ms(
+            lambda n: jitted(n, *args), iters) / 1e3
 
     with jax.default_device(dev):
         # matmul peak: bf16 k^3 keeps the MXU busy ~ms per iteration; each

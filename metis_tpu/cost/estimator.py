@@ -315,11 +315,16 @@ class HeteroCostEstimator(_EstimatorBase):
                 expert_bytes = (block_params
                                 * expert_param_fraction(self.volume.model)
                                 / strat.ep)
+                # two rings, two latency floors: the dense ring over all
+                # sync_degree ranks, the expert ring over its 1/ep subgroup
+                ep_latency = (lat_fn("all_reduce", sync_degree // strat.ep)
+                              if lat_fn is not None else 0.0)
                 dp_costs.append(zfac * (
                     self._dp_cost_ms(stage_params - expert_bytes * strat.ep,
                                      dp_bw, sync_degree)
                     + self._dp_cost_ms(expert_bytes, dp_bw,
-                                       sync_degree // strat.ep)) + dp_latency)
+                                       sync_degree // strat.ep))
+                    + dp_latency + ep_latency)
             else:
                 dp_costs.append(
                     zfac * self._dp_cost_ms(stage_params, dp_bw, sync_degree)

@@ -66,32 +66,45 @@ def moe_layer_range(model: ModelSpec, start: int, end: int) -> int:
     return max(0, hi - lo)
 
 
-def a2a_bytes_per_layer(model: ModelSpec, mbs: int, ep: int, cp: int = 1) -> float:
-    """Un-overlapped all-to-all wire bytes one rank moves per MoE layer per
-    microbatch (4 passes, cross-rank fraction (ep-1)/ep).  With context
-    parallelism each rank holds only seq/cp tokens, so combined (cp, ep)
-    families dispatch proportionally less."""
-    if ep <= 1:
-        return 0.0
-    dispatched = (
+def a2a_buffer_bytes(model: ModelSpec, mbs: int, cp: int = 1) -> float:
+    """One rank's all-to-all send buffer per MoE layer per pass: every
+    routed token copy (``top_k`` per token) with ``hidden`` features.  With
+    context parallelism each rank holds only seq/cp tokens, so combined
+    (cp, ep) families dispatch proportionally less."""
+    return (
         mbs
         * (model.sequence_length // cp)
         * model.expert_top_k
         * model.hidden_size
         * model.dtype_bytes
     )
-    return A2A_PASSES * dispatched * (ep - 1) / ep
+
+
+def a2a_bytes_per_layer(model: ModelSpec, mbs: int, ep: int, cp: int = 1) -> float:
+    """Un-overlapped all-to-all wire bytes one rank moves per MoE layer per
+    microbatch (4 passes, cross-rank fraction (ep-1)/ep) — the *volume*
+    view; the *time* model (``ep_a2a_ms``) prices the ring routing of that
+    volume via ``cost.ici.all_to_all_ms``."""
+    if ep <= 1:
+        return 0.0
+    return A2A_PASSES * a2a_buffer_bytes(model, mbs, cp) * (ep - 1) / ep
 
 
 def ep_a2a_ms(
     model: ModelSpec, mbs: int, ep: int, num_moe_layers: int, bw_gbps: float,
     cp: int = 1,
 ) -> float:
-    """All-to-all time (ms) for one microbatch across a stage's MoE layers."""
+    """All-to-all time (ms) for one microbatch across a stage's MoE layers:
+    4 passes (dispatch + combine, forward + backward) of the per-rank send
+    buffer through the bidirectional-ring all-to-all model
+    (``ici.all_to_all_ms`` — per-link traffic ``n*V/8``, which *grows* with
+    ep; the flat (ep-1)/ep volume model under-charged large ep by >2x)."""
+    from metis_tpu.cost.ici import all_to_all_ms
+
     if ep <= 1 or num_moe_layers <= 0:
         return 0.0
-    nbytes = a2a_bytes_per_layer(model, mbs, ep, cp) * num_moe_layers
-    return nbytes / (bw_gbps * 1e6)
+    per_pass = all_to_all_ms(a2a_buffer_bytes(model, mbs, cp), ep, bw_gbps)
+    return A2A_PASSES * per_pass * num_moe_layers
 
 
 def expert_param_fraction(model: ModelSpec) -> float:

@@ -42,16 +42,14 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from metis_tpu.execution.mesh import DP, TP, gpt_param_specs
-from metis_tpu.execution.train import build_optimizer, fsdp_wrap_specs
-from metis_tpu.models.gpt import (
-    GPTConfig,
-    default_attention,
-    embed,
-    head_logits,
-    init_params,
-    run_blocks,
+from metis_tpu.execution.mesh import DP, TP
+from metis_tpu.execution.train import (
+    build_optimizer,
+    fsdp_wrap_specs,
+    param_specs_for,
 )
+from metis_tpu.models import family_ops
+from metis_tpu.models.gpt import GPTConfig, default_attention
 
 
 @dataclass(frozen=True)
@@ -141,7 +139,7 @@ def _slice_stage_params(params: dict, spec: StageSpec) -> dict:
 
 
 def _stage_param_specs(spec: StageSpec, cfg: GPTConfig) -> dict:
-    full = gpt_param_specs(cfg, tp_axis=TP, pp_axis=None)
+    full = param_specs_for(cfg, tp_axis=TP, tp_size=spec.tp)
     out = {"blocks": full["blocks"]}
     if spec.has_embed:
         out["embed"] = full["embed"]
@@ -183,6 +181,8 @@ def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl):
         to_padded, to_canonical = _pad_maps(spec.replica_rows)
 
     batch_sharded = P(DP, None, None)
+
+    embed, run_blocks, head_logits, _ = family_ops(cfg)
 
     def run(params, first_in, targets=None):
         x_or_tok = first_in
@@ -307,7 +307,7 @@ def make_hetero_train_step(
                 else P(None, None, None))
 
     def init_fn(key):
-        full = init_params(key, cfg)
+        full = family_ops(cfg)[3](key, cfg)
         state = []
         for s, (spec, mesh) in enumerate(zip(stages, meshes)):
             specs = _stage_param_specs(spec, cfg)

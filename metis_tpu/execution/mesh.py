@@ -104,6 +104,38 @@ def moe_param_specs(
     return {**specs, "blocks": blocks}
 
 
+def llama_param_specs(
+    cfg, tp_axis: str = TP, pp_axis: str | None = None, tp_size: int = 1
+) -> dict:
+    """PartitionSpec tree matching models.llama.init_llama_params.
+
+    Megatron layout: wq/w_gate/w_up column-parallel, wo/w_down row-parallel,
+    norms replicated, vocab-parallel embed/head.  The KV projection is
+    column-parallel only when the KV head count divides ``tp_size`` shards
+    evenly (GQA with few KV heads otherwise replicates K/V — the standard
+    fallback, since a head cannot be split across ranks without changing
+    attention math)."""
+    t, p = tp_axis, pp_axis
+    kv_t = t if tp_size <= 1 or cfg.kv_heads % tp_size == 0 else None
+    return {
+        "embed": {"tok": P(t, None)},
+        "blocks": {
+            "attn_norm": P(p, None),
+            "wq": P(p, None, t),
+            "wkv": P(p, None, None, kv_t),
+            "wo": P(p, t, None),
+            "ffn_norm": P(p, None),
+            "w_gate": P(p, None, t),
+            "w_up": P(p, None, t),
+            "w_down": P(p, t, None),
+        },
+        "head": {
+            "norm": P(),
+            "out": P(None, t),
+        },
+    }
+
+
 def batch_spec(dp_axis: str = DP, seq_axis: str | None = None) -> P:
     """Sharding for [batch, seq] token arrays."""
     return P(dp_axis, seq_axis)

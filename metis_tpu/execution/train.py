@@ -22,6 +22,7 @@ from metis_tpu.execution.mesh import (
     TP,
     batch_spec,
     gpt_param_specs,
+    llama_param_specs,
     moe_param_specs,
     shard_params,
 )
@@ -35,17 +36,29 @@ from metis_tpu.ops.ring_attention import make_ring_attention
 
 
 def param_specs_for(cfg: GPTConfig, tp_axis: str = TP, ep_axis: str = EP,
-                    pp_axis: str | None = None) -> dict:
-    """Model-family dispatch: MoE configs get expert sharding specs."""
+                    pp_axis: str | None = None, tp_size: int = 1) -> dict:
+    """Model-family dispatch: MoE configs get expert sharding specs, LLaMA
+    configs the RMSNorm/RoPE/GQA layout (``tp_size`` gates GQA KV-projection
+    sharding)."""
+    from metis_tpu.models.llama import LlamaConfig
+
     if isinstance(cfg, MoEConfig):
         return moe_param_specs(cfg, tp_axis=tp_axis, ep_axis=ep_axis,
                                pp_axis=pp_axis)
+    if isinstance(cfg, LlamaConfig):
+        return llama_param_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
+                                 tp_size=tp_size)
     return gpt_param_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
 
 
 def init_params_for(key: jax.Array, cfg: GPTConfig) -> dict:
-    return (init_moe_params(key, cfg) if isinstance(cfg, MoEConfig)
-            else init_params(key, cfg))
+    from metis_tpu.models.llama import LlamaConfig, init_llama_params
+
+    if isinstance(cfg, MoEConfig):
+        return init_moe_params(key, cfg)
+    if isinstance(cfg, LlamaConfig):
+        return init_llama_params(key, cfg)
+    return init_params(key, cfg)
 
 
 def fsdp_wrap_specs(specs: dict, params: dict, dp_axis: str = DP,
@@ -103,8 +116,13 @@ def opt_state_specs_by_shape(opt_state, params, wrapped_specs) -> object:
 
 
 def loss_fn_for(cfg: GPTConfig):
-    return (moe_next_token_loss if isinstance(cfg, MoEConfig)
-            else next_token_loss)
+    from metis_tpu.models.llama import LlamaConfig, llama_next_token_loss
+
+    if isinstance(cfg, MoEConfig):
+        return moe_next_token_loss
+    if isinstance(cfg, LlamaConfig):
+        return llama_next_token_loss
+    return next_token_loss
 
 
 @jax.tree_util.register_dataclass
@@ -146,7 +164,8 @@ def build_train_state(
     optimizer = optimizer or build_optimizer()
     if zero >= 3 and fsdp_axis is None:
         fsdp_axis = zero_axis
-    specs = param_specs_for(cfg, tp_axis=tp_axis, ep_axis=ep_axis)
+    specs = param_specs_for(cfg, tp_axis=tp_axis, ep_axis=ep_axis,
+                            tp_size=dict(mesh.shape).get(tp_axis, 1))
     host_params = init_params_for(key, cfg)
     if fsdp_axis is not None:
         specs = fsdp_wrap_specs(specs, host_params, fsdp_axis,

@@ -12,13 +12,43 @@ from metis_tpu.models.moe import (
     moe_forward,
     moe_next_token_loss,
 )
+from metis_tpu.models.llama import (
+    LlamaConfig,
+    init_llama_params,
+    llama_forward,
+    llama_next_token_loss,
+)
+
+
+def family_ops(cfg):
+    """The structural forward pieces of a config's model family —
+    ``(embed, run_blocks, head_logits, init_params)`` with identical
+    signatures across families — so stage-sliced executors
+    (``execution.hetero``) run any family without knowing its internals.
+    MoE is excluded: its blocks return (x, aux) pairs and run on the
+    single-program GSPMD path."""
+    from metis_tpu.models import gpt, llama
+
+    if isinstance(cfg, MoEConfig):
+        raise NotImplementedError(
+            "MoE runs on the GSPMD path (execution.train); the per-stage "
+            "executor covers dense families")
+    if isinstance(cfg, llama.LlamaConfig):
+        return (llama.llama_embed, llama.llama_run_blocks,
+                llama.llama_head_logits, llama.init_llama_params)
+    return (gpt.embed, gpt.run_blocks, gpt.head_logits, gpt.init_params)
 
 
 def config_for_model_spec(spec, **overrides):
     """Dispatch a planner ModelSpec to the executable config of its model
-    family: MoEConfig when the spec declares experts, GPTConfig otherwise."""
+    family: MoEConfig when the spec declares experts, LlamaConfig when
+    ``spec.family == "llama"``, GPTConfig otherwise."""
     if spec.num_experts > 0:
+        if getattr(spec, "family", "gpt") == "llama":
+            raise NotImplementedError("MoE is currently GPT-family only")
         return MoEConfig.from_model_spec(spec, **overrides)
+    if getattr(spec, "family", "gpt") == "llama":
+        return LlamaConfig.from_model_spec(spec, **overrides)
     return GPTConfig.from_model_spec(spec, **overrides)
 
 __all__ = [
@@ -33,4 +63,8 @@ __all__ = [
     "init_moe_params",
     "moe_forward",
     "moe_next_token_loss",
+    "LlamaConfig",
+    "init_llama_params",
+    "llama_forward",
+    "llama_next_token_loss",
 ]

@@ -1,0 +1,247 @@
+"""LLaMA-style transformer — second dense model family, TPU-first.
+
+Net-new capability: the reference models exactly one architecture (GPT with
+learned positions, ``model/activation_parameter.py:5``); modern open-weight
+models are LLaMA-shaped.  Differences from :mod:`metis_tpu.models.gpt`, all
+chosen for the same MXU/XLA design stance (stacked block leaves + one
+``lax.scan``, bf16 activations, fp32 accumulation):
+
+- **RMSNorm** (no mean subtraction, no bias) in fp32;
+- **RoPE** rotary position embeddings applied to q/k per head — no learned
+  position table, so sequence length is not baked into the parameters and
+  long-context (ring attention over the "sp" axis) needs only the
+  position offsets;
+- **GQA** grouped-query attention: ``num_kv_heads <= num_heads`` K/V heads,
+  repeated up to the query head count before the pluggable ``AttnFn`` —
+  flash / ring attention slot in unchanged;
+- **SwiGLU** FFN: ``w_down(silu(w_gate x) * w_up x)``, no biases anywhere.
+
+The profile-layer contract is identical to GPT (embed pseudo-layer +
+``num_blocks`` blocks + head pseudo-layer), so the planner, profiler, layer
+balancer, and every execution path treat both families uniformly.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from metis_tpu.core.config import ModelSpec
+from metis_tpu.models.gpt import AttnFn, GPTConfig, causal_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig(GPTConfig):
+    num_kv_heads: int = 0  # 0 -> num_heads (plain MHA)
+    rope_theta: float = 10000.0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    def __post_init__(self) -> None:
+        if self.num_heads % self.kv_heads != 0:
+            raise ValueError(
+                f"num_kv_heads {self.kv_heads} must divide num_heads "
+                f"{self.num_heads}")
+
+    @staticmethod
+    def from_model_spec(spec: ModelSpec, **overrides) -> "LlamaConfig":
+        cfg = LlamaConfig(
+            vocab_size=spec.vocab_size,
+            seq_len=spec.sequence_length,
+            hidden=spec.hidden_size,
+            num_heads=spec.num_heads,
+            num_blocks=spec.num_blocks,
+            ffn_multiplier=spec.ffn_multiplier,
+            num_kv_heads=spec.num_kv_heads,
+        )
+        return replace(cfg, **overrides) if overrides else cfg
+
+
+def init_llama_params(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Parameter pytree; block leaves stacked with leading dim num_blocks."""
+    k_tok, k_blocks, k_head = jax.random.split(key, 3)
+    h, f, v = cfg.hidden, cfg.ffn_dim, cfg.vocab_size
+    kvh, hd = cfg.kv_heads, cfg.head_dim
+    L = cfg.num_blocks
+    pd = cfg.param_dtype
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape) * scale).astype(pd)
+
+    ks = jax.random.split(k_blocks, 6)
+    scale = 0.02
+    resid_scale = scale / math.sqrt(2 * max(L, 1))
+    return {
+        "embed": {"tok": normal(k_tok, (v, h), scale)},
+        "blocks": {
+            "attn_norm": jnp.ones((L, h), pd),
+            "wq": normal(ks[0], (L, h, h), scale),
+            # (layer, {k,v}, in, kv_heads*head_dim): the separate k/v axis
+            # keeps the output dim shardable per-kv-head under TP
+            "wkv": normal(ks[1], (L, 2, h, kvh * hd), scale),
+            "wo": normal(ks[2], (L, h, h), resid_scale),
+            "ffn_norm": jnp.ones((L, h), pd),
+            "w_gate": normal(ks[3], (L, h, f), scale),
+            "w_up": normal(ks[4], (L, h, f), scale),
+            "w_down": normal(ks[5], (L, f, h), resid_scale),
+        },
+        "head": {
+            "norm": jnp.ones((h,), pd),
+            "out": normal(k_head, (h, v), scale),
+        },
+    }
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def rope(x: jnp.ndarray, theta: float, offset: int = 0) -> jnp.ndarray:
+    """Rotary embedding on [b, heads, s, head_dim] (rotate-half convention),
+    fp32 trig.  ``offset`` is the absolute position of the first row — how
+    sequence-sharded (ring attention) shards rotate their local slice."""
+    hd = x.shape[-1]
+    half = hd // 2
+    inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) * 2.0 / hd)
+    pos = jnp.arange(x.shape[2], dtype=jnp.float32) + offset
+    angles = pos[:, None] * inv_freq[None, :]           # [s, half]
+    cos = jnp.cos(angles)[None, None]
+    sin = jnp.sin(angles)[None, None]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def llama_block_forward(
+    x: jnp.ndarray, layer: dict, cfg: LlamaConfig, attn_impl: AttnFn,
+    pos_offset: int = 0,
+) -> jnp.ndarray:
+    """One LLaMA block on [batch, seq, hidden] activations."""
+    h, nh, kvh, hd = cfg.hidden, cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    y = rms_norm(x, layer["attn_norm"])
+    q = jnp.einsum("bsh,hk->bsk", y, layer["wq"].astype(dt),
+                   preferred_element_type=jnp.float32).astype(dt)
+    kv = jnp.einsum("bsh,chk->cbsk", y, layer["wkv"].astype(dt),
+                    preferred_element_type=jnp.float32).astype(dt)
+    k, v = kv[0], kv[1]
+
+    def heads(t, n):  # [b, s, n*hd] -> [b, n, s, hd]
+        b, s, _ = t.shape
+        return t.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+
+    q = rope(heads(q, nh), cfg.rope_theta, pos_offset)
+    k = rope(heads(k, kvh), cfg.rope_theta, pos_offset)
+    v = heads(v, kvh)
+    if kvh != nh:  # GQA: repeat K/V heads up to the query head count
+        rep = nh // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    ctx = attn_impl(q, k, v)
+    b, _, s, _ = ctx.shape
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+    attn_out = jnp.einsum("bsh,hk->bsk", ctx, layer["wo"].astype(dt),
+                          preferred_element_type=jnp.float32)
+    x = x + attn_out.astype(dt)
+
+    y = rms_norm(x, layer["ffn_norm"])
+    gate = jnp.einsum("bsh,hf->bsf", y, layer["w_gate"].astype(dt),
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("bsh,hf->bsf", y, layer["w_up"].astype(dt),
+                    preferred_element_type=jnp.float32)
+    z = (jax.nn.silu(gate) * up).astype(dt)
+    z = jnp.einsum("bsf,fh->bsh", z, layer["w_down"].astype(dt),
+                   preferred_element_type=jnp.float32)
+    return x + z.astype(dt)
+
+
+def llama_embed(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """Embedding pseudo-layer (profile layer 0): token lookup (positions are
+    rotary, inside the blocks)."""
+    return params["embed"]["tok"].astype(cfg.dtype)[tokens]
+
+
+def llama_run_blocks(
+    params: dict,
+    x: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_impl: AttnFn | None = None,
+    block_slice: tuple[int, int] | None = None,
+    resid_fn: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+    pos_offset: int = 0,
+) -> jnp.ndarray:
+    """Scan the (optionally sliced) stacked blocks — same contract as
+    ``gpt.run_blocks`` so pipeline stages and Megatron-SP hooks apply
+    unchanged."""
+    attn = attn_impl or default_llama_attention(cfg)
+    blocks = params["blocks"]
+    if block_slice is not None:
+        i, j = block_slice
+        blocks = jax.tree.map(lambda a: a[i:j], blocks)
+
+    body = partial(llama_block_forward, cfg=cfg, attn_impl=attn,
+                   pos_offset=pos_offset)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def step(carry, layer):
+        if resid_fn is not None:
+            carry = resid_fn(carry)
+        return body(carry, layer), None
+
+    out, _ = jax.lax.scan(step, x, blocks)
+    return out
+
+
+def default_llama_attention(cfg: LlamaConfig) -> AttnFn:
+    if cfg.attn == "flash":
+        from metis_tpu.ops.flash_attention import flash_attn_fn
+        return flash_attn_fn()
+    if cfg.attn != "dense":
+        raise ValueError(f"unknown LlamaConfig.attn: {cfg.attn!r}")
+    return causal_attention
+
+
+def llama_head_logits(params: dict, x: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """LM-head pseudo-layer: final RMSNorm + projection (fp32 logits)."""
+    y = rms_norm(x, params["head"]["norm"])
+    return jnp.einsum(
+        "bsh,hv->bsv", y, params["head"]["out"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32)
+
+
+def llama_forward(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_impl: AttnFn | None = None,
+    resid_fn=None,
+) -> jnp.ndarray:
+    x = llama_embed(params, tokens, cfg)
+    x = llama_run_blocks(params, x, cfg, attn_impl, resid_fn=resid_fn)
+    return llama_head_logits(params, x, cfg)
+
+
+def llama_next_token_loss(
+    params: dict,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_impl: AttnFn | None = None,
+    resid_fn=None,
+) -> jnp.ndarray:
+    logits = llama_forward(params, tokens, cfg, attn_impl, resid_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -picked.mean()

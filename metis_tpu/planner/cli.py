@@ -42,6 +42,11 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--num-experts", type=int, default=0,
                    help="MoE expert count (0 = dense model)")
     g.add_argument("--expert-top-k", type=int, default=1)
+    g.add_argument("--family", choices=("gpt", "llama"), default="gpt",
+                   help="model family: gpt (learned pos, GELU) or llama "
+                        "(RMSNorm/RoPE/GQA/SwiGLU)")
+    g.add_argument("--num-kv-heads", type=int, default=0,
+                   help="GQA KV heads (llama family; 0 = num_heads)")
 
 
 def _add_search_args(p: argparse.ArgumentParser) -> None:
@@ -87,6 +92,8 @@ def _model_from_args(args: argparse.Namespace) -> ModelSpec:
         num_heads=args.num_heads,
         num_experts=args.num_experts,
         expert_top_k=args.expert_top_k,
+        family=args.family,
+        num_kv_heads=args.num_kv_heads,
     )
 
 
@@ -132,6 +139,9 @@ def main(argv: list[str] | None = None) -> int:
                        help="comma-separated slice names, e.g. v4-32,v5e-16")
     p_tpu.add_argument("--chips-per-node", type=int, default=4)
     p_tpu.add_argument("--profile-dir", required=True)
+    p_tpu.add_argument("--calibration", default=None,
+                       help="collective calibration JSON (metis-tpu "
+                            "calibrate) overriding published ICI constants")
     _add_model_args(p_tpu)
     _add_search_args(p_tpu)
 
@@ -143,12 +153,57 @@ def main(argv: list[str] | None = None) -> int:
     _add_model_args(p_uni)
     _add_search_args(p_uni)
 
+    p_prof = sub.add_parser(
+        "profile", help="measure per-layer profiles on the local device(s) "
+                        "and write the profile JSON dir (the collection "
+                        "procedure the reference only documents)")
+    _add_model_args(p_prof)
+    p_prof.add_argument("--output-dir", required=True)
+    p_prof.add_argument("--tps", default="1",
+                        help="comma-separated tp degrees to profile")
+    p_prof.add_argument("--bss", default="1,2,4",
+                        help="comma-separated batch sizes to profile")
+    p_prof.add_argument("--warmup", type=int, default=2)
+    p_prof.add_argument("--iters", type=int, default=5)
+
+    p_cal = sub.add_parser(
+        "calibrate", help="microbenchmark XLA collectives (+ single-chip "
+                          "roofline) and write a calibration JSON for the "
+                          "ICI/DCN cost model")
+    p_cal.add_argument("--output", required=True)
+    p_cal.add_argument("--payload-kb", default="64,256,1024,4096")
+    p_cal.add_argument("--iters", type=int, default=8)
+    p_cal.add_argument("--chip-roofline", action="store_true",
+                       help="also measure matmul TFLOP/s + HBM GB/s of one "
+                            "chip (written next to --output as *.chip.json)")
+
+    p_val = sub.add_parser(
+        "validate", help="predicted-vs-measured step time of the top uniform "
+                         "plans on the local device(s) — the north-star "
+                         "error metric (reference C19, resurrected)")
+    _add_cluster_args(p_val)
+    p_val.add_argument("--profile-dir", required=True)
+    _add_model_args(p_val)
+    _add_search_args(p_val)
+    p_val.add_argument("--validate-top-k", type=int, default=3)
+    p_val.add_argument("--steps", type=int, default=5)
+    p_val.add_argument("--warmup", type=int, default=2)
+
     args = parser.parse_args(argv)
+
+    if args.command == "calibrate":
+        return _cmd_calibrate(args)
+    if args.command == "profile":
+        return _cmd_profile(args)
+
     profiles = ProfileStore.from_dir(args.profile_dir)
     model = _model_from_args(args)
     config = _config_from_args(args)
 
     events = EventLog(args.events) if args.events else NULL_LOG
+
+    if args.command == "validate":
+        return _cmd_validate(args, profiles, model, config)
 
     if args.command == "hetero":
         cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
@@ -158,9 +213,14 @@ def main(argv: list[str] | None = None) -> int:
     elif args.command == "tpu":
         tpu_cluster = TpuClusterSpec(tuple(
             slice_from_name(s.strip()) for s in args.slices.split(",")))
+        calibration = None
+        if args.calibration:
+            from metis_tpu.cost.calibration import CollectiveCalibration
+
+            calibration = CollectiveCalibration.load(args.calibration)
         result = plan_tpu(tpu_cluster, profiles, model, config,
                           chips_per_node=args.chips_per_node, top_k=args.top_k,
-                          events=events)
+                          events=events, calibration=calibration)
         _emit(args, dump_ranked_plans(result.plans))
     else:
         cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
@@ -184,6 +244,79 @@ def main(argv: list[str] | None = None) -> int:
         f"costed {result.num_costed} plans ({result.num_pruned} pruned) "
         f"in {result.search_seconds:.2f}s",
         file=sys.stderr)
+    return 0
+
+
+def _cmd_profile(args: argparse.Namespace) -> int:
+    from metis_tpu.profiles.profiler import ProfilerConfig, profile_model
+
+    model = _model_from_args(args)
+    store = profile_model(
+        model,
+        tps=tuple(int(t) for t in args.tps.split(",")),
+        bss=tuple(int(b) for b in args.bss.split(",")),
+        config=ProfilerConfig(warmup=args.warmup, iters=args.iters))
+    store.dump_to_dir(args.output_dir)
+    print(f"profiled {model.name} -> {args.output_dir} "
+          f"({', '.join(store.device_types)})", file=sys.stderr)
+    return 0
+
+
+def _cmd_calibrate(args: argparse.Namespace) -> int:
+    import jax
+
+    from metis_tpu.cost.calibration import (
+        microbenchmark_chip,
+        microbenchmark_collectives,
+    )
+
+    devices = jax.devices()
+    wrote_output = False
+    if len(devices) >= 2:
+        cal = microbenchmark_collectives(
+            devices,
+            payload_kb=tuple(int(k) for k in args.payload_kb.split(",")),
+            iters=args.iters)
+        cal.dump(args.output)
+        wrote_output = True
+        print(f"calibrated {len(cal.fits)} collectives over {len(devices)} "
+              f"{cal.platform} devices -> {args.output}", file=sys.stderr)
+    else:
+        print("1 device visible: cannot calibrate collectives (needs >= 2); "
+              f"{args.output} NOT written", file=sys.stderr)
+    if args.chip_roofline:
+        chip = microbenchmark_chip(devices[0])
+        chip_path = args.output + ".chip.json"
+        with open(chip_path, "w") as f:
+            json.dump(chip, f, indent=1)
+        print(f"chip roofline -> {chip_path}: {chip}", file=sys.stderr)
+    # a downstream `--calibration args.output` must not find a stale or
+    # missing file after a silent success
+    return 0 if wrote_output else 1
+
+
+def _cmd_validate(args: argparse.Namespace, profiles, model, config) -> int:
+    from metis_tpu.planner.api import plan_uniform as _plan_uniform
+    from metis_tpu.validation import validate_planner_choice
+
+    cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    result = _plan_uniform(cluster, profiles, model, config,
+                           include_oom=True, top_k=None)
+    reports = validate_planner_choice(
+        result.plans, model, top_k=args.validate_top_k,
+        steps=args.steps, warmup=args.warmup)
+    payload = json.dumps([r.to_json_dict() for r in reports], indent=2)
+    _emit(args, payload)
+    if reports:
+        mean_err = sum(r.abs_error_pct for r in reports) / len(reports)
+        print(f"validated {len(reports)} plans, mean abs error "
+              f"{mean_err:.1f}%", file=sys.stderr)
+    else:
+        print(
+            f"no executable plans to validate ({result.num_costed} costed, "
+            f"{result.num_pruned} pruned — a fully-pruned search usually "
+            "means the profile device types don't match the clusterfile)",
+            file=sys.stderr)
     return 0
 
 

@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metis_tpu.core.config import ModelSpec
 from metis_tpu.core.errors import MetisError
+from metis_tpu.core.timing import two_point_queue_ms
 from metis_tpu.execution.mesh import DP, TP, shard_params
 from metis_tpu.execution.train import (
     init_params_for,
@@ -91,15 +92,34 @@ def infer_device_type(device=None) -> str:
 
 
 def _median_ms(fn: Callable, args: tuple, warmup: int, iters: int) -> float:
-    """Median wall time of ``fn(*args)`` in ms, post-warmup, fully synced."""
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    samples = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        samples.append((time.perf_counter() - t0) * 1e3)
-    return float(np.median(samples))
+    """Wall time of ``fn(*args)`` in ms, post-warmup, fully synced.
+
+    CPU backend: per-call medians with ``block_until_ready``.  Accelerator
+    backends: the TPU executes queued programs FIFO, so time a queue of n
+    (and 2n) calls closed by one forced scalar transfer and take the
+    difference — a remote tunnel's ``block_until_ready`` returns before
+    execution finishes, and the two-point form cancels the queue/transfer
+    overhead that would otherwise swamp sub-ms layer times."""
+    first = fn(*args)
+    leaf = jax.tree.leaves(first)[0]
+    dev = next(iter(leaf.devices())) if hasattr(leaf, "devices") else None
+    if dev is None or dev.platform == "cpu":
+        for _ in range(max(warmup - 1, 0)):
+            jax.block_until_ready(fn(*args))
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            samples.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(samples))
+
+    def enqueue(n: int):
+        out = first
+        for _ in range(n):
+            out = fn(*args)
+        return out
+
+    return two_point_queue_ms(enqueue, iters)
 
 
 def _aot_compile(fn: Callable, args: tuple):
@@ -160,6 +180,14 @@ class LayerProfiler:
         gradients, mirroring the per-layer fwd+bwd the reference profiles with
         torch hooks (``README.md:152-163``)."""
 
+        from metis_tpu.models import family_ops
+        from metis_tpu.models.llama import LlamaConfig, llama_block_forward
+
+        if isinstance(cfg, LlamaConfig):
+            family_embed, _, family_head, _ = family_ops(cfg)
+        else:
+            family_embed, family_head = embed, head_logits
+
         def embed_fb(embed_params, tokens):
             # Close over ONLY the embed subtree: differentiating the full
             # params tree would count every block's parameters as compiled-
@@ -167,7 +195,8 @@ class LayerProfiler:
             # XLA's memory analysis, inflating this layer's memory row by
             # ~2x total model bytes.
             def f(ep):
-                return embed({"embed": ep}, tokens, cfg).astype(jnp.float32).sum()
+                return family_embed(
+                    {"embed": ep}, tokens, cfg).astype(jnp.float32).sum()
 
             return jax.value_and_grad(f)(embed_params)
 
@@ -177,6 +206,12 @@ class LayerProfiler:
                     out, aux = moe_block_forward(x, layer, cfg, causal_attention)
                     # aux keeps the router's softmax/stats in the measured graph
                     return out.astype(jnp.float32).sum() + aux
+                if isinstance(cfg, LlamaConfig):
+                    return (
+                        llama_block_forward(x, layer, cfg, causal_attention)
+                        .astype(jnp.float32)
+                        .sum()
+                    )
                 return (
                     block_forward(x, layer, cfg, causal_attention)
                     .astype(jnp.float32)
@@ -188,7 +223,7 @@ class LayerProfiler:
         def head_fb(head_params, x, targets):
             # Same subtree isolation as embed_fb.
             def f(hp, x):
-                logits = head_logits({"head": hp}, x, cfg)
+                logits = family_head({"head": hp}, x, cfg)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 picked = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
                 return -picked.mean()

@@ -108,7 +108,7 @@ def measure_uniform_plan_ms(
         def run_once():
             nonlocal state
             state, loss = step(state, tokens, tokens)
-            jax.block_until_ready(loss)
+            return loss
     else:
         grid = np.array(devs[:need]).reshape(plan.pp, plan.dp, plan.tp)
         mesh = Mesh(grid, (PP, DP, TP))
@@ -120,16 +120,44 @@ def measure_uniform_plan_ms(
         def run_once():
             nonlocal params, opt_state
             params, opt_state, loss = step(params, opt_state, tok_mbs, tok_mbs)
-            jax.block_until_ready(loss)
+            return loss
 
-    for _ in range(warmup):
-        run_once()
-    samples = []
-    for _ in range(steps):
-        t0 = time.perf_counter()
-        run_once()
-        samples.append((time.perf_counter() - t0) * 1e3)
-    return float(np.median(samples))
+    return _timed_steps_ms(run_once, devs[0], steps, warmup)
+
+
+def _timed_steps_ms(run_once, device, steps: int, warmup: int) -> float:
+    """Time chained train steps.
+
+    CPU backend: per-step wall times, median (each step synchronized —
+    dispatch is local and cheap).  Accelerator backends: queue all ``steps``
+    (they chain through the carried state) and force ONE final
+    ``device_get`` — a remote-tunnel ``block_until_ready`` returns before
+    execution finishes, and a per-step ``device_get`` would add a full
+    round trip to every sample."""
+    import jax
+
+    from metis_tpu.core.timing import two_point_queue_ms
+
+    if device.platform == "cpu":
+        for _ in range(warmup):
+            jax.block_until_ready(run_once())
+        samples = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run_once())
+            samples.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(samples))
+
+    def enqueue(n: int):
+        loss = None
+        for _ in range(n):
+            loss = run_once()
+        return loss
+
+    # steps chain through the carried train state, so queue lengths are
+    # sequential on-device; two-point cancels dispatch/transfer overhead
+    # (warmup is folded into the helper's warm pass of both queue lengths)
+    return two_point_queue_ms(enqueue, max(steps, 1))
 
 
 def validate_uniform_plan(
@@ -227,6 +255,10 @@ def measure_ranked_plan_ms(
     def run_once():
         nonlocal state
         state, loss = step(state, mbs, mbs)
+        # the multi-mesh step synchronizes its loss internally (device_get
+        # per microbatch) but dispatches the optimizer updates async; fence
+        # them so each sample contains its own update
+        jax.block_until_ready(jax.tree.leaves(state[0][0]))
 
     for _ in range(warmup):
         run_once()
@@ -272,9 +304,18 @@ def validate_planner_choice(
     warmup: int = 2,
 ) -> list[ValidationReport]:
     """Validate the top-k plans of a :class:`UniformPlannerResult` — the full
-    predicted-vs-measured loop over what the planner would actually deploy."""
+    predicted-vs-measured loop over what the planner would actually deploy.
+
+    Plans the uniform executor cannot realize (pipeline depth not dividing
+    the block count evenly) are skipped, not failed: the ranking may
+    legitimately contain them for cost comparison, but measurement requires
+    an executable plan."""
     reports = []
-    for ranked in list(ranked_plans)[:top_k]:
+    for ranked in ranked_plans:
+        if len(reports) >= top_k:
+            break
+        if ranked.plan.pp > 1 and model.num_blocks % ranked.plan.pp != 0:
+            continue
         reports.append(
             validate_uniform_plan(
                 ranked.plan, ranked.cost.total_ms, model, devices,
