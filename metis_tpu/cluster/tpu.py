@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
+from typing import Sequence
 
 from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
 from metis_tpu.core.errors import ClusterSpecError
@@ -171,6 +172,67 @@ class TpuClusterSpec:
                     "bandwidth model or uniform slice topologies")
             devices[s.generation] = spec
         return ClusterSpec(nodes=tuple(nodes), devices=devices)
+
+
+def rank_slice_placement(
+    tpu_cluster: TpuClusterSpec, node_sequence: Sequence[str]
+) -> list[tuple[int, int]]:
+    """rank -> (slice index, slice-local offset) under the plan's
+    node-sequence placement: all chips of ``node_sequence[0]``'s generation
+    take the lowest ranks (slices keep declaration order within a
+    generation) — the one placement convention shared by the bandwidth
+    models and mesh emission."""
+    placement: list[tuple[int, int]] = []
+    for generation in node_sequence:
+        for idx, s in enumerate(tpu_cluster.slices):
+            if s.generation == generation:
+                placement.extend((idx, off) for off in range(s.num_chips))
+    return placement
+
+
+def stage_groups_torus_aligned(
+    tpu_cluster: TpuClusterSpec,
+    node_sequence: Sequence[str],
+    device_groups: Sequence[int],
+) -> bool:
+    """Whether every pipeline stage's contiguous rank range maps onto the
+    physical topology cleanly (SURVEY.md §7 hard part #4: "device groups
+    must map to contiguous sub-toruses — the C8 enumerator needs a
+    topology-aware validity filter").  A stage is aligned when it either
+
+    - spans *whole* slices (its intra-stage collectives then ride each
+      slice's ICI with DCN only between replicas the cost model already
+      charges), or
+    - stays inside one slice with its local offset aligned to its own size
+      and its size dividing the slice — for the power-of-two group sizes
+      the enumerator emits on power-of-two torus extents, an aligned
+      row-major block IS a rectangular sub-torus.
+
+    Misaligned ranges (straddling a slice boundary partially, or cutting
+    across sub-grid boundaries) would make XLA route per-step collectives
+    over DCN or fold multiple torus rows into one ring — plans the
+    execution layer should never be handed.
+    """
+    placement = rank_slice_placement(tpu_cluster, node_sequence)
+    start = 0
+    for size in device_groups:
+        ranks = placement[start:start + size]
+        slices = sorted({s for s, _ in ranks})
+        if len(slices) == 1:
+            spec = tpu_cluster.slices[slices[0]]
+            local_start = ranks[0][1]
+            if size < spec.num_chips and (
+                    local_start % size != 0 or spec.num_chips % size != 0):
+                return False
+        else:
+            # multi-slice stage: every spanned slice must be whole
+            for s in slices:
+                spec = tpu_cluster.slices[s]
+                covered = sum(1 for si, _ in ranks if si == s)
+                if covered != spec.num_chips:
+                    return False
+        start += size
+    return True
 
 
 def slice_from_name(name: str) -> TpuSliceSpec:

@@ -18,14 +18,26 @@ from __future__ import annotations
 import time
 from typing import Any, Callable
 
+_FENCE = None
+
+
+def _fence_fn():
+    # jit caches by function object: one module-level jitted fence, not a
+    # fresh lambda per call (which would recompile inside timed windows)
+    global _FENCE
+    if _FENCE is None:
+        import jax
+        import jax.numpy as jnp
+
+        _FENCE = jax.jit(lambda x: jnp.ravel(x)[:1].astype(jnp.float32).sum())
+    return _FENCE
+
 
 def forced_scalar(leaf) -> float:
     """Materialize one element of ``leaf`` on the host — the full fence."""
     import jax
-    import jax.numpy as jnp
 
-    return float(jax.device_get(
-        jax.jit(lambda x: jnp.ravel(x)[:1].astype(jnp.float32).sum())(leaf)))
+    return float(jax.device_get(_fence_fn()(leaf)))
 
 
 def two_point_queue_ms(

@@ -185,17 +185,14 @@ class IciDcnBandwidth:
 
     def __init__(self, tpu_cluster: TpuClusterSpec, plan: InterStagePlan,
                  calibration: CollectiveCalibration | None = None):
+        from metis_tpu.cluster.tpu import rank_slice_placement
+
         self.tpu_cluster = tpu_cluster
         self.plan = plan
         self.calibration = calibration
         # rank -> (slice index, slice-local offset), node-sequence order
-        # (stable within a generation: slices keep their declaration order).
-        self._rank_slice: list[tuple[int, int]] = []
-        for generation in plan.node_sequence:
-            for idx, s in enumerate(tpu_cluster.slices):
-                if s.generation == generation:
-                    self._rank_slice.extend(
-                        (idx, off) for off in range(s.num_chips))
+        self._rank_slice = rank_slice_placement(
+            tpu_cluster, plan.node_sequence)
 
     # -- calibration hooks -------------------------------------------------
     def _cal_matches(self, slice_spec: TpuSliceSpec) -> bool:

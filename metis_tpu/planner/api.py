@@ -82,9 +82,14 @@ def plan_hetero(
     bandwidth_factory=None,
     top_k: int | None = None,
     events: EventLog = NULL_LOG,
+    inter_filter=None,
 ) -> PlannerResult:
     """Full heterogeneous search: inter-stage × intra-stage candidates,
-    costed and ranked (≅ ``cost_het_cluster``)."""
+    costed and ranked (≅ ``cost_het_cluster``).
+
+    ``inter_filter``: optional predicate on InterStagePlan applied before
+    intra-stage expansion — topology validity filters (e.g. the TPU
+    sub-torus alignment check of ``plan_tpu``) plug in here."""
     t0 = time.perf_counter()
     volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
     options = EstimatorOptions.from_config(config)
@@ -123,6 +128,9 @@ def plan_hetero(
         variance=config.min_group_scale_variance,
         max_permute_len=config.max_permute_len,
     ):
+        if inter_filter is not None and not inter_filter(inter):
+            pruned += 1
+            continue
         cp_eligible = None
         if len(cp_degrees) > 1:
             # Ring attention needs uniform block timing: only homogeneous
@@ -244,18 +252,31 @@ def plan_tpu(
     top_k: int | None = None,
     events: EventLog = NULL_LOG,
     calibration=None,
+    aligned_groups: bool = True,
 ) -> PlannerResult:
     """Heterogeneous search over TPU slices with the ICI/DCN-aware bandwidth
     model (the BASELINE.md north-star path: e.g. v4-32 + v5e-16 over DCN).
 
     ``calibration``: an optional ``cost.CollectiveCalibration`` from
     ``microbenchmark_collectives`` — measured wire constants override the
-    published per-generation link bandwidths for matching slices."""
+    published per-generation link bandwidths for matching slices.
+
+    ``aligned_groups``: prune inter-stage plans whose stage rank ranges
+    cannot map to contiguous sub-toruses / whole slices (SURVEY.md §7 hard
+    part #4 — arbitrary GPU-style rank sets are not valid TPU device
+    groups); disable to reproduce the unconstrained GPU-style search."""
+    from metis_tpu.cluster.tpu import stage_groups_torus_aligned
+
     cluster = tpu_cluster.as_cluster_spec(chips_per_node)
+    inter_filter = None
+    if aligned_groups:
+        inter_filter = lambda inter: stage_groups_torus_aligned(  # noqa: E731
+            tpu_cluster, inter.node_sequence, inter.device_groups)
     return plan_hetero(
         cluster, profiles, model, config,
         bandwidth_factory=lambda plan: IciDcnBandwidth(
             tpu_cluster, plan, calibration=calibration),
         top_k=top_k,
         events=events,
+        inter_filter=inter_filter,
     )

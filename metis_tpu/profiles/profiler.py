@@ -73,11 +73,21 @@ _MB = 1024 * 1024
 
 @dataclass(frozen=True)
 class ProfilerConfig:
-    """Measurement knobs."""
+    """Measurement knobs.
+
+    ``marginal_blocks``: measure the transformer-block time as the
+    *difference* between a 2-block and a 1-block scan (same activations) —
+    per-call dispatch/launch overhead cancels, so the block vs embed/head
+    pseudo-layer ratio the layer balancer keys on stays faithful at small
+    shapes (an isolated single-block closure is dispatch-dominated there
+    and over-weights the pseudo-layers after rescaling).  Needs >= 2 blocks
+    and two extra compiles per (tp, bs); falls back to the isolated
+    measurement when disabled or inapplicable."""
 
     warmup: int = 2
     iters: int = 5
     seed: int = 0
+    marginal_blocks: bool = True
 
 
 def infer_device_type(device=None) -> str:
@@ -220,6 +230,27 @@ class LayerProfiler:
 
             return jax.value_and_grad(f, argnums=(0, 1))(layer, x)
 
+        def scan_loss(layers, x):
+            def step(carry, layer):
+                if isinstance(cfg, MoEConfig):
+                    return moe_block_forward(x=carry, layer=layer, cfg=cfg,
+                                             attn_impl=causal_attention)
+                if isinstance(cfg, LlamaConfig):
+                    return (llama_block_forward(carry, layer, cfg,
+                                                causal_attention), 0.0)
+                return (block_forward(carry, layer, cfg, causal_attention),
+                        0.0)
+
+            out, auxs = jax.lax.scan(step, x, layers)
+            total = out.astype(jnp.float32).sum()
+            if isinstance(cfg, MoEConfig):
+                total = total + jnp.sum(auxs)
+            return total
+
+        def scan_fb(layers, x):
+            """fwd+bwd of a k-block scan — the marginal-cost probe body."""
+            return jax.value_and_grad(scan_loss, argnums=(0, 1))(layers, x)
+
         def head_fb(head_params, x, targets):
             # Same subtree isolation as embed_fb.
             def f(hp, x):
@@ -230,7 +261,7 @@ class LayerProfiler:
 
             return jax.value_and_grad(f, argnums=(0, 1))(head_params, x)
 
-        return embed_fb, block_fb, head_fb
+        return embed_fb, block_fb, head_fb, scan_fb
 
     def _profile_one(self, tp: int, bs: int) -> LayerProfile:
         cfg, model = self.cfg, self.model
@@ -238,7 +269,10 @@ class LayerProfiler:
             raise MetisError(
                 f"tp={tp} needs {tp} devices, have {len(self.devices)}")
         mesh = Mesh(np.array(self.devices[:tp]).reshape(1, tp), (DP, TP))
-        specs = param_specs_for(cfg, ep_axis=None)
+        # tp_size gates the GQA KV fallback: profile the SAME layout the
+        # execution layer will deploy at this tp, or the measured per-layer
+        # times describe a graph that never runs
+        specs = param_specs_for(cfg, ep_axis=None, tp_size=tp)
 
         key = jax.random.PRNGKey(self.config.seed)
         with mesh:
@@ -252,7 +286,7 @@ class LayerProfiler:
                 NamedSharding(mesh, P()),
             )
             layer0 = jax.tree.map(lambda a: a[0], params["blocks"])
-            embed_fb, block_fb, head_fb = self._make_layer_fns(cfg)
+            embed_fb, block_fb, head_fb, scan_fb = self._make_layer_fns(cfg)
 
             embed_p, head_p = params["embed"], params["head"]
             j_embed = _aot_compile(embed_fb, (embed_p, tokens))
@@ -260,8 +294,24 @@ class LayerProfiler:
             j_head = _aot_compile(head_fb, (head_p, x, tokens))
             w, it = self.config.warmup, self.config.iters
             embed_ms = _median_ms(j_embed, (embed_p, tokens), w, it)
-            block_ms = _median_ms(j_block, (layer0, x), w, it)
             head_ms = _median_ms(j_head, (head_p, x, tokens), w, it)
+
+            block_ms = None
+            if self.config.marginal_blocks and cfg.num_blocks >= 2:
+                # marginal block cost: scan of 2 blocks minus scan of 1 —
+                # per-call dispatch overhead cancels (ProfilerConfig doc)
+                layers1 = jax.tree.map(lambda a: a[:1], params["blocks"])
+                layers2 = jax.tree.map(lambda a: a[:2], params["blocks"])
+                j1 = _aot_compile(scan_fb, (layers1, x))
+                j2 = _aot_compile(scan_fb, (layers2, x))
+                t1 = _median_ms(j1, (layers1, x), w, it)
+                t2 = _median_ms(j2, (layers2, x), w, it)
+                if t2 > t1:
+                    block_ms = t2 - t1
+            if block_ms is None:
+                # isolated-closure fallback (marginal disabled, single-block
+                # model, or a noise-inverted marginal pair)
+                block_ms = _median_ms(j_block, (layer0, x), w, it)
 
             # Whole-model fwd+bwd — the ground truth the per-layer
             # decomposition must sum to (see module docstring).

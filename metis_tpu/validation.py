@@ -252,13 +252,17 @@ def measure_ranked_plan_ms(
         cfg.vocab_size)
     mbs = tokens.reshape(inter.batches, mb, cfg.seq_len)
 
+    from metis_tpu.core.timing import forced_scalar
+
     def run_once():
         nonlocal state
         state, loss = step(state, mbs, mbs)
         # the multi-mesh step synchronizes its loss internally (device_get
         # per microbatch) but dispatches the optimizer updates async; fence
-        # them so each sample contains its own update
-        jax.block_until_ready(jax.tree.leaves(state[0][0]))
+        # EVERY stage's update with a host transfer (block_until_ready can
+        # return early under a remote tunnel — core/timing.py)
+        for stage_state in state:
+            forced_scalar(jax.tree.leaves(stage_state[0])[0])
 
     for _ in range(warmup):
         run_once()

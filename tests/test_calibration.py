@@ -155,3 +155,71 @@ class TestCalibratedBandwidth:
         assert generation_of_device_kind("TPU v4") == "tpu_v4"
         assert generation_of_device_kind("TPU v5p") == "tpu_v5p"
         assert generation_of_device_kind("Quantum QPU") is None
+
+
+class TestTorusAlignment:
+    """SURVEY §7 hard part #4: stage device groups must map to contiguous
+    sub-toruses or whole slices."""
+
+    def _tc(self):
+        return TpuClusterSpec(
+            (slice_from_name("v4-32"), slice_from_name("v5e-16")))
+
+    def test_whole_slices_aligned(self):
+        from metis_tpu.cluster.tpu import stage_groups_torus_aligned
+
+        tc = self._tc()
+        seq = ("tpu_v4", "tpu_v5e")
+        assert stage_groups_torus_aligned(tc, seq, (32, 16))
+        assert stage_groups_torus_aligned(tc, seq, (48,))  # spans both wholly
+
+    def test_aligned_sub_blocks(self):
+        from metis_tpu.cluster.tpu import stage_groups_torus_aligned
+
+        tc = self._tc()
+        seq = ("tpu_v4", "tpu_v5e")
+        # 8+8+16 inside v4 (aligned pow2 blocks), whole v5e
+        assert stage_groups_torus_aligned(tc, seq, (8, 8, 16, 16))
+        assert stage_groups_torus_aligned(tc, seq, (16, 16, 8, 8))
+
+    def test_partial_slice_straddle_rejected(self):
+        from metis_tpu.cluster.tpu import stage_groups_torus_aligned
+
+        tc = self._tc()
+        seq = ("tpu_v4", "tpu_v5e")
+        # stage of 32 starting at offset 16: covers half of v4 + half of v5e
+        assert not stage_groups_torus_aligned(tc, seq, (16, 32))
+
+    def test_misaligned_offset_rejected(self):
+        from metis_tpu.cluster.tpu import stage_groups_torus_aligned
+
+        tc = self._tc()
+        seq = ("tpu_v4", "tpu_v5e")
+        # 4-chip group at local offset 2 of v4 cuts across sub-grid rows
+        assert not stage_groups_torus_aligned(tc, seq, (2, 4, 26, 16))
+
+    def test_plan_tpu_prunes_misaligned(self):
+        from metis_tpu.core.config import ModelSpec, SearchConfig
+        from metis_tpu.planner import plan_tpu
+        from metis_tpu.profiles import synthesize_profiles
+
+        model = ModelSpec(name="align-test", num_layers=4, hidden_size=64,
+                          sequence_length=16, vocab_size=512, num_heads=4)
+        profiles = synthesize_profiles(
+            model, ["tpu_v4", "tpu_v5e"], tps=[1, 2], bss=[1, 2, 4])
+        tc = self._tc()
+        # variance 0.25 admits small/unequal groups (e.g. [16, 32]) whose
+        # second stage straddles the v4/v5e boundary — the filter's target
+        cfg = SearchConfig(gbs=8, max_profiled_tp=2, max_profiled_bs=4,
+                           min_group_scale_variance=0.25)
+        aligned = plan_tpu(tc, profiles, model, cfg, chips_per_node=4)
+        free = plan_tpu(tc, profiles, model, cfg, chips_per_node=4,
+                        aligned_groups=False)
+        assert aligned.best is not None
+        assert aligned.num_costed <= free.num_costed
+        assert aligned.num_pruned > free.num_pruned
+        for r in aligned.plans:
+            from metis_tpu.cluster.tpu import stage_groups_torus_aligned
+
+            assert stage_groups_torus_aligned(
+                tc, r.inter.node_sequence, r.inter.device_groups)

