@@ -15,7 +15,16 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field, asdict
+from functools import lru_cache
 from typing import Iterator, Sequence
+
+
+@lru_cache(maxsize=8192)
+def _group_prefix(groups: tuple) -> tuple:
+    out = [0]
+    for g in groups:
+        out.append(out[-1] + g)
+    return tuple(out)
 
 
 @dataclass(frozen=True)
@@ -96,8 +105,10 @@ class InterStagePlan:
         return len(self.device_groups)
 
     def stage_rank_range(self, stage_id: int) -> tuple[int, int]:
-        start = sum(self.device_groups[:stage_id])
-        return start, start + self.device_groups[stage_id]
+        # search-hot: called millions of times per search; prefix sums are
+        # memoized on the (hashable) group tuple
+        p = _group_prefix(self.device_groups)
+        return p[stage_id], p[stage_id + 1]
 
 
 @dataclass(frozen=True)

@@ -193,6 +193,46 @@ class HeteroCostEstimator(_EstimatorBase):
         self.data_balancer = DataBalancer(profiles)
         self.bandwidth_factory = bandwidth_factory or (
             lambda plan: HeteroScalarBandwidth(cluster, plan, options.strict_compat))
+        # search-hot: bandwidth depends on the plan's *placement* only —
+        # (node_sequence, device_groups) — which the enumeration shares
+        # across every microbatch count and intra candidate; memoize the
+        # model and its per-stage scans on that key (pure functions of it)
+        self._bw_key = None
+        self._bw_model = None
+        self._bw_cache: dict = {}
+
+    def _bandwidth_for(self, plan: InterStagePlan):
+        key = (plan.node_sequence, plan.device_groups)
+        if key != self._bw_key:
+            self._bw_key = key
+            self._bw_model = self.bandwidth_factory(plan)
+            if len(self._bw_cache) > 200_000:
+                self._bw_cache.clear()
+        return self._bw_model
+
+    def _cache_key(self, kind: str, stage_id: int, *rest):
+        return (kind, self._bw_key, stage_id, *rest)
+
+    def _dp_bw(self, bandwidth, stage_id: int, strat: Strategy) -> float:
+        key = self._cache_key("dp", stage_id, strat.dp, strat.cp, strat.tp)
+        if key not in self._bw_cache:
+            self._bw_cache[key] = bandwidth.dp_bandwidth(stage_id, strat)
+        return self._bw_cache[key]
+
+    def _pp_bw(self, bandwidth, stage_id: int) -> float:
+        key = self._cache_key("pp", stage_id)
+        if key not in self._bw_cache:
+            self._bw_cache[key] = bandwidth.pp_bandwidth(stage_id)
+        return self._bw_cache[key]
+
+    def _cp_bw(self, bandwidth, stage_id: int, strat: Strategy) -> float:
+        key = self._cache_key("cp", stage_id, strat.dp, strat.cp, strat.tp)
+        if key not in self._bw_cache:
+            cp_bw_fn = getattr(bandwidth, "cp_bandwidth", None)
+            self._bw_cache[key] = (
+                cp_bw_fn(stage_id, strat) if cp_bw_fn is not None
+                else bandwidth.dp_bandwidth(stage_id, strat))
+        return self._bw_cache[key]
 
     def _stage_execution_ms(
         self,
@@ -236,7 +276,7 @@ class HeteroCostEstimator(_EstimatorBase):
             list(rank_types) if rank_types is not None
             else rank_device_types(self.cluster, plan.node_sequence)
         )
-        bandwidth = self.bandwidth_factory(plan)
+        bandwidth = self._bandwidth_for(plan)
         L = self.volume.num_layers
 
         lens: list[float] = []
@@ -258,9 +298,7 @@ class HeteroCostEstimator(_EstimatorBase):
             if strat.cp > 1:
                 # Ring-attention K/V rotation extends the stage's critical
                 # path (un-overlapped model, cost/context_parallel.py).
-                cp_bw_fn = getattr(bandwidth, "cp_bandwidth", None)
-                cp_bw = (cp_bw_fn(stage_id, strat) if cp_bw_fn is not None
-                         else bandwidth.dp_bandwidth(stage_id, strat))
+                cp_bw = self._cp_bw(bandwidth, stage_id, strat)
                 ring_ms = cp_ring_ms(
                     self.volume.model, mbs, strat.cp, strat.tp,
                     attention_layer_range(self.volume.model, start_l, end_l),
@@ -273,7 +311,7 @@ class HeteroCostEstimator(_EstimatorBase):
                 a2a_ms = ep_a2a_ms(
                     self.volume.model, mbs, strat.ep,
                     moe_layer_range(self.volume.model, start_l, end_l),
-                    bandwidth.dp_bandwidth(stage_id, strat), cp=strat.cp)
+                    self._dp_bw(bandwidth, stage_id, strat), cp=strat.cp)
                 stage_ms += a2a_ms
             comm_by_stage.append(ring_ms + a2a_ms)
             ring_total += ring_ms
@@ -289,14 +327,14 @@ class HeteroCostEstimator(_EstimatorBase):
                 sp_div = strat.tp if strat.sp else 1
                 pp_cost += self._pp_cost_ms(
                     self._activation(end_l, mbs, strat.tp) / strat.cp / sp_div,
-                    bandwidth.pp_bandwidth(stage_id))
+                    self._pp_bw(bandwidth, stage_id))
 
             stage_params = self.volume.stage_parameter_bytes(strat.tp, start_l, end_l)
             # Weights are replicated across cp (ring attention shards only the
             # sequence), so the gradient all-reduce spans dp*cp ranks; its ring
             # crosses both the dp and cp group links.
             sync_degree = strat.dp * strat.cp
-            dp_bw = bandwidth.dp_bandwidth(stage_id, strat)
+            dp_bw = self._dp_bw(bandwidth, stage_id, strat)
             if cp_bw is not None:
                 dp_bw = min(dp_bw, cp_bw)
             # Measured latency floor (calibrated bandwidth models only):

@@ -110,11 +110,11 @@ def build_executable(
             and _uniform_block_split(artifact, cfg, pp)):
         return _pipeline_executable(cfg, artifact, s0, pp, devices, optimizer)
 
-    if any(s["cp"] > 1 or s["ep"] > 1 for s in strategies):
+    if any(s["cp"] > 1 for s in strategies):
         raise NotImplementedError(
-            "cp/ep under pipeline parallelism has no execution path yet "
-            "(cp/ep run on the pp=1 GSPMD path; dp x tp [x zero] stages run "
-            "on the pipeline paths)")
+            "cp under pipeline parallelism has no execution path yet "
+            "(cp runs on the pp=1 GSPMD path); dp x tp [x ep] [x zero] "
+            "stages run on the per-stage executor")
 
     return _hetero_executable(
         cfg, artifact, strategies, devices, optimizer, cluster, profiles)
@@ -170,7 +170,11 @@ def _hetero_executable(cfg, artifact, strategies, devices, optimizer, cluster,
                        profiles) -> Executable:
     pp = len(strategies)
     rows = None
-    if cluster is not None and profiles is not None and artifact.node_sequence:
+    is_moe = isinstance(cfg, MoEConfig)
+    if (not is_moe and cluster is not None and profiles is not None
+            and artifact.node_sequence):
+        # (MoE stages take the even split: capacity-competing routed tokens
+        # make pad rows unsound — execution.hetero._make_stage_fn)
         from metis_tpu.core.types import InterStagePlan, Strategy
 
         inter = InterStagePlan(

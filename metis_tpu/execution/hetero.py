@@ -42,7 +42,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from metis_tpu.execution.mesh import DP, TP
+from metis_tpu.execution.mesh import DP, EP, TP
 from metis_tpu.execution.train import (
     build_optimizer,
     fsdp_wrap_specs,
@@ -50,6 +50,7 @@ from metis_tpu.execution.train import (
 )
 from metis_tpu.models import family_ops
 from metis_tpu.models.gpt import GPTConfig, default_attention
+from metis_tpu.models.moe import MoEConfig
 
 
 @dataclass(frozen=True)
@@ -69,11 +70,16 @@ class StageSpec:
     dp: int
     tp: int
     zero: int = 0
+    ep: int = 1  # expert parallelism rides inside dp (MoE stages only)
     replica_rows: tuple[int, ...] | None = None
 
     @property
     def devices(self) -> int:
         return self.dp * self.tp
+
+    @property
+    def num_blocks(self) -> int:
+        return self.blocks[1] - self.blocks[0]
 
 
 def stage_specs_from_plan(
@@ -108,11 +114,18 @@ def stage_specs_from_plan(
         else:
             dp, tp, zero = strat.dp, strat.tp, strat.zero
             cp, ep = strat.cp, strat.ep
-        if cp > 1 or ep > 1:
+        if cp > 1:
             raise NotImplementedError(
-                f"stage {s}: cp={cp}/ep={ep} strategies run on the "
-                "single-program paths (execution.train with seq/ep axes); "
-                "the per-stage hetero executor covers dp x tp stages")
+                f"stage {s}: cp={cp} strategies run on the single-program "
+                "paths (execution.train with a seq axis); the per-stage "
+                "hetero executor covers dp x tp [x ep] stages")
+        is_moe = isinstance(cfg, MoEConfig)
+        if ep > 1 and not is_moe:
+            raise ValueError(f"stage {s}: ep={ep} needs an MoE config")
+        if ep > 1 and (dp % ep or cfg.num_experts % ep):
+            raise ValueError(
+                f"stage {s}: ep={ep} must divide dp={dp} and "
+                f"num_experts={cfg.num_experts}")
         lo, hi = bounds[s], bounds[s + 1]
         rows = None
         if stage_replica_rows is not None and stage_replica_rows[s] is not None:
@@ -124,7 +137,7 @@ def stage_specs_from_plan(
             blocks=(max(lo - 1, 0), min(hi - 1, cfg.num_blocks)),
             has_embed=lo == 0,
             has_head=hi == n_profile,
-            dp=dp, tp=tp, zero=zero, replica_rows=rows))
+            dp=dp, tp=tp, zero=zero, ep=ep, replica_rows=rows))
     return tuple(out)
 
 
@@ -139,7 +152,8 @@ def _slice_stage_params(params: dict, spec: StageSpec) -> dict:
 
 
 def _stage_param_specs(spec: StageSpec, cfg: GPTConfig) -> dict:
-    full = param_specs_for(cfg, tp_axis=TP, tp_size=spec.tp)
+    full = param_specs_for(cfg, tp_axis=TP, tp_size=spec.tp,
+                           ep_axis=EP if spec.ep > 1 else None)
     out = {"blocks": full["blocks"]}
     if spec.has_embed:
         out["embed"] = full["embed"]
@@ -167,7 +181,8 @@ def _pad_maps(replica_rows: Sequence[int]) -> tuple[np.ndarray, np.ndarray]:
     return np.asarray(to_padded, np.int32), np.asarray(to_canonical, np.int32)
 
 
-def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl):
+def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl,
+                   aux_weight: float = 0.0):
     """The stage's pure forward: params + boundary input -> boundary output
     (or loss, on the last stage).  Signature varies by role:
 
@@ -175,12 +190,26 @@ def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl):
     - middle stage:       f(params, x)                 -> x
     - last stage:         f(params, x, targets)        -> loss
     - single-stage plan:  f(params, tokens, targets)   -> loss
+
+    MoE stages additionally expose their router load-balance auxiliary:
+    non-head stages return ``(x, aux_mean)`` and the head stage folds
+    ``aux_loss_coef * aux_weight * aux_mean`` into its loss, where
+    ``aux_weight`` is the stage's share of the model's blocks — summed
+    across stages this reproduces the single-program
+    ``moe_next_token_loss`` (coef x mean over ALL blocks) exactly.
     """
     pad = spec.replica_rows is not None and len(set(spec.replica_rows)) > 1
+    is_moe = isinstance(cfg, MoEConfig)
+    if pad and is_moe:
+        # routed experts compete for capacity across the whole token batch,
+        # so duplicate pad rows would steal expert slots from real tokens —
+        # the zero-gradient padding argument only holds for row-local blocks
+        raise NotImplementedError(
+            "uneven hetero-DP padding is not sound for MoE stages")
     if pad:
         to_padded, to_canonical = _pad_maps(spec.replica_rows)
-
-    batch_sharded = P(DP, None, None)
+    batch_axes = (DP, EP) if spec.ep > 1 else DP
+    batch_sharded = P(batch_axes, None, None)
 
     embed, run_blocks, head_logits, _ = family_ops(cfg)
 
@@ -193,15 +222,27 @@ def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl):
         else:
             x = x_or_tok
         x = jax.lax.with_sharding_constraint(x, batch_sharded)
-        x = run_blocks(params, x, cfg, attn_impl)
+        aux = None
+        if is_moe:
+            if spec.num_blocks == 0:
+                # embed-/head-only stage: a zero-length scan's aux mean
+                # would be NaN; there are no routers here, aux is zero
+                aux = jnp.zeros((), jnp.float32)
+            else:
+                x, aux = run_blocks(params, x, cfg, attn_impl)
+        else:
+            x = run_blocks(params, x, cfg, attn_impl)
         if pad:
             x = x[to_canonical]
         if not spec.has_head:
-            return x
+            return (x, aux) if is_moe else x
         logits = head_logits(params, x, cfg)
         logp = jax.nn.log_softmax(logits, axis=-1)
         picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -picked.mean()
+        loss = -picked.mean()
+        if is_moe:
+            loss = loss + cfg.aux_loss_coef * aux_weight * aux
+        return loss
 
     return run
 
@@ -237,12 +278,22 @@ def make_hetero_train_step(
     meshes: list[Mesh] = []
     off = 0
     for s in stages:
-        grid = np.array(devs[off:off + s.devices]).reshape(s.dp, s.tp)
-        meshes.append(Mesh(grid, (DP, TP)))
+        if s.ep > 1:
+            grid = np.array(devs[off:off + s.devices]).reshape(
+                s.dp // s.ep, s.ep, s.tp)
+            meshes.append(Mesh(grid, (DP, EP, TP)))
+        else:
+            grid = np.array(devs[off:off + s.devices]).reshape(s.dp, s.tp)
+            meshes.append(Mesh(grid, (DP, TP)))
         off += s.devices
 
     S = len(stages)
-    fns = [_make_stage_fn(s, cfg, attn) for s in stages]
+    is_moe = isinstance(cfg, MoEConfig)
+    total_blocks = max(cfg.num_blocks, 1)
+    # per-stage share of the global aux mean (see _make_stage_fn docstring)
+    aux_w = [s.num_blocks / total_blocks for s in stages]
+    fns = [_make_stage_fn(s, cfg, attn, aux_weight=aux_w[i])
+           for i, s in enumerate(stages)]
 
     def _in_mesh(mesh: Mesh, fn):
         # bare-PartitionSpec constraints inside the stage programs resolve
@@ -274,14 +325,22 @@ def make_hetero_train_step(
             bwd.append(None)
         else:
             fwd.append(_in_mesh(mesh, jax.jit(f)))
+            # MoE stages emit (x, aux); the backward seeds the aux cotangent
+            # with its loss weight directly — aux_s depends only on this
+            # stage's params and input, so no aux value crosses a boundary
+            aux_seed = cfg.aux_loss_coef * aux_w[s] if is_moe else None
             if is_first:
-                def bw(params, tok, ct, _f=f):
+                def bw(params, tok, ct, _f=f, _as=aux_seed):
                     # tokens are ints — pull back to params only
                     _, pull = jax.vjp(lambda p: _f(p, tok), params)
+                    if _as is not None:
+                        ct = (ct, jnp.asarray(_as, jnp.float32))
                     return pull(ct)[0]
             else:
-                def bw(params, x_in, ct, _f=f):
+                def bw(params, x_in, ct, _f=f, _as=aux_seed):
                     _, pull = jax.vjp(_f, params, x_in)
+                    if _as is not None:
+                        ct = (ct, jnp.asarray(_as, jnp.float32))
                     return pull(ct)
             bwd.append(_in_mesh(mesh, jax.jit(bw)))
             lossgrad.append(None)
@@ -347,11 +406,17 @@ def make_hetero_train_step(
         toks = [_put(tokens_mbs[m], 0, P(None, None)) for m in range(M)]
         tgts = [_put(targets_mbs[m], S - 1, P(None, None)) for m in range(M)]
         x_in = [[None] * M for _ in range(S)]  # boundary input of stage s
+        aux_vals = []  # MoE: non-head stages' weighted aux means
         for m in range(M):
             x = None
             for s in range(S - 1):
                 src = toks[m] if s == 0 else x
                 x = fwd[s](state[s][0], src)
+                if is_moe:
+                    # keep aux on device; one fetch at the end (a per-(stage,
+                    # mb) device_get here would serialize the forward fill)
+                    x, aux = x
+                    aux_vals.append(cfg.aux_loss_coef * aux_w[s] * aux)
                 x_in[s + 1][m] = x = _put(x, s + 1, _boundary_spec(s + 1, rows))
 
         # ---- backward drain: per-stage grad accumulation across mbs
@@ -379,6 +444,10 @@ def make_hetero_train_step(
                 state[s][0], state[s][1], accs[s], M)
             state[s] = [params, opt_state]
         loss = float(np.mean([jax.device_get(l) for l in losses]))
+        if aux_vals:
+            # upstream stages' weighted aux terms (the head stage already
+            # folded its own): mean over microbatches, summed over stages
+            loss += float(np.sum(jax.device_get(aux_vals))) / M
         return state, loss
 
     return init_fn, step_fn

@@ -25,14 +25,14 @@ def family_ops(cfg):
     ``(embed, run_blocks, head_logits, init_params)`` with identical
     signatures across families — so stage-sliced executors
     (``execution.hetero``) run any family without knowing its internals.
-    MoE is excluded: its blocks return (x, aux) pairs and run on the
-    single-program GSPMD path."""
-    from metis_tpu.models import gpt, llama
+    Caveat for MoE: its ``run_blocks`` returns ``(x, aux_mean)`` rather
+    than bare activations (callers that thread the aux loss — the hetero
+    executor — branch on ``isinstance(cfg, MoEConfig)``)."""
+    from metis_tpu.models import gpt, llama, moe
 
     if isinstance(cfg, MoEConfig):
-        raise NotImplementedError(
-            "MoE runs on the GSPMD path (execution.train); the per-stage "
-            "executor covers dense families")
+        return (gpt.embed, moe.moe_run_blocks, gpt.head_logits,
+                moe.init_moe_params)
     if isinstance(cfg, llama.LlamaConfig):
         return (llama.llama_embed, llama.llama_run_blocks,
                 llama.llama_head_logits, llama.init_llama_params)

@@ -310,7 +310,9 @@ class LayerProfiler:
                     block_ms = t2 - t1
             if block_ms is None:
                 # isolated-closure fallback (marginal disabled, single-block
-                # model, or a noise-inverted marginal pair)
+                # model, or a noise-inverted marginal pair); j_block itself
+                # is compiled unconditionally because the per-layer memory
+                # row below reads its XLA memory analysis either way
                 block_ms = _median_ms(j_block, (layer0, x), w, it)
 
             # Whole-model fwd+bwd — the ground truth the per-layer

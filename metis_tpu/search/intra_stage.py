@@ -126,7 +126,8 @@ def escalate_dp_to_tp(
     pressure = (
         list(memory_state) if memory_state else [1.0 / s.dp for s in strategies]
     )
-    order = sorted(range(len(strategies)), key=lambda i: pressure[i])
+    # search-hot (~1M calls/search): bound __getitem__ beats a lambda key
+    order = sorted(range(len(strategies)), key=pressure.__getitem__)
     out = list(strategies)
     for stage_id in order:
         s = out[stage_id]

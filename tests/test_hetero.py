@@ -213,3 +213,69 @@ class TestArtifactBridge:
             inter, (Strategy(dp=4, tp=1), Strategy(dp=2, tp=2)),
             cluster, reference_profiles)
         assert rows == [None, None]
+
+
+class TestMoEStages:
+    """MoE stages in the per-stage executor: (x, aux) boundaries, ep-sharded
+    expert weights, loss parity vs the single-program moe loss."""
+
+    def _cfg(self, **kw):
+        from metis_tpu.models.moe import MoEConfig
+
+        base = dict(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                    num_blocks=4, ffn_multiplier=2, num_experts=2, top_k=1,
+                    capacity_factor=8.0, dtype=jnp.float32)
+        base.update(kw)
+        return MoEConfig(**base)
+
+    def test_two_stage_moe_matches_single_program(self):
+        from metis_tpu.models.moe import init_moe_params, moe_next_token_loss
+
+        cfg = self._cfg()
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (4, cfg.seq_len), 0, cfg.vocab_size)
+        expected = float(moe_next_token_loss(
+            init_moe_params(jax.random.PRNGKey(0), cfg), toks, toks, cfg))
+
+        stages = stage_specs_from_plan(
+            [0, 3, cfg.num_profile_layers],
+            [{"dp": 2, "tp": 1}, {"dp": 2, "tp": 2}], cfg)
+        init_fn, step_fn = make_hetero_train_step(
+            cfg, stages, devices=jax.devices()[:6])
+        state = init_fn(jax.random.PRNGKey(0))
+        mbs = toks.reshape(2, 2, -1)
+        _, loss = step_fn(state, mbs, mbs)
+        assert loss == pytest.approx(expected, rel=1e-4)
+
+    def test_ep_stage_trains(self):
+        cfg = self._cfg()
+        stages = stage_specs_from_plan(
+            [0, 3, cfg.num_profile_layers],
+            [{"dp": 4, "tp": 1, "ep": 2}, {"dp": 2, "tp": 2}], cfg)
+        assert stages[0].ep == 2
+        init_fn, step_fn = make_hetero_train_step(
+            cfg, stages, devices=jax.devices()[:8])
+        state = init_fn(jax.random.PRNGKey(0))
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (4, cfg.seq_len), 0, cfg.vocab_size)
+        mbs = toks.reshape(1, 4, -1)
+        losses = []
+        for _ in range(3):
+            state, loss = step_fn(state, mbs, mbs)
+            losses.append(loss)
+        assert losses[-1] < losses[0]
+
+    def test_ep_must_divide(self):
+        cfg = self._cfg()
+        with pytest.raises(ValueError, match="divide"):
+            stage_specs_from_plan(
+                [0, cfg.num_profile_layers], [{"dp": 3, "tp": 1, "ep": 2}],
+                cfg)
+
+    def test_moe_padding_rejected(self):
+        cfg = self._cfg()
+        stages = stage_specs_from_plan(
+            [0, cfg.num_profile_layers], [{"dp": 2, "tp": 1}], cfg,
+            stage_replica_rows=[(3, 1)])
+        with pytest.raises(NotImplementedError, match="MoE"):
+            make_hetero_train_step(cfg, stages, devices=jax.devices()[:2])
