@@ -191,6 +191,12 @@ class HeteroCostEstimator(_EstimatorBase):
                  bandwidth_factory: BandwidthFactory | None = None):
         super().__init__(cluster, profiles, volume, options)
         self.data_balancer = DataBalancer(profiles)
+        # CONTRACT: factories must depend on the plan's placement only
+        # (node_sequence + device_groups) — the memo below reuses one model
+        # across plans that share a placement but differ in batches/gbs.
+        # Both in-repo models (HeteroScalarBandwidth, IciDcnBandwidth)
+        # satisfy this; a batches-sensitive custom factory must not be
+        # passed here.
         self.bandwidth_factory = bandwidth_factory or (
             lambda plan: HeteroScalarBandwidth(cluster, plan, options.strict_compat))
         # search-hot: bandwidth depends on the plan's *placement* only —
@@ -249,6 +255,21 @@ class HeteroCostEstimator(_EstimatorBase):
             # comm is charged separately in get_cost).
             return (self.profiles.get(stage_types[0], tp, bs)
                     .time_slice(start, end) / strategy.cp)
+        if self.volume.model.num_experts > 0:
+            # MoE mixed-type stages execute with the EVEN split (uneven
+            # padding is unsound for capacity-competing routed tokens —
+            # execution.hetero); price what actually runs: the slowest
+            # type at the even per-replica batch.
+            bs = plan.gbs // dp // plan.batches
+            slowest = 0.0
+            for t in set(stage_types):
+                total = 0.0
+                for c in power_of_two_chunks(bs):
+                    if c > self.options.max_profiled_bs:
+                        raise ProfileMissError(t, tp, c)
+                    total += self.profiles.get(t, tp, c).time_slice(start, end)
+                slowest = max(slowest, total)
+            return slowest / strategy.cp
         split = self.data_balancer.partition(
             stage_types, dp, tp, plan.gbs // plan.batches)
         chunks = replica_chunks(stage_types, dp)

@@ -12,9 +12,10 @@ path that realizes it (the reference prints plan tuples and stops,
   single-program pipeline;
 - **multi-mesh per-stage** (``execution.hetero``) for everything else a
   hetero planner emits: non-uniform layer partitions, per-stage strategies,
-  uneven hetero-DP microbatches, ZeRO under pipelining (each stage is a
-  GSPMD program, so state sharding composes per stage — the configuration
-  the ADVICE r1 medium finding flagged as cost-model-only).
+  uneven hetero-DP microbatches, ZeRO under pipelining, MoE/ep stages, and
+  cp (ring attention) stages (each stage is a GSPMD program, so state
+  sharding and per-stage mesh axes compose — the configuration the ADVICE
+  r1 medium finding flagged as cost-model-only).
 
 Every path is normalized to ``(init, step)`` with
 ``init(key) -> state`` and ``step(state, tokens, targets) -> (state, loss)``
@@ -109,12 +110,6 @@ def build_executable(
             and not s0["sp"] and s0["cp"] == 1 and s0["ep"] == 1
             and _uniform_block_split(artifact, cfg, pp)):
         return _pipeline_executable(cfg, artifact, s0, pp, devices, optimizer)
-
-    if any(s["cp"] > 1 for s in strategies):
-        raise NotImplementedError(
-            "cp under pipeline parallelism has no execution path yet "
-            "(cp runs on the pp=1 GSPMD path); dp x tp [x ep] [x zero] "
-            "stages run on the per-stage executor")
 
     return _hetero_executable(
         cfg, artifact, strategies, devices, optimizer, cluster, profiles)

@@ -42,7 +42,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from metis_tpu.execution.mesh import DP, EP, TP
+from metis_tpu.execution.mesh import DP, EP, SP, TP
 from metis_tpu.execution.train import (
     build_optimizer,
     fsdp_wrap_specs,
@@ -71,11 +71,12 @@ class StageSpec:
     tp: int
     zero: int = 0
     ep: int = 1  # expert parallelism rides inside dp (MoE stages only)
+    cp: int = 1  # context parallelism: ring attention over a dedicated axis
     replica_rows: tuple[int, ...] | None = None
 
     @property
     def devices(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.cp * self.tp
 
     @property
     def num_blocks(self) -> int:
@@ -114,12 +115,14 @@ def stage_specs_from_plan(
         else:
             dp, tp, zero = strat.dp, strat.tp, strat.zero
             cp, ep = strat.cp, strat.ep
-        if cp > 1:
-            raise NotImplementedError(
-                f"stage {s}: cp={cp} strategies run on the single-program "
-                "paths (execution.train with a seq axis); the per-stage "
-                "hetero executor covers dp x tp [x ep] stages")
         is_moe = isinstance(cfg, MoEConfig)
+        if cp > 1 and is_moe:
+            raise NotImplementedError(
+                f"stage {s}: cp+MoE stages have no execution path "
+                "(ring attention composes with dense families)")
+        if cp > 1 and cfg.seq_len % cp:
+            raise ValueError(
+                f"stage {s}: cp={cp} must divide seq_len={cfg.seq_len}")
         if ep > 1 and not is_moe:
             raise ValueError(f"stage {s}: ep={ep} needs an MoE config")
         if ep > 1 and (dp % ep or cfg.num_experts % ep):
@@ -137,7 +140,7 @@ def stage_specs_from_plan(
             blocks=(max(lo - 1, 0), min(hi - 1, cfg.num_blocks)),
             has_embed=lo == 0,
             has_head=hi == n_profile,
-            dp=dp, tp=tp, zero=zero, ep=ep, replica_rows=rows))
+            dp=dp, tp=tp, zero=zero, ep=ep, cp=cp, replica_rows=rows))
     return tuple(out)
 
 
@@ -209,7 +212,8 @@ def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl,
     if pad:
         to_padded, to_canonical = _pad_maps(spec.replica_rows)
     batch_axes = (DP, EP) if spec.ep > 1 else DP
-    batch_sharded = P(batch_axes, None, None)
+    seq_axis = SP if spec.cp > 1 else None
+    batch_sharded = P(batch_axes, seq_axis, None)
 
     embed, run_blocks, head_logits, _ = family_ops(cfg)
 
@@ -278,12 +282,15 @@ def make_hetero_train_step(
     meshes: list[Mesh] = []
     off = 0
     for s in stages:
+        chips = devs[off:off + s.devices]
         if s.ep > 1:
-            grid = np.array(devs[off:off + s.devices]).reshape(
-                s.dp // s.ep, s.ep, s.tp)
+            grid = np.array(chips).reshape(s.dp // s.ep, s.ep, s.tp)
             meshes.append(Mesh(grid, (DP, EP, TP)))
+        elif s.cp > 1:
+            grid = np.array(chips).reshape(s.dp, s.cp, s.tp)
+            meshes.append(Mesh(grid, (DP, SP, TP)))
         else:
-            grid = np.array(devs[off:off + s.devices]).reshape(s.dp, s.tp)
+            grid = np.array(chips).reshape(s.dp, s.tp)
             meshes.append(Mesh(grid, (DP, TP)))
         off += s.devices
 
@@ -292,8 +299,16 @@ def make_hetero_train_step(
     total_blocks = max(cfg.num_blocks, 1)
     # per-stage share of the global aux mean (see _make_stage_fn docstring)
     aux_w = [s.num_blocks / total_blocks for s in stages]
-    fns = [_make_stage_fn(s, cfg, attn, aux_weight=aux_w[i])
-           for i, s in enumerate(stages)]
+    fns = []
+    for i, s in enumerate(stages):
+        stage_attn = attn
+        if s.cp > 1:
+            # ring attention over the stage's dedicated sp axis; positions
+            # stay global (embed/rope run on the GSPMD-global array)
+            from metis_tpu.ops.ring_attention import make_ring_attention
+
+            stage_attn = make_ring_attention(meshes[i], SP)
+        fns.append(_make_stage_fn(s, cfg, stage_attn, aux_weight=aux_w[i]))
 
     def _in_mesh(mesh: Mesh, fn):
         # bare-PartitionSpec constraints inside the stage programs resolve

@@ -64,13 +64,18 @@ class TestRouting:
         first, second = _train_two_steps(exe, art.gbs)
         assert np.isfinite(first) and second < first
 
-    def test_cp_under_pp_rejected(self):
+    def test_cp_under_pp_routes_hetero(self):
         art = PlanArtifact(
             mesh_axes=(), mesh_shape=(), layer_partition=(0, 2, 6),
             strategies=({"dp": 2, "tp": 1, "cp": 2}, {"dp": 4, "tp": 1}),
             gbs=8, microbatches=2)
-        with pytest.raises(NotImplementedError, match="cp"):
-            build_executable(CFG, art)
+        exe = build_executable(CFG, art)
+        assert exe.kind == "hetero"
+        state = exe.init(jax.random.PRNGKey(0))
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (8, CFG.seq_len), 0, CFG.vocab_size)
+        _, loss = exe.step(state, toks, toks)
+        assert np.isfinite(loss)
 
     def test_cp_plan_routes_gspmd_with_ring_attention(self):
         art = PlanArtifact(

@@ -95,9 +95,14 @@ class TestStageSpecConversion:
                 [0, 6], [{"dp": 2, "tp": 1}], CFG,
                 stage_replica_rows=[(1, 2, 3)])
 
-    def test_cp_ep_strategies_rejected(self):
+    def test_cp_moe_combination_rejected(self):
+        from metis_tpu.models.moe import MoEConfig
+
+        moe = MoEConfig(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                        num_blocks=4, ffn_multiplier=2, num_experts=2,
+                        top_k=1, dtype=jnp.float32)
         with pytest.raises(NotImplementedError, match="cp"):
-            stage_specs_from_plan([0, 6], [{"dp": 2, "tp": 1, "cp": 2}], CFG)
+            stage_specs_from_plan([0, 6], [{"dp": 2, "tp": 1, "cp": 2}], moe)
 
 
 class TestNonUniformParity:
@@ -279,3 +284,24 @@ class TestMoEStages:
             stage_replica_rows=[(3, 1)])
         with pytest.raises(NotImplementedError, match="MoE"):
             make_hetero_train_step(cfg, stages, devices=jax.devices()[:2])
+
+
+class TestCpStages:
+    """cp (ring attention) stages under pipelining: a stage's mesh carries a
+    dedicated sp axis and its attention runs the K/V-rotating ring."""
+
+    def test_cp_stage_matches_single_device(self):
+        toks = _data(4)
+        stages = stage_specs_from_plan(
+            [0, 3, CFG.num_profile_layers],
+            [{"dp": 2, "tp": 1, "cp": 2}, {"dp": 2, "tp": 1}], CFG)
+        assert stages[0].cp == 2 and stages[0].devices == 4
+        got = _hetero_losses(stages, toks, microbatches=2, steps=2)
+        want = _reference_losses(toks, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_cp_seq_divisibility_checked(self):
+        with pytest.raises(ValueError, match="divide seq_len"):
+            stage_specs_from_plan(
+                [0, CFG.num_profile_layers], [{"dp": 1, "tp": 1, "cp": 3}],
+                CFG)
