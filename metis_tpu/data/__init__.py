@@ -1,0 +1,15 @@
+from metis_tpu.data.pipeline import (
+    TokenDataset,
+    batch_source,
+    batches_per_epoch,
+    make_input_pipeline,
+    measure_batch_generator_ms,
+)
+
+__all__ = [
+    "TokenDataset",
+    "batch_source",
+    "batches_per_epoch",
+    "make_input_pipeline",
+    "measure_batch_generator_ms",
+]

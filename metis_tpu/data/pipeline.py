@@ -1,0 +1,211 @@
+"""Training input pipeline — host batching + double-buffered device prefetch.
+
+The reference *prices* input loading (its profiles carry a
+``batch_generator_time_ms`` the cost model adds per step,
+``cost_estimator.py:34-35``) but ships no loader.  This is the execution
+counterpart: a token-stream dataset abstraction, a device-prefetching
+iterator, and a measurement hook that produces the very
+``batch_generator_ms`` number the profile contract wants — closing the
+loop between the priced quantity and an implemented subsystem.
+
+TPU-first design:
+
+- the host thread prepares batch ``i+1`` while the device runs step ``i``
+  (one-deep pipeline — deeper buffering only hides host time already
+  hidden);
+- batches land directly in their target sharding via ``jax.device_put``
+  with a ``NamedSharding`` (dp over batch, optional sp over sequence), so
+  no gather/reshard runs on device;
+- next-token targets are the shifted token stream — one host array, two
+  views, zero extra copies on device.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TokenDataset:
+    """A flat token stream chunked into [seq_len + 1] windows.
+
+    ``tokens`` may be any 1-D integer array-like (an ``np.memmap`` of a
+    tokenized corpus works unchanged — nothing here copies the stream).
+    Window ``i`` yields inputs ``tokens[i*L : i*L+L]`` and next-token
+    targets shifted by one.
+    """
+
+    tokens: np.ndarray
+    seq_len: int
+
+    def __post_init__(self) -> None:
+        if getattr(self.tokens, "ndim", 1) != 1:
+            raise ValueError("TokenDataset wants a flat 1-D token stream")
+        if self.num_windows < 1:
+            raise ValueError(
+                f"stream of {len(self.tokens)} tokens has no full "
+                f"[{self.seq_len}+1] window")
+
+    @property
+    def num_windows(self) -> int:
+        return (len(self.tokens) - 1) // self.seq_len
+
+    def window(self, i: int) -> tuple[np.ndarray, np.ndarray]:
+        lo = i * self.seq_len
+        chunk = np.asarray(self.tokens[lo:lo + self.seq_len + 1])
+        return chunk[:-1], chunk[1:]
+
+    @staticmethod
+    def synthetic(vocab_size: int, num_tokens: int, seq_len: int,
+                  seed: int = 0) -> "TokenDataset":
+        rng = np.random.default_rng(seed)
+        return TokenDataset(
+            rng.integers(0, vocab_size, num_tokens, dtype=np.int32), seq_len)
+
+
+def batches_per_epoch(dataset: TokenDataset, gbs: int) -> int:
+    return dataset.num_windows // gbs
+
+
+def _host_batches(dataset: TokenDataset, gbs: int, shuffle_seed: int | None,
+                  epochs: int | None) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    per_epoch = batches_per_epoch(dataset, gbs)
+    if per_epoch < 1:
+        raise ValueError(
+            f"dataset has {dataset.num_windows} windows < gbs={gbs}")
+    L = dataset.seq_len
+    offsets = np.arange(L + 1)[None, :]
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = np.arange(dataset.num_windows)
+        if shuffle_seed is not None:
+            np.random.default_rng(shuffle_seed + epoch).shuffle(order)
+        for b in range(per_epoch):
+            idx = order[b * gbs:(b + 1) * gbs]
+            # one vectorized gather per batch (fancy indexing pages a memmap
+            # in bulk; a per-row Python loop would dominate host time)
+            gather = np.asarray(
+                dataset.tokens)[idx[:, None] * L + offsets].astype(np.int32)
+            yield gather[:, :-1], gather[:, 1:]
+        epoch += 1
+
+
+def make_input_pipeline(
+    dataset: TokenDataset,
+    gbs: int,
+    mesh=None,
+    dp_axis: str | None = "dp",
+    seq_axis: str | None = None,
+    shuffle_seed: int | None = 0,
+    epochs: int | None = None,
+    prefetch: int = 1,
+):
+    """Iterator of device-resident ``(tokens, targets)`` batches.
+
+    With ``mesh``, batches are placed with ``P(dp_axis, seq_axis)`` sharding
+    (the executor's ``batch_spec``); without one they stay host-side numpy
+    (the hetero executor does its own per-stage placement).  ``prefetch``
+    host batches are prepared ahead by a daemon thread so host batching
+    overlaps device compute — the overlap the cost model's additive
+    ``batch_generator_ms`` term conservatively ignores.
+    """
+    host_iter = _host_batches(dataset, gbs, shuffle_seed, epochs)
+
+    put = None
+    if mesh is not None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P(dp_axis, seq_axis))
+
+        def put(batch):  # noqa: F811
+            toks, tgts = batch
+            return (jax.device_put(toks, sharding),
+                    jax.device_put(tgts, sharding))
+
+    if prefetch < 1:
+        for batch in host_iter:
+            yield put(batch) if put is not None else batch
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=prefetch)
+    stop = threading.Event()
+    _END = object()
+
+    def _offer(item) -> bool:
+        """q.put that gives up when the consumer abandoned the pipeline
+        (otherwise an early `break` would leave this thread blocked forever
+        holding prefetched — possibly device-resident — batches)."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def feed():
+        try:
+            for batch in host_iter:
+                if not _offer(put(batch) if put is not None else batch):
+                    return
+            _offer(_END)
+        except BaseException as e:  # propagate, don't masquerade as end-of-data
+            _offer(e)
+
+    thread = threading.Thread(target=feed, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+def batch_source(dataset: TokenDataset, gbs: int, device=None,
+                 shuffle_seed: int | None = None):
+    """A zero-arg callable yielding the next batch forever — the ONE batch
+    producer both the profiler's ``batch_generator_ms`` measurement and
+    :func:`measure_batch_generator_ms` time (a second implementation would
+    drift from what training actually runs).  With ``device``, each call
+    also lands the tokens on it (the host->device transfer the profile
+    contract's field includes)."""
+    it = _host_batches(dataset, gbs, shuffle_seed, epochs=None)
+    if device is None:
+        return lambda: next(it)
+    import jax
+
+    return lambda: jax.device_put(next(it)[0], device)
+
+
+def measure_batch_generator_ms(
+    dataset: TokenDataset, gbs: int, iters: int = 10,
+    shuffle_seed: int | None = 0, device=None,
+) -> float:
+    """Median time (ms) to materialize one [gbs, seq] batch through the
+    shipped pipeline (+ device transfer when ``device`` is given) — the
+    profile contract's ``batch_generator_ms`` (the reference documents
+    collecting it with torch hooks, ``README.md:174-186``)."""
+    import time
+
+    gen = batch_source(dataset, gbs, device, shuffle_seed)
+    gen()  # touch the stream (page in a memmap's first windows)
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = gen()
+        if device is not None:
+            import jax
+
+            jax.block_until_ready(out)
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(samples))

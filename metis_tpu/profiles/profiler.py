@@ -383,14 +383,18 @@ class LayerProfiler:
             step, (params, opt_state, grads), self.config.warmup, self.config.iters)
 
     def _profile_batch_gen_ms(self, bs: int) -> float:
-        """Host batch synthesis + host->device transfer."""
-        rng = np.random.default_rng(self.config.seed)
+        """Host batching through the shipped input pipeline
+        (:mod:`metis_tpu.data`) + host->device transfer — measuring the
+        loader that actually feeds training, not a synthetic stand-in."""
+        from metis_tpu.data import TokenDataset
+        from metis_tpu.data.pipeline import batch_source
 
-        def gen():
-            batch = rng.integers(
-                0, self.cfg.vocab_size, (bs, self.cfg.seq_len), dtype=np.int32)
-            return jax.device_put(batch, self.devices[0])
-
+        n_batches = self.config.warmup + 3 * self.config.iters + 2
+        ds = TokenDataset.synthetic(
+            self.cfg.vocab_size,
+            bs * n_batches * self.cfg.seq_len + 1,
+            self.cfg.seq_len, seed=self.config.seed)
+        gen = batch_source(ds, bs, device=self.devices[0])
         return _median_ms(lambda: gen(), (), self.config.warmup, self.config.iters)
 
     # -- public API ---------------------------------------------------------
