@@ -189,6 +189,24 @@ def main(argv: list[str] | None = None) -> int:
     p_val.add_argument("--steps", type=int, default=5)
     p_val.add_argument("--warmup", type=int, default=2)
 
+    p_rep = sub.add_parser(
+        "replan", help="elastic re-plan on topology change: diff two cluster "
+                       "descriptions, search the survivor topology, report "
+                       "the delta and cost movement")
+    p_rep.add_argument("--hostfile", required=True,
+                       help="OLD topology hostfile")
+    p_rep.add_argument("--clusterfile", required=True,
+                       help="OLD topology clusterfile")
+    p_rep.add_argument("--new-hostfile", required=True)
+    p_rep.add_argument("--new-clusterfile", required=True)
+    p_rep.add_argument("--profile-dir", required=True)
+    p_rep.add_argument("--no-old-cost", action="store_true",
+                       help="search ONLY the survivor topology (skip the "
+                            "old-cluster search that supplies the cost "
+                            "comparison) — the time-critical recovery path")
+    _add_model_args(p_rep)
+    _add_search_args(p_rep)
+
     args = parser.parse_args(argv)
 
     if args.command == "calibrate":
@@ -204,6 +222,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "validate":
         return _cmd_validate(args, profiles, model, config)
+    if args.command == "replan":
+        return _cmd_replan(args, profiles, model, config, events)
 
     if args.command == "hetero":
         cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
@@ -317,6 +337,33 @@ def _cmd_validate(args: argparse.Namespace, profiles, model, config) -> int:
             f"{result.num_pruned} pruned — a fully-pruned search usually "
             "means the profile device types don't match the clusterfile)",
             file=sys.stderr)
+    return 0
+
+
+def _cmd_replan(args: argparse.Namespace, profiles, model, config,
+                events) -> int:
+    from metis_tpu.planner.replan import replan
+
+    old = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    new = ClusterSpec.from_files(args.new_hostfile, args.new_clusterfile)
+    report = replan(old, new, profiles, model, config,
+                    search_old=not args.no_old_cost, events=events)
+    payload = {
+        "delta": {"added": report.delta.added,
+                  "removed": report.delta.removed},
+        "plan_changed": report.plan_changed,
+        "old_best_cost_ms": report.old_best_cost_ms,
+        "new_best_cost_ms": report.new_best_cost_ms,
+        "cost_ratio": report.cost_ratio,
+        "plans": json.loads(
+            dump_ranked_plans(report.result.plans, limit=args.top_k)),
+    }
+    _emit(args, json.dumps(payload, indent=2))
+    print(
+        f"replan: delta +{report.delta.added or '{}'} "
+        f"-{report.delta.removed or '{}'}; plan_changed="
+        f"{report.plan_changed}; cost {report.old_best_cost_ms} -> "
+        f"{report.new_best_cost_ms} ms", file=sys.stderr)
     return 0
 
 

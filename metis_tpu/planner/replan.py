@@ -71,19 +71,24 @@ def replan(
     model: ModelSpec,
     config: SearchConfig,
     old_result: PlannerResult | None = None,
+    search_old: bool = True,
     **plan_kwargs,
 ) -> ReplanReport:
     """Re-plan against ``new_cluster`` and report the topology delta and cost
     movement.  ``old_result`` (if available) supplies the previous best cost
-    and plan identity; otherwise the old cluster is re-planned too."""
+    and plan identity; otherwise the old cluster is re-planned too — unless
+    ``search_old=False``, which searches ONLY the survivor topology (the
+    time-critical elastic-recovery path: old-plan comparison is then
+    reported as unknown rather than paid for)."""
     delta = ClusterDelta.between(old_cluster, new_cluster)
-    if old_result is None:
+    if old_result is None and search_old:
         old_result = plan_hetero(old_cluster, profiles, model, config,
                                  **plan_kwargs)
     new_result = plan_hetero(new_cluster, profiles, model, config,
                              **plan_kwargs)
 
-    old_best, new_best = old_result.best, new_result.best
+    old_best = old_result.best if old_result is not None else None
+    new_best = new_result.best
     changed = (
         old_best is None or new_best is None
         or old_best.inter != new_best.inter

@@ -1,0 +1,132 @@
+"""Driver-layer CLI: every subcommand drives end-to-end in-process."""
+import json
+
+import pytest
+
+from metis_tpu.planner.cli import main
+
+MODEL_ARGS = [
+    "--model-name", "cli-test", "--num-layers", "4", "--hidden-size", "32",
+    "--seq-len", "16", "--vocab-size", "64", "--num-heads", "2",
+]
+
+
+@pytest.fixture(scope="module")
+def fixture_dir(tmp_path_factory):
+    from metis_tpu.core.config import ModelSpec
+    from metis_tpu.profiles import synthesize_profiles
+
+    tmp = tmp_path_factory.mktemp("cli")
+    model = ModelSpec(name="cli-test", num_layers=4, hidden_size=32,
+                      sequence_length=16, vocab_size=64, num_heads=2)
+    synthesize_profiles(model, ["A100", "T4"], tps=[1, 2],
+                        bss=[1, 2, 4]).dump_to_dir(tmp / "profiles")
+    synthesize_profiles(model, ["tpu_v5e"], tps=[1, 2],
+                        bss=[1, 2, 4]).dump_to_dir(tmp / "v5e_profiles")
+    (tmp / "hostfile").write_text(
+        "10.0.0.1 slots=4\n10.0.0.2 slots=4\n")
+    (tmp / "hostfile_small").write_text("10.0.0.1 slots=4\n")
+    (tmp / "cluster.json").write_text(json.dumps({
+        "10.0.0.1": {"instance_type": "A100", "inter_bandwidth": 10,
+                     "intra_bandwidth": 46, "memory": 80},
+        "10.0.0.2": {"instance_type": "T4", "inter_bandwidth": 10,
+                     "intra_bandwidth": 50, "memory": 15},
+    }))
+    return tmp
+
+
+def _cluster_args(tmp):
+    return ["--hostfile", str(tmp / "hostfile"),
+            "--clusterfile", str(tmp / "cluster.json")]
+
+
+def test_hetero_subcommand(fixture_dir, tmp_path, capsys):
+    out = tmp_path / "plans.json"
+    rc = main(["hetero", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--top-k", "3",
+               "--output", str(out)])
+    assert rc == 0
+    plans = json.loads(out.read_text())
+    assert plans and plans[0]["rank"] == 1
+
+
+def test_tpu_subcommand_with_alignment(fixture_dir, tmp_path):
+    out = tmp_path / "plans.json"
+    rc = main(["tpu", "--slices", "v5e-4,v5e-4",
+               "--profile-dir", str(fixture_dir / "v5e_profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--top-k", "2",
+               "--output", str(out)])
+    assert rc == 0
+    assert json.loads(out.read_text())
+
+
+def test_uniform_subcommand(fixture_dir, tmp_path):
+    out = tmp_path / "plans.json"
+    rc = main(["uniform", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               "--device-type", "A100", "--include-oom",
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+               "--output", str(out)])
+    assert rc == 0
+    assert json.loads(out.read_text())
+
+
+def test_replan_subcommand(fixture_dir, tmp_path):
+    out = tmp_path / "replan.json"
+    rc = main(["replan", "--hostfile", str(fixture_dir / "hostfile"),
+               "--clusterfile", str(fixture_dir / "cluster.json"),
+               "--new-hostfile", str(fixture_dir / "hostfile_small"),
+               "--new-clusterfile", str(fixture_dir / "cluster.json"),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+               "--output", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["delta"]["removed"] == {"T4": 4}
+    assert report["new_best_cost_ms"] is not None
+
+
+def test_calibrate_subcommand(tmp_path):
+    out = tmp_path / "cal.json"
+    rc = main(["calibrate", "--output", str(out),
+               "--payload-kb", "64", "--iters", "2"])
+    assert rc == 0
+    cal = json.loads(out.read_text())
+    assert cal["group_size"] >= 2
+
+
+def test_profile_subcommand(tmp_path):
+    rc = main(["profile", *MODEL_ARGS, "--output-dir", str(tmp_path / "prof"),
+               "--tps", "1", "--bss", "1", "--warmup", "1", "--iters", "2"])
+    assert rc == 0
+    assert list((tmp_path / "prof").glob("*.json"))
+
+
+def test_replan_no_old_cost(fixture_dir, tmp_path):
+    out = tmp_path / "replan.json"
+    rc = main(["replan", "--hostfile", str(fixture_dir / "hostfile"),
+               "--clusterfile", str(fixture_dir / "cluster.json"),
+               "--new-hostfile", str(fixture_dir / "hostfile_small"),
+               "--new-clusterfile", str(fixture_dir / "cluster.json"),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               "--no-old-cost", *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+               "--output", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["old_best_cost_ms"] is None
+    assert report["new_best_cost_ms"] is not None
+
+
+def test_replan_events_logged(fixture_dir, tmp_path):
+    ev = tmp_path / "events.jsonl"
+    rc = main(["replan", "--hostfile", str(fixture_dir / "hostfile"),
+               "--clusterfile", str(fixture_dir / "cluster.json"),
+               "--new-hostfile", str(fixture_dir / "hostfile_small"),
+               "--new-clusterfile", str(fixture_dir / "cluster.json"),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+               "--events", str(ev), "--output", str(tmp_path / "r.json")])
+    assert rc == 0
+    lines = [json.loads(l) for l in ev.read_text().splitlines()]
+    assert any(e["event"] == "search_finished" for e in lines)
