@@ -7,9 +7,10 @@ path that realizes it (the reference prints plan tuples and stops,
 - **GSPMD single-program** (``execution.train``) for pp=1 rectangular plans —
   dp/ep batch sharding, tp via GSPMD, cp via ring attention over the "sp"
   mesh axis, Megatron SP via residual constraints, ZeRO via state sharding;
-- **shard_map GPipe** (``execution.pipeline``) for pp>1 rectangular plans
-  with one (dp, tp) strategy, even layer split, and zero=0 — the fastest
-  single-program pipeline;
+- **shard_map pipeline** (``execution.pipeline``) for pp>1 rectangular
+  plans with one (dp, tp) strategy, even layer split, and zero=0 — the
+  fastest single-program pipeline (GPipe or memory-bounded 1F1B via
+  ``schedule=``);
 - **multi-mesh per-stage** (``execution.hetero``) for everything else a
   hetero planner emits: non-uniform layer partitions, per-stage strategies,
   uneven hetero-DP microbatches, ZeRO under pipelining, MoE/ep stages, and
@@ -80,11 +81,22 @@ def build_executable(
     optimizer=None,
     cluster=None,
     profiles=None,
+    schedule: str = "gpipe",
 ) -> Executable:
     """Route ``artifact`` to the execution path that realizes it.
 
     ``cluster`` + ``profiles`` (optional) enable the data balancer's uneven
-    per-replica microbatches on mixed-type hetero stages."""
+    per-replica microbatches on mixed-type hetero stages.  ``schedule``
+    selects the single-program pipeline schedule ("gpipe" or the
+    memory-bounded "1f1b") and applies only when the plan routes to the
+    shard_map pipeline; the gspmd route has no pipeline and the hetero
+    route is already stage-granular-remat with boundary-only storage.
+    Note 1F1B trades FLOPs for memory: it recomputes each stage forward
+    from the saved boundary input (~one extra forward per microbatch-stage
+    that the cost model's fill-drain formula does not price), so prefer it
+    when activation memory binds, not when step time does."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     strategies = [dict(s) for s in artifact.strategies]
     for s in strategies:
         s.setdefault("cp", 1)
@@ -109,7 +121,8 @@ def build_executable(
     if (artifact.mesh_shape and uniform and s0["zero"] == 0
             and not s0["sp"] and s0["cp"] == 1 and s0["ep"] == 1
             and _uniform_block_split(artifact, cfg, pp)):
-        return _pipeline_executable(cfg, artifact, s0, pp, devices, optimizer)
+        return _pipeline_executable(cfg, artifact, s0, pp, devices, optimizer,
+                                    schedule)
 
     return _hetero_executable(
         cfg, artifact, strategies, devices, optimizer, cluster, profiles)
@@ -135,7 +148,7 @@ def _gspmd_executable(cfg, artifact, s0, devices, optimizer) -> Executable:
 
 
 def _pipeline_executable(cfg, artifact, s0, pp, devices,
-                         optimizer) -> Executable:
+                         optimizer, schedule="gpipe") -> Executable:
     import numpy as np
     from jax.sharding import Mesh
 
@@ -146,7 +159,8 @@ def _pipeline_executable(cfg, artifact, s0, pp, devices,
     mesh = Mesh(
         np.array(devs[:need]).reshape(pp, s0["dp"], s0["tp"]), (PP, DP, TP))
     init_fn, raw_step = make_pipeline_train_step(
-        cfg, mesh, artifact.microbatches, optimizer=optimizer)
+        cfg, mesh, artifact.microbatches, optimizer=optimizer,
+        schedule=schedule)
 
     def init(key):
         return init_fn(key)

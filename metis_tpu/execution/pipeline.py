@@ -117,7 +117,23 @@ def tp_head_loss(params: dict, x: jnp.ndarray, targets: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# GPipe schedule
+# Schedules
+#
+# Two pipeline schedules share the Megatron-style TP layers above:
+#
+# - **GPipe** (``_pipeline_loss_local``): forward-only scan over
+#   M + S - 1 ticks, loss out, gradients by autodiff through the scan.  XLA
+#   stores every tick's residuals (all block internals), so peak activation
+#   memory grows with the microbatch count M.
+# - **1F1B, memory-bounded** (``_pipeline_1f1b_local``): each tick runs one
+#   forward slot and one backward slot; backwards start as soon as the first
+#   microbatch reaches the last stage, so at most ``min(M, 2(S-1)+1)``
+#   boundary activations are live per stage — peak activation memory is
+#   O(S), independent of M.  The backward slot recomputes its stage forward
+#   from the saved boundary input (stage-granular rematerialization), the
+#   standard memory/FLOPs trade.  Step time obeys the same fill-drain
+#   formula the cost model prices (the bubble fraction (S-1)/(M+S-1) is
+#   unchanged; ticks = M + 2(S-1) of fwd+bwd work vs GPipe's two passes).
 # ---------------------------------------------------------------------------
 
 
@@ -174,16 +190,176 @@ def _pipeline_loss_local(
     return jax.lax.pmean(loss, DP)
 
 
+def _pipeline_1f1b_local(
+    params: dict,
+    tokens_mbs: jnp.ndarray,   # [M, mbs_local, S]
+    targets_mbs: jnp.ndarray,
+    cfg: GPTConfig,
+) -> tuple[jnp.ndarray, dict]:
+    """Per-device memory-bounded 1F1B body: returns ``(loss, grads)``.
+
+    Schedule (global tick t, stage s, S stages, M microbatches):
+
+    - forward slot: microbatch ``mf = t - s`` (GPipe forward timing);
+    - backward slot: microbatch ``mb = t - (2(S-1) - s)`` — the last stage
+      runs a microbatch's backward in the same tick as its forward, each
+      earlier stage one tick later, so in-flight microbatches per stage
+      never exceed ``2(S-1-s) + 1``.
+
+    The stage's boundary input is saved in a ring of ``R = min(M, 2(S-1)+1)``
+    slots; the backward slot recomputes the stage forward from the saved
+    input with ``jax.vjp``.  Slot reuse is safe: a slot written by forward
+    microbatch ``mb + R`` at tick ``s + mb + R`` is read by backward ``mb``
+    at tick ``2(S-1) - s + mb``, and ``s + R >= 2(S-1) - s`` for every
+    stage; within a tick the forward write precedes the backward read (the
+    two coincide only on the last stage, where the same microbatch's input
+    is written then immediately consumed).
+
+    Gradients accumulate in the scan carry: the loss cotangent is seeded
+    only on the last stage, the embed branch transposes to zero off stage 0,
+    so per-leaf contributions live on their owning stage; the caller psums
+    pipeline-replicated leaves over "pp" and pmeans everything over "dp".
+    """
+    num_stages = jax.lax.axis_size(PP)
+    stage = jax.lax.axis_index(PP)
+    M, mbs_local, seq = tokens_mbs.shape
+    S = num_stages
+    R = min(M, 2 * (S - 1) + 1)
+    ticks = M + 2 * (S - 1)
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    bwd_perm = [(i + 1, i) for i in range(S - 1)]
+
+    def _varying(x):
+        # cast up to varying over (pp, dp), skipping axes the value already
+        # varies over (param-derived zeros inherit the shards' vma)
+        need = tuple(a for a in (PP, DP) if a not in jax.typeof(x).vma)
+        return jax.lax.pcast(x, need, to='varying') if need else x
+
+    # Mark every param leaf varying over (pp, dp) BEFORE the per-stage vjp:
+    # for a leaf the vjp sees as pp/dp-INVARIANT it would insert the
+    # invariance-restoring psum itself (each stage is mid-backward on a
+    # DIFFERENT microbatch, so that reduction both mixes microbatches and
+    # double-counts against the explicit psum/pmean after the scan).  Leaves
+    # stay tp-invariant where they are tp-replicated — the vjp's automatic
+    # tp reduction of their gradients is exactly Megatron's grad psum.
+    params = jax.tree.map(_varying, params)
+
+    def blocks_local(p, x):
+        def step(carry, layer):
+            return tp_block_forward(carry, layer, cfg), None
+        out, _ = jax.lax.scan(step, x, p["blocks"])
+        return out
+
+    def stage_fn(p, x_in, tok, tgt):
+        """Uniform per-stage program: embed on stage 0, blocks, head loss on
+        the last stage (loss cotangent seeded there only)."""
+        x0 = tp_embed(p, tok, cfg)
+        x = jnp.where(stage == 0, x0, x_in)
+        x_out = blocks_local(p, x)
+        loss = tp_head_loss(p, x_out, tgt, cfg)
+        return x_out, loss
+
+    def tick(carry, t):
+        buf_fwd, buf_ct, ring, gacc, loss_sum = carry
+
+        # ---- forward slot: microbatch t - stage
+        mf = t - stage
+        active_f = (mf >= 0) & (mf < M)
+        mf_c = jnp.clip(mf, 0, M - 1)
+        tok_f = jax.lax.dynamic_index_in_dim(tokens_mbs, mf_c, 0, False)
+        x0 = tp_embed(params, tok_f, cfg)
+        x_in = jnp.where(stage == 0, x0, buf_fwd)
+        # save the boundary input (masked in-place: an inactive slot keeps
+        # its old value — mf_c clips onto live slots, so a blind write would
+        # clobber them)
+        slot_f = mf_c % R
+        old = jax.lax.dynamic_index_in_dim(ring, slot_f, 0, False)
+        ring = jax.lax.dynamic_update_index_in_dim(
+            ring, jnp.where(active_f, x_in, old), slot_f, 0)
+        x_out = blocks_local(params, x_in)
+
+        # ---- backward slot: microbatch t - (2(S-1) - stage)
+        mb = t - (2 * (S - 1) - stage)
+        active_b = (mb >= 0) & (mb < M)
+        mb_c = jnp.clip(mb, 0, M - 1)
+        tok_b = jax.lax.dynamic_index_in_dim(tokens_mbs, mb_c, 0, False)
+        tgt_b = jax.lax.dynamic_index_in_dim(targets_mbs, mb_c, 0, False)
+        x_saved = jax.lax.dynamic_index_in_dim(ring, mb_c % R, 0, False)
+
+        is_last = stage == S - 1
+        (x_p, loss_p), pull = jax.vjp(
+            lambda p, x: stage_fn(p, x, tok_b, tgt_b), params, x_saved)
+        # cotangents: boundary ct from the next stage, except the last
+        # stage, which seeds the loss instead
+        def _match_vma(ct, primal):
+            # a cotangent must carry the primal output's exact vma
+            need = tuple(a for a in jax.typeof(primal).vma
+                         if a not in jax.typeof(ct).vma)
+            return jax.lax.pcast(ct, need, to='varying') if need else ct
+
+        ct_x = _match_vma(jnp.where(is_last, jnp.zeros_like(buf_ct), buf_ct),
+                          x_p)
+        ct_loss = _match_vma(
+            jnp.where(is_last & active_b, 1.0, 0.0).astype(loss_p.dtype),
+            loss_p)
+        g_params, g_x = pull((ct_x, ct_loss))
+        gacc = jax.tree.map(
+            lambda a, g: a + jnp.where(active_b, g, jnp.zeros_like(g)),
+            gacc, g_params)
+        loss_sum = loss_sum + jnp.where(active_b & is_last, loss_p, 0.0)
+
+        # ---- rotate: activations forward, cotangents backward
+        buf_fwd = jax.lax.ppermute(x_out, PP, fwd_perm) if S > 1 else x_out
+        ct_send = jnp.where(active_b, g_x, jnp.zeros_like(g_x))
+        buf_ct = (jax.lax.ppermute(ct_send, PP, bwd_perm)
+                  if S > 1 else ct_send)
+        return (buf_fwd, buf_ct, ring, gacc, loss_sum), None
+
+    act = jnp.zeros((mbs_local, seq, cfg.hidden), cfg.dtype)
+    carry0 = (
+        _varying(act),                       # buf_fwd
+        _varying(act),                       # buf_ct
+        _varying(jnp.zeros((R,) + act.shape, cfg.dtype)),  # ring
+        jax.tree.map(                        # gacc: local grad shards
+            lambda p: _varying(jnp.zeros_like(p, dtype=jnp.float32)), params),
+        _varying(jnp.zeros((), jnp.float32)),  # loss_sum
+    )
+    (_, _, _, gacc, loss_sum), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(ticks))
+
+    loss = jax.lax.psum(loss_sum, PP) / M
+    loss = jax.lax.pmean(loss, DP)
+    # grads: average over microbatches and dp; pipeline-replicated leaves
+    # (embed/head) live on one stage each — psum over pp rebuilds the
+    # replicated gradient (contributions elsewhere are exactly zero)
+    grads = jax.tree.map(lambda g: jax.lax.pmean(g / M, DP), gacc)
+    grads = {
+        "embed": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["embed"]),
+        "blocks": grads["blocks"],
+        "head": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["head"]),
+    }
+    return loss, grads
+
+
 def make_pipeline_train_step(
     cfg: GPTConfig,
     mesh: Mesh,
     num_microbatches: int,
     optimizer=None,
+    schedule: str = "gpipe",
 ):
-    """Jitted GPipe train step over a (pp, dp, tp) mesh.
+    """Jitted pipeline train step over a (pp, dp, tp) mesh.
+
+    ``schedule`` picks "gpipe" (forward scan + autodiff backward; activation
+    memory grows with the microbatch count) or "1f1b" (memory-bounded
+    one-forward-one-backward with stage-level rematerialization; peak
+    boundary activations O(pp) — the right choice when microbatch counts are
+    high and memory is tight).  Both produce identical losses and gradients
+    (pinned by the parity tests).
 
     Requires ``cfg.num_blocks %% pp == 0`` (uniform stages — the stacked
-    layer axis shards evenly; non-uniform stages are a planned extension).
+    layer axis shards evenly; non-uniform stages run on the multi-mesh
+    executor in ``execution.hetero``).
     Returns (init_fn, step_fn): ``init_fn(key) -> (params, opt_state)`` on
     mesh; ``step_fn(params, opt_state, tokens, targets) -> (params,
     opt_state, loss)`` with tokens/targets [gbs_local..., seq] already
@@ -194,19 +370,23 @@ def make_pipeline_train_step(
         raise ValueError(
             f"num_blocks={cfg.num_blocks} must divide evenly into pp={pp} "
             "stages for the uniform pipeline")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     optimizer = optimizer or optax.adamw(1e-4)
     specs = gpt_param_specs(cfg, tp_axis=TP, pp_axis=PP)
     data_spec = P(None, DP, None)  # [M, batch, seq]
-
-    loss_local = partial(_pipeline_loss_local, cfg=cfg)
 
     # With vma checking on, autodiff through the manual collectives (tp
     # psums, the pp loss psum, the dp pmean) transposes exactly: gradients
     # arrive correctly reduced over dp and correctly replicated over pp for
     # the pipeline-replicated embed/head leaves.  No manual grad collectives
     # — adding them double-counts (caught by the grad-parity test).
+    if schedule == "gpipe":
+        local = jax.value_and_grad(partial(_pipeline_loss_local, cfg=cfg))
+    else:
+        local = partial(_pipeline_1f1b_local, cfg=cfg)
     sharded_step = jax.shard_map(
-        jax.value_and_grad(loss_local), mesh=mesh,
+        local, mesh=mesh,
         in_specs=(specs, data_spec, data_spec),
         out_specs=(P(), specs),
     )

@@ -42,6 +42,15 @@ class TestRouting:
         first, second = _train_two_steps(exe, art.gbs)
         assert np.isfinite(first) and second < first
 
+    def test_pp2_1f1b_schedule_trains(self):
+        """schedule="1f1b" rides the same pipeline route and trains."""
+        art = PlanArtifact.from_uniform_plan(
+            UniformPlan(dp=2, pp=2, tp=2, mbs=2, gbs=8))
+        exe = build_executable(CFG, art, schedule="1f1b")
+        assert exe.kind == "pipeline"
+        first, second = _train_two_steps(exe, art.gbs)
+        assert np.isfinite(first) and second < first
+
     def test_pp2_with_zero_routes_hetero(self):
         """ZeRO under pipelining: the per-stage GSPMD executor delivers the
         state sharding the cost model credits (ADVICE r1 medium)."""
