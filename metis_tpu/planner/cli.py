@@ -49,6 +49,24 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
                    help="GQA KV heads (llama family; 0 = num_heads)")
 
 
+def _add_platform_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--platform", default=None, choices=("cpu", "tpu"),
+        help="pin the jax backend before first use (device-touching "
+             "subcommands only).  Plain JAX_PLATFORMS is not enough under "
+             "plugin backends that override it at import time; this sets "
+             "jax.config directly.  Use --platform cpu to collect CPU "
+             "fixtures or when the TPU is unreachable")
+
+
+def _pin_platform(args: argparse.Namespace) -> None:
+    platform = getattr(args, "platform", None)
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
 def _add_search_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("search")
     g.add_argument("--gbs", type=int, required=True)
@@ -165,6 +183,7 @@ def main(argv: list[str] | None = None) -> int:
                         help="comma-separated batch sizes to profile")
     p_prof.add_argument("--warmup", type=int, default=2)
     p_prof.add_argument("--iters", type=int, default=5)
+    _add_platform_arg(p_prof)
 
     p_cal = sub.add_parser(
         "calibrate", help="microbenchmark XLA collectives (+ single-chip "
@@ -176,6 +195,7 @@ def main(argv: list[str] | None = None) -> int:
     p_cal.add_argument("--chip-roofline", action="store_true",
                        help="also measure matmul TFLOP/s + HBM GB/s of one "
                             "chip (written next to --output as *.chip.json)")
+    _add_platform_arg(p_cal)
 
     p_val = sub.add_parser(
         "validate", help="predicted-vs-measured step time of the top uniform "
@@ -188,6 +208,7 @@ def main(argv: list[str] | None = None) -> int:
     p_val.add_argument("--validate-top-k", type=int, default=3)
     p_val.add_argument("--steps", type=int, default=5)
     p_val.add_argument("--warmup", type=int, default=2)
+    _add_platform_arg(p_val)
 
     p_rep = sub.add_parser(
         "replan", help="elastic re-plan on topology change: diff two cluster "
@@ -209,6 +230,7 @@ def main(argv: list[str] | None = None) -> int:
 
     args = parser.parse_args(argv)
 
+    _pin_platform(args)
     if args.command == "calibrate":
         return _cmd_calibrate(args)
     if args.command == "profile":

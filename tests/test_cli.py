@@ -97,8 +97,12 @@ def test_calibrate_subcommand(tmp_path):
 
 
 def test_profile_subcommand(tmp_path):
+    # --platform cpu pins the backend via jax.config (tests already run on
+    # cpu; this exercises the flag path plugin backends need, where plain
+    # JAX_PLATFORMS is overridden at import time)
     rc = main(["profile", *MODEL_ARGS, "--output-dir", str(tmp_path / "prof"),
-               "--tps", "1", "--bss", "1", "--warmup", "1", "--iters", "2"])
+               "--tps", "1", "--bss", "1", "--warmup", "1", "--iters", "2",
+               "--platform", "cpu"])
     assert rc == 0
     assert list((tmp_path / "prof").glob("*.json"))
 

@@ -102,6 +102,45 @@ def test_profiled_store_drives_planner(measured_store):
     assert result.best.cost.total_ms > 0
 
 
+def test_dispatch_overhead_cancellation(monkeypatch):
+    """The marginal pair isolates per-call dispatch overhead (2*t1 - t2,
+    cross-bounded by iso_block - marginal_block) and subtracts it from the
+    embed/head pseudo-layer measurements.  Stub the timer with exact values
+    to pin the arithmetic: embed=5, head=6, t1=3, t2=4, iso_block=3 gives
+    overhead min(2, 2)=2, block 1, adjusted embed 3 / head 4; with
+    full = 3 + 2*1 + 4 = 9 (TINY has 2 blocks) the rescale is exactly
+    1.0."""
+    import metis_tpu.profiles.profiler as prof_mod
+
+    # _profile_one consumes 6 timings (embed, head, t1, t2, iso_block,
+    # full); run() then measures optimizer and batch-gen
+    values = iter([5.0, 6.0, 3.0, 4.0, 3.0, 9.0, 7.0, 0.5])
+    monkeypatch.setattr(prof_mod, "_median_ms",
+                        lambda fn, args, w, it: next(values))
+    store = prof_mod.profile_model(
+        TINY, tps=(1,), bss=(1,),
+        config=ProfilerConfig(warmup=1, iters=1, marginal_blocks=True))
+    p = store.get(store.device_types[0], 1, 1)
+    assert p.layer_times_ms == pytest.approx((3.0, 1.0, 1.0, 4.0))
+
+
+def test_overhead_contained_on_noisy_marginal_pair(monkeypatch):
+    """A noise-compressed pair (t2 barely above t1) makes 2*t1 - t2 explode;
+    the independent iso_block - marginal_block bound contains it: t1=3.9,
+    t2=4.0, iso_block=1.5 gives overhead min(3.8, 1.4) = 1.4, not 3.8 —
+    embed 5 -> 3.6 and head 6 -> 4.6 instead of collapsing to the floor."""
+    import metis_tpu.profiles.profiler as prof_mod
+
+    values = iter([5.0, 6.0, 3.9, 4.0, 1.5, 8.4, 7.0, 0.5])
+    monkeypatch.setattr(prof_mod, "_median_ms",
+                        lambda fn, args, w, it: next(values))
+    store = prof_mod.profile_model(
+        TINY, tps=(1,), bss=(1,),
+        config=ProfilerConfig(warmup=1, iters=1, marginal_blocks=True))
+    p = store.get(store.device_types[0], 1, 1)
+    assert p.layer_times_ms == pytest.approx((3.6, 0.1, 0.1, 4.6))
+
+
 def test_marginal_block_measurement():
     """Marginal 2-vs-1-block scan timing produces positive block times and a
     smaller pseudo-layer share than the isolated-closure measurement at toy
