@@ -20,6 +20,7 @@ Policy parity notes (each mirrors a reference behavior):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from itertools import product
 from typing import Iterator, Protocol, Sequence
 
@@ -77,9 +78,26 @@ def initial_strategies(
     Returns None when no stage can actually take the requested axis
     (degenerate family — identical to a lower-degree search).
     """
+    # search-hot: the result depends only on the group sizes + axis degrees,
+    # which repeat across the thousands of inter-stage plans sharing a
+    # device-group composition — memoize on exactly those
+    return _initial_strategies(
+        plan.device_groups, cp,
+        None if cp_eligible is None else tuple(cp_eligible), ep, zero, sp)
+
+
+@lru_cache(maxsize=65536)
+def _initial_strategies(
+    device_groups: tuple[int, ...],
+    cp: int,
+    cp_eligible: tuple[bool, ...] | None,
+    ep: int,
+    zero: int,
+    sp: bool,
+) -> tuple[Strategy, ...] | None:
     out = []
     any_cp, any_ep, any_zero = False, False, False
-    for stage_id, g in enumerate(plan.device_groups):
+    for stage_id, g in enumerate(device_groups):
         eligible = cp_eligible is None or cp_eligible[stage_id]
         stage_cp = cp if (cp > 1 and eligible and g % cp == 0) else 1
         any_cp |= stage_cp > 1
@@ -100,19 +118,46 @@ def initial_strategies(
     return tuple(out)
 
 
+VALID, RETRY, DOOMED = "valid", "retry", "doomed"
+
+
+def classify_strategies(
+    plan: InterStagePlan,
+    strategies: Sequence[Strategy],
+    max_tp: int,
+    max_bs: int,
+) -> str:
+    """One scan, three outcomes for the search-hot escalation loop:
+
+    - ``VALID`` — every stage's microbatch is in [1, max_bs] and tp within
+      the profiled range (the reference validity rule, ``plan.py:238-249``);
+    - ``DOOMED`` — NO amount of further dp->tp escalation can reach
+      validity, so the family can stop early (observably identical to
+      escalating to exhaustion — the reference loop grinds on regardless,
+      ``plan.py:192-226``, but yields nothing on the way).  Escalation only
+      shrinks a stage's dp (growing its microbatch) and only grows its tp,
+      so a stage whose mbs already exceeds ``max_bs`` or whose tp exceeds
+      ``max_tp`` is unrecoverable;
+    - ``RETRY`` — invalid but recoverable (some stage's mbs == 0: halving
+      its dp grows the microbatch).
+    """
+    verdict = VALID
+    for s in strategies:
+        mbs = plan.gbs // s.dp // plan.batches
+        if mbs > max_bs or s.tp > max_tp:
+            return DOOMED
+        if mbs == 0:
+            verdict = RETRY
+    return verdict
+
+
 def strategies_valid(
     plan: InterStagePlan,
     strategies: Sequence[Strategy],
     max_tp: int,
     max_bs: int,
 ) -> bool:
-    for s in strategies:
-        mbs = plan.gbs // s.dp // plan.batches
-        if mbs == 0 or mbs > max_bs:
-            return False
-        if s.tp > max_tp:
-            return False
-    return True
+    return classify_strategies(plan, strategies, max_tp, max_bs) == VALID
 
 
 def escalate_dp_to_tp(
@@ -121,24 +166,28 @@ def escalate_dp_to_tp(
 ) -> tuple[Strategy, ...] | None:
     """Halve dp / double tp on the most memory-pressured stage that still has
     dp to give.  Returns None when no stage can escalate (search exhausted)."""
+    # search-hot (~1M calls/search): the full pressure ordering is only used
+    # to take the FIRST escalatable stage, so an O(n) stable argmin over the
+    # escalatable stages replaces the sort (+ its list allocations).
     # Truthiness (not `is not None`): an empty memory_state means "no per-stage
     # feedback", same as None — matches the reference guard (plan.py:252-255).
-    pressure = (
-        list(memory_state) if memory_state else [1.0 / s.dp for s in strategies]
-    )
-    # search-hot (~1M calls/search): bound __getitem__ beats a lambda key
-    order = sorted(range(len(strategies)), key=pressure.__getitem__)
-    out = list(strategies)
-    for stage_id in order:
-        s = out[stage_id]
+    best_id, best_p = -1, None
+    for stage_id, s in enumerate(strategies):
         # ep must keep dividing dp after the halving (ep rides inside dp)
-        if s.dp != 1 and (s.ep <= 1 or (s.dp // 2) % s.ep == 0):
-            # zero degenerates to 0 when no data ranks remain to shard over
-            new_zero = s.zero if (s.dp // 2) * s.cp > 1 else 0
-            out[stage_id] = Strategy(dp=s.dp // 2, tp=s.tp * 2, sp=s.sp,
-                                     cp=s.cp, ep=s.ep, zero=new_zero)
-            return tuple(out)
-    return None
+        if s.dp == 1 or (s.ep > 1 and (s.dp // 2) % s.ep != 0):
+            continue
+        p = memory_state[stage_id] if memory_state else 1.0 / s.dp
+        if best_p is None or p < best_p:  # strict <: stable ties by index
+            best_id, best_p = stage_id, p
+    if best_id < 0:
+        return None
+    out = list(strategies)
+    s = out[best_id]
+    # zero degenerates to 0 when no data ranks remain to shard over
+    new_zero = s.zero if (s.dp // 2) * s.cp > 1 else 0
+    out[best_id] = Strategy(dp=s.dp // 2, tp=s.tp * 2, sp=s.sp,
+                            cp=s.cp, ep=s.ep, zero=new_zero)
+    return tuple(out)
 
 
 def intra_stage_plans(
@@ -171,7 +220,10 @@ def intra_stage_plans(
         memory_state: tuple[float, ...] | None = None
 
         while strategies is not None:
-            if strategies_valid(plan, strategies, max_tp, max_bs):
+            verdict = classify_strategies(plan, strategies, max_tp, max_bs)
+            if verdict is DOOMED:
+                break
+            if verdict is VALID:
                 if capacity is None:
                     capacity = evaluator.memory_capacity(plan)
                 performance = evaluator.compute_performance(plan, strategies)
