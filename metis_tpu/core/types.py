@@ -48,6 +48,11 @@ class Strategy:
     # ZeRO stage (0 = replicated state, 1 = sharded optimizer, 2 = +grads,
     # 3 = +params/FSDP); state shards over the dp*cp data ranks (cost/zero.py)
     zero: int = 0
+    # context-parallel mode when cp > 1: "ring" (K/V rotation, ops/
+    # ring_attention) or "a2a" (Ulysses all-to-all head re-shard,
+    # ops/ulysses) — searched as separate families, priced by
+    # cost/context_parallel.cp_comm_ms
+    cp_mode: str = "ring"
 
     @property
     def devices(self) -> int:

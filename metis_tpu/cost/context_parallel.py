@@ -74,6 +74,46 @@ def cp_ring_ms(
     return nbytes / (bw_gbps * 1e6)
 
 
+def a2a_comm_bytes_per_layer(
+    model: ModelSpec, mbs: int, cp: int, tp: int
+) -> float:
+    """Un-overlapped Ulysses (all-to-all) wire bytes one device moves per
+    transformer layer per microbatch: 4 tensors re-shard each direction of
+    the forward (q, k, v in; context out) and their 4 gradients on the
+    backward; an all-to-all moves ``(cp-1)/cp`` of each local tensor of
+    ``mbs x S/cp x hidden/tp``.  Asymptotically ~cp x less traffic than the
+    ring's K/V rotation (``ring_comm_bytes_per_layer``) — the planner prices
+    both and picks per stage (``Strategy.cp_mode``)."""
+    if cp <= 1:
+        return 0.0
+    local = (
+        mbs
+        * (model.sequence_length // cp)
+        * (model.hidden_size // tp)
+        * model.dtype_bytes
+    )
+    return 8 * local * (cp - 1) / cp
+
+
+def cp_comm_ms(
+    model: ModelSpec,
+    mbs: int,
+    cp: int,
+    tp: int,
+    num_attn_layers: int,
+    bw_gbps: float,
+    mode: str = "ring",
+) -> float:
+    """Context-parallel comm time (ms) for one microbatch across a stage's
+    attention layers, for either cp mode ("ring" or "a2a")."""
+    if cp <= 1 or num_attn_layers <= 0:
+        return 0.0
+    per_layer = (
+        a2a_comm_bytes_per_layer(model, mbs, cp, tp) if mode == "a2a"
+        else ring_comm_bytes_per_layer(model, mbs, cp, tp))
+    return per_layer * num_attn_layers / (bw_gbps * 1e6)
+
+
 def attention_layer_range(model: ModelSpec, start: int, end: int) -> int:
     """How many layers in [start, end) are transformer blocks (ring attention
     runs only there; the embed (0) and head (L-1) pseudo-layers carry none)."""

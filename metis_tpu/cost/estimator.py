@@ -33,7 +33,7 @@ from metis_tpu.cost.bandwidth import (
     HomoScalarBandwidth,
     StageBandwidthModel,
 )
-from metis_tpu.cost.context_parallel import attention_layer_range, cp_ring_ms
+from metis_tpu.cost.context_parallel import attention_layer_range, cp_comm_ms
 from metis_tpu.cost.expert_parallel import (
     ep_a2a_ms,
     expert_param_fraction,
@@ -317,13 +317,15 @@ class HeteroCostEstimator(_EstimatorBase):
             cp_bw = None
             ring_ms = a2a_ms = 0.0
             if strat.cp > 1:
-                # Ring-attention K/V rotation extends the stage's critical
-                # path (un-overlapped model, cost/context_parallel.py).
+                # Context-parallel comm extends the stage's critical path
+                # (un-overlapped model, cost/context_parallel.py): the ring
+                # K/V rotation, or the Ulysses all-to-alls when the
+                # strategy's cp_mode is "a2a".
                 cp_bw = self._cp_bw(bandwidth, stage_id, strat)
-                ring_ms = cp_ring_ms(
+                ring_ms = cp_comm_ms(
                     self.volume.model, mbs, strat.cp, strat.tp,
                     attention_layer_range(self.volume.model, start_l, end_l),
-                    cp_bw)
+                    cp_bw, mode=strat.cp_mode)
                 stage_ms += ring_ms
             if strat.ep > 1:
                 # MoE token all-to-all rides the links of the dp sub-group

@@ -103,6 +103,7 @@ def build_executable(
         s.setdefault("ep", 1)
         s.setdefault("zero", 0)
         s.setdefault("sp", False)
+        s.setdefault("cp_mode", "ring")
     # uniform artifacts carry ONE strategy with pp encoded in the mesh shape
     # (PlanArtifact.from_uniform_plan); hetero artifacts carry one per stage
     if artifact.mesh_shape and PP in artifact.mesh_axes:
@@ -143,7 +144,8 @@ def _gspmd_executable(cfg, artifact, s0, devices, optimizer) -> Executable:
 
     step = make_train_step(
         cfg, mesh, optimizer=optimizer, seq_axis=seq_axis, dp_axis=dp_axis,
-        megatron_sp=bool(s0["sp"]), tp_axis=TP)
+        megatron_sp=bool(s0["sp"]), tp_axis=TP,
+        cp_mode=s0.get("cp_mode", "ring"))
     return Executable(kind="gspmd", init=init, step=step)
 
 

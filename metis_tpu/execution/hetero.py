@@ -71,7 +71,8 @@ class StageSpec:
     tp: int
     zero: int = 0
     ep: int = 1  # expert parallelism rides inside dp (MoE stages only)
-    cp: int = 1  # context parallelism: ring attention over a dedicated axis
+    cp: int = 1  # context parallelism over a dedicated axis
+    cp_mode: str = "ring"  # "ring" (K/V rotation) or "a2a" (Ulysses)
     replica_rows: tuple[int, ...] | None = None
 
     @property
@@ -112,9 +113,11 @@ def stage_specs_from_plan(
             dp, tp = strat["dp"], strat["tp"]
             zero = strat.get("zero", 0)
             cp, ep = strat.get("cp", 1), strat.get("ep", 1)
+            cp_mode = strat.get("cp_mode", "ring")
         else:
             dp, tp, zero = strat.dp, strat.tp, strat.zero
             cp, ep = strat.cp, strat.ep
+            cp_mode = strat.cp_mode
         is_moe = isinstance(cfg, MoEConfig)
         if cp > 1 and is_moe:
             raise NotImplementedError(
@@ -140,7 +143,8 @@ def stage_specs_from_plan(
             blocks=(max(lo - 1, 0), min(hi - 1, cfg.num_blocks)),
             has_embed=lo == 0,
             has_head=hi == n_profile,
-            dp=dp, tp=tp, zero=zero, ep=ep, cp=cp, replica_rows=rows))
+            dp=dp, tp=tp, zero=zero, ep=ep, cp=cp, cp_mode=cp_mode,
+            replica_rows=rows))
     return tuple(out)
 
 
@@ -303,11 +307,18 @@ def make_hetero_train_step(
     for i, s in enumerate(stages):
         stage_attn = attn
         if s.cp > 1:
-            # ring attention over the stage's dedicated sp axis; positions
-            # stay global (embed/rope run on the GSPMD-global array)
-            from metis_tpu.ops.ring_attention import make_ring_attention
+            # context parallelism over the stage's dedicated sp axis;
+            # positions stay global (embed/rope run on the GSPMD-global
+            # array).  Mode per the plan: ring K/V rotation or Ulysses a2a.
+            if s.cp_mode == "a2a":
+                from metis_tpu.ops.ulysses import make_ulysses_attention
 
-            stage_attn = make_ring_attention(meshes[i], SP)
+                stage_attn = make_ulysses_attention(
+                    meshes[i], SP, head_axes=(TP,))
+            else:
+                from metis_tpu.ops.ring_attention import make_ring_attention
+
+                stage_attn = make_ring_attention(meshes[i], SP)
         fns.append(_make_stage_fn(s, cfg, stage_attn, aux_weight=aux_w[i]))
 
     def _in_mesh(mesh: Mesh, fn):

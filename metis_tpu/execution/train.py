@@ -192,19 +192,28 @@ def make_train_step(
     dp_axis: str = DP,
     megatron_sp: bool = False,
     tp_axis: str = TP,
+    cp_mode: str = "ring",
 ) -> Callable:
     """Jitted (state, tokens, targets) -> (state, loss).
 
-    ``seq_axis``: shard the sequence over this mesh axis with ring attention
-    (context parallelism).  Without it, full attention runs locally and tp
-    sharding is handled entirely by GSPMD.  ``megatron_sp`` sequence-shards
-    the residual stream over ``tp_axis`` (Megatron sequence parallelism: the
-    non-matmul regions' activations divide by tp; XLA turns the TP
-    all-reduces into reduce-scatter + all-gather pairs around them).
+    ``seq_axis``: shard the sequence over this mesh axis (context
+    parallelism) — ``cp_mode`` picks ring attention (K/V rotation) or the
+    Ulysses all-to-all head re-shard ("a2a", ops/ulysses.py).  Without it,
+    full attention runs locally and tp sharding is handled entirely by
+    GSPMD.  ``megatron_sp`` sequence-shards the residual stream over
+    ``tp_axis`` (Megatron sequence parallelism: the non-matmul regions'
+    activations divide by tp; XLA turns the TP all-reduces into
+    reduce-scatter + all-gather pairs around them).
     """
     optimizer = optimizer or build_optimizer()
     if seq_axis is not None and attn_impl is None:
-        attn_impl = make_ring_attention(mesh, seq_axis)
+        if cp_mode == "a2a":
+            from metis_tpu.ops.ulysses import make_ulysses_attention
+
+            attn_impl = make_ulysses_attention(
+                mesh, seq_axis, head_axes=(tp_axis,))
+        else:
+            attn_impl = make_ring_attention(mesh, seq_axis)
 
     tok_sharding = NamedSharding(mesh, batch_spec(dp_axis, seq_axis))
 

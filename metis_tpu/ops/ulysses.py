@@ -1,0 +1,75 @@
+"""Ulysses (all-to-all) sequence parallelism — the second long-context mode.
+
+Net-new TPU capability (the reference has no sequence/context parallelism
+anywhere — SURVEY.md §2.2/§5 "Long-context"; the module complements
+:mod:`metis_tpu.ops.ring_attention`): instead of rotating K/V blocks around
+a ring, the sequence-sharded q/k/v are re-sharded **head-wise** for the
+attention — each device then holds the FULL sequence for a subset of heads,
+runs unmodified causal attention (dense or the pallas flash kernel, full
+MXU-sized matmuls), and the context re-shards back to sequence-sharded.
+
+The two re-shards are exactly XLA all-to-alls over the sequence axis, and
+this is expressed GSPMD-first: two ``with_sharding_constraint`` calls, XLA
+inserts the collectives (no shard_map, no manual ppermute).  Wire cost per
+device is ``(sp-1)/sp`` of each tensor — asymptotically ~sp× less traffic
+than the ring's ``(sp-1)``-step K/V rotation — at the price of a head-count
+ceiling (efficient only while ``num_heads % (tp * sp) == 0``; GSPMD pads
+otherwise).  The planner prices both modes and picks per stage
+(``cost/context_parallel.py``, ``Strategy.cp_mode``).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    seq_axis: str,
+    head_axes: tuple[str, ...] = (),
+    inner=None,
+):
+    """A drop-in AttnFn (q, k, v -> context, [b, h, s, d]) running Ulysses
+    sequence parallelism over ``seq_axis`` of ``mesh``.
+
+    ``head_axes``: mesh axes the head dim is ALREADY sharded over (Megatron
+    tp) — the attention-time constraint shards heads over
+    ``(*head_axes, seq_axis)`` so tp sharding is preserved rather than
+    gathered.  ``inner`` is the full-sequence attention body; defaults to
+    the pallas flash kernel on TPU meshes and dense causal attention
+    elsewhere.
+    """
+    if inner is None:
+        if mesh.devices.flat[0].platform == "tpu":
+            from metis_tpu.ops.flash_attention import flash_attn_fn
+
+            inner = flash_attn_fn()
+        else:
+            from metis_tpu.models.gpt import causal_attention
+
+            inner = causal_attention
+
+    axes = tuple(a for a in head_axes if a in mesh.axis_names)
+    # Only the head/seq dims are pinned; batch and head_dim stay
+    # UNCONSTRAINED so GSPMD keeps whatever dp (or other) sharding the
+    # surrounding step put there — a None (= replicated) batch dim would
+    # force a full batch all-gather over dp and dp-fold redundant attention
+    # compute.  Sharding (*axes, seq_axis) onto heads necessarily removes
+    # seq_axis from the sequence dim (an axis shards one dim at a time), so
+    # each device sees the full sequence at attention time.
+    U = P.UNCONSTRAINED
+    heads_sharded = NamedSharding(mesh, P(U, (*axes, seq_axis), U, U))
+    seq_sharded = NamedSharding(
+        mesh, P(U, axes if axes else U, seq_axis, U))
+    constrain = jax.lax.with_sharding_constraint
+
+    def attn(q, k, v):
+        # all-to-all in: trade the sequence shards for head shards
+        q = constrain(q, heads_sharded)
+        k = constrain(k, heads_sharded)
+        v = constrain(v, heads_sharded)
+        ctx = inner(q, k, v)
+        # all-to-all out: back to the surrounding sequence-sharded layout
+        return constrain(ctx, seq_sharded)
+
+    return attn

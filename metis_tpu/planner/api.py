@@ -101,9 +101,16 @@ def plan_hetero(
     # Context-/expert-parallel families (net-new vs the reference,
     # SURVEY.md §5): degree 1 is always searched; higher powers of two join
     # when enabled and the sequence/expert count divides evenly.
-    cp_degrees: list[int] = [1]
+    # cp families carry (degree, mode): every degree > 1 searches the ring
+    # K/V-rotation mode, plus the Ulysses all-to-all mode when the head
+    # count splits evenly over the cp axis (ops/ulysses.py; with uneven
+    # heads GSPMD pads, so a2a is searched only where it is efficient)
+    cp_families: list[tuple[int, str]] = [(1, "ring")]
     if config.enable_cp and not config.strict_compat:
-        cp_degrees += cp_candidates(config.max_cp_degree, model.sequence_length)
+        for d in cp_candidates(config.max_cp_degree, model.sequence_length):
+            cp_families.append((d, "ring"))
+            if model.num_heads % d == 0:
+                cp_families.append((d, "a2a"))
     ep_degrees: list[int] = [1]
     if config.enable_ep and not config.strict_compat:
         ep_degrees += ep_candidates(config.max_ep_degree, model.num_experts)
@@ -112,7 +119,7 @@ def plan_hetero(
     sp_variants = ((False, True)
                    if config.enable_sp and not config.strict_compat
                    else (False,))
-    families = list(product(cp_degrees, ep_degrees, zero_stages, sp_variants))
+    families = list(product(cp_families, ep_degrees, zero_stages, sp_variants))
     events.emit(
         "search_started", mode="hetero", devices=cluster.total_devices,
         device_types=list(cluster.device_types), gbs=config.gbs,
@@ -132,7 +139,7 @@ def plan_hetero(
             pruned += 1
             continue
         cp_eligible = None
-        if len(cp_degrees) > 1:
+        if len(cp_families) > 1:
             # Ring attention needs uniform block timing: only homogeneous
             # stages take the cp axis.  One placement resolve per inter plan.
             ranks = rank_device_types(cluster, inter.node_sequence)
@@ -142,7 +149,7 @@ def plan_hetero(
             ]
         # one try-block per (cp, ep, zero, sp) family: a profile miss
         # mid-generation prunes only that family, not its siblings
-        for cp, ep, zero, sp in families:
+        for (cp, cp_mode), ep, zero, sp in families:
             try:
                 for intra in intra_stage_plans(
                     inter, evaluator, balancer,
@@ -150,7 +157,8 @@ def plan_hetero(
                     max_bs=config.max_profiled_bs,
                     cp_degrees=(cp,), cp_eligible=cp_eligible,
                     ep_degrees=(ep,), zero_stages=(zero,),
-                    sp_variants=(sp,),
+                    sp_variants=(sp,), cp_modes=(cp_mode,),
+                    num_heads=model.num_heads,
                 ):
                     try:
                         cost = estimator.get_cost(

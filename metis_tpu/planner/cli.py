@@ -77,7 +77,8 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--strict-compat", action="store_true",
                    help="reproduce reference cost-model quirks bit-for-bit")
     g.add_argument("--enable-cp", action="store_true",
-                   help="search context-parallel (ring attention) plan families")
+                   help="search context-parallel plan families (ring "
+                        "attention AND Ulysses all-to-all, ranked per stage)")
     g.add_argument("--max-cp", type=int, default=4,
                    help="largest context-parallel degree to search")
     g.add_argument("--enable-ep", action="store_true",

@@ -67,6 +67,7 @@ def initial_strategies(
     ep: int = 1,
     zero: int = 0,
     sp: bool = False,
+    cp_mode: str = "ring",
 ) -> tuple[Strategy, ...] | None:
     """Every stage starts fully data-parallel (``plan.py:231-236``).
 
@@ -83,7 +84,8 @@ def initial_strategies(
     # device-group composition — memoize on exactly those
     return _initial_strategies(
         plan.device_groups, cp,
-        None if cp_eligible is None else tuple(cp_eligible), ep, zero, sp)
+        None if cp_eligible is None else tuple(cp_eligible), ep, zero, sp,
+        cp_mode)
 
 
 @lru_cache(maxsize=65536)
@@ -94,6 +96,7 @@ def _initial_strategies(
     ep: int,
     zero: int,
     sp: bool,
+    cp_mode: str = "ring",
 ) -> tuple[Strategy, ...] | None:
     out = []
     any_cp, any_ep, any_zero = False, False, False
@@ -108,7 +111,8 @@ def _initial_strategies(
         stage_zero = zero if dp * stage_cp > 1 else 0
         any_zero |= stage_zero > 0
         out.append(Strategy(dp=dp, tp=1, sp=sp, cp=stage_cp, ep=stage_ep,
-                            zero=stage_zero))
+                            zero=stage_zero,
+                            cp_mode=cp_mode if stage_cp > 1 else "ring"))
     if cp > 1 and not any_cp:
         return None
     if ep > 1 and not any_ep:
@@ -126,6 +130,7 @@ def classify_strategies(
     strategies: Sequence[Strategy],
     max_tp: int,
     max_bs: int,
+    num_heads: int | None = None,
 ) -> str:
     """One scan, three outcomes for the search-hot escalation loop:
 
@@ -137,7 +142,11 @@ def classify_strategies(
       ``plan.py:192-226``, but yields nothing on the way).  Escalation only
       shrinks a stage's dp (growing its microbatch) and only grows its tp,
       so a stage whose mbs already exceeds ``max_bs`` or whose tp exceeds
-      ``max_tp`` is unrecoverable;
+      ``max_tp`` is unrecoverable.  With ``num_heads`` given, an a2a cp
+      stage whose heads don't split evenly over ``tp * cp`` is also doom:
+      both factors are powers of two, so once ``2^k`` stops dividing the
+      head count no further doubling recovers — and the a2a cost/execution
+      path assumes even head splits (no padding term, ``ops/ulysses.py``);
     - ``RETRY`` — invalid but recoverable (some stage's mbs == 0: halving
       its dp grows the microbatch).
     """
@@ -145,6 +154,9 @@ def classify_strategies(
     for s in strategies:
         mbs = plan.gbs // s.dp // plan.batches
         if mbs > max_bs or s.tp > max_tp:
+            return DOOMED
+        if (num_heads is not None and s.cp > 1 and s.cp_mode == "a2a"
+                and num_heads % (s.tp * s.cp) != 0):
             return DOOMED
         if mbs == 0:
             verdict = RETRY
@@ -186,7 +198,8 @@ def escalate_dp_to_tp(
     # zero degenerates to 0 when no data ranks remain to shard over
     new_zero = s.zero if (s.dp // 2) * s.cp > 1 else 0
     out[best_id] = Strategy(dp=s.dp // 2, tp=s.tp * 2, sp=s.sp,
-                            cp=s.cp, ep=s.ep, zero=new_zero)
+                            cp=s.cp, ep=s.ep, zero=new_zero,
+                            cp_mode=s.cp_mode)
     return tuple(out)
 
 
@@ -201,6 +214,8 @@ def intra_stage_plans(
     ep_degrees: Sequence[int] = (1,),
     zero_stages: Sequence[int] = (0,),
     sp_variants: Sequence[bool] = (False,),
+    cp_modes: Sequence[str] = ("ring",),
+    num_heads: int | None = None,
 ) -> Iterator[IntraStagePlan]:
     """Yield feasible intra-stage plans for one inter-stage candidate.
 
@@ -214,13 +229,18 @@ def intra_stage_plans(
     keeps escalating toward tp>1 shapes where sp actually pays.
     """
     capacity: list[float] | None = None  # strategy-independent; resolve once
-    for cp, ep, zero, sp in product(cp_degrees, ep_degrees, zero_stages,
-                                    sp_variants):
-        strategies = initial_strategies(plan, cp, cp_eligible, ep, zero, sp)
+    for cp, ep, zero, sp, cp_mode in product(cp_degrees, ep_degrees,
+                                             zero_stages, sp_variants,
+                                             cp_modes):
+        if cp == 1 and cp_mode != "ring":
+            continue  # mode is meaningless without a cp axis; skip duplicates
+        strategies = initial_strategies(plan, cp, cp_eligible, ep, zero, sp,
+                                        cp_mode)
         memory_state: tuple[float, ...] | None = None
 
         while strategies is not None:
-            verdict = classify_strategies(plan, strategies, max_tp, max_bs)
+            verdict = classify_strategies(plan, strategies, max_tp, max_bs,
+                                          num_heads)
             if verdict is DOOMED:
                 break
             if verdict is VALID:

@@ -98,6 +98,21 @@ class TestRouting:
         assert np.isfinite(first) and second < first
 
 
+    def test_a2a_cp_plan_routes_gspmd_with_ulysses(self):
+        """cp_mode="a2a" in the artifact reaches the Ulysses attention path
+        and still trains."""
+        art = PlanArtifact(
+            mesh_axes=(PP, DP, "ep", SP, TP), mesh_shape=(1, 2, 1, 2, 2),
+            layer_partition=(),
+            strategies=(
+                {"dp": 2, "tp": 2, "cp": 2, "ep": 1, "cp_mode": "a2a"},),
+            gbs=4, microbatches=1)
+        exe = build_executable(CFG, art)
+        assert exe.kind == "gspmd"
+        first, second = _train_two_steps(exe, art.gbs)
+        assert np.isfinite(first) and second < first
+
+
 class TestZeroStateSharding:
     def test_zero1_shards_opt_state_not_params(self):
         mesh = mesh_dp_tp(4, 2, jax.devices()[:8])

@@ -8,8 +8,10 @@ from metis_tpu.core.config import ModelSpec, SearchConfig
 from metis_tpu.core.types import InterStagePlan, Strategy
 from metis_tpu.cost.context_parallel import (
     ActivationSplitModel,
+    a2a_comm_bytes_per_layer,
     attention_layer_range,
     cp_candidates,
+    cp_comm_ms,
     cp_ring_ms,
     ring_comm_bytes_per_layer,
 )
@@ -147,6 +149,60 @@ class TestCpCostEstimation:
             cluster, profiles, model, (Strategy(dp=4, tp=1, cp=2),),
             bandwidth=lambda p: IciDcnBandwidth(tpu, p))
         assert cost.cp_comm_ms > 0
+
+
+class TestUlyssesMode:
+    def test_a2a_moves_less_than_ring(self, model):
+        """Ulysses traffic scales (cp-1)/cp vs the ring's (cp-1): a2a must
+        be strictly cheaper per layer at every cp > 1, by a growing factor."""
+        for cp in (2, 4, 8):
+            ring = ring_comm_bytes_per_layer(model, mbs=4, cp=cp, tp=1)
+            a2a = a2a_comm_bytes_per_layer(model, mbs=4, cp=cp, tp=1)
+            assert 0 < a2a < ring
+        # exact: 8 tensors of mbs*(S/cp)*h bytes, (cp-1)/cp wire fraction
+        assert a2a_comm_bytes_per_layer(model, 4, 4, 1) == pytest.approx(
+            8 * 4 * (model.sequence_length // 4) * model.hidden_size
+            * model.dtype_bytes * 3 / 4)
+
+    def test_cp_comm_ms_dispatches_on_mode(self, model):
+        ring = cp_comm_ms(model, 4, 4, 1, 8, 100.0, mode="ring")
+        a2a = cp_comm_ms(model, 4, 4, 1, 8, 100.0, mode="a2a")
+        assert ring == cp_ring_ms(model, 4, 4, 1, 8, 100.0)
+        assert 0 < a2a < ring
+
+    def test_estimator_prices_a2a_below_ring(self, cluster, profiles, model):
+        volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
+        est = HeteroCostEstimator(
+            cluster, profiles, volume, EstimatorOptions(), None)
+        plan = InterStagePlan(
+            node_sequence=("tpu_v5e",), device_groups=(8,), batches=4, gbs=32)
+        part = (0, model.num_layers)
+        ring = est.get_cost(plan, (Strategy(dp=4, tp=1, cp=2),), part)
+        a2a = est.get_cost(
+            plan, (Strategy(dp=4, tp=1, cp=2, cp_mode="a2a"),), part)
+        assert 0 < a2a.cp_comm_ms < ring.cp_comm_ms
+        assert a2a.total_ms < ring.total_ms
+
+    def test_search_yields_both_modes_and_prefers_a2a(
+            self, cluster, profiles, model):
+        """With heads % cp == 0 both modes are searched; identical compute +
+        cheaper comm must rank the a2a family above its ring twin."""
+        cfg = SearchConfig(gbs=32, enable_cp=True, max_cp_degree=4)
+        result = plan_hetero(cluster, profiles, model, cfg, top_k=None)
+        modes = {(s.cp, s.cp_mode) for p in result.plans
+                 for s in p.intra.strategies if s.cp > 1}
+        assert any(m == "a2a" for _, m in modes)
+        assert any(m == "ring" for _, m in modes)
+        by_key = {}
+        for p in result.plans:
+            s = p.intra.strategies[0]
+            if s.cp > 1 and len(p.intra.strategies) == 1:
+                key = (p.inter.device_groups, p.inter.batches,
+                       s.dp, s.tp, s.cp)
+                by_key.setdefault(key, {})[s.cp_mode] = p.cost.total_ms
+        paired = [v for v in by_key.values() if len(v) == 2]
+        assert paired, "no ring/a2a twin plans found"
+        assert all(v["a2a"] < v["ring"] for v in paired)
 
 
 class TestCpSearch:

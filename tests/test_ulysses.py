@@ -1,0 +1,119 @@
+"""Ulysses (all-to-all) sequence parallelism: numeric parity with dense
+causal attention on the virtual CPU mesh (SURVEY.md §5 race detection:
+parity of sharded vs single-device is the correctness check)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metis_tpu.execution.mesh import DP, SP, TP
+from metis_tpu.models.gpt import causal_attention
+from metis_tpu.ops.ulysses import make_ulysses_attention
+
+B, H, S, D = 2, 8, 32, 16
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return tuple(jax.random.normal(k, (B, H, S, D), jnp.float32) for k in ks)
+
+
+def _mesh(shape, axes):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, axes)
+
+
+def test_forward_matches_dense(qkv):
+    q, k, v = qkv
+    expected = causal_attention(q, k, v)
+
+    mesh = _mesh((2, 4), (DP, SP))
+    attn = make_ulysses_attention(mesh, SP)
+    seq_sharded = NamedSharding(mesh, P(DP, None, SP, None))
+    args = [jax.device_put(t, seq_sharded) for t in (q, k, v)]
+    with mesh:
+        got = jax.jit(attn, out_shardings=None)(*args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+    # the constraints leave batch UNCONSTRAINED: dp sharding must survive
+    # (a replicated batch would mean a dp-wide all-gather inside attention)
+    assert got.sharding.spec[0] == DP
+
+
+def test_grads_match_dense(qkv):
+    q, k, v = qkv
+    loss_ref = lambda q, k, v: causal_attention(q, k, v).sum()  # noqa: E731
+    ref_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    mesh = _mesh((2, 4), (DP, SP))
+    attn = make_ulysses_attention(mesh, SP)
+    loss = lambda q, k, v: attn(q, k, v).sum()  # noqa: E731
+    seq_sharded = NamedSharding(mesh, P(None, None, SP, None))
+    args = [jax.device_put(t, seq_sharded) for t in (q, k, v)]
+    with mesh:
+        got = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(*args)
+    for g, rg in zip(got, ref_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_train_step_a2a_loss_matches_single_device():
+    """make_train_step(cp_mode="a2a") — the GSPMD step with Ulysses
+    attention must reproduce the single-device loss (the executed
+    counterpart of a Strategy(cp>1, cp_mode="a2a") plan)."""
+    from metis_tpu.execution import build_train_state, make_train_step
+    from metis_tpu.models import GPTConfig, init_params, next_token_loss
+
+    cfg = GPTConfig(vocab_size=128, seq_len=32, hidden=64, num_heads=4,
+                    num_blocks=2, ffn_multiplier=2, dtype=jnp.float32)
+    del init_params
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, cfg.seq_len), 0,
+                                cfg.vocab_size)
+
+    mesh = _mesh((2, 4), (DP, SP))
+    state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh,
+                                 tp_axis=None)
+    expected = float(next_token_loss(
+        jax.device_get(state.params), tokens, tokens, cfg))
+    step = make_train_step(cfg, mesh, seq_axis=SP, cp_mode="a2a")
+    _, loss = step(state, tokens, tokens)
+    assert float(loss) == pytest.approx(expected, rel=1e-4)
+
+
+def test_a2a_family_doomed_when_heads_stop_dividing():
+    """Escalation doubles tp while keeping cp_mode; once num_heads stops
+    dividing tp*cp the a2a stage is unrecoverable (powers of two) and the
+    family must classify as doomed — the cost/execution path assumes even
+    head splits."""
+    from metis_tpu.core.types import InterStagePlan, Strategy
+    from metis_tpu.search.intra_stage import DOOMED, VALID, classify_strategies
+
+    plan = InterStagePlan(node_sequence=("x",), device_groups=(8,),
+                          batches=2, gbs=32)
+    ok = (Strategy(dp=2, tp=2, cp=2, cp_mode="a2a"),)
+    bad = (Strategy(dp=1, tp=4, cp=2, cp_mode="a2a"),)
+    assert classify_strategies(plan, ok, 8, 16, num_heads=12) is VALID
+    assert classify_strategies(plan, bad, 8, 16, num_heads=12) is DOOMED
+    # ring mode has no head ceiling
+    ring = (Strategy(dp=1, tp=4, cp=2, cp_mode="ring"),)
+    assert classify_strategies(plan, ring, 8, 16, num_heads=12) is VALID
+    # without model knowledge the check is off (legacy callers)
+    assert classify_strategies(plan, bad, 8, 16) is VALID
+
+
+def test_composes_with_tp_head_sharding(qkv):
+    """With a tp axis already sharding heads, the attention-time constraint
+    shards heads over (tp, sp) — tp sharding is preserved, output matches."""
+    q, k, v = qkv
+    expected = causal_attention(q, k, v)
+
+    mesh = _mesh((2, 2, 2), (DP, TP, SP))
+    attn = make_ulysses_attention(mesh, SP, head_axes=(TP,))
+    spec = NamedSharding(mesh, P(None, TP, SP, None))
+    args = [jax.device_put(t, spec) for t in (q, k, v)]
+    with mesh:
+        got = jax.jit(attn)(*args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
