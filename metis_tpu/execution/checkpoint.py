@@ -74,12 +74,48 @@ def save_checkpoint(
     crash leaves the primary missing — at every instant one complete
     checkpoint is on disk.  Synchronous — returns when the swap is done."""
     directory = Path(directory).absolute()
+    # Multi-host: the tmp-dir (re)creation, the meta/plan writes, and the
+    # final swap are plain filesystem surgery on the shared directory — one
+    # host performs each, fenced by barriers (no host enters the orbax save
+    # before tmp exists; none returns mid-swap).  Ordering invariant: never
+    # delete the only complete checkpoint — .prev is cleared early only when
+    # the primary exists (to make room for the park), and cleared finally
+    # only after the new primary is in place.
+    tmp, prev, multi_host = _prepare_tmp(directory)
+    tree = _state_tree(state)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(tmp / _STATE_DIR, tree, force=True)
+    _write_meta_and_plan(tmp, state, mesh, plan)
+    _swap_tmp_into_place(directory, tmp, prev, multi_host)
+    return directory
+
+
+def _state_tree(state: TrainState) -> dict:
+    """THE serialized schema — shared by the sync and async writers so the
+    restore path always matches."""
+    return {"params": state.params, "opt_state": state.opt_state,
+            "step": state.step}
+
+
+def _write_meta_and_plan(tmp: Path, state: TrainState, mesh: Mesh,
+                         plan: PlanArtifact | None) -> None:
+    if jax.process_index() != 0:
+        return
+    meta = CheckpointMeta(
+        step=int(state.step),
+        mesh_axes=tuple(mesh.axis_names),
+        mesh_shape=tuple(mesh.devices.shape),
+    )
+    (tmp / _META_FILE).write_text(meta.to_json())
+    if plan is not None:
+        (tmp / _PLAN_FILE).write_text(plan.to_json())
+
+
+def _prepare_tmp(directory: Path) -> tuple[Path, Path, bool]:
+    """(tmp, prev, multi_host) with tmp freshly (re)created and all hosts
+    fenced behind its existence."""
     tmp = directory.with_name(directory.name + ".tmp")
     prev = directory.with_name(directory.name + ".prev")
-    # Multi-host: the tmp-dir (re)creation and the meta/plan writes are plain
-    # filesystem surgery on the shared directory — one host performs them,
-    # fenced so no host enters the orbax save (which writes shards into tmp
-    # from every host) before the directory exists.
     multi_host = jax.process_count() > 1
     if jax.process_index() == 0:
         if tmp.exists():
@@ -89,28 +125,13 @@ def save_checkpoint(
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("metis_ckpt_tmp_ready")
+    return tmp, prev, multi_host
 
-    tree = {"params": state.params, "opt_state": state.opt_state,
-            "step": state.step}
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(tmp / _STATE_DIR, tree, force=True)
-    if jax.process_index() == 0:
-        meta = CheckpointMeta(
-            step=int(state.step),
-            mesh_axes=tuple(mesh.axis_names),
-            mesh_shape=tuple(mesh.devices.shape),
-        )
-        (tmp / _META_FILE).write_text(meta.to_json())
-        if plan is not None:
-            (tmp / _PLAN_FILE).write_text(plan.to_json())
 
-    # Ordering invariant: never delete the only complete checkpoint — .prev
-    # is cleared early only when the primary exists (to make room for the
-    # park), and cleared finally only after the new primary is in place.
-    # Multi-host: orbax's save above is multi-host coordinated, but the swap
-    # is plain filesystem surgery on a shared directory — exactly one host
-    # performs it, fenced by barriers so no host returns (and possibly
-    # restores) mid-swap.
+def _swap_tmp_into_place(directory: Path, tmp: Path, prev: Path,
+                         multi_host: bool) -> None:
+    """The crash-safe primary swap (see ``save_checkpoint`` ordering
+    invariant); fenced so no host returns mid-swap."""
     if multi_host:
         from jax.experimental import multihost_utils
 
@@ -127,7 +148,66 @@ def save_checkpoint(
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("metis_ckpt_post_swap")
-    return directory
+
+
+class AsyncCheckpointWriter:
+    """Checkpoint writes overlapped with training.
+
+    ``save`` snapshots the state with orbax's ``AsyncCheckpointer`` (device
+    arrays are copied out, serialization runs on background threads) and
+    returns immediately; the crash-safe ``.tmp``/``.prev`` swap of
+    ``save_checkpoint`` is deferred until the write completes — performed by
+    ``wait()``, or automatically at the start of the next ``save``.  Until a
+    pending write is swapped, the previous complete checkpoint remains the
+    primary, so a crash mid-write loses at most the in-flight checkpoint.
+
+    Usage::
+
+        writer = AsyncCheckpointWriter()
+        for step in ...:
+            state, loss = train_step(state, ...)
+            if step % interval == 0:
+                writer.save(ckpt_dir, state, mesh, plan)  # non-blocking
+        writer.close()                                    # flush + swap
+    """
+
+    def __init__(self):
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        self._pending: tuple[Path, Path, Path, bool] | None = None
+
+    def save(
+        self,
+        directory: str | Path,
+        state: TrainState,
+        mesh: Mesh,
+        plan: PlanArtifact | None = None,
+    ) -> None:
+        self.wait()  # finish + swap any previous write first
+        directory = Path(directory).absolute()
+        tmp, prev, multi_host = _prepare_tmp(directory)
+        self._ckptr.save(tmp / _STATE_DIR, _state_tree(state), force=True)
+        _write_meta_and_plan(tmp, state, mesh, plan)
+        self._pending = (directory, tmp, prev, multi_host)
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) is durable and swapped
+        into place as the primary checkpoint."""
+        if self._pending is None:
+            return
+        self._ckptr.wait_until_finished()
+        directory, tmp, prev, multi_host = self._pending
+        self._pending = None
+        _swap_tmp_into_place(directory, tmp, prev, multi_host)
+
+    def close(self) -> None:
+        self.wait()
+        self._ckptr.close()
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def _resolve_dir(directory: str | Path) -> Path:
@@ -160,9 +240,7 @@ def restore_checkpoint(
     with ``build_train_state`` on the *target* mesh — which may differ from
     the mesh the checkpoint was written on; orbax reshards on read)."""
     directory = _resolve_dir(directory)
-    ref = {"params": reference_state.params,
-           "opt_state": reference_state.opt_state,
-           "step": reference_state.step}
+    ref = _state_tree(reference_state)
 
     def as_restore(leaf):
         if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding") and \

@@ -104,12 +104,18 @@ def plan_hetero(
     # cp families carry (degree, mode): every degree > 1 searches the ring
     # K/V-rotation mode, plus the Ulysses all-to-all mode when the head
     # count splits evenly over the cp axis (ops/ulysses.py; with uneven
-    # heads GSPMD pads, so a2a is searched only where it is efficient)
+    # heads GSPMD pads, so a2a is searched only where it is efficient).
+    # GQA: K/V carry num_kv_heads heads, so the a2a head split must divide
+    # BOTH counts — equivalently their gcd (d | nh and d | kv <=> d | gcd).
+    import math
+
+    a2a_head_limit = math.gcd(
+        model.num_heads, model.num_kv_heads or model.num_heads)
     cp_families: list[tuple[int, str]] = [(1, "ring")]
     if config.enable_cp and not config.strict_compat:
         for d in cp_candidates(config.max_cp_degree, model.sequence_length):
             cp_families.append((d, "ring"))
-            if model.num_heads % d == 0:
+            if a2a_head_limit % d == 0:
                 cp_families.append((d, "a2a"))
     ep_degrees: list[int] = [1]
     if config.enable_ep and not config.strict_compat:
@@ -158,7 +164,7 @@ def plan_hetero(
                     cp_degrees=(cp,), cp_eligible=cp_eligible,
                     ep_degrees=(ep,), zero_stages=(zero,),
                     sp_variants=(sp,), cp_modes=(cp_mode,),
-                    num_heads=model.num_heads,
+                    num_heads=a2a_head_limit,
                 ):
                     try:
                         cost = estimator.get_cost(

@@ -57,10 +57,28 @@ def _add_platform_arg(p: argparse.ArgumentParser) -> None:
              "plugin backends that override it at import time; this sets "
              "jax.config directly.  Use --platform cpu to collect CPU "
              "fixtures or when the TPU is unreachable")
+    p.add_argument(
+        "--virtual-devices", type=int, default=0,
+        help="with --platform cpu: expose N virtual CPU devices "
+             "(xla_force_host_platform_device_count) so multi-device plans "
+             "execute without hardware — the zero-TPU testing story "
+             "(SURVEY.md §4)")
 
 
 def _pin_platform(args: argparse.Namespace) -> None:
     platform = getattr(args, "platform", None)
+    n = getattr(args, "virtual_devices", 0)
+    if n:
+        import os
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        # replace a stale count rather than silently keeping it — the user
+        # just asked for n devices explicitly
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", flags)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip())
     if platform:
         import jax
 
@@ -211,6 +229,34 @@ def main(argv: list[str] | None = None) -> int:
     p_val.add_argument("--warmup", type=int, default=2)
     _add_platform_arg(p_val)
 
+    p_train = sub.add_parser(
+        "train", help="plan AND run: search the cluster, build the best "
+                      "plan's executable, stream batches through the input "
+                      "pipeline, train with checkpointing — the end-to-end "
+                      "driver (the execution half the reference never "
+                      "shipped)")
+    _add_cluster_args(p_train)
+    p_train.add_argument("--profile-dir", required=True)
+    _add_model_args(p_train)
+    _add_search_args(p_train)
+    p_train.add_argument("--steps", type=int, default=10,
+                         help="training steps to run")
+    p_train.add_argument("--schedule", choices=("gpipe", "1f1b"),
+                         default="gpipe",
+                         help="pipeline schedule for rectangular pp>1 plans")
+    p_train.add_argument("--data", default=None,
+                         help="flat token stream (.npy / raw int32 .bin, "
+                              "memmapped); default: synthetic tokens")
+    p_train.add_argument("--checkpoint-dir", default=None,
+                         help="save (and resume from) checkpoints here "
+                              "(GSPMD-routed plans)")
+    p_train.add_argument("--checkpoint-every", type=int, default=0,
+                         help="also checkpoint every N steps (async, "
+                              "overlapped with training); 0 = final only")
+    p_train.add_argument("--log-every", type=int, default=1,
+                         help="emit a train_step event every N steps")
+    _add_platform_arg(p_train)
+
     p_rep = sub.add_parser(
         "replan", help="elastic re-plan on topology change: diff two cluster "
                        "descriptions, search the survivor topology, report "
@@ -247,6 +293,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_validate(args, profiles, model, config)
     if args.command == "replan":
         return _cmd_replan(args, profiles, model, config, events)
+    if args.command == "train":
+        return _cmd_train(args, profiles, model, config, events)
 
     if args.command == "hetero":
         cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
@@ -360,6 +408,162 @@ def _cmd_validate(args: argparse.Namespace, profiles, model, config) -> int:
             f"{result.num_pruned} pruned — a fully-pruned search usually "
             "means the profile device types don't match the clusterfile)",
             file=sys.stderr)
+    return 0
+
+
+def _cmd_train(args: argparse.Namespace, profiles, model, config,
+               events) -> int:
+    """Plan -> executable -> data pipeline -> checkpointed train loop."""
+    import time
+
+    import numpy as np
+
+    import jax
+
+    from metis_tpu.data.pipeline import TokenDataset, make_input_pipeline
+    from metis_tpu.execution.builder import build_executable
+    from metis_tpu.execution.checkpoint import (
+        AsyncCheckpointWriter,
+        load_meta,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from metis_tpu.execution.mesh import PlanArtifact
+    from metis_tpu.models import config_for_model_spec
+    from metis_tpu.planner.api import plan_hetero as _plan_hetero
+
+    cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    result = _plan_hetero(cluster, profiles, model, config, top_k=1,
+                          events=events)
+    if result.best is None:
+        print(f"no feasible plan ({result.num_costed} costed, "
+              f"{result.num_pruned} pruned)", file=sys.stderr)
+        return 1
+    art = PlanArtifact.from_ranked_plan(result.best)
+    cfg = config_for_model_spec(model)
+    try:
+        exe = build_executable(cfg, art, cluster=cluster, profiles=profiles,
+                               schedule=args.schedule)
+    except ValueError as e:
+        if "devices" in str(e):
+            print(f"{e}\nthe plan targets the clusterfile's topology; this "
+                  f"process sees {len(jax.devices())} local jax device(s). "
+                  "Run under the full deployment, or rehearse locally with "
+                  "--platform cpu --virtual-devices N.", file=sys.stderr)
+            return 1
+        raise
+    print(f"best plan (cost {result.best.cost.total_ms:.1f} ms) -> "
+          f"{exe.kind} executable; stages {art.device_groups or '1'}, "
+          f"gbs {art.gbs} x {args.steps} steps", file=sys.stderr)
+
+    if args.data:
+        tokens = (np.load(args.data, mmap_mode="r")
+                  if args.data.endswith(".npy")
+                  else np.memmap(args.data, dtype=np.int32, mode="r"))
+        dataset = TokenDataset(tokens, model.sequence_length)
+    else:
+        dataset = TokenDataset.synthetic(
+            model.vocab_size,
+            art.gbs * model.sequence_length * (args.steps + 2) + 1,
+            model.sequence_length)
+    mesh = art.build_mesh() if art.mesh_shape else None
+    if exe.kind == "gspmd":
+        # land each batch directly in the executor's sharding (dp over
+        # batch — (dp, ep) for MoE plans — sp over sequence when cp is on)
+        from metis_tpu.execution.mesh import DP, EP, SP
+
+        s0 = dict(art.strategies[0])
+        batches = make_input_pipeline(
+            dataset, art.gbs, mesh=mesh,
+            dp_axis=(DP, EP) if s0.get("ep", 1) > 1 else DP,
+            seq_axis=SP if s0.get("cp", 1) > 1 else None,
+            epochs=None)
+    else:
+        # pipeline/hetero steps do their own microbatch placement
+        batches = make_input_pipeline(dataset, art.gbs, epochs=None)
+
+    # gspmd states ARE TrainStates; the pipeline route's (params, opt_state)
+    # pair wraps into one for the checkpointer (step counted here).  The
+    # multi-mesh hetero route (per-stage states on per-stage meshes) has no
+    # checkpoint path yet.
+    can_ckpt = (args.checkpoint_dir is not None
+                and exe.kind in ("gspmd", "pipeline"))
+    if args.checkpoint_dir is not None and not can_ckpt:
+        print(f"checkpointing supports GSPMD- and pipeline-routed plans "
+              f"(this plan routed to '{exe.kind}'); continuing without",
+              file=sys.stderr)
+
+    from metis_tpu.execution.train import TrainState
+
+    def as_train_state(state, step):
+        if exe.kind == "gspmd":
+            return state
+        params, opt_state = state
+        import jax.numpy as jnp
+
+        return TrainState(params=params, opt_state=opt_state,
+                          step=jnp.asarray(step, jnp.int32))
+
+    state = exe.init(jax.random.PRNGKey(0))
+    start_step = 0
+    if can_ckpt:
+        try:
+            start_step = load_meta(args.checkpoint_dir).step
+            restored = restore_checkpoint(
+                args.checkpoint_dir, as_train_state(state, start_step))
+            state = (restored if exe.kind == "gspmd"
+                     else (restored.params, restored.opt_state))
+            print(f"resumed from {args.checkpoint_dir} at step {start_step}",
+                  file=sys.stderr)
+        except FileNotFoundError:
+            pass
+    # a resumed run continues through the data stream, not from batch 0 —
+    # one batch per completed step (host-side numpy gathers, no device work)
+    for _ in range(start_step):
+        next(batches)
+
+    writer = AsyncCheckpointWriter() if can_ckpt else None
+    losses: list[float] = []
+    t0 = time.perf_counter()
+    try:
+        for i in range(args.steps):
+            toks, tgts = next(batches)
+            state, loss = exe.step(state, toks, tgts)
+            if (i + 1) % args.log_every == 0 or i + 1 == args.steps:
+                loss = float(loss)
+                losses.append(loss)
+                events.emit("train_step", step=start_step + i + 1, loss=loss,
+                            elapsed_s=round(time.perf_counter() - t0, 3))
+            if (writer is not None and args.checkpoint_every
+                    and (i + 1) % args.checkpoint_every == 0):
+                writer.save(args.checkpoint_dir,
+                            as_train_state(state, start_step + i + 1),
+                            mesh, plan=art)
+        # measure before the shutdown flush: the close() below blocks on the
+        # last in-flight write, which is checkpoint IO, not step time
+        elapsed = time.perf_counter() - t0
+    finally:
+        if writer is not None:
+            writer.close()
+    final_already_saved = bool(
+        args.checkpoint_every and args.steps % args.checkpoint_every == 0)
+    if can_ckpt and not final_already_saved:
+        save_checkpoint(args.checkpoint_dir,
+                        as_train_state(state, start_step + args.steps),
+                        mesh, plan=art)
+
+    summary = {
+        "executable": exe.kind,
+        "plan_cost_ms": result.best.cost.total_ms,
+        "steps": args.steps,
+        "first_loss": losses[0] if losses else None,
+        "final_loss": losses[-1] if losses else None,
+        "mean_step_ms": round(elapsed / args.steps * 1e3, 2),
+        "tokens_per_s": round(art.gbs * model.sequence_length
+                              * args.steps / elapsed),
+        "checkpoint": args.checkpoint_dir if can_ckpt else None,
+    }
+    _emit(args, json.dumps(summary, indent=2))
     return 0
 
 

@@ -142,11 +142,13 @@ def classify_strategies(
       ``plan.py:192-226``, but yields nothing on the way).  Escalation only
       shrinks a stage's dp (growing its microbatch) and only grows its tp,
       so a stage whose mbs already exceeds ``max_bs`` or whose tp exceeds
-      ``max_tp`` is unrecoverable.  With ``num_heads`` given, an a2a cp
-      stage whose heads don't split evenly over ``tp * cp`` is also doom:
-      both factors are powers of two, so once ``2^k`` stops dividing the
-      head count no further doubling recovers — and the a2a cost/execution
-      path assumes even head splits (no padding term, ``ops/ulysses.py``);
+      ``max_tp`` is unrecoverable.  With ``num_heads`` given (callers pass
+      the binding head count — for GQA the gcd of Q and KV heads, since the
+      a2a split must divide both), an a2a cp stage whose heads don't split
+      evenly over ``tp * cp`` is also doom: both factors are powers of two,
+      so once ``2^k`` stops dividing the head count no further doubling
+      recovers — and the a2a cost/execution path assumes even head splits
+      (no padding term, ``ops/ulysses.py``);
     - ``RETRY`` — invalid but recoverable (some stage's mbs == 0: halving
       its dp grows the microbatch).
     """

@@ -107,6 +107,32 @@ def test_profile_subcommand(tmp_path):
     assert list((tmp_path / "prof").glob("*.json"))
 
 
+def test_train_subcommand_end_to_end(fixture_dir, tmp_path):
+    """plan -> executable -> pipeline -> train loop -> checkpoint, then a
+    second invocation resumes from the saved step (the full driver story)."""
+    out = tmp_path / "summary.json"
+    ckpt = tmp_path / "ckpt"
+    base = ["train", *_cluster_args(fixture_dir),
+            "--profile-dir", str(fixture_dir / "profiles"),
+            *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+            "--checkpoint-dir", str(ckpt), "--output", str(out)]
+    rc = main([*base, "--steps", "3"])
+    assert rc == 0
+    summary = json.loads(out.read_text())
+    assert summary["steps"] == 3
+    assert summary["final_loss"] is not None
+    assert summary["tokens_per_s"] > 0
+
+    if summary["checkpoint"] is not None:  # plan routed to gspmd
+        from metis_tpu.execution.checkpoint import load_meta, load_plan
+
+        assert load_meta(ckpt).step == 3
+        assert load_plan(ckpt) is not None
+        rc = main([*base, "--steps", "2"])
+        assert rc == 0
+        assert load_meta(ckpt).step == 5
+
+
 def test_replan_no_old_cost(fixture_dir, tmp_path):
     out = tmp_path / "replan.json"
     rc = main(["replan", "--hostfile", str(fixture_dir / "hostfile"),
