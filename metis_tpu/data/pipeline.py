@@ -72,25 +72,30 @@ def batches_per_epoch(dataset: TokenDataset, gbs: int) -> int:
 
 
 def _host_batches(dataset: TokenDataset, gbs: int, shuffle_seed: int | None,
-                  epochs: int | None) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+                  epochs: int | None,
+                  skip: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     per_epoch = batches_per_epoch(dataset, gbs)
     if per_epoch < 1:
         raise ValueError(
             f"dataset has {dataset.num_windows} windows < gbs={gbs}")
     L = dataset.seq_len
     offsets = np.arange(L + 1)[None, :]
-    epoch = 0
+    # arithmetic fast-forward (resume): the schedule is deterministic given
+    # the seed, so skipping means starting mid-epoch — no gathers are paid
+    # for batches already consumed
+    epoch, b0 = divmod(max(skip, 0), per_epoch)
     while epochs is None or epoch < epochs:
         order = np.arange(dataset.num_windows)
         if shuffle_seed is not None:
             np.random.default_rng(shuffle_seed + epoch).shuffle(order)
-        for b in range(per_epoch):
+        for b in range(b0, per_epoch):
             idx = order[b * gbs:(b + 1) * gbs]
             # one vectorized gather per batch (fancy indexing pages a memmap
             # in bulk; a per-row Python loop would dominate host time)
             gather = np.asarray(
                 dataset.tokens)[idx[:, None] * L + offsets].astype(np.int32)
             yield gather[:, :-1], gather[:, 1:]
+        b0 = 0
         epoch += 1
 
 
@@ -103,6 +108,7 @@ def make_input_pipeline(
     shuffle_seed: int | None = 0,
     epochs: int | None = None,
     prefetch: int = 1,
+    skip_batches: int = 0,
 ):
     """Iterator of device-resident ``(tokens, targets)`` batches.
 
@@ -111,9 +117,12 @@ def make_input_pipeline(
     (the hetero executor does its own per-stage placement).  ``prefetch``
     host batches are prepared ahead by a daemon thread so host batching
     overlaps device compute — the overlap the cost model's additive
-    ``batch_generator_ms`` term conservatively ignores.
+    ``batch_generator_ms`` term conservatively ignores.  ``skip_batches``
+    fast-forwards the deterministic schedule arithmetically (resume: one
+    batch per completed step) without paying gathers or transfers.
     """
-    host_iter = _host_batches(dataset, gbs, shuffle_seed, epochs)
+    host_iter = _host_batches(dataset, gbs, shuffle_seed, epochs,
+                              skip=skip_batches)
 
     put = None
     if mesh is not None:

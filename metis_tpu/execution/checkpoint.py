@@ -85,7 +85,7 @@ def save_checkpoint(
     tree = _state_tree(state)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp / _STATE_DIR, tree, force=True)
-    _write_meta_and_plan(tmp, state, mesh, plan)
+    _write_meta_and_plan(tmp, _mesh_meta(state, mesh), plan)
     _swap_tmp_into_place(directory, tmp, prev, multi_host)
     return directory
 
@@ -97,18 +97,21 @@ def _state_tree(state: TrainState) -> dict:
             "step": state.step}
 
 
-def _write_meta_and_plan(tmp: Path, state: TrainState, mesh: Mesh,
+def _write_meta_and_plan(tmp: Path, meta: CheckpointMeta,
                          plan: PlanArtifact | None) -> None:
     if jax.process_index() != 0:
         return
-    meta = CheckpointMeta(
+    (tmp / _META_FILE).write_text(meta.to_json())
+    if plan is not None:
+        (tmp / _PLAN_FILE).write_text(plan.to_json())
+
+
+def _mesh_meta(state: TrainState, mesh: Mesh) -> CheckpointMeta:
+    return CheckpointMeta(
         step=int(state.step),
         mesh_axes=tuple(mesh.axis_names),
         mesh_shape=tuple(mesh.devices.shape),
     )
-    (tmp / _META_FILE).write_text(meta.to_json())
-    if plan is not None:
-        (tmp / _PLAN_FILE).write_text(plan.to_json())
 
 
 def _prepare_tmp(directory: Path) -> tuple[Path, Path, bool]:
@@ -186,7 +189,7 @@ class AsyncCheckpointWriter:
         directory = Path(directory).absolute()
         tmp, prev, multi_host = _prepare_tmp(directory)
         self._ckptr.save(tmp / _STATE_DIR, _state_tree(state), force=True)
-        _write_meta_and_plan(tmp, state, mesh, plan)
+        _write_meta_and_plan(tmp, _mesh_meta(state, mesh), plan)
         self._pending = (directory, tmp, prev, multi_host)
 
     def wait(self) -> None:
@@ -232,6 +235,25 @@ def load_plan(directory: str | Path) -> PlanArtifact | None:
     return PlanArtifact.from_json(p.read_text()) if p.exists() else None
 
 
+def _as_restore(leaf):
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding") and \
+            isinstance(leaf.sharding, NamedSharding):
+        return ocp.ArrayRestoreArgs(
+            sharding=leaf.sharding, global_shape=leaf.shape,
+            dtype=leaf.dtype)
+    return ocp.RestoreArgs()
+
+
+def _restore_tree(directory: Path, ref: dict) -> dict:
+    """Restore the state tree shaped/sharded like ``ref`` (orbax reshards
+    onto the reference leaves' NamedShardings on read)."""
+    restore_args = jax.tree.map(_as_restore, ref)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(
+            directory / _STATE_DIR,
+            args=ocp.args.PyTreeRestore(item=ref, restore_args=restore_args))
+
+
 def restore_checkpoint(
     directory: str | Path,
     reference_state: TrainState,
@@ -239,24 +261,76 @@ def restore_checkpoint(
     """Restore a TrainState shaped/sharded like ``reference_state`` (built
     with ``build_train_state`` on the *target* mesh — which may differ from
     the mesh the checkpoint was written on; orbax reshards on read)."""
-    directory = _resolve_dir(directory)
-    ref = _state_tree(reference_state)
-
-    def as_restore(leaf):
-        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding") and \
-                isinstance(leaf.sharding, NamedSharding):
-            return ocp.ArrayRestoreArgs(
-                sharding=leaf.sharding, global_shape=leaf.shape,
-                dtype=leaf.dtype)
-        return ocp.RestoreArgs()
-
-    restore_args = jax.tree.map(as_restore, ref)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        tree = ckptr.restore(
-            directory / _STATE_DIR,
-            args=ocp.args.PyTreeRestore(item=ref, restore_args=restore_args))
+    tree = _restore_tree(_resolve_dir(directory), _state_tree(reference_state))
     step = tree["step"]
     if not isinstance(step, jax.Array):
         step = jax.numpy.asarray(np.asarray(step))
     return TrainState(params=tree["params"], opt_state=tree["opt_state"],
                       step=step)
+
+
+# ---------------------------------------------------------------------------
+# hetero (multi-mesh) checkpoints: one per-stage state list, one directory
+# ---------------------------------------------------------------------------
+
+
+def _hetero_tree(state: list, step) -> dict:
+    return {
+        "stages": [{"params": p, "opt_state": o} for p, o in state],
+        "step": step,
+    }
+
+
+def _pad_empty(tree):
+    """orbax refuses zero-size arrays; a hetero stage holding only the
+    embed/head pseudo-layer has empty block-param leaves.  Swap them for
+    1-element placeholders at save; the restore side grafts the reference's
+    (identical, correctly sharded) empties back."""
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda a: (jnp.zeros((1,), getattr(a, "dtype", jnp.float32))
+                   if getattr(a, "size", 1) == 0 else a),
+        tree)
+
+
+def save_hetero_checkpoint(
+    directory: str | Path,
+    state: list,
+    step: int,
+    plan: PlanArtifact | None = None,
+) -> Path:
+    """Checkpoint the multi-mesh hetero executor's state — a list of
+    per-stage ``[params, opt_state]`` pairs, each living on its own stage
+    mesh (``execution.hetero.make_hetero_train_step``).  Same crash-safe
+    swap as ``save_checkpoint``; the meta records the stage count in place
+    of a mesh shape."""
+    import jax.numpy as jnp
+
+    directory = Path(directory).absolute()
+    tmp, prev, multi_host = _prepare_tmp(directory)
+    tree = _pad_empty(_hetero_tree(state, jnp.asarray(step, jnp.int32)))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(tmp / _STATE_DIR, tree, force=True)
+    _write_meta_and_plan(
+        tmp, CheckpointMeta(step=int(step), mesh_axes=("stage",),
+                            mesh_shape=(len(state),)), plan)
+    _swap_tmp_into_place(directory, tmp, prev, multi_host)
+    return directory
+
+
+def restore_hetero_checkpoint(
+    directory: str | Path,
+    reference_state: list,
+) -> list:
+    """Restore a per-stage state list shaped/sharded like
+    ``reference_state`` (a fresh ``init_fn(key)`` of the SAME plan — stage
+    structure must match; shardings are taken from the reference leaves)."""
+    import jax.numpy as jnp
+
+    ref = _hetero_tree(reference_state, jnp.zeros((), jnp.int32))
+    tree = _restore_tree(_resolve_dir(directory), _pad_empty(ref))
+    # graft the reference's empty leaves back over their saved placeholders
+    tree = jax.tree.map(
+        lambda r, g: r if getattr(r, "size", 1) == 0 else g, ref, tree)
+    return [[s["params"], s["opt_state"]] for s in tree["stages"]]

@@ -248,8 +248,7 @@ def main(argv: list[str] | None = None) -> int:
                          help="flat token stream (.npy / raw int32 .bin, "
                               "memmapped); default: synthetic tokens")
     p_train.add_argument("--checkpoint-dir", default=None,
-                         help="save (and resume from) checkpoints here "
-                              "(GSPMD-routed plans)")
+                         help="save (and resume from) checkpoints here")
     p_train.add_argument("--checkpoint-every", type=int, default=0,
                          help="also checkpoint every N steps (async, "
                               "overlapped with training); 0 = final only")
@@ -433,13 +432,32 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     from metis_tpu.planner.api import plan_hetero as _plan_hetero
 
     cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
-    result = _plan_hetero(cluster, profiles, model, config, top_k=1,
-                          events=events)
-    if result.best is None:
-        print(f"no feasible plan ({result.num_costed} costed, "
-              f"{result.num_pruned} pruned)", file=sys.stderr)
-        return 1
-    art = PlanArtifact.from_ranked_plan(result.best)
+
+    # Resume pins the checkpoint's saved plan: re-running the search could
+    # pick a DIFFERENT best plan (new profiles, cost-model changes, broken
+    # ties) whose state structure/sharding no longer matches the checkpoint
+    # — the plan artifact saved alongside the weights is the layout contract
+    # (execution.checkpoint module docstring).
+    art = plan_cost_ms = None
+    if args.checkpoint_dir is not None:
+        from metis_tpu.execution.checkpoint import load_plan
+
+        try:
+            art = load_plan(args.checkpoint_dir)
+        except FileNotFoundError:
+            art = None
+        if art is not None:
+            print(f"resuming with the plan pinned by {args.checkpoint_dir} "
+                  "(search skipped)", file=sys.stderr)
+    if art is None:
+        result = _plan_hetero(cluster, profiles, model, config, top_k=1,
+                              events=events)
+        if result.best is None:
+            print(f"no feasible plan ({result.num_costed} costed, "
+                  f"{result.num_pruned} pruned)", file=sys.stderr)
+            return 1
+        art = PlanArtifact.from_ranked_plan(result.best)
+        plan_cost_ms = result.best.cost.total_ms
     cfg = config_for_model_spec(model)
     try:
         exe = build_executable(cfg, art, cluster=cluster, profiles=profiles,
@@ -452,7 +470,9 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                   "--platform cpu --virtual-devices N.", file=sys.stderr)
             return 1
         raise
-    print(f"best plan (cost {result.best.cost.total_ms:.1f} ms) -> "
+    cost_txt = (f"cost {plan_cost_ms:.1f} ms" if plan_cost_ms is not None
+                else "pinned")
+    print(f"best plan ({cost_txt}) -> "
           f"{exe.kind} executable; stages {art.device_groups or '1'}, "
           f"gbs {art.gbs} x {args.steps} steps", file=sys.stderr)
 
@@ -467,32 +487,16 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
             art.gbs * model.sequence_length * (args.steps + 2) + 1,
             model.sequence_length)
     mesh = art.build_mesh() if art.mesh_shape else None
-    if exe.kind == "gspmd":
-        # land each batch directly in the executor's sharding (dp over
-        # batch — (dp, ep) for MoE plans — sp over sequence when cp is on)
-        from metis_tpu.execution.mesh import DP, EP, SP
-
-        s0 = dict(art.strategies[0])
-        batches = make_input_pipeline(
-            dataset, art.gbs, mesh=mesh,
-            dp_axis=(DP, EP) if s0.get("ep", 1) > 1 else DP,
-            seq_axis=SP if s0.get("cp", 1) > 1 else None,
-            epochs=None)
-    else:
-        # pipeline/hetero steps do their own microbatch placement
-        batches = make_input_pipeline(dataset, art.gbs, epochs=None)
 
     # gspmd states ARE TrainStates; the pipeline route's (params, opt_state)
-    # pair wraps into one for the checkpointer (step counted here).  The
-    # multi-mesh hetero route (per-stage states on per-stage meshes) has no
-    # checkpoint path yet.
-    can_ckpt = (args.checkpoint_dir is not None
-                and exe.kind in ("gspmd", "pipeline"))
-    if args.checkpoint_dir is not None and not can_ckpt:
-        print(f"checkpointing supports GSPMD- and pipeline-routed plans "
-              f"(this plan routed to '{exe.kind}'); continuing without",
-              file=sys.stderr)
+    # pair wraps into one; the hetero route's per-stage state list has its
+    # own save/restore pair.  Every route checkpoints.
+    can_ckpt = args.checkpoint_dir is not None
 
+    from metis_tpu.execution.checkpoint import (
+        restore_hetero_checkpoint,
+        save_hetero_checkpoint,
+    )
     from metis_tpu.execution.train import TrainState
 
     def as_train_state(state, step):
@@ -509,20 +513,49 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     if can_ckpt:
         try:
             start_step = load_meta(args.checkpoint_dir).step
-            restored = restore_checkpoint(
-                args.checkpoint_dir, as_train_state(state, start_step))
-            state = (restored if exe.kind == "gspmd"
-                     else (restored.params, restored.opt_state))
+            if exe.kind == "hetero":
+                state = restore_hetero_checkpoint(args.checkpoint_dir, state)
+            else:
+                restored = restore_checkpoint(
+                    args.checkpoint_dir, as_train_state(state, start_step))
+                state = (restored if exe.kind == "gspmd"
+                         else (restored.params, restored.opt_state))
             print(f"resumed from {args.checkpoint_dir} at step {start_step}",
                   file=sys.stderr)
         except FileNotFoundError:
-            pass
-    # a resumed run continues through the data stream, not from batch 0 —
-    # one batch per completed step (host-side numpy gathers, no device work)
-    for _ in range(start_step):
-        next(batches)
+            start_step = 0
 
-    writer = AsyncCheckpointWriter() if can_ckpt else None
+    # a resumed run continues through the data stream, not from batch 0 —
+    # skip_batches fast-forwards the deterministic schedule arithmetically
+    # (one batch per completed step; no gathers or transfers are paid)
+    if exe.kind == "gspmd":
+        # land each batch directly in the executor's sharding (dp over
+        # batch — (dp, ep) for MoE plans — sp over sequence when cp is on)
+        from metis_tpu.execution.mesh import DP, EP, SP
+
+        s0 = dict(art.strategies[0])
+        batches = make_input_pipeline(
+            dataset, art.gbs, mesh=mesh,
+            dp_axis=(DP, EP) if s0.get("ep", 1) > 1 else DP,
+            seq_axis=SP if s0.get("cp", 1) > 1 else None,
+            epochs=None, skip_batches=start_step)
+    else:
+        # pipeline/hetero steps do their own microbatch placement
+        batches = make_input_pipeline(dataset, art.gbs, epochs=None,
+                                      skip_batches=start_step)
+
+    # async writes for the single-state routes; the hetero route's per-stage
+    # list saves synchronously (its own save path)
+    writer = (AsyncCheckpointWriter()
+              if can_ckpt and exe.kind != "hetero" else None)
+
+    def periodic_save(state, step):
+        if exe.kind == "hetero":
+            save_hetero_checkpoint(args.checkpoint_dir, state, step, plan=art)
+        else:
+            writer.save(args.checkpoint_dir, as_train_state(state, step),
+                        mesh, plan=art)
+
     losses: list[float] = []
     t0 = time.perf_counter()
     try:
@@ -534,11 +567,9 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                 losses.append(loss)
                 events.emit("train_step", step=start_step + i + 1, loss=loss,
                             elapsed_s=round(time.perf_counter() - t0, 3))
-            if (writer is not None and args.checkpoint_every
+            if (can_ckpt and args.checkpoint_every
                     and (i + 1) % args.checkpoint_every == 0):
-                writer.save(args.checkpoint_dir,
-                            as_train_state(state, start_step + i + 1),
-                            mesh, plan=art)
+                periodic_save(state, start_step + i + 1)
         # measure before the shutdown flush: the close() below blocks on the
         # last in-flight write, which is checkpoint IO, not step time
         elapsed = time.perf_counter() - t0
@@ -546,21 +577,27 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         if writer is not None:
             writer.close()
     final_already_saved = bool(
-        args.checkpoint_every and args.steps % args.checkpoint_every == 0)
+        args.steps and args.checkpoint_every
+        and args.steps % args.checkpoint_every == 0)
     if can_ckpt and not final_already_saved:
-        save_checkpoint(args.checkpoint_dir,
-                        as_train_state(state, start_step + args.steps),
-                        mesh, plan=art)
+        end = start_step + args.steps
+        if exe.kind == "hetero":
+            save_hetero_checkpoint(args.checkpoint_dir, state, end, plan=art)
+        else:
+            save_checkpoint(args.checkpoint_dir, as_train_state(state, end),
+                            mesh, plan=art)
 
     summary = {
         "executable": exe.kind,
-        "plan_cost_ms": result.best.cost.total_ms,
+        "plan_cost_ms": plan_cost_ms,
         "steps": args.steps,
         "first_loss": losses[0] if losses else None,
         "final_loss": losses[-1] if losses else None,
-        "mean_step_ms": round(elapsed / args.steps * 1e3, 2),
-        "tokens_per_s": round(art.gbs * model.sequence_length
-                              * args.steps / elapsed),
+        "mean_step_ms": (round(elapsed / args.steps * 1e3, 2)
+                         if args.steps else None),
+        "tokens_per_s": (round(art.gbs * model.sequence_length
+                               * args.steps / elapsed)
+                         if args.steps and elapsed > 0 else None),
         "checkpoint": args.checkpoint_dir if can_ckpt else None,
     }
     _emit(args, json.dumps(summary, indent=2))
