@@ -82,21 +82,27 @@ def build_executable(
     cluster=None,
     profiles=None,
     schedule: str = "gpipe",
+    virtual_stages: int = 2,
 ) -> Executable:
     """Route ``artifact`` to the execution path that realizes it.
 
     ``cluster`` + ``profiles`` (optional) enable the data balancer's uneven
     per-replica microbatches on mixed-type hetero stages.  ``schedule``
-    selects the single-program pipeline schedule ("gpipe" or the
-    memory-bounded "1f1b") and applies only when the plan routes to the
+    selects the single-program pipeline schedule ("gpipe", the
+    memory-bounded "1f1b", or "interleaved" with ``virtual_stages`` model
+    chunks per device — smaller fill/drain bubble when the microbatch
+    count is below ~virtual_stages*pp; it drains between microbatch
+    groups) and applies only when the plan routes to the
     shard_map pipeline; the gspmd route has no pipeline and the hetero
     route is already stage-granular-remat with boundary-only storage.
     Note 1F1B trades FLOPs for memory: it recomputes each stage forward
     from the saved boundary input (~one extra forward per microbatch-stage
     that the cost model's fill-drain formula does not price), so prefer it
     when activation memory binds, not when step time does."""
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "interleaved" and virtual_stages < 1:
+        raise ValueError(f"virtual_stages={virtual_stages} must be >= 1")
     strategies = [dict(s) for s in artifact.strategies]
     for s in strategies:
         s.setdefault("cp", 1)
@@ -123,7 +129,7 @@ def build_executable(
             and not s0["sp"] and s0["cp"] == 1 and s0["ep"] == 1
             and _uniform_block_split(artifact, cfg, pp)):
         return _pipeline_executable(cfg, artifact, s0, pp, devices, optimizer,
-                                    schedule)
+                                    schedule, virtual_stages)
 
     return _hetero_executable(
         cfg, artifact, strategies, devices, optimizer, cluster, profiles)
@@ -150,7 +156,8 @@ def _gspmd_executable(cfg, artifact, s0, devices, optimizer) -> Executable:
 
 
 def _pipeline_executable(cfg, artifact, s0, pp, devices,
-                         optimizer, schedule="gpipe") -> Executable:
+                         optimizer, schedule="gpipe",
+                         virtual_stages=2) -> Executable:
     import numpy as np
     from jax.sharding import Mesh
 
@@ -162,7 +169,7 @@ def _pipeline_executable(cfg, artifact, s0, pp, devices,
         np.array(devs[:need]).reshape(pp, s0["dp"], s0["tp"]), (PP, DP, TP))
     init_fn, raw_step = make_pipeline_train_step(
         cfg, mesh, artifact.microbatches, optimizer=optimizer,
-        schedule=schedule)
+        schedule=schedule, virtual_stages=virtual_stages)
 
     def init(key):
         return init_fn(key)

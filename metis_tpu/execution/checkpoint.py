@@ -37,17 +37,26 @@ _META_FILE = "meta.json"
 
 @dataclass(frozen=True)
 class CheckpointMeta:
-    """Sidecar metadata — enough to sanity-check a resume."""
+    """Sidecar metadata — enough to sanity-check a resume.
+
+    ``block_layout`` records the physical ordering of the stacked block
+    axis: "canonical", or "interleaved:<vs>" for the interleaved pipeline
+    schedule's device-major chunk permutation
+    (``execution.pipeline.interleave_block_order``) — restoring a permuted
+    checkpoint under a different schedule would silently scramble the
+    layers, so resume must compare this field."""
 
     step: int
     mesh_axes: tuple[str, ...]
     mesh_shape: tuple[int, ...]
+    block_layout: str = "canonical"
 
     def to_json(self) -> str:
         return json.dumps({
             "step": self.step,
             "mesh_axes": list(self.mesh_axes),
             "mesh_shape": list(self.mesh_shape),
+            "block_layout": self.block_layout,
         }, indent=2)
 
     @staticmethod
@@ -57,6 +66,7 @@ class CheckpointMeta:
             step=d["step"],
             mesh_axes=tuple(d["mesh_axes"]),
             mesh_shape=tuple(d["mesh_shape"]),
+            block_layout=d.get("block_layout", "canonical"),
         )
 
 
@@ -65,6 +75,7 @@ def save_checkpoint(
     state: TrainState,
     mesh: Mesh,
     plan: PlanArtifact | None = None,
+    block_layout: str = "canonical",
 ) -> Path:
     """Write state (+ optional plan artifact) under ``directory``.
 
@@ -85,7 +96,7 @@ def save_checkpoint(
     tree = _state_tree(state)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp / _STATE_DIR, tree, force=True)
-    _write_meta_and_plan(tmp, _mesh_meta(state, mesh), plan)
+    _write_meta_and_plan(tmp, _mesh_meta(state, mesh, block_layout), plan)
     _swap_tmp_into_place(directory, tmp, prev, multi_host)
     return directory
 
@@ -106,11 +117,13 @@ def _write_meta_and_plan(tmp: Path, meta: CheckpointMeta,
         (tmp / _PLAN_FILE).write_text(plan.to_json())
 
 
-def _mesh_meta(state: TrainState, mesh: Mesh) -> CheckpointMeta:
+def _mesh_meta(state: TrainState, mesh: Mesh,
+               block_layout: str = "canonical") -> CheckpointMeta:
     return CheckpointMeta(
         step=int(state.step),
         mesh_axes=tuple(mesh.axis_names),
         mesh_shape=tuple(mesh.devices.shape),
+        block_layout=block_layout,
     )
 
 
@@ -184,12 +197,13 @@ class AsyncCheckpointWriter:
         state: TrainState,
         mesh: Mesh,
         plan: PlanArtifact | None = None,
+        block_layout: str = "canonical",
     ) -> None:
         self.wait()  # finish + swap any previous write first
         directory = Path(directory).absolute()
         tmp, prev, multi_host = _prepare_tmp(directory)
         self._ckptr.save(tmp / _STATE_DIR, _state_tree(state), force=True)
-        _write_meta_and_plan(tmp, _mesh_meta(state, mesh), plan)
+        _write_meta_and_plan(tmp, _mesh_meta(state, mesh, block_layout), plan)
         self._pending = (directory, tmp, prev, multi_host)
 
     def wait(self) -> None:

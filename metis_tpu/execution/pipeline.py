@@ -341,21 +341,191 @@ def _pipeline_1f1b_local(
     return loss, grads
 
 
+def interleave_block_order(num_blocks: int, pp: int, vs: int) -> list[int]:
+    """Block permutation for the interleaved schedule: device ``s`` owns
+    virtual chunks ``v`` covering global blocks ``(v*pp + s)*K .. +K`` with
+    ``K = num_blocks // (pp * vs)``; the stacked block axis is reordered
+    device-major (s, v, k) so the contiguous pp sharding of
+    ``gpt_param_specs`` lands each device exactly its chunks."""
+    K = num_blocks // (pp * vs)
+    return [(v * pp + s) * K + k
+            for s in range(pp) for v in range(vs) for k in range(K)]
+
+
+def _pipeline_interleaved_local(
+    params: dict,
+    tokens_mbs: jnp.ndarray,   # [M, mbs_local, S]
+    targets_mbs: jnp.ndarray,
+    cfg: GPTConfig,
+    vs: int,
+) -> tuple[jnp.ndarray, dict]:
+    """Per-device interleaved-pipeline body: returns ``(loss, grads)``.
+
+    Each device holds ``vs`` virtual chunks of ``K = L/(S*vs)`` blocks
+    (device-major interleaved layout, ``interleave_block_order``); a
+    microbatch traverses chunk 0 across all stages, wraps around the ring,
+    then chunk 1, and so on.  Microbatches run in groups of S:
+
+    - forward tick t: unit ``(g, v)`` with ``g + v*S = t - s`` (unique
+      decomposition, so each device runs exactly one chunk-unit per tick);
+      activations move stage s -> s+1, wrapping S-1 -> 0 into the next
+      chunk; ticks per group = vs*S + S - 1;
+    - backward mirrors it reversed (``t' = vs*S + S - 2 - (g + v*S + s)``),
+      cotangents move s -> s-1 wrapping 0 -> S-1, each unit recomputing its
+      chunk forward from the saved boundary input (stage-level remat, as
+      the 1f1b schedule).
+
+    The pipeline fill/drain exposes only CHUNK units (K layers), so the
+    bubble is ~1/vs of GPipe's per group — the interleaved schedule's
+    point (at the price of S x more frequent, S x smaller boundary sends,
+    which ride the same links).  Peak boundary storage is vs*S inputs per
+    device per group.
+    """
+    S = jax.lax.axis_size(PP)
+    stage = jax.lax.axis_index(PP)
+    M, mbs_local, seq = tokens_mbs.shape
+    if M % S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) divisible by "
+            f"pp ({S}) — microbatches run in groups of S")
+    groups = M // S
+    VS = vs * S
+    ticks = VS + S - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+    local_blocks = jax.tree.leaves(params["blocks"])[0].shape[0]
+    K = local_blocks // vs
+
+    def _varying(x):
+        need = tuple(a for a in (PP, DP) if a not in jax.typeof(x).vma)
+        return jax.lax.pcast(x, need, to='varying') if need else x
+
+    params = jax.tree.map(_varying, params)
+
+    def chunk_fwd(p, x, v):
+        chunk = jax.tree.map(
+            lambda a: jax.lax.dynamic_slice_in_dim(a, v * K, K, 0),
+            p["blocks"])
+
+        def step(carry, layer):
+            return tp_block_forward(carry, layer, cfg), None
+        out, _ = jax.lax.scan(step, x, chunk)
+        return out
+
+    def unit_fn(p, x_in, tok, tgt, v):
+        """One (chunk, stage) unit; embed on the first unit, head loss on
+        the last (its cotangent is seeded only there)."""
+        x0 = tp_embed(p, tok, cfg)
+        x = jnp.where((v == 0) & (stage == 0), x0, x_in)
+        x_out = chunk_fwd(p, x, v)
+        loss = tp_head_loss(p, x_out, tgt, cfg)
+        return x_out, loss
+
+    def _match_vma(ct, primal):
+        need = tuple(a for a in jax.typeof(primal).vma
+                     if a not in jax.typeof(ct).vma)
+        return jax.lax.pcast(ct, need, to='varying') if need else ct
+
+    act = jnp.zeros((mbs_local, seq, cfg.hidden), cfg.dtype)
+
+    def run_group(carry, grp):
+        gacc, loss_sum = carry
+        toks = jax.lax.dynamic_slice_in_dim(tokens_mbs, grp * S, S, 0)
+        tgts = jax.lax.dynamic_slice_in_dim(targets_mbs, grp * S, S, 0)
+
+        # ---- forward fill: save every unit's boundary input
+        def ftick(fc, t):
+            buf, ring = fc
+            u = t - stage
+            active = (u >= 0) & (u < VS)
+            u_c = jnp.clip(u, 0, VS - 1)
+            v, g = u_c // S, u_c % S
+            tok = jax.lax.dynamic_index_in_dim(toks, g, 0, False)
+            x0 = tp_embed(params, tok, cfg)
+            x_in = jnp.where((v == 0) & (stage == 0), x0, buf)
+            old = jax.lax.dynamic_index_in_dim(ring, u_c, 0, False)
+            ring = jax.lax.dynamic_update_index_in_dim(
+                ring, jnp.where(active, x_in, old), u_c, 0)
+            x_out = chunk_fwd(params, x_in, v)
+            buf = jax.lax.ppermute(x_out, PP, fwd_perm) if S > 1 else x_out
+            return (buf, ring), None
+
+        ring0 = _varying(jnp.zeros((VS,) + act.shape, cfg.dtype))
+        (_, ring), _ = jax.lax.scan(
+            ftick, (_varying(act), ring0), jnp.arange(ticks))
+
+        # ---- backward drain: reversed order, remat per unit
+        def btick(bc, tb):
+            gacc, loss_sum, buf_ct = bc
+            u = (VS + S - 2) - stage - tb      # g + v*S
+            active = (u >= 0) & (u < VS)
+            u_c = jnp.clip(u, 0, VS - 1)
+            v, g = u_c // S, u_c % S
+            tok = jax.lax.dynamic_index_in_dim(toks, g, 0, False)
+            tgt = jax.lax.dynamic_index_in_dim(tgts, g, 0, False)
+            x_saved = jax.lax.dynamic_index_in_dim(ring, u_c, 0, False)
+            is_last = (v == vs - 1) & (stage == S - 1)
+            (x_p, loss_p), pull = jax.vjp(
+                lambda p, x: unit_fn(p, x, tok, tgt, v), params, x_saved)
+            ct_x = _match_vma(
+                jnp.where(is_last, jnp.zeros_like(buf_ct), buf_ct), x_p)
+            ct_loss = _match_vma(
+                jnp.where(is_last & active, 1.0, 0.0).astype(loss_p.dtype),
+                loss_p)
+            g_params, g_x = pull((ct_x, ct_loss))
+            gacc = jax.tree.map(
+                lambda a, gr: a + jnp.where(active, gr, jnp.zeros_like(gr)),
+                gacc, g_params)
+            loss_sum = loss_sum + jnp.where(active & is_last, loss_p, 0.0)
+            ct_send = jnp.where(active, g_x, jnp.zeros_like(g_x))
+            buf_ct = (jax.lax.ppermute(ct_send, PP, bwd_perm)
+                      if S > 1 else ct_send)
+            return (gacc, loss_sum, buf_ct), None
+
+        (gacc, loss_sum, _), _ = jax.lax.scan(
+            btick, (gacc, loss_sum, _varying(act)), jnp.arange(ticks))
+        return (gacc, loss_sum), None
+
+    gacc0 = jax.tree.map(
+        lambda p: _varying(jnp.zeros_like(p, dtype=jnp.float32)), params)
+    (gacc, loss_sum), _ = jax.lax.scan(
+        run_group, (gacc0, _varying(jnp.zeros((), jnp.float32))),
+        jnp.arange(groups))
+
+    loss = jax.lax.psum(loss_sum, PP) / M
+    loss = jax.lax.pmean(loss, DP)
+    grads = jax.tree.map(lambda g: jax.lax.pmean(g / M, DP), gacc)
+    grads = {
+        "embed": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["embed"]),
+        "blocks": grads["blocks"],
+        "head": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["head"]),
+    }
+    return loss, grads
+
+
 def make_pipeline_train_step(
     cfg: GPTConfig,
     mesh: Mesh,
     num_microbatches: int,
     optimizer=None,
     schedule: str = "gpipe",
+    virtual_stages: int = 2,
 ):
     """Jitted pipeline train step over a (pp, dp, tp) mesh.
 
     ``schedule`` picks "gpipe" (forward scan + autodiff backward; activation
-    memory grows with the microbatch count) or "1f1b" (memory-bounded
+    memory grows with the microbatch count), "1f1b" (memory-bounded
     one-forward-one-backward with stage-level rematerialization; peak
-    boundary activations O(pp) — the right choice when microbatch counts are
-    high and memory is tight).  Both produce identical losses and gradients
-    (pinned by the parity tests).
+    boundary activations O(pp)), or "interleaved" (each device owns
+    ``virtual_stages`` model chunks in the device-major interleaved layout;
+    microbatches run in groups of pp with a bubble of
+    (pp-1)/(virtual_stages*pp + pp - 1) per group — smaller than GPipe's
+    when the microbatch count is below ~virtual_stages*pp, since this
+    implementation drains between groups rather than overlapping them).
+    All produce identical losses and gradients (pinned by the parity
+    tests).  NOTE the interleaved layout also changes the physical block
+    order of params/checkpoints (``interleave_block_order``) — resume
+    compares ``CheckpointMeta.block_layout``.
 
     Requires ``cfg.num_blocks %% pp == 0`` (uniform stages — the stacked
     layer axis shards evenly; non-uniform stages run on the multi-mesh
@@ -370,8 +540,20 @@ def make_pipeline_train_step(
         raise ValueError(
             f"num_blocks={cfg.num_blocks} must divide evenly into pp={pp} "
             "stages for the uniform pipeline")
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "interleaved":
+        if virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages={virtual_stages} must be >= 1")
+        if cfg.num_blocks % (pp * virtual_stages):
+            raise ValueError(
+                f"interleaved schedule needs num_blocks={cfg.num_blocks} "
+                f"divisible by pp*virtual_stages={pp * virtual_stages}")
+        if num_microbatches % pp:
+            raise ValueError(
+                f"interleaved schedule runs microbatches in groups of "
+                f"pp={pp}; {num_microbatches} microbatches don't divide")
     optimizer = optimizer or optax.adamw(1e-4)
     specs = gpt_param_specs(cfg, tp_axis=TP, pp_axis=PP)
     data_spec = P(None, DP, None)  # [M, batch, seq]
@@ -383,8 +565,11 @@ def make_pipeline_train_step(
     # — adding them double-counts (caught by the grad-parity test).
     if schedule == "gpipe":
         local = jax.value_and_grad(partial(_pipeline_loss_local, cfg=cfg))
-    else:
+    elif schedule == "1f1b":
         local = partial(_pipeline_1f1b_local, cfg=cfg)
+    else:
+        local = partial(_pipeline_interleaved_local, cfg=cfg,
+                        vs=virtual_stages)
     sharded_step = jax.shard_map(
         local, mesh=mesh,
         in_specs=(specs, data_spec, data_spec),
@@ -405,7 +590,16 @@ def make_pipeline_train_step(
         jitted = jax.jit(step_fn, donate_argnums=(0, 1))
 
     def init_fn(key):
-        params = shard_params(init_params(key, cfg), mesh, specs)
+        full = init_params(key, cfg)
+        if schedule == "interleaved":
+            # reorder the stacked block axis device-major so the contiguous
+            # pp sharding gives device s its virtual chunks (the optimizer,
+            # grads, and checkpoints all live in this layout consistently)
+            order = jnp.asarray(interleave_block_order(
+                cfg.num_blocks, pp, virtual_stages))
+            full = {**full,
+                    "blocks": jax.tree.map(lambda a: a[order], full["blocks"])}
+        params = shard_params(full, mesh, specs)
         opt_state = optimizer.init(params)
         return params, opt_state
 

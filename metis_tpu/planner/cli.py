@@ -241,9 +241,13 @@ def main(argv: list[str] | None = None) -> int:
     _add_search_args(p_train)
     p_train.add_argument("--steps", type=int, default=10,
                          help="training steps to run")
-    p_train.add_argument("--schedule", choices=("gpipe", "1f1b"),
+    p_train.add_argument("--schedule",
+                         choices=("gpipe", "1f1b", "interleaved"),
                          default="gpipe",
                          help="pipeline schedule for rectangular pp>1 plans")
+    p_train.add_argument("--virtual-stages", type=int, default=2,
+                         help="model chunks per device for "
+                              "--schedule interleaved")
     p_train.add_argument("--data", default=None,
                          help="flat token stream (.npy / raw int32 .bin, "
                               "memmapped); default: synthetic tokens")
@@ -459,9 +463,26 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         art = PlanArtifact.from_ranked_plan(result.best)
         plan_cost_ms = result.best.cost.total_ms
     cfg = config_for_model_spec(model)
+    schedule = args.schedule
+
+    def _build(sched):
+        return build_executable(cfg, art, cluster=cluster, profiles=profiles,
+                                schedule=sched,
+                                virtual_stages=args.virtual_stages)
+
     try:
-        exe = build_executable(cfg, art, cluster=cluster, profiles=profiles,
-                               schedule=args.schedule)
+        try:
+            exe = _build(schedule)
+        except ValueError as e:
+            if schedule == "interleaved" and "interleaved" in str(e):
+                # the CHOSEN plan's shape decides eligibility (microbatches
+                # % pp, blocks % pp*vs) — degrade rather than die
+                print(f"{e}; falling back to --schedule gpipe",
+                      file=sys.stderr)
+                schedule = "gpipe"
+                exe = _build(schedule)
+            else:
+                raise
     except ValueError as e:
         if "devices" in str(e):
             print(f"{e}\nthe plan targets the clusterfile's topology; this "
@@ -508,11 +529,25 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.asarray(step, jnp.int32))
 
+    # the interleaved schedule permutes the physical block order of
+    # params/checkpoints; record it and refuse a resume under a different
+    # layout (a silent mismatch would scramble the layers)
+    block_layout = ("canonical" if exe.kind != "pipeline"
+                    or schedule != "interleaved"
+                    else f"interleaved:{args.virtual_stages}")
+
     state = exe.init(jax.random.PRNGKey(0))
     start_step = 0
     if can_ckpt:
         try:
-            start_step = load_meta(args.checkpoint_dir).step
+            meta = load_meta(args.checkpoint_dir)
+            start_step = meta.step
+            if meta.block_layout != block_layout:
+                print(f"checkpoint {args.checkpoint_dir} was written with "
+                      f"block layout '{meta.block_layout}' but this run uses "
+                      f"'{block_layout}' (--schedule/--virtual-stages "
+                      "changed?) — refusing to resume", file=sys.stderr)
+                return 1
             if exe.kind == "hetero":
                 state = restore_hetero_checkpoint(args.checkpoint_dir, state)
             else:
@@ -554,7 +589,7 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
             save_hetero_checkpoint(args.checkpoint_dir, state, step, plan=art)
         else:
             writer.save(args.checkpoint_dir, as_train_state(state, step),
-                        mesh, plan=art)
+                        mesh, plan=art, block_layout=block_layout)
 
     losses: list[float] = []
     t0 = time.perf_counter()
@@ -585,7 +620,7 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
             save_hetero_checkpoint(args.checkpoint_dir, state, end, plan=art)
         else:
             save_checkpoint(args.checkpoint_dir, as_train_state(state, end),
-                            mesh, plan=art)
+                            mesh, plan=art, block_layout=block_layout)
 
     summary = {
         "executable": exe.kind,

@@ -133,6 +133,27 @@ def test_train_subcommand_end_to_end(fixture_dir, tmp_path):
         assert load_meta(ckpt).step == 5
 
 
+def test_train_refuses_layout_mismatch_resume(fixture_dir, tmp_path):
+    """A checkpoint written under one block layout must not resume under
+    another (the interleaved schedule permutes the physical block order)."""
+    from metis_tpu.execution.checkpoint import CheckpointMeta, load_meta
+
+    ckpt = tmp_path / "ckpt"
+    base = ["train", *_cluster_args(fixture_dir),
+            "--profile-dir", str(fixture_dir / "profiles"),
+            *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+            "--checkpoint-dir", str(ckpt),
+            "--output", str(tmp_path / "out.json")]
+    assert main([*base, "--steps", "1"]) == 0
+    # forge a layout mismatch in the sidecar meta
+    meta = load_meta(ckpt)
+    (ckpt / "meta.json").write_text(CheckpointMeta(
+        step=meta.step, mesh_axes=meta.mesh_axes,
+        mesh_shape=meta.mesh_shape,
+        block_layout="interleaved:2").to_json())
+    assert main([*base, "--steps", "1"]) == 1
+
+
 def test_replan_no_old_cost(fixture_dir, tmp_path):
     out = tmp_path / "replan.json"
     rc = main(["replan", "--hostfile", str(fixture_dir / "hostfile"),

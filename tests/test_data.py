@@ -40,6 +40,19 @@ class TestPipeline:
         assert sorted(seen) == sorted(
             (np.arange(16) * 10).tolist())  # every window exactly once
 
+    def test_skip_batches_fast_forwards_deterministically(self):
+        """skip_batches=k yields exactly the stream from batch k on — the
+        resume contract: same seed, mid-epoch start, epoch-boundary
+        wraparound included (16 windows / gbs 4 = 4 per epoch; skip 6 lands
+        in epoch 1, batch 2)."""
+        ds = TokenDataset(np.arange(161, dtype=np.int32), seq_len=10)
+        full = [t[:, 0].tolist() for t, _ in make_input_pipeline(
+            ds, 4, shuffle_seed=3, epochs=2)]
+        for skip in (1, 3, 6):
+            skipped = [t[:, 0].tolist() for t, _ in make_input_pipeline(
+                ds, 4, shuffle_seed=3, epochs=2, skip_batches=skip)]
+            assert skipped == full[skip:], f"skip={skip}"
+
     def test_shuffle_changes_order_not_content(self):
         ds = TokenDataset(np.arange(161, dtype=np.int32), seq_len=10)
         a = [t[:, 0].tolist() for t, _ in

@@ -208,6 +208,65 @@ class TestPipelinePath:
                 np.asarray(g), np.asarray(ref_flat[path]),
                 rtol=2e-3, atol=2e-5, err_msg=jax.tree_util.keystr(path))
 
+    def test_interleaved_loss_and_grads_match_single_device(self, data):
+        """Interleaved (virtual-stage) schedule: device-major block
+        permutation + grouped schedule + wraparound rings must reproduce the
+        single-device loss AND gradients (compared through the layout
+        permutation)."""
+        params, tokens, targets = data
+        expected = float(next_token_loss(params, tokens, targets, CFG))
+        ref_grads = jax.grad(next_token_loss)(params, tokens, targets, CFG)
+
+        from functools import partial
+
+        from metis_tpu.execution.pipeline import (
+            _pipeline_interleaved_local,
+            interleave_block_order,
+        )
+
+        mesh = _mesh((2, 2, 2), (PP, DP, TP))
+        vs = 2  # CFG has 4 blocks: 2 stages x 2 virtual chunks x 1 block
+        order = np.asarray(interleave_block_order(CFG.num_blocks, 2, vs))
+        permuted = {**params, "blocks": jax.tree.map(
+            lambda a: a[order], params["blocks"])}
+        specs = gpt_param_specs(CFG, tp_axis=TP, pp_axis=PP)
+        sharded = shard_params(permuted, mesh, specs)
+        fn = jax.shard_map(
+            partial(_pipeline_interleaved_local, cfg=CFG, vs=vs),
+            mesh=mesh,
+            in_specs=(specs, P(None, DP, None), P(None, DP, None)),
+            out_specs=(P(), specs))
+        M = 4  # 2 groups of S=2
+        with mesh:
+            loss, grads = jax.jit(fn)(
+                sharded, microbatch_split(tokens, M),
+                microbatch_split(targets, M))
+        assert float(loss) == pytest.approx(expected, rel=1e-4)
+        # grads come back in the interleaved layout; undo it for comparison
+        inv = np.argsort(order)
+        grads = {**grads, "blocks": jax.tree.map(
+            lambda a: np.asarray(a)[inv], grads["blocks"])}
+        flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+        ref_flat = dict(jax.tree_util.tree_flatten_with_path(ref_grads)[0])
+        for path, g in flat:
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(ref_flat[path]),
+                rtol=2e-3, atol=2e-5, err_msg=jax.tree_util.keystr(path))
+
+    def test_interleaved_train_step_learns(self, data):
+        _, tokens, targets = data
+        mesh = _mesh((2, 2, 2), (PP, DP, TP))
+        M = 4
+        init_fn, step = make_pipeline_train_step(
+            CFG, mesh, M, schedule="interleaved", virtual_stages=2)
+        params, opt_state = init_fn(jax.random.PRNGKey(7))
+        tok_mbs = microbatch_split(tokens, M)
+        tgt_mbs = microbatch_split(targets, M)
+        params, opt_state, loss0 = step(params, opt_state, tok_mbs, tgt_mbs)
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tok_mbs, tgt_mbs)
+        assert float(loss) < float(loss0)
+
     def test_1f1b_train_step_learns(self, data):
         _, tokens, targets = data
         mesh = _mesh((2, 2, 2), (PP, DP, TP))
