@@ -271,10 +271,24 @@ def _restore_tree(directory: Path, ref: dict) -> dict:
 def restore_checkpoint(
     directory: str | Path,
     reference_state: TrainState,
+    expected_block_layout: str | None = None,
 ) -> TrainState:
     """Restore a TrainState shaped/sharded like ``reference_state`` (built
     with ``build_train_state`` on the *target* mesh — which may differ from
-    the mesh the checkpoint was written on; orbax reshards on read)."""
+    the mesh the checkpoint was written on; orbax reshards on read).
+
+    ``expected_block_layout``: when given, refuse a checkpoint whose
+    recorded ``CheckpointMeta.block_layout`` differs — restoring a permuted
+    (interleaved-schedule) checkpoint under a different layout silently
+    scrambles the layers."""
+    if expected_block_layout is not None:
+        got = load_meta(directory).block_layout
+        if got != expected_block_layout:
+            raise ValueError(
+                f"checkpoint {directory} was written with block layout "
+                f"'{got}', expected '{expected_block_layout}' — refusing "
+                "to restore (a layout mismatch silently scrambles the "
+                "stacked block axis)")
     tree = _restore_tree(_resolve_dir(directory), _state_tree(reference_state))
     step = tree["step"]
     if not isinstance(step, jax.Array):

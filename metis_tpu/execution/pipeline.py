@@ -117,9 +117,84 @@ def tp_head_loss(params: dict, x: jnp.ndarray, targets: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# shared vma/reduction helpers for the manual-backward schedules
+# ---------------------------------------------------------------------------
+
+
+def _varying(x, axes=(DP,)):
+    """Cast up to varying over ``axes``, skipping axes the value already
+    varies over (param-derived zeros inherit the shards' vma)."""
+    need = tuple(a for a in axes if a not in jax.typeof(x).vma)
+    return jax.lax.pcast(x, need, to='varying') if need else x
+
+
+def _match_vma(ct, primal):
+    """A cotangent must carry the primal output's exact vma."""
+    need = tuple(a for a in jax.typeof(primal).vma
+                 if a not in jax.typeof(ct).vma)
+    return jax.lax.pcast(ct, need, to='varying') if need else ct
+
+
+def _vary_params_for_manual_vjp(params):
+    """Mark every param leaf varying over (pp, dp) BEFORE a per-stage vjp:
+    for a leaf the vjp sees as pp/dp-INVARIANT it would insert the
+    invariance-restoring psum itself (each stage is mid-backward on a
+    DIFFERENT microbatch, so that reduction both mixes microbatches and
+    double-counts against the explicit psum/pmean of
+    ``_reduce_pipeline_grads``).  Leaves stay tp-invariant where they are
+    tp-replicated — the vjp's automatic tp reduction of their gradients is
+    exactly Megatron's grad psum."""
+    return jax.tree.map(lambda x: _varying(x, (PP, DP)), params)
+
+
+def _gated_embed(params, tok, x_in, is_first, cfg):
+    """tp_embed only where this device's current unit is the model's first
+    (the predicate varies over pp but is tp-invariant, so the embed psum
+    inside the taken branch is collective-safe); elsewhere the boundary
+    input passes through untouched — the vocab lookup + psum is skipped,
+    not just masked."""
+    return jax.lax.cond(
+        is_first,
+        # branch outputs must agree in vma; the boundary input always
+        # carries >= the embed's (it crossed pp rings), so cast up to it
+        lambda xi: _match_vma(tp_embed(params, tok, cfg), xi),
+        lambda xi: xi,
+        x_in)
+
+
+def _gated_head_loss(params, x_out, tgt, is_last, cfg):
+    """tp_head_loss only on the model's last unit — the [hidden, vocab]
+    projection + softmax rivals a whole block at realistic vocab sizes, so
+    computing it on every stage/tick (as a masked-SPMD where would) wastes
+    S x (or vs*S x, interleaved) its cost.  The zero branch carries the
+    loss's (pp, dp) vma so cond types match."""
+    return jax.lax.cond(
+        is_last,
+        lambda xo: tp_head_loss(params, xo, tgt, cfg),
+        lambda xo: _varying(jnp.zeros((), jnp.float32), (PP, DP)),
+        x_out)
+
+
+def _reduce_pipeline_grads(gacc, loss_sum, M):
+    """Final reductions shared by the manual-backward schedules: loss and
+    grads average over microbatches and dp; pipeline-replicated leaves
+    (embed/head) live on one stage each — psum over pp rebuilds the
+    replicated gradient (contributions elsewhere are exactly zero)."""
+    loss = jax.lax.psum(loss_sum, PP) / M
+    loss = jax.lax.pmean(loss, DP)
+    grads = jax.tree.map(lambda g: jax.lax.pmean(g / M, DP), gacc)
+    grads = {
+        "embed": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["embed"]),
+        "blocks": grads["blocks"],
+        "head": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["head"]),
+    }
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
 # Schedules
 #
-# Two pipeline schedules share the Megatron-style TP layers above:
+# Three pipeline schedules share the Megatron-style TP layers above:
 #
 # - **GPipe** (``_pipeline_loss_local``): forward-only scan over
 #   M + S - 1 ticks, loss out, gradients by autodiff through the scan.  XLA
@@ -134,6 +209,12 @@ def tp_head_loss(params: dict, x: jnp.ndarray, targets: jnp.ndarray,
 #   standard memory/FLOPs trade.  Step time obeys the same fill-drain
 #   formula the cost model prices (the bubble fraction (S-1)/(M+S-1) is
 #   unchanged; ticks = M + 2(S-1) of fwd+bwd work vs GPipe's two passes).
+# - **Interleaved virtual stages** (``_pipeline_interleaved_local``): each
+#   device owns ``vs`` model chunks in the device-major interleaved layout;
+#   microbatches run in groups of S over a vs*S-deep chunk pipeline with
+#   wraparound rings, remat per unit.  The fill/drain exposes chunk units,
+#   so the per-group bubble is (S-1)/(vs*S + S - 1) — smaller than GPipe's
+#   when M is below ~vs*S (groups drain between themselves).
 # ---------------------------------------------------------------------------
 
 
@@ -162,6 +243,10 @@ def _pipeline_loss_local(
         buf, loss_sum = carry
         feed_idx = jnp.clip(t, 0, M - 1)
         tok = jax.lax.dynamic_index_in_dim(tokens_mbs, feed_idx, 0, False)
+        # NOTE masked (where), not cond-gated like the manual-vjp schedules:
+        # autodiff of cond+collectives through this whole-scan
+        # value_and_grad path aborts inside XLA (runtime CHECK), so GPipe
+        # keeps the compute-everywhere-select-one form
         x0 = tp_embed(params, tok, cfg)
         x_in = jnp.where(stage == 0, x0, buf)
         x_out = blocks_local(x_in)
@@ -229,20 +314,7 @@ def _pipeline_1f1b_local(
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
-    def _varying(x):
-        # cast up to varying over (pp, dp), skipping axes the value already
-        # varies over (param-derived zeros inherit the shards' vma)
-        need = tuple(a for a in (PP, DP) if a not in jax.typeof(x).vma)
-        return jax.lax.pcast(x, need, to='varying') if need else x
-
-    # Mark every param leaf varying over (pp, dp) BEFORE the per-stage vjp:
-    # for a leaf the vjp sees as pp/dp-INVARIANT it would insert the
-    # invariance-restoring psum itself (each stage is mid-backward on a
-    # DIFFERENT microbatch, so that reduction both mixes microbatches and
-    # double-counts against the explicit psum/pmean after the scan).  Leaves
-    # stay tp-invariant where they are tp-replicated — the vjp's automatic
-    # tp reduction of their gradients is exactly Megatron's grad psum.
-    params = jax.tree.map(_varying, params)
+    params = _vary_params_for_manual_vjp(params)
 
     def blocks_local(p, x):
         def step(carry, layer):
@@ -252,11 +324,11 @@ def _pipeline_1f1b_local(
 
     def stage_fn(p, x_in, tok, tgt):
         """Uniform per-stage program: embed on stage 0, blocks, head loss on
-        the last stage (loss cotangent seeded there only)."""
-        x0 = tp_embed(p, tok, cfg)
-        x = jnp.where(stage == 0, x0, x_in)
+        the last stage (loss cotangent seeded there only); embed/head run
+        under lax.cond so the other stages skip their compute entirely."""
+        x = _gated_embed(p, tok, x_in, stage == 0, cfg)
         x_out = blocks_local(p, x)
-        loss = tp_head_loss(p, x_out, tgt, cfg)
+        loss = _gated_head_loss(p, x_out, tgt, stage == S - 1, cfg)
         return x_out, loss
 
     def tick(carry, t):
@@ -267,8 +339,7 @@ def _pipeline_1f1b_local(
         active_f = (mf >= 0) & (mf < M)
         mf_c = jnp.clip(mf, 0, M - 1)
         tok_f = jax.lax.dynamic_index_in_dim(tokens_mbs, mf_c, 0, False)
-        x0 = tp_embed(params, tok_f, cfg)
-        x_in = jnp.where(stage == 0, x0, buf_fwd)
+        x_in = _gated_embed(params, tok_f, buf_fwd, stage == 0, cfg)
         # save the boundary input (masked in-place: an inactive slot keeps
         # its old value — mf_c clips onto live slots, so a blind write would
         # clobber them)
@@ -291,12 +362,6 @@ def _pipeline_1f1b_local(
             lambda p, x: stage_fn(p, x, tok_b, tgt_b), params, x_saved)
         # cotangents: boundary ct from the next stage, except the last
         # stage, which seeds the loss instead
-        def _match_vma(ct, primal):
-            # a cotangent must carry the primal output's exact vma
-            need = tuple(a for a in jax.typeof(primal).vma
-                         if a not in jax.typeof(ct).vma)
-            return jax.lax.pcast(ct, need, to='varying') if need else ct
-
         ct_x = _match_vma(jnp.where(is_last, jnp.zeros_like(buf_ct), buf_ct),
                           x_p)
         ct_loss = _match_vma(
@@ -316,29 +381,18 @@ def _pipeline_1f1b_local(
         return (buf_fwd, buf_ct, ring, gacc, loss_sum), None
 
     act = jnp.zeros((mbs_local, seq, cfg.hidden), cfg.dtype)
+    vary = lambda x: _varying(x, (PP, DP))  # noqa: E731
     carry0 = (
-        _varying(act),                       # buf_fwd
-        _varying(act),                       # buf_ct
-        _varying(jnp.zeros((R,) + act.shape, cfg.dtype)),  # ring
+        vary(act),                           # buf_fwd
+        vary(act),                           # buf_ct
+        vary(jnp.zeros((R,) + act.shape, cfg.dtype)),  # ring
         jax.tree.map(                        # gacc: local grad shards
-            lambda p: _varying(jnp.zeros_like(p, dtype=jnp.float32)), params),
-        _varying(jnp.zeros((), jnp.float32)),  # loss_sum
+            lambda p: vary(jnp.zeros_like(p, dtype=jnp.float32)), params),
+        vary(jnp.zeros((), jnp.float32)),    # loss_sum
     )
     (_, _, _, gacc, loss_sum), _ = jax.lax.scan(
         tick, carry0, jnp.arange(ticks))
-
-    loss = jax.lax.psum(loss_sum, PP) / M
-    loss = jax.lax.pmean(loss, DP)
-    # grads: average over microbatches and dp; pipeline-replicated leaves
-    # (embed/head) live on one stage each — psum over pp rebuilds the
-    # replicated gradient (contributions elsewhere are exactly zero)
-    grads = jax.tree.map(lambda g: jax.lax.pmean(g / M, DP), gacc)
-    grads = {
-        "embed": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["embed"]),
-        "blocks": grads["blocks"],
-        "head": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["head"]),
-    }
-    return loss, grads
+    return _reduce_pipeline_grads(gacc, loss_sum, M)
 
 
 def interleave_block_order(num_blocks: int, pp: int, vs: int) -> list[int]:
@@ -396,11 +450,8 @@ def _pipeline_interleaved_local(
     local_blocks = jax.tree.leaves(params["blocks"])[0].shape[0]
     K = local_blocks // vs
 
-    def _varying(x):
-        need = tuple(a for a in (PP, DP) if a not in jax.typeof(x).vma)
-        return jax.lax.pcast(x, need, to='varying') if need else x
-
-    params = jax.tree.map(_varying, params)
+    vary = lambda x: _varying(x, (PP, DP))  # noqa: E731
+    params = _vary_params_for_manual_vjp(params)
 
     def chunk_fwd(p, x, v):
         chunk = jax.tree.map(
@@ -414,17 +465,13 @@ def _pipeline_interleaved_local(
 
     def unit_fn(p, x_in, tok, tgt, v):
         """One (chunk, stage) unit; embed on the first unit, head loss on
-        the last (its cotangent is seeded only there)."""
-        x0 = tp_embed(p, tok, cfg)
-        x = jnp.where((v == 0) & (stage == 0), x0, x_in)
+        the last (its cotangent is seeded only there); both gated under
+        lax.cond so every other unit skips the compute."""
+        x = _gated_embed(p, tok, x_in, (v == 0) & (stage == 0), cfg)
         x_out = chunk_fwd(p, x, v)
-        loss = tp_head_loss(p, x_out, tgt, cfg)
+        loss = _gated_head_loss(
+            p, x_out, tgt, (v == vs - 1) & (stage == S - 1), cfg)
         return x_out, loss
-
-    def _match_vma(ct, primal):
-        need = tuple(a for a in jax.typeof(primal).vma
-                     if a not in jax.typeof(ct).vma)
-        return jax.lax.pcast(ct, need, to='varying') if need else ct
 
     act = jnp.zeros((mbs_local, seq, cfg.hidden), cfg.dtype)
 
@@ -441,8 +488,8 @@ def _pipeline_interleaved_local(
             u_c = jnp.clip(u, 0, VS - 1)
             v, g = u_c // S, u_c % S
             tok = jax.lax.dynamic_index_in_dim(toks, g, 0, False)
-            x0 = tp_embed(params, tok, cfg)
-            x_in = jnp.where((v == 0) & (stage == 0), x0, buf)
+            x_in = _gated_embed(
+                params, tok, buf, (v == 0) & (stage == 0), cfg)
             old = jax.lax.dynamic_index_in_dim(ring, u_c, 0, False)
             ring = jax.lax.dynamic_update_index_in_dim(
                 ring, jnp.where(active, x_in, old), u_c, 0)
@@ -450,9 +497,9 @@ def _pipeline_interleaved_local(
             buf = jax.lax.ppermute(x_out, PP, fwd_perm) if S > 1 else x_out
             return (buf, ring), None
 
-        ring0 = _varying(jnp.zeros((VS,) + act.shape, cfg.dtype))
+        ring0 = vary(jnp.zeros((VS,) + act.shape, cfg.dtype))
         (_, ring), _ = jax.lax.scan(
-            ftick, (_varying(act), ring0), jnp.arange(ticks))
+            ftick, (vary(act), ring0), jnp.arange(ticks))
 
         # ---- backward drain: reversed order, remat per unit
         def btick(bc, tb):
@@ -483,24 +530,15 @@ def _pipeline_interleaved_local(
             return (gacc, loss_sum, buf_ct), None
 
         (gacc, loss_sum, _), _ = jax.lax.scan(
-            btick, (gacc, loss_sum, _varying(act)), jnp.arange(ticks))
+            btick, (gacc, loss_sum, vary(act)), jnp.arange(ticks))
         return (gacc, loss_sum), None
 
     gacc0 = jax.tree.map(
-        lambda p: _varying(jnp.zeros_like(p, dtype=jnp.float32)), params)
+        lambda p: vary(jnp.zeros_like(p, dtype=jnp.float32)), params)
     (gacc, loss_sum), _ = jax.lax.scan(
-        run_group, (gacc0, _varying(jnp.zeros((), jnp.float32))),
+        run_group, (gacc0, vary(jnp.zeros((), jnp.float32))),
         jnp.arange(groups))
-
-    loss = jax.lax.psum(loss_sum, PP) / M
-    loss = jax.lax.pmean(loss, DP)
-    grads = jax.tree.map(lambda g: jax.lax.pmean(g / M, DP), gacc)
-    grads = {
-        "embed": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["embed"]),
-        "blocks": grads["blocks"],
-        "head": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["head"]),
-    }
-    return loss, grads
+    return _reduce_pipeline_grads(gacc, loss_sum, M)
 
 
 def make_pipeline_train_step(

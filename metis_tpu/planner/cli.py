@@ -552,7 +552,8 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                 state = restore_hetero_checkpoint(args.checkpoint_dir, state)
             else:
                 restored = restore_checkpoint(
-                    args.checkpoint_dir, as_train_state(state, start_step))
+                    args.checkpoint_dir, as_train_state(state, start_step),
+                    expected_block_layout=block_layout)
                 state = (restored if exe.kind == "gspmd"
                          else (restored.params, restored.opt_state))
             print(f"resumed from {args.checkpoint_dir} at step {start_step}",

@@ -51,6 +51,17 @@ class TestRouting:
         first, second = _train_two_steps(exe, art.gbs)
         assert np.isfinite(first) and second < first
 
+    def test_pp2_interleaved_schedule_trains(self):
+        """schedule="interleaved" rides the pipeline route (CFG: 4 blocks =
+        2 stages x 2 virtual chunks) and trains."""
+        art = PlanArtifact.from_uniform_plan(
+            UniformPlan(dp=2, pp=2, tp=2, mbs=2, gbs=8))
+        exe = build_executable(CFG, art, schedule="interleaved",
+                               virtual_stages=2)
+        assert exe.kind == "pipeline"
+        first, second = _train_two_steps(exe, art.gbs)
+        assert np.isfinite(first) and second < first
+
     def test_pp2_with_zero_routes_hetero(self):
         """ZeRO under pipelining: the per-stage GSPMD executor delivers the
         state sharding the cost model credits (ADVICE r1 medium)."""
