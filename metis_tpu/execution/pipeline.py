@@ -121,7 +121,7 @@ def tp_head_loss(params: dict, x: jnp.ndarray, targets: jnp.ndarray,
 # ---------------------------------------------------------------------------
 
 
-def _varying(x, axes=(DP,)):
+def _varying(x, axes=(PP, DP)):
     """Cast up to varying over ``axes``, skipping axes the value already
     varies over (param-derived zeros inherit the shards' vma)."""
     need = tuple(a for a in axes if a not in jax.typeof(x).vma)
@@ -381,14 +381,13 @@ def _pipeline_1f1b_local(
         return (buf_fwd, buf_ct, ring, gacc, loss_sum), None
 
     act = jnp.zeros((mbs_local, seq, cfg.hidden), cfg.dtype)
-    vary = lambda x: _varying(x, (PP, DP))  # noqa: E731
     carry0 = (
-        vary(act),                           # buf_fwd
-        vary(act),                           # buf_ct
-        vary(jnp.zeros((R,) + act.shape, cfg.dtype)),  # ring
+        _varying(act),                       # buf_fwd
+        _varying(act),                       # buf_ct
+        _varying(jnp.zeros((R,) + act.shape, cfg.dtype)),  # ring
         jax.tree.map(                        # gacc: local grad shards
-            lambda p: vary(jnp.zeros_like(p, dtype=jnp.float32)), params),
-        vary(jnp.zeros((), jnp.float32)),    # loss_sum
+            lambda p: _varying(jnp.zeros_like(p, dtype=jnp.float32)), params),
+        _varying(jnp.zeros((), jnp.float32)),  # loss_sum
     )
     (_, _, _, gacc, loss_sum), _ = jax.lax.scan(
         tick, carry0, jnp.arange(ticks))
@@ -450,7 +449,6 @@ def _pipeline_interleaved_local(
     local_blocks = jax.tree.leaves(params["blocks"])[0].shape[0]
     K = local_blocks // vs
 
-    vary = lambda x: _varying(x, (PP, DP))  # noqa: E731
     params = _vary_params_for_manual_vjp(params)
 
     def chunk_fwd(p, x, v):
@@ -497,9 +495,9 @@ def _pipeline_interleaved_local(
             buf = jax.lax.ppermute(x_out, PP, fwd_perm) if S > 1 else x_out
             return (buf, ring), None
 
-        ring0 = vary(jnp.zeros((VS,) + act.shape, cfg.dtype))
+        ring0 = _varying(jnp.zeros((VS,) + act.shape, cfg.dtype))
         (_, ring), _ = jax.lax.scan(
-            ftick, (vary(act), ring0), jnp.arange(ticks))
+            ftick, (_varying(act), ring0), jnp.arange(ticks))
 
         # ---- backward drain: reversed order, remat per unit
         def btick(bc, tb):
@@ -530,13 +528,13 @@ def _pipeline_interleaved_local(
             return (gacc, loss_sum, buf_ct), None
 
         (gacc, loss_sum, _), _ = jax.lax.scan(
-            btick, (gacc, loss_sum, vary(act)), jnp.arange(ticks))
+            btick, (gacc, loss_sum, _varying(act)), jnp.arange(ticks))
         return (gacc, loss_sum), None
 
     gacc0 = jax.tree.map(
-        lambda p: vary(jnp.zeros_like(p, dtype=jnp.float32)), params)
+        lambda p: _varying(jnp.zeros_like(p, dtype=jnp.float32)), params)
     (gacc, loss_sum), _ = jax.lax.scan(
-        run_group, (gacc0, vary(jnp.zeros((), jnp.float32))),
+        run_group, (gacc0, _varying(jnp.zeros((), jnp.float32))),
         jnp.arange(groups))
     return _reduce_pipeline_grads(gacc, loss_sum, M)
 

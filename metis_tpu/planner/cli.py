@@ -551,9 +551,10 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
             if exe.kind == "hetero":
                 state = restore_hetero_checkpoint(args.checkpoint_dir, state)
             else:
+                # layout already compared above (single check; the
+                # library-level guard serves non-CLI consumers)
                 restored = restore_checkpoint(
-                    args.checkpoint_dir, as_train_state(state, start_step),
-                    expected_block_layout=block_layout)
+                    args.checkpoint_dir, as_train_state(state, start_step))
                 state = (restored if exe.kind == "gspmd"
                          else (restored.params, restored.opt_state))
             print(f"resumed from {args.checkpoint_dir} at step {start_step}",
