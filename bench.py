@@ -430,23 +430,116 @@ def tpu_validation(record: dict) -> None:
         record["tpu_validation"] = {"skipped": f"{type(e).__name__}: {e}"[:160]}
 
 
+PROBE_LOG = Path(__file__).resolve().parent / "calibration" / \
+    "tpu_probe_log.jsonl"
+TPU_CACHE = Path(__file__).resolve().parent / "calibration" / \
+    "tpu_results_cache.json"
+
+
 def probe_tpu(timeout_s: float = 90.0) -> bool:
     """Whether the default jax backend initializes AND executes in a
     subprocess within the budget.  The remote-TPU tunnel can wedge in a way
     that hangs backend init forever (no exception to catch), which would
-    hang the whole bench — probe out-of-process and fall back to CPU."""
+    hang the whole bench — probe out-of-process and fall back to CPU.
+
+    Every attempt is appended to ``calibration/tpu_probe_log.jsonl`` so a
+    round whose every probe failed still ships evidence the chip was tried
+    (VERDICT r2 next-step 1: "a recorded probe log proving the chip was
+    unreachable every attempt")."""
+    attempt: dict = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "timeout_s": timeout_s,
+    }
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
              "import jax, jax.numpy as jnp; "
              "x = jnp.ones((128, 128)); "
-             "print(float(jax.device_get((x @ x).sum())))"],
+             "print(float(jax.device_get((x @ x).sum()))); "
+             "print(jax.devices()[0].platform, jax.devices()[0].device_kind)"],
             capture_output=True, text=True, timeout=timeout_s,
             env={**os.environ, "JAX_PLATFORMS": ""},
         )
-        return proc.returncode == 0
+        attempt["rc"] = proc.returncode
+        lines = proc.stdout.strip().splitlines()
+        # reachable but CPU-only backends count as failure for TPU purposes
+        ok = proc.returncode == 0 and bool(lines) and \
+            not lines[-1].startswith("cpu")
+        attempt["backend"] = lines[-1][:80] if lines else None
+        if proc.returncode != 0:
+            attempt["stderr_tail"] = proc.stderr[-300:]
     except subprocess.TimeoutExpired:
+        ok = False
+        attempt["timed_out"] = True
+    attempt["ok"] = ok
+    try:
+        PROBE_LOG.parent.mkdir(exist_ok=True)
+        with PROBE_LOG.open("a") as fh:
+            fh.write(json.dumps(attempt) + "\n")
+    except OSError:
+        pass
+    return ok
+
+
+def probe_attempts(limit: int | None = None) -> list[dict]:
+    """Probe attempts from the persistent transcript (all by default)."""
+    try:
+        lines = PROBE_LOG.read_text().strip().splitlines()
+    except OSError:
+        return []
+    out = []
+    for ln in (lines if limit is None else lines[-limit:]):
+        try:
+            out.append(json.loads(ln))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+def _tpu_entry_has_numbers(key: str, entry) -> bool:
+    """Whether a tpu_step/tpu_validation entry carries actual hardware
+    measurements (not a skip, an error, or all-failed sub-measurements)."""
+    if not isinstance(entry, dict) or "skipped" in entry or "error" in entry:
         return False
+    if key == "tpu_step":
+        return any(isinstance(entry.get(a), dict) and "failed" not in entry[a]
+                   for a in ("dense", "flash"))
+    if key == "tpu_validation":
+        return bool(entry.get("plans"))
+    return True
+
+
+def tpu_capture() -> bool:
+    """Opportunistic hardware capture: probe the chip; on success run ONLY
+    the TPU sections and persist them to ``calibration/tpu_results_cache.json``
+    so a later bench run (when the tunnel may be wedged again) can still
+    report hardware-measured numbers with their capture timestamp.  Only
+    entries with actual measurements are cached — a skip/error/all-failed
+    entry must never masquerade later as preserved hardware data."""
+    if not probe_tpu():
+        print(json.dumps({"ok": False, "reason": "probe failed"}))
+        return False
+    # the probe subprocess runs with JAX_PLATFORMS cleared; the capture in
+    # THIS process must see the same backend, or a lingering cpu pin would
+    # skip the sections the probe just proved reachable
+    os.environ.pop("JAX_PLATFORMS", None)
+    rec: dict = {}
+    for section in (tpu_step, tpu_validation):
+        try:
+            section(rec)
+        except Exception as e:  # noqa: BLE001 — record, keep the other half
+            rec[section.__name__] = {"error": f"{type(e).__name__}: {e}"[:160]}
+    cacheable = {k: v for k, v in rec.items()
+                 if _tpu_entry_has_numbers(k, v)}
+    if cacheable:
+        cacheable["captured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        try:
+            TPU_CACHE.write_text(json.dumps(cacheable, indent=1))
+        except OSError as e:  # still print the measured numbers below
+            rec["cache_write_failed"] = str(e)[:120]
+    print(json.dumps({"ok": bool(cacheable), **rec}))
+    return bool(cacheable)
 
 
 def main() -> None:
@@ -460,8 +553,22 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        record["tpu_probe"] = "unreachable (backend init/execute timed out); "\
-            "bench pinned to cpu"
+        attempts = probe_attempts()
+        last = attempts[-1] if attempts else {}
+        if last.get("timed_out"):
+            why = "backend init/execute timed out (wedged tunnel)"
+        elif (last.get("backend") or "").startswith("cpu"):
+            why = "backend reachable but CPU-only (no TPU attached)"
+        elif last.get("rc") not in (0, None):
+            why = f"backend init failed (rc={last['rc']})"
+        else:
+            why = "probe failed"
+        record["tpu_probe"] = {
+            "status": f"no TPU: {why}; bench pinned to cpu",
+            "attempts_total": len(attempts),
+            "attempts_ok": sum(1 for a in attempts if a.get("ok")),
+            "recent_attempts": attempts[-8:],
+        }
     parity_search(record)
     for section in (scale_search, tpu_step, validation_error, tpu_validation):
         try:
@@ -469,8 +576,25 @@ def main() -> None:
         except Exception as e:
             record[section.__name__] = {
                 "error": f"{type(e).__name__}: {e}"[:160]}
+    # a wedged tunnel at bench time must not erase hardware numbers captured
+    # earlier in the round (bench --tpu-capture persists them with a stamp);
+    # only entries with real measurements replace a live skip
+    if TPU_CACHE.exists():
+        try:
+            cache = json.loads(TPU_CACHE.read_text())
+            for key in ("tpu_step", "tpu_validation"):
+                live = record.get(key, {})
+                if (not _tpu_entry_has_numbers(key, live)
+                        and _tpu_entry_has_numbers(key, cache.get(key))):
+                    record[key] = {**cache[key],
+                                   "cached_at": cache.get("captured_at"),
+                                   "live_attempt": live}
+        except (OSError, json.JSONDecodeError):
+            pass
     print(json.dumps(record))
 
 
 if __name__ == "__main__":
+    if "--tpu-capture" in sys.argv:
+        sys.exit(0 if tpu_capture() else 1)
     main()

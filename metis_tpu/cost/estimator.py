@@ -301,8 +301,8 @@ class HeteroCostEstimator(_EstimatorBase):
         L = self.volume.num_layers
 
         lens: list[float] = []
-        comm_by_stage: list[float] = []  # ring + a2a, for breakdown reconcile
-        ring_total = a2a_total = 0.0
+        comm_by_stage: list[float] = []  # cp + ep, for breakdown reconcile
+        cp_total = a2a_total = 0.0
         dp_costs: list[float] = []
         opt_costs: list[float] = []
         fb_sync = pp_cost = 0.0
@@ -315,18 +315,19 @@ class HeteroCostEstimator(_EstimatorBase):
                 plan, strat, stage_types, start_l, end_l)
             mbs = plan.gbs // strat.dp // plan.batches
             cp_bw = None
-            ring_ms = a2a_ms = 0.0
+            cp_ms = a2a_ms = 0.0
             if strat.cp > 1:
                 # Context-parallel comm extends the stage's critical path
                 # (un-overlapped model, cost/context_parallel.py): the ring
                 # K/V rotation, or the Ulysses all-to-alls when the
-                # strategy's cp_mode is "a2a".
+                # strategy's cp_mode is "a2a" — cp_ms is mode-neutral, it is
+                # whatever the priced cp_mode's traffic costs.
                 cp_bw = self._cp_bw(bandwidth, stage_id, strat)
-                ring_ms = cp_comm_ms(
+                cp_ms = cp_comm_ms(
                     self.volume.model, mbs, strat.cp, strat.tp,
                     attention_layer_range(self.volume.model, start_l, end_l),
                     cp_bw, mode=strat.cp_mode)
-                stage_ms += ring_ms
+                stage_ms += cp_ms
             if strat.ep > 1:
                 # MoE token all-to-all rides the links of the dp sub-group
                 # the ep axis is carved from (un-overlapped model,
@@ -336,8 +337,8 @@ class HeteroCostEstimator(_EstimatorBase):
                     moe_layer_range(self.volume.model, start_l, end_l),
                     self._dp_bw(bandwidth, stage_id, strat), cp=strat.cp)
                 stage_ms += a2a_ms
-            comm_by_stage.append(ring_ms + a2a_ms)
-            ring_total += ring_ms
+            comm_by_stage.append(cp_ms + a2a_ms)
+            cp_total += cp_ms
             a2a_total += a2a_ms
             lens.append(stage_ms)
 
@@ -399,15 +400,15 @@ class HeteroCostEstimator(_EstimatorBase):
                 * (end_l - start_l) / L)
 
         execution = (plan.batches - 1) * max(lens) + sum(lens)
-        # cp_comm_ms / ep_comm_ms report exactly the ring / all-to-all
-        # traffic's contribution to the GPipe execution total (the with-comm
-        # minus without-comm delta, split pro rata), so the breakdown fields
-        # reconcile for the validator.
+        # cp_comm_ms / ep_comm_ms report exactly the cp (ring or a2a) /
+        # MoE all-to-all traffic's contribution to the GPipe execution total
+        # (the with-comm minus without-comm delta, split pro rata), so the
+        # breakdown fields reconcile for the validator.
         lens_nocomm = [l - c for l, c in zip(lens, comm_by_stage)]
         comm_delta = execution - (
             (plan.batches - 1) * max(lens_nocomm) + sum(lens_nocomm))
-        comm_total = ring_total + a2a_total
-        cp_cost = comm_delta * ring_total / comm_total if comm_total else 0.0
+        comm_total = cp_total + a2a_total
+        cp_cost = comm_delta * cp_total / comm_total if comm_total else 0.0
         ep_cost = comm_delta * a2a_total / comm_total if comm_total else 0.0
         first_stage_type = ranks[0] if ranks else None
         batch_gen = self._batch_gen_ms(plan.batches, first_stage_type)

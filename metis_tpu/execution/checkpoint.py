@@ -40,7 +40,7 @@ class CheckpointMeta:
     """Sidecar metadata — enough to sanity-check a resume.
 
     ``block_layout`` records the physical ordering of the stacked block
-    axis: "canonical", or "interleaved:<vs>" for the interleaved pipeline
+    axis: "canonical", or "interleaved:<pp>x<vs>" for the interleaved pipeline
     schedule's device-major chunk permutation
     (``execution.pipeline.interleave_block_order``) — restoring a permuted
     checkpoint under a different schedule would silently scramble the
@@ -268,6 +268,30 @@ def _restore_tree(directory: Path, ref: dict) -> dict:
             args=ocp.args.PyTreeRestore(item=ref, restore_args=restore_args))
 
 
+def block_layouts_compatible(meta: CheckpointMeta, expected: str) -> bool:
+    """Whether a checkpoint's recorded block layout matches ``expected``.
+
+    Handles the legacy "interleaved:<vs>" format (before pp was encoded in
+    the string): it is accepted iff the vs matches AND the checkpoint's own
+    recorded mesh pp extent equals the expected pp — the permutation
+    (``interleave_block_order``) depends on both, so a same-vs checkpoint
+    from a different pp must still be refused."""
+    if meta.block_layout == expected:
+        return True
+    if (meta.block_layout.startswith("interleaved:")
+            and "x" not in meta.block_layout
+            and expected.startswith("interleaved:")
+            and "x" in expected):
+        exp_pp, _, exp_vs = expected[len("interleaved:"):].partition("x")
+        legacy_vs = meta.block_layout[len("interleaved:"):]
+        try:
+            meta_pp = meta.mesh_shape[meta.mesh_axes.index("pp")]
+        except ValueError:
+            meta_pp = 1
+        return legacy_vs == exp_vs and str(meta_pp) == exp_pp
+    return False
+
+
 def restore_checkpoint(
     directory: str | Path,
     reference_state: TrainState,
@@ -282,13 +306,13 @@ def restore_checkpoint(
     (interleaved-schedule) checkpoint under a different layout silently
     scrambles the layers."""
     if expected_block_layout is not None:
-        got = load_meta(directory).block_layout
-        if got != expected_block_layout:
+        meta = load_meta(directory)
+        if not block_layouts_compatible(meta, expected_block_layout):
             raise ValueError(
                 f"checkpoint {directory} was written with block layout "
-                f"'{got}', expected '{expected_block_layout}' — refusing "
-                "to restore (a layout mismatch silently scrambles the "
-                "stacked block axis)")
+                f"'{meta.block_layout}', expected '{expected_block_layout}' "
+                "— refusing to restore (a layout mismatch silently "
+                "scrambles the stacked block axis)")
     tree = _restore_tree(_resolve_dir(directory), _state_tree(reference_state))
     step = tree["step"]
     if not isinstance(step, jax.Array):

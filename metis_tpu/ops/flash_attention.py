@@ -16,11 +16,12 @@ Kernel shape (canonical TPU flash attention):
   bf16; output cast back to the query dtype.
 
 Differentiation: ``flash_attention`` carries a ``jax.custom_vjp`` whose
-backward recomputes attention with the dense jnp reference and differentiates
-that — numerically consistent with the forward to fp32 rounding, O(seq^2)
-memory only inside the backward of one head-batch.  A fully-blockwise pallas
-backward is a later optimization; the forward is where inference and
-activation-recompute training spend their time.
+backward is itself a blockwise pallas kernel (``_fa_bwd_call``): it replays
+the KV-block grid with the forward's saved (output, logsumexp) state to
+recompute probabilities tile-by-tile and accumulate dQ/dK/dV in VMEM scratch
+— O(seq) HBM traffic in the backward too, never materializing the
+[seq, seq] score matrix.  The same backward serves the ring-attention
+per-shard backward (``ops/ring_attention.py``).
 
 ``flash_attention_stats`` returns the *unnormalized* accumulator plus the
 running (m, l) softmax state, which makes the kernel composable into ring

@@ -531,18 +531,27 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
 
     # the interleaved schedule permutes the physical block order of
     # params/checkpoints; record it and refuse a resume under a different
-    # layout (a silent mismatch would scramble the layers)
+    # layout (a silent mismatch would scramble the layers).  The permutation
+    # (interleave_block_order) depends on BOTH pp and virtual_stages, and
+    # restore supports a different target mesh — so pp must be part of the
+    # layout string or a same-vs/different-pp resume would pass the guard
+    # and scramble the stacked block axis.
+    pp_extent = (art.mesh_shape[art.mesh_axes.index("pp")]
+                 if "pp" in art.mesh_axes else 1)
     block_layout = ("canonical" if exe.kind != "pipeline"
                     or schedule != "interleaved"
-                    else f"interleaved:{args.virtual_stages}")
+                    else f"interleaved:{pp_extent}x{args.virtual_stages}")
 
     state = exe.init(jax.random.PRNGKey(0))
     start_step = 0
     if can_ckpt:
         try:
+            from metis_tpu.execution.checkpoint import \
+                block_layouts_compatible
+
             meta = load_meta(args.checkpoint_dir)
             start_step = meta.step
-            if meta.block_layout != block_layout:
+            if not block_layouts_compatible(meta, block_layout):
                 print(f"checkpoint {args.checkpoint_dir} was written with "
                       f"block layout '{meta.block_layout}' but this run uses "
                       f"'{block_layout}' (--schedule/--virtual-stages "
@@ -599,7 +608,10 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         for i in range(args.steps):
             toks, tgts = next(batches)
             state, loss = exe.step(state, toks, tgts)
-            if (i + 1) % args.log_every == 0 or i + 1 == args.steps:
+            # step-1 loss is always recorded so the summary's first_loss is
+            # genuinely the first step, not the first --log-every boundary
+            if (i == 0 or (i + 1) % args.log_every == 0
+                    or i + 1 == args.steps):
                 loss = float(loss)
                 losses.append(loss)
                 events.emit("train_step", step=start_step + i + 1, loss=loss,

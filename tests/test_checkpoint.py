@@ -284,3 +284,37 @@ class TestPlanArtifact:
         save_checkpoint(tmp_path / "ckpt", state, mesh, plan=art)
         assert load_plan(tmp_path / "ckpt") == art
         assert load_plan(tmp_path / "no-such-ckpt") is None
+
+
+def test_block_layouts_compatible_legacy_format():
+    """Legacy 'interleaved:<vs>' metas (written before pp was encoded in the
+    layout string) are accepted iff vs matches AND the checkpoint's own mesh
+    pp extent equals the expected pp — a same-vs/different-pp resume must
+    still be refused (the interleave permutation depends on both)."""
+    from metis_tpu.execution.checkpoint import (
+        CheckpointMeta,
+        block_layouts_compatible,
+    )
+
+    legacy = CheckpointMeta(step=1, mesh_axes=("pp", "dp"),
+                            mesh_shape=(2, 4), block_layout="interleaved:3")
+    assert block_layouts_compatible(legacy, "interleaved:2x3")
+    assert not block_layouts_compatible(legacy, "interleaved:4x3")  # pp diff
+    assert not block_layouts_compatible(legacy, "interleaved:2x2")  # vs diff
+    assert not block_layouts_compatible(legacy, "canonical")
+
+    # a legacy meta with no pp axis has pp extent 1
+    legacy_nopp = CheckpointMeta(step=1, mesh_axes=("dp",), mesh_shape=(8,),
+                                 block_layout="interleaved:2")
+    assert block_layouts_compatible(legacy_nopp, "interleaved:1x2")
+    assert not block_layouts_compatible(legacy_nopp, "interleaved:2x2")
+
+    # new-format strings compare exactly; canonical matches only canonical
+    new = CheckpointMeta(step=1, mesh_axes=("pp", "dp"), mesh_shape=(2, 4),
+                         block_layout="interleaved:2x3")
+    assert block_layouts_compatible(new, "interleaved:2x3")
+    assert not block_layouts_compatible(new, "interleaved:2x2")
+    canon = CheckpointMeta(step=1, mesh_axes=("dp",), mesh_shape=(8,),
+                           block_layout="canonical")
+    assert block_layouts_compatible(canon, "canonical")
+    assert not block_layouts_compatible(canon, "interleaved:2x2")

@@ -150,7 +150,7 @@ def test_train_refuses_layout_mismatch_resume(fixture_dir, tmp_path):
     (ckpt / "meta.json").write_text(CheckpointMeta(
         step=meta.step, mesh_axes=meta.mesh_axes,
         mesh_shape=meta.mesh_shape,
-        block_layout="interleaved:2").to_json())
+        block_layout="interleaved:2x2").to_json())
     assert main([*base, "--steps", "1"]) == 1
 
 
