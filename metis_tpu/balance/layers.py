@@ -225,6 +225,83 @@ class LayerBalancer:
         return 0.001 + self.config.mem_coef * sum(
             sum(row[start:end]) for row in rows)
 
+    # -- schedule-aware feasibility (pipeline-schedule plan families) ------
+    def schedule_partition(
+        self,
+        plan: InterStagePlan,
+        strategies: Sequence[Strategy],
+        memory_capacity: Sequence[float],
+        schedule: str,
+        virtual_stages: int,
+    ) -> PartitionResult:
+        """Even-split partition + schedule-aware memory feasibility for the
+        pipeline-schedule families (cost/schedule.py).
+
+        The shard_map pipeline executor requires the canonical even block
+        split (``execution/builder.py _uniform_block_split``), so these
+        families don't run the minmax DP — they take the canonical split and
+        check it against the schedule's TRUE activation peak:
+
+            demand = mem_coef * static + act_factor * act + boundary_bufs
+
+        where (static, act) come from the profile store's batch-size-sweep
+        fit (``ActivationSplitModel``), ``act_factor`` is the schedule's
+        in-flight microbatch count (gpipe: M, 1f1b: 1, interleaved: 1/vs),
+        and ``boundary_bufs`` are the remat schedules' saved boundary
+        inputs.  ``mem_coef`` (the reference's 5.0 fudge,
+        ``load_balancer.py:31``) multiplies only the static component here —
+        it stands in for grad/optimizer state, which scales with params; the
+        activation term is charged at its actual in-flight count instead.
+        Falls back to the legacy schedule-blind demand when the store has
+        too few batch points to identify the split (conservative for the
+        remat schedules — never optimistic about relief)."""
+        from metis_tpu.cost.estimator import uniform_layer_split
+        from metis_tpu.cost.schedule import (
+            boundary_buffer_mb,
+            schedule_activation_factor,
+            schedule_boundary_buffers,
+        )
+
+        S = plan.num_stages
+        L = len(self.layer_weights)
+        if S > L:
+            return PartitionResult(None, -1, None)
+        counts = uniform_layer_split(L, S)
+        bounds = [0]
+        for c in counts:
+            bounds.append(bounds[-1] + c)
+        ranks = rank_device_types(self.cluster, plan.node_sequence)
+        act_factor = schedule_activation_factor(
+            schedule, plan.batches, virtual_stages)
+        nbuf = schedule_boundary_buffers(
+            schedule, S, plan.batches, virtual_stages)
+        demands: list[float] = []
+        for s, strat in enumerate(strategies):
+            stage_types = ranks[slice(*plan.stage_rank_range(s))]
+            mem_type = stage_types[0]
+            bs = plan.gbs // plan.batches // strat.dp
+            base = self.profiles.get(mem_type, strat.tp, bs).layer_memory_mb
+            start, end = bounds[s], bounds[s + 1]
+            fitted = self.act_split.split(mem_type, strat.tp)
+            if fitted is None:
+                demands.append(
+                    0.001 + self.config.mem_coef * sum(base[start:end]))
+                continue
+            static, slope = fitted
+            stat_mb = sum(static[start:end])
+            act_mb = sum(sl * bs for sl in slope[start:end])
+            bnd_mb = 0.0
+            if nbuf and self.model is not None:
+                bnd_mb = nbuf * boundary_buffer_mb(
+                    bs, self.model.sequence_length, self.model.hidden_size,
+                    self.model.dtype_bytes)
+            demands.append(0.001 + self.config.mem_coef * stat_mb
+                           + act_factor * act_mb + bnd_mb)
+        state = tuple(c - d for c, d in zip(memory_capacity, demands))
+        if min(state) >= 0:
+            return PartitionResult(tuple(bounds), 1, state)
+        return PartitionResult(None, -1, state)
+
     # -- partitioning ------------------------------------------------------
     def partition(
         self,

@@ -93,9 +93,16 @@ class SearchConfig:
     enable_ep: bool = False  # add expert-parallel (MoE) variants
     max_ep_degree: int = 1
     enable_zero: bool = False  # add ZeRO-1/2/3 sharded-state variants
+    # add 1f1b/interleaved pipeline-SCHEDULE variants to the plan space
+    # (cost/schedule.py; gpipe is always searched — it is the reference
+    # baseline formula, cost_estimator.py:129)
+    enable_schedule_search: bool = False
+    virtual_stage_candidates: tuple[int, ...] = (2,)
 
     def __post_init__(self) -> None:
         if self.gbs < 1:
             raise ValueError("gbs must be positive")
         if self.max_permute_len < 1:
             raise ValueError("max_permute_len must be >= 1")
+        if any(v < 2 for v in self.virtual_stage_candidates):
+            raise ValueError("virtual_stage_candidates must all be >= 2")

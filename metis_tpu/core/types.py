@@ -125,12 +125,19 @@ class IntraStagePlan:
     reference's repair-attempt counter (``plan.py:37``): 1 means the
     compute-optimal partition was memory-feasible as-is; >1 means the memory
     repair path ran.
+
+    ``schedule``/``virtual_stages`` record the pipeline schedule this plan
+    was priced (and must be executed) with — a searched axis beyond the
+    reference, which prices only the GPipe fill-drain
+    (``cost_estimator.py:129``; see cost/schedule.py).
     """
 
     strategies: tuple[Strategy, ...]
     layer_partition: tuple[int, ...]
     memory_state: tuple[float, ...]
     num_repartition: int
+    schedule: str = "gpipe"
+    virtual_stages: int = 1
 
 
 @dataclass(frozen=True)
@@ -169,6 +176,8 @@ class RankedPlan:
             "strategies": [asdict(s) for s in self.intra.strategies],
             "layer_partition": list(self.intra.layer_partition),
             "num_repartition": self.intra.num_repartition,
+            "schedule": self.intra.schedule,
+            "virtual_stages": self.intra.virtual_stages,
         }
 
 

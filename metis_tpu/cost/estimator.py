@@ -39,6 +39,10 @@ from metis_tpu.cost.expert_parallel import (
     expert_param_fraction,
     moe_layer_range,
 )
+from metis_tpu.cost.schedule import (
+    schedule_execution_ms,
+    schedule_pp_send_factor,
+)
 from metis_tpu.cost.zero import zero_dp_factor
 from metis_tpu.cost.volume import TransformerVolume
 
@@ -292,6 +296,8 @@ class HeteroCostEstimator(_EstimatorBase):
         strategies: Sequence[Strategy],
         layer_partition: Sequence[int],
         rank_types: Sequence[str] | None = None,
+        schedule: str = "gpipe",
+        virtual_stages: int = 1,
     ) -> PlanCost:
         ranks = (
             list(rank_types) if rank_types is not None
@@ -399,14 +405,21 @@ class HeteroCostEstimator(_EstimatorBase):
                 self._optimizer_ms(opt_type) / strat.tp / opt_shard
                 * (end_l - start_l) / L)
 
-        execution = (plan.batches - 1) * max(lens) + sum(lens)
+        # the schedule is a plan axis (cost/schedule.py): gpipe reproduces
+        # the reference fill-drain verbatim; 1f1b adds the remat factor;
+        # interleaved prices the implemented group-drain bubble and its
+        # vs-times-more pp boundary crossings
+        execution = schedule_execution_ms(
+            schedule, lens, plan.batches, virtual_stages)
+        pp_cost *= schedule_pp_send_factor(
+            schedule, plan.num_stages, virtual_stages)
         # cp_comm_ms / ep_comm_ms report exactly the cp (ring or a2a) /
-        # MoE all-to-all traffic's contribution to the GPipe execution total
-        # (the with-comm minus without-comm delta, split pro rata), so the
-        # breakdown fields reconcile for the validator.
+        # MoE all-to-all traffic's contribution to the schedule's execution
+        # total (the with-comm minus without-comm delta, split pro rata), so
+        # the breakdown fields reconcile for the validator.
         lens_nocomm = [l - c for l, c in zip(lens, comm_by_stage)]
-        comm_delta = execution - (
-            (plan.batches - 1) * max(lens_nocomm) + sum(lens_nocomm))
+        comm_delta = execution - schedule_execution_ms(
+            schedule, lens_nocomm, plan.batches, virtual_stages)
         comm_total = cp_total + a2a_total
         cp_cost = comm_delta * cp_total / comm_total if comm_total else 0.0
         ep_cost = comm_delta * a2a_total / comm_total if comm_total else 0.0

@@ -56,22 +56,41 @@ class Executable:
 
 def _uniform_block_split(artifact: PlanArtifact, cfg: GPTConfig,
                          pp: int) -> bool:
-    """True when the layer partition gives every stage the same block count
+    """True when the layer partition gives every stage the same BLOCK count
     (the shard_map pipeline's contract: the stacked layer axis shards
-    evenly over pp)."""
+    evenly over pp).  Counted in transformer blocks, not profile layers:
+    the canonical even split (``uniform_layer_split``) gives the first/last
+    stages +1 profile layer for the embed/head pseudo-layers while their
+    block counts stay equal — exactly the partition the schedule families
+    emit, which must route here, not to the hetero executor."""
     bounds = artifact.layer_partition
     if not bounds:
         return cfg.num_blocks % max(pp, 1) == 0
-    counts = {bounds[i + 1] - bounds[i] for i in range(len(bounds) - 1)}
-    if len(counts) != 1:
-        return False
-    # profile-layer counts equal; block counts still differ for the
-    # embed/head stages unless the partition is the canonical even split
     blocks = []
     for i in range(len(bounds) - 1):
         lo, hi = bounds[i], bounds[i + 1]
         blocks.append(min(hi - 1, cfg.num_blocks) - max(lo - 1, 0))
-    return len(set(blocks)) == 1 and cfg.num_blocks % len(blocks) == 0
+    return (len(set(blocks)) == 1 and blocks[0] > 0
+            and cfg.num_blocks % len(blocks) == 0)
+
+
+def resolve_schedule(
+    artifact: PlanArtifact,
+    schedule: str | None = None,
+    virtual_stages: int | None = None,
+) -> tuple[str, int]:
+    """One resolution rule for the (schedule, virtual_stages) a plan runs
+    with: explicit arguments win, else the artifact's priced values (with
+    the historical default of 2 chunks when an explicit interleaved request
+    meets an artifact that never recorded a vs).  Shared by
+    ``build_executable`` and the CLI so the checkpoint layout string always
+    describes what actually executes."""
+    if schedule is None:
+        schedule = artifact.schedule
+    if virtual_stages is None:
+        virtual_stages = (artifact.virtual_stages
+                          if artifact.virtual_stages > 1 else 2)
+    return schedule, virtual_stages
 
 
 def build_executable(
@@ -81,8 +100,8 @@ def build_executable(
     optimizer=None,
     cluster=None,
     profiles=None,
-    schedule: str = "gpipe",
-    virtual_stages: int = 2,
+    schedule: str | None = None,
+    virtual_stages: int | None = None,
 ) -> Executable:
     """Route ``artifact`` to the execution path that realizes it.
 
@@ -95,10 +114,12 @@ def build_executable(
     groups) and applies only when the plan routes to the
     shard_map pipeline; the gspmd route has no pipeline and the hetero
     route is already stage-granular-remat with boundary-only storage.
-    Note 1F1B trades FLOPs for memory: it recomputes each stage forward
-    from the saved boundary input (~one extra forward per microbatch-stage
-    that the cost model's fill-drain formula does not price), so prefer it
-    when activation memory binds, not when step time does."""
+    ``None`` (default) runs the schedule the ARTIFACT was priced with —
+    the planner searches the schedule as a plan axis (cost/schedule.py,
+    including 1f1b's remat overhead and true activation peak) and the
+    executable must realize what was costed; pass explicitly to override."""
+    schedule, virtual_stages = resolve_schedule(
+        artifact, schedule, virtual_stages)
     if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if schedule == "interleaved" and virtual_stages < 1:

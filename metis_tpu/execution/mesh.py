@@ -162,6 +162,10 @@ class PlanArtifact:
     # per-stage device counts — non-rectangular stages don't form one mesh
     node_sequence: tuple[str, ...] = ()
     device_groups: tuple[int, ...] = ()
+    # pipeline schedule the plan was PRICED with (a searched axis,
+    # cost/schedule.py) — the executable must run what the planner costed
+    schedule: str = "gpipe"
+    virtual_stages: int = 1
 
     def to_json(self) -> str:
         return json.dumps({
@@ -173,6 +177,8 @@ class PlanArtifact:
             "microbatches": self.microbatches,
             "node_sequence": list(self.node_sequence),
             "device_groups": list(self.device_groups),
+            "schedule": self.schedule,
+            "virtual_stages": self.virtual_stages,
         }, indent=2)
 
     @staticmethod
@@ -187,6 +193,8 @@ class PlanArtifact:
             microbatches=d["microbatches"],
             node_sequence=tuple(d.get("node_sequence", ())),
             device_groups=tuple(d.get("device_groups", ())),
+            schedule=d.get("schedule", "gpipe"),
+            virtual_stages=d.get("virtual_stages", 1),
         )
 
     def save(self, path) -> None:
@@ -242,4 +250,6 @@ class PlanArtifact:
             microbatches=inter.batches,
             node_sequence=tuple(inter.node_sequence),
             device_groups=tuple(inter.device_groups),
+            schedule=getattr(intra, "schedule", "gpipe"),
+            virtual_stages=getattr(intra, "virtual_stages", 1),
         )

@@ -35,7 +35,7 @@ from metis_tpu.cost.zero import zero_candidates
 from metis_tpu.cost.ici import IciDcnBandwidth
 from metis_tpu.cost.volume import TransformerVolume
 from metis_tpu.search.inter_stage import inter_stage_plans
-from metis_tpu.search.intra_stage import intra_stage_plans
+from metis_tpu.search.intra_stage import intra_stage_plans, schedule_intra_plans
 from metis_tpu.search.uniform import uniform_plans
 
 
@@ -126,6 +126,15 @@ def plan_hetero(
                    if config.enable_sp and not config.strict_compat
                    else (False,))
     families = list(product(cp_families, ep_degrees, zero_stages, sp_variants))
+    # Pipeline-SCHEDULE families (cost/schedule.py): 1f1b and interleaved
+    # variants of the base (dp, tp) family only — they run on the shard_map
+    # pipeline executor, whose contract excludes cp/ep/zero/sp axes
+    # (execution/builder.py routing).  gpipe is always searched above.
+    sched_families: list[tuple[str, int]] = []
+    if config.enable_schedule_search and not config.strict_compat:
+        sched_families.append(("1f1b", 1))
+        for vs in config.virtual_stage_candidates:
+            sched_families.append(("interleaved", vs))
     events.emit(
         "search_started", mode="hetero", devices=cluster.total_devices,
         device_types=list(cluster.device_types), gbs=config.gbs,
@@ -153,6 +162,29 @@ def plan_hetero(
                 len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
                 for s in range(inter.num_stages)
             ]
+        for sched, vs in sched_families:
+            try:
+                for intra in schedule_intra_plans(
+                    inter, evaluator, balancer,
+                    max_tp=config.max_profiled_tp,
+                    max_bs=config.max_profiled_bs,
+                    schedule=sched, virtual_stages=vs,
+                    num_blocks=model.num_layers - 2,
+                    types_uniform=(
+                        len(set(rank_device_types(
+                            cluster, inter.node_sequence))) == 1),
+                ):
+                    try:
+                        cost = estimator.get_cost(
+                            inter, intra.strategies, intra.layer_partition,
+                            schedule=sched, virtual_stages=vs)
+                    except KeyError:
+                        pruned += 1
+                        continue
+                    results.append(
+                        RankedPlan(inter=inter, intra=intra, cost=cost))
+            except KeyError:
+                pruned += 1
         # one try-block per (cp, ep, zero, sp) family: a profile miss
         # mid-generation prunes only that family, not its siblings
         for (cp, cp_mode), ep, zero, sp in families:

@@ -107,6 +107,9 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
                    help="search ZeRO-1/2/3 sharded-state plan families")
     g.add_argument("--enable-sp", action="store_true",
                    help="search Megatron sequence-parallel plan families")
+    g.add_argument("--enable-schedule-search", action="store_true",
+                   help="search 1f1b/interleaved pipeline-schedule plan "
+                        "families (gpipe is always searched)")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
     g.add_argument("--events", default=None,
@@ -148,6 +151,7 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         max_ep_degree=args.max_ep,
         enable_zero=args.enable_zero,
         enable_sp=args.enable_sp,
+        enable_schedule_search=getattr(args, "enable_schedule_search", False),
     )
 
 
@@ -243,11 +247,13 @@ def main(argv: list[str] | None = None) -> int:
                          help="training steps to run")
     p_train.add_argument("--schedule",
                          choices=("gpipe", "1f1b", "interleaved"),
-                         default="gpipe",
-                         help="pipeline schedule for rectangular pp>1 plans")
-    p_train.add_argument("--virtual-stages", type=int, default=2,
+                         default=None,
+                         help="pipeline schedule for rectangular pp>1 plans "
+                              "(default: the schedule the chosen/pinned "
+                              "plan was priced with)")
+    p_train.add_argument("--virtual-stages", type=int, default=None,
                          help="model chunks per device for "
-                              "--schedule interleaved")
+                              "--schedule interleaved (default: the plan's)")
     p_train.add_argument("--data", default=None,
                          help="flat token stream (.npy / raw int32 .bin, "
                               "memmapped); default: synthetic tokens")
@@ -463,12 +469,19 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         art = PlanArtifact.from_ranked_plan(result.best)
         plan_cost_ms = result.best.cost.total_ms
     cfg = config_for_model_spec(model)
-    schedule = args.schedule
+    # default: run the schedule the chosen/pinned plan was PRICED with
+    # (a searched axis — cost/schedule.py); explicit flags override.  One
+    # resolution rule shared with build_executable so the checkpoint layout
+    # string always describes what actually executes.
+    from metis_tpu.execution.builder import resolve_schedule
+
+    schedule, virtual_stages = resolve_schedule(
+        art, args.schedule, args.virtual_stages)
 
     def _build(sched):
         return build_executable(cfg, art, cluster=cluster, profiles=profiles,
                                 schedule=sched,
-                                virtual_stages=args.virtual_stages)
+                                virtual_stages=virtual_stages)
 
     try:
         try:
@@ -540,7 +553,7 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                  if "pp" in art.mesh_axes else 1)
     block_layout = ("canonical" if exe.kind != "pipeline"
                     or schedule != "interleaved"
-                    else f"interleaved:{pp_extent}x{args.virtual_stages}")
+                    else f"interleaved:{pp_extent}x{virtual_stages}")
 
     state = exe.init(jax.random.PRNGKey(0))
     start_step = 0

@@ -125,6 +125,81 @@ def _initial_strategies(
 VALID, RETRY, DOOMED = "valid", "retry", "doomed"
 
 
+class SchedulePartitioner(Protocol):
+    """Even-split + schedule-aware memory feasibility
+    (implemented by metis_tpu.balance.LayerBalancer.schedule_partition)."""
+
+    def schedule_partition(
+        self,
+        plan: InterStagePlan,
+        strategies: Sequence[Strategy],
+        memory_capacity: Sequence[float],
+        schedule: str,
+        virtual_stages: int,
+    ) -> PartitionResult: ...
+
+
+def schedule_intra_plans(
+    plan: InterStagePlan,
+    evaluator: StageEvaluator,
+    partitioner: SchedulePartitioner,
+    max_tp: int,
+    max_bs: int,
+    schedule: str,
+    virtual_stages: int = 1,
+    num_blocks: int | None = None,
+    types_uniform: bool = True,
+) -> Iterator[IntraStagePlan]:
+    """Yield intra plans for one pipeline-SCHEDULE family (1f1b /
+    interleaved) of an inter-stage candidate — a searched axis beyond the
+    reference's GPipe-only pricing (cost/schedule.py).
+
+    These schedules run on the shard_map pipeline executor
+    (``execution/builder.py``), which demands a rectangular plan: equal
+    device groups, ONE strategy shape, the canonical even block split, and
+    a single device type (SPMD lockstep — mixed chip speeds would idle the
+    faster type every tick, and the mesh admits no per-stage profiles).
+    Escalation is therefore uniform: all stages trade dp for tp together.
+    Memory feasibility uses the schedule's true activation peak
+    (``LayerBalancer.schedule_partition``) — the whole point of the 1f1b
+    family is admitting memory-tight plans the gpipe footprint rejects.
+    """
+    from metis_tpu.cost.schedule import schedule_valid
+
+    if len(set(plan.device_groups)) != 1 or not types_uniform:
+        return
+    if not schedule_valid(schedule, plan.num_stages, plan.batches,
+                          virtual_stages, num_blocks):
+        return
+    group = plan.device_groups[0]
+    strategies: tuple[Strategy, ...] | None = tuple(
+        Strategy(dp=group, tp=1) for _ in plan.device_groups)
+    capacity: list[float] | None = None
+    while strategies is not None:
+        verdict = classify_strategies(plan, strategies, max_tp, max_bs)
+        if verdict is DOOMED:
+            break
+        if verdict is VALID:
+            if capacity is None:
+                capacity = evaluator.memory_capacity(plan)
+            result = partitioner.schedule_partition(
+                plan, strategies, capacity, schedule, virtual_stages)
+            if result.partition is not None:
+                yield IntraStagePlan(
+                    strategies=strategies,
+                    layer_partition=result.partition,
+                    memory_state=result.memory_state or (),
+                    num_repartition=result.attempts,
+                    schedule=schedule,
+                    virtual_stages=virtual_stages,
+                )
+                break  # feasible at this dp — higher tp never cheaper here
+        s0 = strategies[0]
+        strategies = (
+            tuple(Strategy(dp=s0.dp // 2, tp=s0.tp * 2) for _ in strategies)
+            if s0.dp > 1 else None)
+
+
 def classify_strategies(
     plan: InterStagePlan,
     strategies: Sequence[Strategy],

@@ -238,6 +238,13 @@ def measure_ranked_plan_ms(
     cfg = config_for_model_spec(
         model, **({"dtype": dtype} if dtype is not None else {}))
     inter, intra = ranked.inter, ranked.intra
+    if getattr(intra, "schedule", "gpipe") != "gpipe":
+        # schedule-tagged plans (1f1b/interleaved — a searched axis,
+        # cost/schedule.py) must be measured on the shard_map pipeline
+        # executor running the EXACT schedule the cost model priced; the
+        # multi-mesh path below has no schedule concept
+        return _measure_scheduled_plan_ms(
+            ranked, cfg, devices, steps=steps, warmup=warmup, seed=seed)
     rows = None
     if cluster is not None and profiles is not None:
         rows = plan_replica_rows(inter, intra.strategies, cluster, profiles)
@@ -272,6 +279,34 @@ def measure_ranked_plan_ms(
         run_once()
         samples.append((_time.perf_counter() - t0) * 1e3)
     return float(np.median(samples))
+
+
+def _measure_scheduled_plan_ms(
+    ranked, cfg, devices, steps: int, warmup: int, seed: int
+) -> float:
+    """Median wall time (ms) of one training step of a schedule-tagged
+    RankedPlan on the shard_map pipeline executor, with the plan's own
+    schedule/virtual_stages (``build_executable`` reads them off the
+    artifact)."""
+    import jax
+
+    from metis_tpu.execution.builder import build_executable
+    from metis_tpu.execution.mesh import PlanArtifact
+
+    art = PlanArtifact.from_ranked_plan(ranked)
+    exe = build_executable(cfg, art, devices=devices)
+    state = exe.init(jax.random.PRNGKey(seed))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (art.gbs, cfg.seq_len), 0,
+        cfg.vocab_size)
+
+    def run_once():
+        nonlocal state
+        state, loss = exe.step(state, tokens, tokens)
+        return loss
+
+    devs = list(devices if devices is not None else jax.devices())
+    return _timed_steps_ms(run_once, devs[0], steps, warmup)
 
 
 def validate_hetero_choice(

@@ -1,0 +1,334 @@
+"""Pipeline-schedule plan axis: pricing, memory feasibility, planner
+integration (cost/schedule.py — VERDICT r2 next-step 3).
+
+The reference prices only the GPipe fill-drain (``cost_estimator.py:129``)
+and has no schedule concept; these tests pin that (a) gpipe pricing is
+byte-identical to the old formula, (b) the remat schedules are priced with
+their implemented overheads, (c) 1f1b's true activation peak admits
+memory-tight plans the gpipe footprint rejects, and (d) the planner emits
+schedule-tagged plans whose artifacts carry the schedule to execution.
+"""
+import pytest
+
+from metis_tpu.balance.layers import LayerBalancer
+from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.types import InterStagePlan, IntraStagePlan, Strategy
+from metis_tpu.cost.schedule import (
+    REMAT_FWD_FRACTION,
+    boundary_buffer_mb,
+    schedule_activation_factor,
+    schedule_boundary_buffers,
+    schedule_execution_ms,
+    schedule_pp_send_factor,
+    schedule_valid,
+)
+from metis_tpu.profiles.store import (
+    LayerProfile,
+    ModelProfileMeta,
+    ProfileStore,
+)
+
+L = 6  # embed + 4 blocks + head
+STATIC_MB = 10.0   # per-layer weights/optimizer share
+ACT_MB = 100.0     # per-layer activation MB per unit batch
+
+
+def make_store() -> ProfileStore:
+    """Hand-built store: per-layer memory exactly affine in bs
+    (static + bs * act) so the activation-split fit is exact, and uniform
+    1 ms layer times."""
+    entries = {}
+    for bs in (1, 2):
+        entries[("X", 1, bs)] = LayerProfile(
+            layer_times_ms=(1.0,) * L,
+            layer_memory_mb=tuple([STATIC_MB + ACT_MB * bs] * L),
+            fb_sync_ms=0.0,
+        )
+    meta = ModelProfileMeta(
+        num_layers=L, optimizer_time_ms=1.0, batch_generator_ms=0.1,
+        params_per_layer_bytes=(1_000_000,) * L)
+    return ProfileStore(entries, meta)
+
+
+def make_cluster(mem_gb: float) -> ClusterSpec:
+    return ClusterSpec(
+        nodes=(NodeSpec("X", 8),),
+        devices={"X": DeviceSpec("X", mem_gb, 100.0, 25.0)})
+
+
+def model_spec() -> ModelSpec:
+    return ModelSpec(name="sched-test", num_layers=L, hidden_size=64,
+                     sequence_length=32, vocab_size=256, num_heads=4)
+
+
+class TestFormulas:
+    def test_gpipe_is_reference_formula(self):
+        lens = [3.0, 5.0, 4.0]
+        assert schedule_execution_ms("gpipe", lens, 8) == 7 * 5.0 + 12.0
+
+    def test_1f1b_adds_remat_factor(self):
+        lens = [3.0, 5.0, 4.0]
+        g = schedule_execution_ms("gpipe", lens, 8)
+        f = schedule_execution_ms("1f1b", lens, 8)
+        assert f == pytest.approx((1 + REMAT_FWD_FRACTION) * g)
+
+    def test_interleaved_bubble_shrinks_with_vs(self):
+        # uniform stages, few microbatches: the per-group bubble term
+        # (S-1)/(vs*S) of a group's ticks shrinks as vs grows
+        lens = [4.0] * 4
+        S, M = 4, 4  # one group
+        i2 = schedule_execution_ms("interleaved", lens, M, virtual_stages=2)
+        i4 = schedule_execution_ms("interleaved", lens, M, virtual_stages=4)
+        assert i4 < i2
+        # closed form: G * (vs*S + S - 1) * (1+r) * max/vs
+        assert i2 == pytest.approx(1 * (2 * 4 + 3) * (4 / 3) * 4.0 / 2)
+
+    def test_interleaved_beats_gpipe_at_small_m_large_bubble(self):
+        # M = S: gpipe bubble is (S-1)/M = 75%; interleaved at vs=4 pays
+        # the remat factor but exposes chunk-sized fill/drain
+        lens = [4.0] * 4
+        g = schedule_execution_ms("gpipe", lens, 4)
+        i = schedule_execution_ms("interleaved", lens, 4, virtual_stages=4)
+        assert i < g
+
+    def test_pp_send_factor(self):
+        assert schedule_pp_send_factor("gpipe", 4) == 1.0
+        assert schedule_pp_send_factor("1f1b", 4) == 1.0
+        assert schedule_pp_send_factor("interleaved", 4, 2) == 7 / 3
+
+    def test_activation_factors(self):
+        assert schedule_activation_factor("gpipe", 8) == 8.0
+        assert schedule_activation_factor("1f1b", 8) == 1.0
+        assert schedule_activation_factor("interleaved", 8, 2) == 0.5
+
+    def test_boundary_buffers(self):
+        assert schedule_boundary_buffers("gpipe", 4, 8) == 0
+        assert schedule_boundary_buffers("1f1b", 4, 8) == 7
+        assert schedule_boundary_buffers("1f1b", 4, 2) == 2  # min(M, ...)
+        assert schedule_boundary_buffers("interleaved", 4, 8, 2) == 8
+
+    def test_schedule_valid(self):
+        assert schedule_valid("gpipe", 1, 8, 1)
+        assert not schedule_valid("1f1b", 1, 8, 1)       # no pipeline
+        assert schedule_valid("1f1b", 2, 8, 1, num_blocks=4)
+        assert not schedule_valid("1f1b", 3, 8, 1, num_blocks=4)  # 4 % 3
+        assert schedule_valid("interleaved", 2, 8, 2, num_blocks=4)
+        assert not schedule_valid("interleaved", 2, 7, 2, num_blocks=4)  # M%S
+        assert not schedule_valid("interleaved", 2, 8, 3, num_blocks=4)  # blk
+        assert not schedule_valid("interleaved", 2, 8, 1, num_blocks=4)  # vs
+
+
+class TestEstimatorPricing:
+    def _cost(self, schedule, vs=1):
+        from metis_tpu.cost.estimator import (
+            EstimatorOptions,
+            HeteroCostEstimator,
+        )
+        from metis_tpu.cost.volume import TransformerVolume
+
+        store = make_store()
+        cluster = make_cluster(mem_gb=1000.0)
+        model = model_spec()
+        volume = TransformerVolume(model, store.model.params_per_layer_bytes)
+        est = HeteroCostEstimator(
+            cluster, store, volume,
+            EstimatorOptions(max_profiled_bs=2))
+        plan = InterStagePlan(node_sequence=("X",), device_groups=(4, 4),
+                              batches=4, gbs=16)
+        strats = (Strategy(dp=4, tp=1), Strategy(dp=4, tp=1))
+        return est.get_cost(plan, strats, (0, 3, 6), schedule=schedule,
+                            virtual_stages=vs)
+
+    def test_gpipe_unchanged_1f1b_scaled(self):
+        g = self._cost("gpipe")
+        f = self._cost("1f1b")
+        assert f.execution_ms == pytest.approx(
+            (1 + REMAT_FWD_FRACTION) * g.execution_ms)
+        # non-execution terms are schedule-independent
+        assert f.dp_comm_ms == g.dp_comm_ms
+        assert f.optimizer_ms == g.optimizer_ms
+        assert f.pp_comm_ms == g.pp_comm_ms
+
+    def test_interleaved_pp_sends_scaled(self):
+        g = self._cost("gpipe")
+        i = self._cost("interleaved", vs=2)
+        assert i.pp_comm_ms == pytest.approx(g.pp_comm_ms * 3.0)  # (2*2-1)/1
+
+
+class TestMemoryFeasibility:
+    def test_1f1b_admits_memory_tight_plan(self):
+        """Capacity between the gpipe footprint and 1f1b's true peak: the
+        legacy (schedule-blind) partition refuses, schedule_partition
+        accepts — the exact plan class VERDICT r2 said was lost."""
+        store = make_store()
+        model = model_spec()
+        config = SearchConfig(gbs=8, max_profiled_bs=2, max_profiled_tp=1)
+        plan = InterStagePlan(node_sequence=("X",), device_groups=(4, 4),
+                              batches=2, gbs=8)
+        strats = (Strategy(dp=4, tp=1), Strategy(dp=4, tp=1))
+        # legacy demand/stage (3 layers, mbs=1): 5 * 3 * (10+100) = 1650 MB
+        # 1f1b demand: 5*3*10 + 1*3*100 + 2 boundary bufs (~0) ~ 450 MB
+        cap_mb = 1000.0
+        cluster = make_cluster(mem_gb=cap_mb / 1024 / 4)  # 4 devices/stage
+        balancer = LayerBalancer(cluster, store, config, model=model)
+        legacy = balancer.partition(
+            plan, strats, [0.5, 0.5], [cap_mb, cap_mb])
+        assert legacy.partition is None  # gpipe footprint: OOM
+        sched = balancer.schedule_partition(
+            plan, strats, [cap_mb, cap_mb], "1f1b", 1)
+        assert sched.partition == (0, 3, 6)
+        assert min(sched.memory_state) >= 0
+
+    def test_gpipe_schedule_partition_charges_m_microbatches(self):
+        store = make_store()
+        model = model_spec()
+        config = SearchConfig(gbs=8, max_profiled_bs=2, max_profiled_tp=1)
+        plan = InterStagePlan(node_sequence=("X",), device_groups=(4, 4),
+                              batches=2, gbs=8)
+        strats = (Strategy(dp=4, tp=1), Strategy(dp=4, tp=1))
+        cluster = make_cluster(mem_gb=1000.0)
+        balancer = LayerBalancer(cluster, store, config, model=model)
+        cap = [1e9, 1e9]
+        g = balancer.schedule_partition(plan, strats, cap, "gpipe", 1)
+        f = balancer.schedule_partition(plan, strats, cap, "1f1b", 1)
+        # gpipe peak holds M=2 microbatches' activations; 1f1b holds 1
+        # (plus tiny boundary buffers)
+        act_stage = 3 * ACT_MB
+        assert (f.memory_state[0] - g.memory_state[0]) == pytest.approx(
+            act_stage, rel=0.01)
+
+
+class TestPlannerIntegration:
+    def _plan(self, mem_gb_per_dev, enable=True):
+        from metis_tpu.planner import plan_hetero
+
+        store = make_store()
+        cluster = make_cluster(mem_gb_per_dev)
+        config = SearchConfig(
+            gbs=8, max_profiled_tp=1, max_profiled_bs=2,
+            enable_schedule_search=enable)
+        return plan_hetero(cluster, store, model_spec(), config)
+
+    def test_schedule_variants_emitted(self):
+        result = self._plan(mem_gb_per_dev=1000.0)
+        schedules = {p.intra.schedule for p in result.plans}
+        assert "gpipe" in schedules and "1f1b" in schedules
+        for p in result.plans:
+            if p.intra.schedule != "gpipe":
+                # shard_map pipeline contract: equal groups, one strategy
+                assert len(set(p.inter.device_groups)) == 1
+                assert len({(s.dp, s.tp) for s in p.intra.strategies}) == 1
+
+    def test_default_config_emits_only_gpipe(self):
+        result = self._plan(mem_gb_per_dev=1000.0, enable=False)
+        assert {p.intra.schedule for p in result.plans} == {"gpipe"}
+
+    def test_memory_tight_search_picks_1f1b(self):
+        # 250 MB/device: every legacy (gpipe-footprint) plan is infeasible —
+        # even the 1-stage plan pooling all 8 devices (2000 MB < 3300 MB
+        # demand) and every >=550 MB-per-layer pipelined split — but the
+        # pp=2 1f1b peak (~450 MB vs 1000 MB stage capacity) fits: the
+        # planner's best plan is a remat schedule; gpipe alone finds NOTHING
+        tight = self._plan(mem_gb_per_dev=250.0 / 1024)
+        assert tight.plans, "schedule search found no plan"
+        assert tight.best.intra.schedule in ("1f1b", "interleaved")
+        assert all(p.intra.schedule != "gpipe" for p in tight.plans)
+
+    def test_artifact_carries_schedule(self):
+        from metis_tpu.execution.mesh import PlanArtifact
+
+        result = self._plan(mem_gb_per_dev=1000.0)
+        tagged = next(p for p in result.plans
+                      if p.intra.schedule == "1f1b")
+        art = PlanArtifact.from_ranked_plan(tagged)
+        assert art.schedule == "1f1b"
+        rt = PlanArtifact.from_json(art.to_json())
+        assert rt.schedule == "1f1b" and rt.virtual_stages == 1
+        # ranking JSON carries the axis too
+        assert tagged.to_json_dict()["schedule"] == "1f1b"
+
+    def test_boundary_buffer_mb(self):
+        assert boundary_buffer_mb(2, 1024, 4096, 2) == pytest.approx(
+            2 * 1024 * 4096 * 2 / 1e6)
+
+
+class TestDeepPipelineRouting:
+    def test_canonical_split_routes_to_pipeline_at_s4(self):
+        """The canonical even split gives the end stages +1 PROFILE layer
+        (embed/head) while block counts stay equal — the builder must route
+        such schedule-tagged plans to the shard_map pipeline executor (the
+        only one that runs the priced schedule), at every depth, not just
+        pp=2."""
+        import jax
+
+        from metis_tpu.execution.builder import build_executable
+        from metis_tpu.execution.mesh import PlanArtifact
+        from metis_tpu.models import config_for_model_spec
+
+        model = ModelSpec(name="deep", num_layers=10, hidden_size=64,
+                          sequence_length=32, vocab_size=256, num_heads=4)
+        cfg = config_for_model_spec(model)
+        # canonical split of 10 profile layers into 4 stages: layer counts
+        # (3, 2, 2, 3), block counts (2, 2, 2, 2)
+        art = PlanArtifact(
+            mesh_axes=("pp", "dp", "ep", "sp", "tp"),
+            mesh_shape=(4, 1, 1, 1, 1),
+            layer_partition=(0, 3, 5, 7, 10),
+            strategies=({"dp": 1, "tp": 1},) * 4,
+            gbs=4, microbatches=4, schedule="1f1b")
+        exe = build_executable(cfg, art, devices=jax.devices("cpu")[:4])
+        assert exe.kind == "pipeline"
+
+    def test_resolve_schedule_shared_rule(self):
+        from metis_tpu.execution.builder import resolve_schedule
+        from metis_tpu.execution.mesh import PlanArtifact
+
+        art = PlanArtifact(
+            mesh_axes=(), mesh_shape=(), layer_partition=(),
+            strategies=({"dp": 1, "tp": 1},), gbs=4, microbatches=2,
+            schedule="interleaved", virtual_stages=3)
+        assert resolve_schedule(art) == ("interleaved", 3)
+        assert resolve_schedule(art, "gpipe") == ("gpipe", 3)
+        assert resolve_schedule(art, None, 4) == ("interleaved", 4)
+        plain = PlanArtifact(
+            mesh_axes=(), mesh_shape=(), layer_partition=(),
+            strategies=({"dp": 1, "tp": 1},), gbs=4, microbatches=2)
+        # explicit interleaved request on a vs-less artifact: historical 2
+        assert resolve_schedule(plain, "interleaved") == ("interleaved", 2)
+
+
+class TestScheduledValidation:
+    def test_validate_closes_loop_on_scheduled_plan(self):
+        """A schedule-tagged plan is measured on the shard_map pipeline
+        executor running the EXACT schedule it was priced with — the
+        predicted-vs-measured loop closes for the new plan axis (the
+        numbers use synthetic profiles, so only the mechanics are pinned
+        here; fidelity is bench's validation section)."""
+        import jax
+
+        from metis_tpu.planner import plan_hetero
+        from metis_tpu.validation import validate_hetero_choice
+
+        store = make_store()
+        cluster = make_cluster(1000.0)
+        result = plan_hetero(
+            cluster, store, model_spec(),
+            SearchConfig(gbs=8, max_profiled_tp=1, max_profiled_bs=2,
+                         enable_schedule_search=True))
+        tagged = [p for p in result.plans if p.intra.schedule == "1f1b"
+                  and sum(p.inter.device_groups) <= 8]
+        assert tagged
+        reports = validate_hetero_choice(
+            tagged[:1], model_spec(), jax.devices("cpu")[:8],
+            top_k=1, steps=2, warmup=1)
+        assert len(reports) == 1
+        assert reports[0].measured_ms > 0
+        assert reports[0].plan_dict["schedule"] == "1f1b"
+
+
+def test_intra_plan_defaults_are_gpipe():
+    p = IntraStagePlan(strategies=(Strategy(dp=1, tp=1),),
+                       layer_partition=(0, 6), memory_state=(),
+                       num_repartition=1)
+    assert p.schedule == "gpipe" and p.virtual_stages == 1
