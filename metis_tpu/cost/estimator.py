@@ -53,6 +53,13 @@ class EstimatorOptions:
     optimizer_factor: float = 2.0   # ref data_loader.py:19
     max_profiled_bs: int = 16       # ref cost_estimator.py:166 cap
     dp_over_pp_rows: bool = True    # homo: whole pp-row treated as one dp group
+    # Measured fraction of the dp gradient all-reduce hidden under backward
+    # compute (cost/calibration.measure_dp_overlap).  0.0 = fully serial —
+    # the reference's model (cost_estimator.py:37-43 charged on the critical
+    # path) and the only behavior under strict_compat.  Native mode charges
+    # only the exposed (1 - fraction) share; the latency floor stays fully
+    # charged (a ring's alpha cost cannot be hidden by more compute).
+    dp_overlap_fraction: float = 0.0
 
     @staticmethod
     def from_config(cfg: SearchConfig) -> "EstimatorOptions":
@@ -60,7 +67,15 @@ class EstimatorOptions:
             strict_compat=cfg.strict_compat,
             optimizer_factor=cfg.optimizer_factor,
             max_profiled_bs=cfg.max_profiled_bs,
+            dp_overlap_fraction=cfg.dp_overlap_fraction,
         )
+
+    @property
+    def dp_exposed_share(self) -> float:
+        """Share of dp gradient-sync volume charged on the critical path."""
+        if self.strict_compat:
+            return 1.0
+        return 1.0 - min(max(self.dp_overlap_fraction, 0.0), 1.0)
 
     def bw_to_bytes_per_ms(self, bw_gbps: float) -> float:
         # Reference converts GB/s with 1024*1024 (cost_estimator.py:40,46);
@@ -168,8 +183,11 @@ class UniformCostEstimator(_EstimatorBase):
         oom = self.cluster.memory_mb(cap_type) < max(stage_memory)
         execution = (num_mbs - 1) * max(lens) + sum(lens)
         optimizer = self._optimizer_ms(device_type) / plan.pp / plan.tp
+        # only the measured exposed share of the gradient sync rides the
+        # critical path (overlap calibration; serial under strict_compat)
         dp_cost = self._dp_cost_ms(
-            max(stage_params), self.bandwidth.dp_bandwidth(plan.pp, plan.tp), plan.dp)
+            max(stage_params), self.bandwidth.dp_bandwidth(plan.pp, plan.tp),
+            plan.dp) * self.options.dp_exposed_share
         batch_gen = self._batch_gen_ms(num_mbs, device_type)
 
         return PlanCost(
@@ -384,7 +402,10 @@ class HeteroCostEstimator(_EstimatorBase):
                                 * expert_param_fraction(self.volume.model)
                                 / strat.ep)
                 # two rings, two latency floors: the dense ring over all
-                # sync_degree ranks, the expert ring over its 1/ep subgroup
+                # sync_degree ranks, the expert ring over its 1/ep subgroup.
+                # Volume terms charge only the measured exposed share
+                # (overlap calibration); the alpha/latency floors stay fully
+                # charged — a ring's startup cost cannot hide under compute.
                 ep_latency = (lat_fn("all_reduce", sync_degree // strat.ep)
                               if lat_fn is not None else 0.0)
                 dp_costs.append(zfac * (
@@ -392,10 +413,12 @@ class HeteroCostEstimator(_EstimatorBase):
                                      dp_bw, sync_degree)
                     + self._dp_cost_ms(expert_bytes, dp_bw,
                                        sync_degree // strat.ep))
+                    * self.options.dp_exposed_share
                     + dp_latency + ep_latency)
             else:
                 dp_costs.append(
                     zfac * self._dp_cost_ms(stage_params, dp_bw, sync_degree)
+                    * self.options.dp_exposed_share
                     + dp_latency)
 
             opt_type = None if self.options.strict_compat else stage_types[0]

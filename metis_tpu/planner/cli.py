@@ -110,6 +110,10 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--enable-schedule-search", action="store_true",
                    help="search 1f1b/interleaved pipeline-schedule plan "
                         "families (gpipe is always searched)")
+    g.add_argument("--dp-overlap", type=float, default=0.0,
+                   help="measured fraction of the dp gradient all-reduce "
+                        "hidden under backward compute "
+                        "(cost.measure_dp_overlap); 0 = serial model")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
     g.add_argument("--events", default=None,
@@ -152,6 +156,7 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         enable_zero=args.enable_zero,
         enable_sp=args.enable_sp,
         enable_schedule_search=getattr(args, "enable_schedule_search", False),
+        dp_overlap_fraction=getattr(args, "dp_overlap", 0.0),
     )
 
 

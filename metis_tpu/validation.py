@@ -246,7 +246,13 @@ def measure_ranked_plan_ms(
         return _measure_scheduled_plan_ms(
             ranked, cfg, devices, steps=steps, warmup=warmup, seed=seed)
     rows = None
-    if cluster is not None and profiles is not None:
+    # (mirrors execution.builder: MoE stages take the even split — uneven
+    # pad rows are unsound for capacity-competing routed tokens, and the
+    # executor refuses them)
+    from metis_tpu.models.moe import MoEConfig as _MoECfg
+
+    if (cluster is not None and profiles is not None
+            and not isinstance(cfg, _MoECfg)):
         rows = plan_replica_rows(inter, intra.strategies, cluster, profiles)
     stage_specs = stage_specs_from_plan(
         intra.layer_partition, intra.strategies, cfg, stage_replica_rows=rows)
@@ -332,6 +338,40 @@ def validate_hetero_choice(
             measured_ms=measured,
             steps=steps))
     return reports
+
+
+def contention_calibrated(reports: Sequence, key=None) -> tuple[dict, list]:
+    """Fit-and-hold-out environment calibration for validation runs whose
+    profiles were measured in a DIFFERENT contention regime than execution
+    (e.g. per-layer profiles from one local CPU device, plans executed on
+    an 8-virtual-device mesh oversubscribing the same cores ~8x — the
+    systematic ~-86% error of BENCH_r02).
+
+    ``key(report)`` groups reports into contention regimes (default: one
+    group) — e.g. the GSPMD and shard_map-pipeline executors dispatch and
+    synchronize differently, so each gets its own factor.  Within each
+    group the FIRST report fits the scalar factor (measured / predicted);
+    the remaining reports are re-issued with calibrated predictions
+    ``predicted * factor``.  Factors are fit on held-in plans and evaluated
+    on held-out plans only — the resulting errors are a real
+    generalization measure, not self-fitting.  Works for both
+    ValidationReport and HeteroValidationReport (same field names).
+
+    Returns ``(factors, held_out)``: factors keyed by group key (None for
+    the default single group)."""
+    import dataclasses
+
+    groups: dict = {}
+    for r in reports:
+        groups.setdefault(key(r) if key is not None else None, []).append(r)
+    factors: dict = {}
+    held_out: list = []
+    for k, rs in groups.items():
+        factors[k] = rs[0].measured_ms / rs[0].predicted_ms
+        held_out.extend(
+            dataclasses.replace(r, predicted_ms=r.predicted_ms * factors[k])
+            for r in rs[1:])
+    return factors, held_out
 
 
 def validate_planner_choice(

@@ -283,3 +283,83 @@ class TestRouteGrouping:
         assert np.isfinite(float(loss))
         flat = jax.tree.leaves(grads)
         assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+
+
+class TestNoUnrunnablePlans:
+    """Property: NO plan the planner emits can hit a NotImplementedError in
+    execution (VERDICT r2 next-step 6).  The two executor soundness guards —
+    cp+MoE stages (no execution path) and uneven hetero-DP pad rows on MoE
+    stages (capacity-unsound) — must be unreachable from planner output:
+    cp>1 families are pruned in search for MoE models, and every builder /
+    validator call site takes the even split for MoE."""
+
+    def _emit_and_check(self, model, store, cluster, config):
+        from metis_tpu.execution.hetero import (
+            plan_replica_rows,
+            stage_specs_from_plan,
+        )
+        from metis_tpu.models import config_for_model_spec
+        from metis_tpu.models.moe import MoEConfig
+        from metis_tpu.planner import plan_hetero
+
+        result = plan_hetero(cluster, store, model, config)
+        assert result.plans, "planner emitted nothing"
+        cfg = config_for_model_spec(model)
+        is_moe = isinstance(cfg, MoEConfig)
+        for r in result.plans:
+            rows = None
+            if not is_moe:  # the builder/validator gate, mirrored
+                rows = plan_replica_rows(
+                    r.inter, r.intra.strategies, cluster, store)
+            # stage_specs_from_plan hosts both NotImplementedError guards;
+            # any raise here is a planner/executor contract break
+            stage_specs_from_plan(
+                r.intra.layer_partition, r.intra.strategies, cfg,
+                stage_replica_rows=rows)
+        return result
+
+    def test_moe_model_all_families(self):
+        from metis_tpu.cluster import ClusterSpec
+        from metis_tpu.cluster.spec import DeviceSpec, NodeSpec
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+        model = replace(tiny_test_model(), num_experts=8, expert_top_k=2)
+        store = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2, 4],
+                                    bss=[1, 2, 4, 8, 16])
+        cluster = ClusterSpec(
+            nodes=(NodeSpec("A100", 4), NodeSpec("T4", 4)),
+            devices={"A100": DeviceSpec("A100", 80, 100, 25),
+                     "T4": DeviceSpec("T4", 15, 50, 10)})
+        config = SearchConfig(
+            gbs=64, enable_cp=True, max_cp_degree=4, enable_ep=True,
+            max_ep_degree=4, enable_zero=True, enable_sp=True,
+            enable_schedule_search=True)
+        result = self._emit_and_check(model, store, cluster, config)
+        # the cp families were requested but must have been pruned: the
+        # execution layer has no cp+MoE path
+        assert all(s.cp == 1 for r in result.plans
+                   for s in r.intra.strategies)
+        # likewise the schedule families: the shard_map pipeline is a
+        # dense-GPT program — an MoE plan routed there would silently
+        # train without the experts
+        assert all(r.intra.schedule == "gpipe" for r in result.plans)
+
+    def test_dense_model_all_families(self):
+        from metis_tpu.cluster import ClusterSpec
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+        model = tiny_test_model()
+        store = synthesize_profiles(model, ["A100"], tps=[1, 2, 4],
+                                    bss=[1, 2, 4, 8, 16])
+        cluster = ClusterSpec.homogeneous("A100", num_nodes=2,
+                                          devices_per_node=4)
+        config = SearchConfig(
+            gbs=64, enable_cp=True, max_cp_degree=4, enable_ep=True,
+            max_ep_degree=4, enable_zero=True, enable_sp=True,
+            enable_schedule_search=True)
+        result = self._emit_and_check(model, store, cluster, config)
+        # dense models DO search cp
+        assert any(s.cp > 1 for r in result.plans
+                   for s in r.intra.strategies)
