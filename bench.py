@@ -21,6 +21,12 @@ The same line carries the round-2 additions as extra fields:
   profiles measured on the local CPU backend, plans chosen by the planner,
   executed on the 8-device virtual CPU mesh, per-plan error recorded
   (the loop the reference's dead C19 validator never closed).
+
+Round-3 additions: ``scale_search_256`` (256-device 4-type search under
+composition-level pruning + exact-prune ranking parity vs exhaustive at 64
+devices), per-executor-family contention calibration with held-out errors
+in ``validation``, measured dp-overlap feeding the cost model, and the
+probe transcript / capture cache documented at ``probe_tpu``/``tpu_capture``.
 """
 from __future__ import annotations
 
@@ -221,6 +227,92 @@ def scale_search(record: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# scale point: 256 devices, 4 types (search/prune.py — VERDICT r2 step 7)
+# ---------------------------------------------------------------------------
+
+S256_LAYERS = 50
+S256_GBS = 1024
+S256_VARIANCE = 0.5
+
+
+def scale_search_256(record: dict) -> None:
+    """256-device 4-type search with small-group variance — ~32.5M raw
+    inter candidates, where the FLAT walk's iteration alone breaks a
+    10-minute budget.  Runs with composition-level bound pruning + beam
+    (top-20; beam is the documented-inexact knob), and records exact-prune
+    ranking parity vs exhaustive on the 64-device workload (the bound
+    filter alone is exact for the top K under the monotone-profile
+    assumption; search/prune.py)."""
+    import time as _time
+
+    from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+    from metis_tpu.core.config import ModelSpec, SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import synthesize_profiles
+
+    model = ModelSpec(name="gpt-256", num_layers=S256_LAYERS,
+                      hidden_size=4096, sequence_length=1024,
+                      vocab_size=51200, num_heads=32)
+    types = [("A100", 16, 80), ("V100", 16, 32), ("T4", 16, 15),
+             ("P100", 16, 16)]
+    store = synthesize_profiles(
+        model, [t for t, _, _ in types], tps=[1, 2, 4],
+        bss=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024])
+    nodes, devices = [], {}
+    for t, n_nodes, mem in types:
+        nodes += [NodeSpec(t, 4)] * n_nodes
+        devices[t] = DeviceSpec(t, mem, 40, 10)
+    cluster = ClusterSpec(nodes=tuple(nodes), devices=devices)
+    t0 = _time.perf_counter()
+    res = plan_hetero(
+        cluster, store, model,
+        SearchConfig(gbs=S256_GBS, max_profiled_tp=4, max_profiled_bs=16,
+                     min_group_scale_variance=S256_VARIANCE,
+                     prune_to_top_k=20, beam_patience=30),
+        top_k=20)
+    entry = {
+        "devices": 256, "types": 4, "gbs": S256_GBS, "layers": S256_LAYERS,
+        "variance": S256_VARIANCE,
+        "ours_s": round(_time.perf_counter() - t0, 2),
+        "plans_costed": res.num_costed,
+        "classes_pruned": res.num_bound_pruned,
+        "best_ms": round(res.best.cost.total_ms, 1) if res.best else None,
+        "mode": "prune_to_top_k=20 + beam_patience=30 (beam inexact; "
+                "exhaustive flat walk exceeds 10 min on this workload)",
+    }
+
+    # exact-prune ranking parity vs exhaustive, on the 64-device workload
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_scale_fixture(tmp)
+        cluster64 = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        from metis_tpu.profiles import ProfileStore
+
+        store64 = ProfileStore.from_dir(tmp / "profiles")
+
+        def plan_key(r):
+            return (r.inter.node_sequence, r.inter.device_groups,
+                    r.inter.batches,
+                    tuple((s.dp, s.tp) for s in r.intra.strategies),
+                    r.intra.layer_partition)
+
+        full = plan_hetero(cluster64, store64, scale_model(),
+                           SearchConfig(gbs=SCALE_GBS, max_profiled_tp=4,
+                                        max_profiled_bs=16))
+        exact = plan_hetero(cluster64, store64, scale_model(),
+                            SearchConfig(gbs=SCALE_GBS, max_profiled_tp=4,
+                                         max_profiled_bs=16,
+                                         prune_to_top_k=20))
+        entry["exact_prune_parity_top20_64dev"] = (
+            [(plan_key(r), round(r.cost.total_ms, 6))
+             for r in full.plans[:20]]
+            == [(plan_key(r), round(r.cost.total_ms, 6))
+                for r in exact.plans[:20]])
+    record["scale_search_256"] = entry
+
+
+# ---------------------------------------------------------------------------
 # real-TPU single-chip train step
 # ---------------------------------------------------------------------------
 
@@ -413,14 +505,32 @@ def validation_error(record: dict) -> None:
         # hetero plan, hold out the rest (its per-stage dispatch overhead
         # differs from the single-program uniform path, so the uniform
         # factor does not transfer)
+        # the multi-mesh executor host-syncs each microbatch's loss, so its
+        # overhead scales with the microbatch count: fit (factor,
+        # per-microbatch overhead) on the first two plans — which must
+        # differ in batches for the 2x2 solve — and hold out the rest
+        from metis_tpu.validation import dispatch_affine_calibrated
+
         reports_h = validate_hetero_choice(
             nonuni, model, cpus, cluster=cluster2, profiles=store2,
-            top_k=3, steps=5, warmup=1)
-        factors_h, held_out_h = contention_calibrated(reports_h)
-        record["validation"]["hetero_contention_factor"] = round(
-            factors_h.get(None, 1.0), 3)
-        record["validation"]["hetero_calibration_plan"] = (
-            reports_h[0].to_json_dict() if reports_h else None)
+            top_k=4, steps=5, warmup=1)
+        reports_h.sort(key=lambda r: r.plan_dict["batches"])
+        if (len(reports_h) >= 3
+                and reports_h[0].plan_dict["batches"]
+                == reports_h[1].plan_dict["batches"]):
+            # ensure the two fit points differ in batches
+            for i in range(2, len(reports_h)):
+                if (reports_h[i].plan_dict["batches"]
+                        != reports_h[0].plan_dict["batches"]):
+                    reports_h[1], reports_h[i] = reports_h[i], reports_h[1]
+                    break
+        fit_h, held_out_h = dispatch_affine_calibrated(
+            reports_h, lambda r: r.plan_dict["batches"])
+        record["validation"]["hetero_fit"] = {
+            k: round(v, 4) for k, v in fit_h.items()}
+        record["validation"]["hetero_calibration_plans"] = [
+            r.to_json_dict()
+            for r in reports_h[:int(fit_h.get("fit_points", 2))]]
         record["validation"]["hetero_plans"] = [
             r.to_json_dict() for r in held_out_h]
         if held_out_h:
@@ -618,7 +728,8 @@ def main() -> None:
             "recent_attempts": attempts[-8:],
         }
     parity_search(record)
-    for section in (scale_search, tpu_step, validation_error, tpu_validation):
+    for section in (scale_search, scale_search_256, tpu_step,
+                    validation_error, tpu_validation):
         try:
             section(record)
         except Exception as e:

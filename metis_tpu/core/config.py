@@ -102,6 +102,17 @@ class SearchConfig:
     # (cost/calibration.measure_dp_overlap); 0.0 = serial, the reference's
     # model and the only strict_compat behavior
     dp_overlap_fraction: float = 0.0
+    # Search-scalability pruning (search/prune.py; VERDICT r2 next-step 7).
+    # ``prune_to_top_k=K`` enables the EXACT execution-lower-bound prune:
+    # candidates that provably cannot enter the best K are skipped (the
+    # returned top-K ranking is identical to exhaustive, assuming per-layer
+    # profile times are non-decreasing in batch size; the tail beyond K is
+    # dropped).  ``beam_patience=N`` additionally stops each
+    # (placement, stage-count) class after N consecutive candidates that
+    # failed to enter the top K — INEXACT (anytime beam), requires
+    # prune_to_top_k.  Both are off by default and under strict_compat.
+    prune_to_top_k: int | None = None
+    beam_patience: int | None = None
 
     def __post_init__(self) -> None:
         if self.gbs < 1:

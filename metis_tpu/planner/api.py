@@ -36,18 +36,27 @@ from metis_tpu.cost.ici import IciDcnBandwidth
 from metis_tpu.cost.volume import TransformerVolume
 from metis_tpu.search.inter_stage import inter_stage_plans
 from metis_tpu.search.intra_stage import intra_stage_plans, schedule_intra_plans
+from metis_tpu.search.prune import SearchPruner, pruned_inter_stage_plans
 from metis_tpu.search.uniform import uniform_plans
 
 
 @dataclass(frozen=True)
 class PlannerResult:
     """Ranked plans plus search accounting (the north-star search-time metric
-    lives here, BASELINE.md)."""
+    lives here, BASELINE.md).
+
+    ``num_bound_pruned`` counts inter-stage candidates skipped by the
+    scalability prunes (search/prune.py): the always-on doom fast-path
+    (observably identical results) plus, when ``SearchConfig.prune_to_top_k``
+    / ``beam_patience`` are set, the lower-bound and beam filters (top-K
+    ranking exact under the bound's monotonicity assumption; beam inexact).
+    """
 
     plans: tuple[RankedPlan, ...]  # sorted by total cost, best first
     num_costed: int
     num_pruned: int
     search_seconds: float
+    num_bound_pruned: int = 0
 
     @property
     def best(self) -> RankedPlan | None:
@@ -152,17 +161,37 @@ def plan_hetero(
 
     results: list[RankedPlan] = []
     pruned = 0
-    for inter in inter_stage_plans(
-        cluster.device_types,
-        cluster.total_devices,
-        config.gbs,
-        model.num_layers,
-        variance=config.min_group_scale_variance,
-        max_permute_len=config.max_permute_len,
-    ):
+    pruner = SearchPruner(config, cluster, profiles, model)
+    if pruner.active:
+        # composition-level pruning: doom/bound filters run once per
+        # (composition, batches) class and beam-dead classes skip
+        # arrangement expansion — the flat walk's iteration cost alone
+        # breaks the budget at 256 devices (search/prune.py)
+        inter_iter = pruned_inter_stage_plans(
+            cluster.device_types,
+            cluster.total_devices,
+            config.gbs,
+            model.num_layers,
+            pruner,
+            variance=config.min_group_scale_variance,
+            max_permute_len=config.max_permute_len,
+        )
+    else:
+        inter_iter = inter_stage_plans(
+            cluster.device_types,
+            cluster.total_devices,
+            config.gbs,
+            model.num_layers,
+            variance=config.min_group_scale_variance,
+            max_permute_len=config.max_permute_len,
+        )
+    for inter in inter_iter:
         if inter_filter is not None and not inter_filter(inter):
             pruned += 1
             continue
+        if not pruner.admit(inter):
+            continue
+        pruner.begin_candidate()
         cp_eligible = None
         types_uniform = True
         if len(cp_families) > 1 or sched_families:
@@ -193,6 +222,7 @@ def plan_hetero(
                     except KeyError:
                         pruned += 1
                         continue
+                    pruner.record(cost.total_ms)
                     results.append(
                         RankedPlan(inter=inter, intra=intra, cost=cost))
             except KeyError:
@@ -216,11 +246,13 @@ def plan_hetero(
                     except KeyError:
                         pruned += 1
                         continue
+                    pruner.record(cost.total_ms)
                     results.append(
                         RankedPlan(inter=inter, intra=intra, cost=cost))
             except KeyError:
                 # profile miss inside stage evaluation: prune this family
                 pruned += 1
+        pruner.end_candidate(inter)
 
     results.sort(key=lambda r: r.cost.total_ms)
     num_costed = len(results)
@@ -231,12 +263,13 @@ def plan_hetero(
     events.emit(
         "search_finished", mode="hetero", num_costed=num_costed,
         num_pruned=pruned, seconds=round(elapsed, 4),
-        best_cost_ms=best_cost)
+        best_cost_ms=best_cost, num_bound_pruned=pruner.num_pruned)
     return PlannerResult(
         plans=tuple(results),
         num_costed=num_costed,
         num_pruned=pruned,
         search_seconds=elapsed,
+        num_bound_pruned=pruner.num_pruned,
     )
 
 

@@ -374,6 +374,52 @@ def contention_calibrated(reports: Sequence, key=None) -> tuple[dict, list]:
     return factors, held_out
 
 
+def dispatch_affine_calibrated(
+    reports: Sequence, batches_of
+) -> tuple[dict, list]:
+    """Two-parameter fit-and-hold-out calibration for executors whose
+    per-step overhead scales with the microbatch count (the multi-mesh
+    hetero executor host-syncs each microbatch's loss):
+
+        measured ~= factor * predicted + overhead_ms * batches
+
+    The first TWO reports (with distinct microbatch counts) fit
+    (factor, overhead_ms) exactly; the rest are held out with calibrated
+    predictions.  Falls back to the scalar ``contention_calibrated`` fit
+    when the 2x2 system is singular or fewer than 3 reports exist.
+    ``batches_of(report)`` extracts the microbatch count."""
+    import dataclasses
+
+    def scalar_fallback():
+        factors, held = contention_calibrated(reports)
+        # fit_points tells callers which leading reports are held IN (the
+        # scalar path fits on one, the affine on two) so calibration and
+        # held-out plans are never double-reported
+        return ({"factor": factors.get(None, 1.0), "overhead_ms": 0.0,
+                 "fit_points": 1 if reports else 0}, held)
+
+    if len(reports) < 3:
+        return scalar_fallback()
+    r1, r2 = reports[0], reports[1]
+    p1, b1, m1 = r1.predicted_ms, batches_of(r1), r1.measured_ms
+    p2, b2, m2 = r2.predicted_ms, batches_of(r2), r2.measured_ms
+    det = p1 * b2 - p2 * b1
+    if abs(det) < 1e-12:
+        return scalar_fallback()
+    a = (m1 * b2 - m2 * b1) / det
+    b = (p1 * m2 - p2 * m1) / det
+    # physical clamps: negative factor/overhead means the two fit points
+    # don't separate compute from dispatch — fall back to the scalar fit
+    if a <= 0 or b < 0:
+        return scalar_fallback()
+    held_out = [
+        dataclasses.replace(
+            r, predicted_ms=a * r.predicted_ms + b * batches_of(r))
+        for r in reports[2:]
+    ]
+    return {"factor": a, "overhead_ms": b, "fit_points": 2}, held_out
+
+
 def validate_planner_choice(
     ranked_plans,
     model: ModelSpec,

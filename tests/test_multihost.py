@@ -1,0 +1,82 @@
+"""Multi-host (multi-controller) execution slice — VERDICT r2 next-step 4.
+
+Two REAL processes x 4 virtual CPU devices each, wired by
+``jax.distributed.initialize`` (Gloo collectives), running the SAME
+GSPMD / shard_map-pipeline train steps production uses, with per-host data
+feeding (``execution/multihost.global_batch_pipeline``).  Checks:
+
+- both processes complete and report identical losses (the multi-controller
+  program is SPMD — divergence means broken cross-host collectives);
+- the losses numerically match the identical single-process 8-device run
+  (multihost is an execution-topology change, not a math change).
+"""
+import numpy as np
+import pytest
+
+
+def _spawn_workers(mode: str, port: int, num_procs: int = 2):
+    from metis_tpu.execution.multihost import spawn_workers
+
+    return spawn_workers(mode, port, num_procs=num_procs,
+                         devices_per_process=4)
+
+
+def _single_process_losses(mode: str) -> list[float]:
+    """The identical run in ONE process over 8 virtual devices (the test
+    process's own backend) — the numeric parity oracle."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from metis_tpu.data.pipeline import TokenDataset, _host_batches
+    from metis_tpu.execution.mesh import DP, PP, TP
+    from metis_tpu.execution.pipeline import (
+        make_pipeline_train_step,
+        microbatch_split,
+    )
+    from metis_tpu.execution.train import build_train_state, make_train_step
+    from metis_tpu.models import GPTConfig
+
+    devs = jax.devices("cpu")[:8]
+    cfg = GPTConfig(vocab_size=512, seq_len=16, hidden=64, num_heads=4,
+                    num_blocks=2, ffn_multiplier=2, dtype=jnp.float32)
+    gbs, steps = 8, 2
+    dataset = TokenDataset.synthetic(
+        cfg.vocab_size, gbs * cfg.seq_len * (steps + 2) + 1, cfg.seq_len)
+    host = _host_batches(dataset, gbs, 0, None, skip=0)
+    losses = []
+    if mode == "gspmd":
+        mesh = Mesh(np.array(devs).reshape(4, 2), (DP, TP))
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        step = make_train_step(cfg, mesh)
+        for _ in range(steps):
+            toks, tgts = next(host)
+            state, loss = step(state, jnp.asarray(toks), jnp.asarray(tgts))
+            losses.append(float(jax.device_get(loss)))
+    else:
+        mesh = Mesh(np.array(devs).reshape(2, 2, 2), (PP, DP, TP))
+        init_fn, step = make_pipeline_train_step(cfg, mesh, 2)
+        params, opt_state = init_fn(jax.random.PRNGKey(1))
+        for _ in range(steps):
+            toks, tgts = next(host)
+            params, opt_state, loss = step(
+                params, opt_state,
+                microbatch_split(jnp.asarray(toks), 2),
+                microbatch_split(jnp.asarray(tgts), 2))
+            losses.append(float(jax.device_get(loss)))
+    return losses
+
+
+@pytest.mark.parametrize("mode,port", [("gspmd", 12421),
+                                       ("pipeline", 12423)])
+def test_two_process_step_matches_single_process(mode, port):
+    outs = _spawn_workers(mode, port)
+    assert all(o["processes"] == 2 for o in outs)
+    assert all(o["global_devices"] == 8 for o in outs)
+    assert all(o["local_devices"] == 4 for o in outs)
+    # SPMD: every controller computes the same (replicated) loss
+    assert outs[0]["losses"] == pytest.approx(outs[1]["losses"])
+    assert all(np.isfinite(outs[0]["losses"]))
+    # numeric parity with the identical single-process run
+    expected = _single_process_losses(mode)
+    assert outs[0]["losses"] == pytest.approx(expected, rel=1e-4)

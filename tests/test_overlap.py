@@ -158,6 +158,53 @@ class TestContentionCalibration:
         assert contention_calibrated([]) == ({}, [])
 
 
+class TestDispatchAffineCalibration:
+    def _hreport(self, batches, predicted, measured):
+        from metis_tpu.validation import HeteroValidationReport
+
+        return HeteroValidationReport(
+            plan_dict={"batches": batches}, predicted_ms=predicted,
+            measured_ms=measured, steps=3)
+
+    def test_affine_fit_recovers_overhead(self):
+        from metis_tpu.validation import dispatch_affine_calibrated
+
+        # ground truth: measured = 5 * predicted + 2 * batches
+        reports = [self._hreport(2, 10.0, 54.0),
+                   self._hreport(8, 10.0, 66.0),
+                   self._hreport(4, 20.0, 108.0),   # holdout: exact
+                   self._hreport(16, 10.0, 164.0)]  # holdout: 2x off
+        fit, held = dispatch_affine_calibrated(
+            reports, lambda r: r.plan_dict["batches"])
+        assert fit["factor"] == pytest.approx(5.0)
+        assert fit["overhead_ms"] == pytest.approx(2.0)
+        assert held[0].error_pct == pytest.approx(0.0)
+        assert held[1].error_pct == pytest.approx(-50.0, abs=0.5)
+
+    def test_falls_back_to_scalar_on_few_reports(self):
+        from metis_tpu.validation import dispatch_affine_calibrated
+
+        reports = [self._hreport(2, 10.0, 70.0),
+                   self._hreport(2, 10.0, 70.0)]
+        fit, held = dispatch_affine_calibrated(
+            reports, lambda r: r.plan_dict["batches"])
+        assert fit == {"factor": pytest.approx(7.0), "overhead_ms": 0.0,
+                       "fit_points": 1}
+        assert len(held) == 1
+
+    def test_falls_back_on_singular_system(self):
+        from metis_tpu.validation import dispatch_affine_calibrated
+
+        # same predicted/batches ratio: singular 2x2
+        reports = [self._hreport(2, 10.0, 70.0),
+                   self._hreport(4, 20.0, 140.0),
+                   self._hreport(8, 10.0, 70.0)]
+        fit, held = dispatch_affine_calibrated(
+            reports, lambda r: r.plan_dict["batches"])
+        assert fit["overhead_ms"] == 0.0
+        assert len(held) == 2
+
+
 class TestMeasuredCalibration:
     def test_measure_dp_overlap_on_cpu_mesh(self):
         import jax
