@@ -278,11 +278,15 @@ class HeteroCostEstimator(_EstimatorBase):
             return (self.profiles.get(stage_types[0], tp, bs)
                     .time_slice(start, end) / strategy.cp)
         if self.volume.model.num_experts > 0:
-            # MoE mixed-type stages execute with the EVEN split (uneven
-            # padding is unsound for capacity-competing routed tokens —
-            # execution.hetero); price what actually runs: the slowest
-            # type at the even per-replica batch.
-            bs = plan.gbs // dp // plan.batches
+            # Uneven hetero-DP is SOUND for MoE (the router masks pad
+            # tokens out of expert capacity, models/moe.moe_ffn) but not
+            # FASTER: the executor pads every replica to max(split) rows
+            # and expert compute is capacity-shaped — masking frees slots,
+            # not FLOPs.  Price the slowest member type at the PADDED
+            # per-replica batch, which is what every replica executes.
+            split = self.data_balancer.partition(
+                stage_types, dp, tp, plan.gbs // plan.batches)
+            bs = max(split)
             slowest = 0.0
             for t in set(stage_types):
                 total = 0.0

@@ -209,11 +209,11 @@ def _hetero_executable(cfg, artifact, strategies, devices, optimizer, cluster,
                        profiles) -> Executable:
     pp = len(strategies)
     rows = None
-    is_moe = isinstance(cfg, MoEConfig)
-    if (not is_moe and cluster is not None and profiles is not None
+    if (cluster is not None and profiles is not None
             and artifact.node_sequence):
-        # (MoE stages take the even split: capacity-competing routed tokens
-        # make pad rows unsound — execution.hetero._make_stage_fn)
+        # uneven per-replica microbatches apply to MoE stages too: the
+        # router masks pad tokens out of capacity competition
+        # (execution.hetero._make_stage_fn / models.moe.moe_ffn)
         from metis_tpu.core.types import InterStagePlan, Strategy
 
         inter = InterStagePlan(

@@ -207,14 +207,21 @@ def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl,
     """
     pad = spec.replica_rows is not None and len(set(spec.replica_rows)) > 1
     is_moe = isinstance(cfg, MoEConfig)
-    if pad and is_moe:
-        # routed experts compete for capacity across the whole token batch,
-        # so duplicate pad rows would steal expert slots from real tokens —
-        # the zero-gradient padding argument only holds for row-local blocks
-        raise NotImplementedError(
-            "uneven hetero-DP padding is not sound for MoE stages")
+    pad_mask = None
     if pad:
         to_padded, to_canonical = _pad_maps(spec.replica_rows)
+        if is_moe:
+            # routed experts compete for capacity across the whole token
+            # batch, so a duplicate pad row claiming an expert slot would
+            # displace a real token.  The router takes a validity mask
+            # (models/moe.moe_ffn): pad tokens never enter routing,
+            # capacity, or the aux statistics — uneven hetero-DP (Metis's
+            # signature feature) is sound for MoE stages with it (exact
+            # below capacity pressure; see moe_ffn on the drop-set
+            # approximation when capacity binds).  Per-ROW vector; the
+            # router broadcasts over seq.
+            pad_mask = np.zeros(len(to_padded), np.float32)
+            pad_mask[to_canonical] = 1.0
     batch_axes = (DP, EP) if spec.ep > 1 else DP
     seq_axis = SP if spec.cp > 1 else None
     batch_sharded = P(batch_axes, seq_axis, None)
@@ -237,7 +244,10 @@ def _make_stage_fn(spec: StageSpec, cfg: GPTConfig, attn_impl,
                 # would be NaN; there are no routers here, aux is zero
                 aux = jnp.zeros((), jnp.float32)
             else:
-                x, aux = run_blocks(params, x, cfg, attn_impl)
+                mask = (jnp.asarray(pad_mask)
+                        if pad_mask is not None else None)
+                x, aux = run_blocks(params, x, cfg, attn_impl,
+                                    valid_mask=mask)
         else:
             x = run_blocks(params, x, cfg, attn_impl)
         if pad:

@@ -129,7 +129,8 @@ def _route_group_len(tokens: int, target: int) -> int:
 
 
 def moe_ffn(
-    x: jnp.ndarray, layer: dict, cfg: MoEConfig
+    x: jnp.ndarray, layer: dict, cfg: MoEConfig,
+    valid_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k routed expert FFN on [b, s, h].  Returns (output, aux_loss).
 
@@ -137,21 +138,47 @@ def moe_ffn(
     keeps every step a static-shape matmul.  Tokens are routed in fixed-size
     groups (``cfg.route_group_size``): the one-hot tensors are
     [G, g, E, C_g], linear in total tokens, and every expert processes
-    ``C_g`` slots per group (capacity discipline per group, as GShard)."""
+    ``C_g`` slots per group (capacity discipline per group, as GShard).
+
+    ``valid_mask`` [b] or [b, s] (1 = real token): masked tokens never
+    enter the router's capacity competition or the aux-loss statistics —
+    the uneven hetero-DP executor pads replica batches with duplicate
+    rows, and a pad row claiming an expert slot would displace a real
+    token (the soundness hazard that previously made uneven splits
+    MoE-forbidden).  Capacity slots per group stay computed from the group
+    SIZE (static shapes), so masking only ever frees slots relative to the
+    unmasked batch.  Note the approximation under capacity PRESSURE: the
+    padded batch's token grouping differs from the canonical batch's, so
+    when drops occur, a different set of real tokens may drop than an
+    unpadded run would choose — sound (no pad ever displaces a real
+    token), exact whenever nothing exceeds capacity (pinned by the parity
+    tests)."""
     b, s, h = x.shape
     T = b * s
     tokens = x.reshape(T, h)
     g = _route_group_len(T, cfg.route_group_size)
     grouped = tokens.reshape(T // g, g, h)
-    out, aux = jax.vmap(lambda t: _route_tokens(t, layer, cfg))(grouped)
-    return out.reshape(b, s, h), aux.mean()
+    if valid_mask is None:
+        out, aux = jax.vmap(lambda t: _route_tokens(t, layer, cfg))(grouped)
+        return out.reshape(b, s, h), aux.mean()
+    if valid_mask.ndim == 1:  # per-row mask: broadcast over seq (free in XLA)
+        valid_mask = jnp.broadcast_to(valid_mask[:, None], (b, s))
+    vgrouped = valid_mask.astype(jnp.float32).reshape(T // g, g)
+    out, aux = jax.vmap(
+        lambda t, v: _route_tokens(t, layer, cfg, valid=v))(grouped, vgrouped)
+    # aux is a masked mean per group; weight groups by their valid counts
+    weights = vgrouped.sum(-1)
+    aux = (aux * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    return out.reshape(b, s, h), aux
 
 
 def _route_tokens(
-    tokens: jnp.ndarray, layer: dict, cfg: MoEConfig
+    tokens: jnp.ndarray, layer: dict, cfg: MoEConfig,
+    valid: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Route one token group [T, h] through the experts; returns
-    ([T, h] mixed output, aux loss scalar)."""
+    ([T, h] mixed output, aux loss scalar).  ``valid`` [T] masks tokens out
+    of routing, capacity, and the aux statistics (see ``moe_ffn``)."""
     T, h = tokens.shape
     E, k, dt = cfg.num_experts, cfg.top_k, cfg.dtype
     C = expert_capacity(cfg, T)
@@ -171,6 +198,10 @@ def _route_tokens(
     # cumulative count of prior assignments to the same expert, counting
     # choice slots in priority order (k=0 first).
     choice_onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T,k,E]
+    if valid is not None:
+        # masked tokens claim no expert slot and shift no real token's
+        # position in the capacity cumsum
+        choice_onehot = choice_onehot * valid[:, None, None]
     flat = choice_onehot.transpose(1, 0, 2).reshape(k * T, E)   # priority-major
     pos_flat = jnp.cumsum(flat, axis=0) - flat                  # [k*T, E]
     position = (pos_flat.reshape(k, T, E) * choice_onehot.transpose(1, 0, 2)) \
@@ -203,16 +234,24 @@ def _route_tokens(
         preferred_element_type=jnp.float32).astype(dt)
 
     # Switch-style load-balance loss: E * sum_e mean(router prob) * frac(tokens)
-    assign_frac = choice_onehot[:, 0, :].mean(0)                # top-1 counts
-    aux = E * jnp.sum(probs.mean(0) * assign_frac)
+    if valid is None:
+        assign_frac = choice_onehot[:, 0, :].mean(0)            # top-1 counts
+        aux = E * jnp.sum(probs.mean(0) * assign_frac)
+    else:
+        denom = jnp.maximum(valid.sum(), 1.0)
+        assign_frac = choice_onehot[:, 0, :].sum(0) / denom
+        probs_mean = (probs * valid[:, None]).sum(0) / denom
+        aux = E * jnp.sum(probs_mean * assign_frac)
 
     return out, aux
 
 
 def moe_block_forward(
-    x: jnp.ndarray, layer: dict, cfg: MoEConfig, attn_impl: AttnFn
+    x: jnp.ndarray, layer: dict, cfg: MoEConfig, attn_impl: AttnFn,
+    valid_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """One MoE transformer block; returns (activations, aux_loss)."""
+    """One MoE transformer block; returns (activations, aux_loss).
+    ``valid_mask`` [b, s] masks pad tokens out of expert routing."""
     h, nh, hd = cfg.hidden, cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
 
@@ -234,7 +273,7 @@ def moe_block_forward(
     x = x + (attn_out + layer["proj_bias"]).astype(dt)
 
     y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-    z, aux = moe_ffn(y, layer, cfg)
+    z, aux = moe_ffn(y, layer, cfg, valid_mask=valid_mask)
     return x + z, aux
 
 
@@ -245,16 +284,19 @@ def moe_run_blocks(
     attn_impl: AttnFn | None = None,
     block_slice: tuple[int, int] | None = None,
     resid_fn=None,
+    valid_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Scan the stacked MoE blocks; returns (activations, mean aux loss).
-    ``resid_fn`` hooks the residual stream per block (gpt.run_blocks)."""
+    ``resid_fn`` hooks the residual stream per block (gpt.run_blocks);
+    ``valid_mask`` [b, s] masks pad tokens out of expert routing."""
     attn = attn_impl or default_attention(cfg)
     blocks = params["blocks"]
     if block_slice is not None:
         i, j = block_slice
         blocks = jax.tree.map(lambda a: a[i:j], blocks)
 
-    body = partial(moe_block_forward, cfg=cfg, attn_impl=attn)
+    body = partial(moe_block_forward, cfg=cfg, attn_impl=attn,
+                   valid_mask=valid_mask)
     if cfg.remat:
         body = jax.checkpoint(body)
 

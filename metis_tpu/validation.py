@@ -246,13 +246,9 @@ def measure_ranked_plan_ms(
         return _measure_scheduled_plan_ms(
             ranked, cfg, devices, steps=steps, warmup=warmup, seed=seed)
     rows = None
-    # (mirrors execution.builder: MoE stages take the even split — uneven
-    # pad rows are unsound for capacity-competing routed tokens, and the
-    # executor refuses them)
-    from metis_tpu.models.moe import MoEConfig as _MoECfg
-
-    if (cluster is not None and profiles is not None
-            and not isinstance(cfg, _MoECfg)):
+    if cluster is not None and profiles is not None:
+        # uneven per-replica microbatches apply to MoE stages too — the
+        # router masks pad tokens out of capacity competition
         rows = plan_replica_rows(inter, intra.strategies, cluster, profiles)
     stage_specs = stage_specs_from_plan(
         intra.layer_partition, intra.strategies, cfg, stage_replica_rows=rows)

@@ -277,13 +277,49 @@ class TestMoEStages:
                 [0, cfg.num_profile_layers], [{"dp": 3, "tp": 1, "ep": 2}],
                 cfg)
 
-    def test_moe_padding_rejected(self):
+    def test_moe_uneven_padding_matches_single_program(self):
+        """Uneven hetero-DP rows on an MoE stage: the router's pad mask
+        (models/moe.moe_ffn valid_mask) keeps duplicate pad rows out of
+        expert-capacity competition, so the padded run reproduces the
+        single-program loss exactly — ample capacity here, since capacity
+        DROPS are the only grouping-order-dependent behavior."""
+        from metis_tpu.models.moe import init_moe_params, moe_next_token_loss
+
         cfg = self._cfg()
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (4, cfg.seq_len), 0, cfg.vocab_size)
+        expected = float(moe_next_token_loss(
+            init_moe_params(jax.random.PRNGKey(0), cfg), toks, toks, cfg))
+
         stages = stage_specs_from_plan(
             [0, cfg.num_profile_layers], [{"dp": 2, "tp": 1}], cfg,
             stage_replica_rows=[(3, 1)])
-        with pytest.raises(NotImplementedError, match="MoE"):
-            make_hetero_train_step(cfg, stages, devices=jax.devices()[:2])
+        init_fn, step_fn = make_hetero_train_step(
+            cfg, stages, devices=jax.devices()[:2])
+        state = init_fn(jax.random.PRNGKey(0))
+        mbs = toks.reshape(1, 4, -1)
+        _, loss = step_fn(state, mbs, mbs)
+        assert loss == pytest.approx(expected, rel=1e-4)
+
+    def test_moe_uneven_two_stage_matches_single_program(self):
+        from metis_tpu.models.moe import init_moe_params, moe_next_token_loss
+
+        cfg = self._cfg()
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (4, cfg.seq_len), 0, cfg.vocab_size)
+        expected = float(moe_next_token_loss(
+            init_moe_params(jax.random.PRNGKey(0), cfg), toks, toks, cfg))
+
+        stages = stage_specs_from_plan(
+            [0, 3, cfg.num_profile_layers],
+            [{"dp": 2, "tp": 1}, {"dp": 2, "tp": 2}], cfg,
+            stage_replica_rows=[(3, 1), None])
+        init_fn, step_fn = make_hetero_train_step(
+            cfg, stages, devices=jax.devices()[:6])
+        state = init_fn(jax.random.PRNGKey(0))
+        mbs = toks.reshape(1, 4, -1)
+        _, loss = step_fn(state, mbs, mbs)
+        assert loss == pytest.approx(expected, rel=1e-4)
 
 
 class TestCpStages:

@@ -305,13 +305,13 @@ class TestNoUnrunnablePlans:
         result = plan_hetero(cluster, store, model, config)
         assert result.plans, "planner emitted nothing"
         cfg = config_for_model_spec(model)
-        is_moe = isinstance(cfg, MoEConfig)
+        assert isinstance(cfg, MoEConfig) == (model.num_experts > 0)
         for r in result.plans:
-            rows = None
-            if not is_moe:  # the builder/validator gate, mirrored
-                rows = plan_replica_rows(
-                    r.inter, r.intra.strategies, cluster, store)
-            # stage_specs_from_plan hosts both NotImplementedError guards;
+            # uneven replica rows now apply to MoE stages too (the router
+            # masks pad tokens out of expert capacity)
+            rows = plan_replica_rows(
+                r.inter, r.intra.strategies, cluster, store)
+            # stage_specs_from_plan hosts the remaining guard (cp+MoE);
             # any raise here is a planner/executor contract break
             stage_specs_from_plan(
                 r.intra.layer_partition, r.intra.strategies, cfg,
