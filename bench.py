@@ -313,6 +313,117 @@ def scale_search_256(record: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# north-star scenario: GPT-2.7B-class on v4-32 + v5e-16 (BASELINE.md)
+# ---------------------------------------------------------------------------
+
+NORTHSTAR_EXHAUSTIVE_BUDGET_S = 600.0
+# measured once on this box (2026-07-30): exhaustive = 435,737 plans in
+# ~424 s, optimum 2361.94 ms with device groups [16, 32]; used as the
+# comparison point when the live exhaustive run exceeds the budget
+NORTHSTAR_RECORDED_EXHAUSTIVE_MS = 2361.94
+
+# ONE workload definition shared by the in-process beam run and the
+# exhaustive subprocess driver — divergent copies would compare optima of
+# different search spaces
+NORTHSTAR_MODEL_KW = dict(name="gpt-2p7b", num_layers=34, hidden_size=2560,
+                          sequence_length=2048, vocab_size=51200,
+                          num_heads=32)
+NORTHSTAR_SLICES = ("v4-32", "v5e-16")
+NORTHSTAR_PROFILE_TPS = (1, 2, 4)
+NORTHSTAR_PROFILE_BSS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+NORTHSTAR_GBS = 256
+NORTHSTAR_VARIANCE = 0.5
+
+
+def _northstar_workload():
+    from metis_tpu.cluster.tpu import TpuClusterSpec, slice_from_name
+    from metis_tpu.core.config import ModelSpec
+    from metis_tpu.profiles import synthesize_profiles
+
+    model = ModelSpec(**NORTHSTAR_MODEL_KW)
+    store = synthesize_profiles(
+        model, ["tpu_v4", "tpu_v5e"], tps=list(NORTHSTAR_PROFILE_TPS),
+        bss=list(NORTHSTAR_PROFILE_BSS))
+    tc = TpuClusterSpec(tuple(slice_from_name(s) for s in NORTHSTAR_SLICES))
+    return model, store, tc
+
+
+_NORTHSTAR_DRIVER = r"""
+import json, time
+import bench
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.planner import plan_tpu
+model, store, tc = bench._northstar_workload()
+t0 = time.perf_counter()
+res = plan_tpu(tc, store, model,
+               SearchConfig(gbs=bench.NORTHSTAR_GBS,
+                            min_group_scale_variance=bench.NORTHSTAR_VARIANCE),
+               top_k=1)
+print(json.dumps({"elapsed_s": time.perf_counter() - t0,
+                  "best_ms": res.best.cost.total_ms,
+                  "costed": res.num_costed}))
+"""
+
+
+def northstar(record: dict) -> None:
+    """BASELINE.md north star: plan GPT-3-2.7B-class on a heterogeneous
+    v4-32 + v5e-16 deployment, chosen plan within 10% of the
+    exhaustive-search optimum, zero GPUs involved.  The anytime beam finds
+    the plan in ~1 s; the exhaustive oracle (~7 min over 435k candidates)
+    runs live under a budget, falling back to its recorded optimum."""
+    import time as _time
+
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_tpu
+
+    model, store, tc = _northstar_workload()
+    t0 = _time.perf_counter()
+    res = plan_tpu(tc, store, model,
+                   SearchConfig(gbs=NORTHSTAR_GBS,
+                                min_group_scale_variance=NORTHSTAR_VARIANCE,
+                                prune_to_top_k=10, beam_patience=30),
+                   top_k=5)
+    beam_s = _time.perf_counter() - t0
+    entry: dict = {
+        "scenario": "GPT-2.7B-class, v4-32 + v5e-16 over DCN, gbs=256",
+        "beam_s": round(beam_s, 2),
+        "beam_best_ms": round(res.best.cost.total_ms, 2)
+        if res.best else None,
+        "beam_plans_costed": res.num_costed,
+        "beam_groups": list(res.best.inter.device_groups)
+        if res.best else None,
+    }
+    exhaustive_ms = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _NORTHSTAR_DRIVER],
+            capture_output=True, text=True,
+            timeout=NORTHSTAR_EXHAUSTIVE_BUDGET_S,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=str(Path(__file__).resolve().parent))
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"rc={proc.returncode}: {proc.stderr[-300:]}")
+        ref = json.loads(proc.stdout.strip().splitlines()[-1])
+        exhaustive_ms = ref["best_ms"]
+        entry["exhaustive_s"] = round(ref["elapsed_s"], 1)
+        entry["exhaustive_plans_costed"] = ref["costed"]
+        entry["exhaustive_source"] = "live"
+    except subprocess.TimeoutExpired:
+        exhaustive_ms = NORTHSTAR_RECORDED_EXHAUSTIVE_MS
+        entry["exhaustive_source"] = "recorded (live run exceeded budget)"
+    except Exception as e:  # noqa: BLE001 — crash: record, don't mask
+        exhaustive_ms = NORTHSTAR_RECORDED_EXHAUSTIVE_MS
+        entry["exhaustive_source"] = (
+            f"recorded (live run FAILED: {e})"[:300])
+    if res.best is not None and exhaustive_ms:
+        gap = (res.best.cost.total_ms / exhaustive_ms - 1) * 100
+        entry["gap_vs_exhaustive_pct"] = round(gap, 2)
+        entry["within_10pct_target"] = gap <= 10.0
+    record["northstar"] = entry
+
+
+# ---------------------------------------------------------------------------
 # real-TPU single-chip train step
 # ---------------------------------------------------------------------------
 
@@ -728,7 +839,7 @@ def main() -> None:
             "recent_attempts": attempts[-8:],
         }
     parity_search(record)
-    for section in (scale_search, scale_search_256, tpu_step,
+    for section in (scale_search, scale_search_256, northstar, tpu_step,
                     validation_error, tpu_validation):
         try:
             section(record)

@@ -147,12 +147,16 @@ def moe_ffn(
     token (the soundness hazard that previously made uneven splits
     MoE-forbidden).  Capacity slots per group stay computed from the group
     SIZE (static shapes), so masking only ever frees slots relative to the
-    unmasked batch.  Note the approximation under capacity PRESSURE: the
-    padded batch's token grouping differs from the canonical batch's, so
-    when drops occur, a different set of real tokens may drop than an
-    unpadded run would choose — sound (no pad ever displaces a real
-    token), exact whenever nothing exceeds capacity (pinned by the parity
-    tests)."""
+    unmasked batch.  Exactness scope: real tokens' OUTPUTS are bit-exact
+    vs the canonical batch whenever nothing exceeds capacity (routing is
+    per-token; pinned by the output-parity test).  Two grouping-dependent
+    residuals remain: under capacity PRESSURE the padded grouping may drop
+    a different set of real tokens than the canonical grouping would
+    (sound — no pad ever displaces a real token), and the aux
+    load-balance STATISTIC is aggregated over the padded groups (masked
+    per-group means, valid-count-weighted), which can differ slightly from
+    the canonical per-group aggregation when group boundaries shift — a
+    training-signal regularizer, not a model-output surface."""
     b, s, h = x.shape
     T = b * s
     tokens = x.reshape(T, h)

@@ -363,3 +363,39 @@ class TestNoUnrunnablePlans:
         # dense models DO search cp
         assert any(s.cp > 1 for r in result.plans
                    for s in r.intra.strategies)
+
+
+class TestPadMaskRouting:
+    def test_masked_outputs_exact_across_misaligned_groups(self):
+        """Real tokens' expert outputs must be bit-exact vs the canonical
+        (unpadded) batch even when padding changes the route-group length —
+        group boundaries shift, but per-token routing and ample capacity
+        make outputs grouping-independent.  (The aux STATISTIC is
+        grouping-dependent by design; only outputs are pinned here.)"""
+        import numpy as np
+
+        from metis_tpu.models.moe import MoEConfig, init_moe_params, moe_ffn
+
+        # canonical 4 rows x seq 16 = 64 tokens -> g = 32 (two groups);
+        # padded 6 rows = 96 tokens -> g = 48: misaligned boundaries
+        cfg = MoEConfig(vocab_size=64, seq_len=16, hidden=32, num_heads=2,
+                        num_blocks=1, ffn_multiplier=2, num_experts=2,
+                        top_k=1, capacity_factor=8.0, dtype=jnp.float32,
+                        route_group_size=48)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        layer = jax.tree.map(lambda a: a[0], params["blocks"])
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, cfg.seq_len, 32),
+                              jnp.float32)
+
+        want, _ = moe_ffn(x, layer, cfg)
+
+        # pad layout from replica_rows (3, 1): rows [r0 r1 r2 r3 pad pad]
+        to_padded = np.array([0, 1, 2, 3, 0, 0])
+        to_canonical = np.array([0, 1, 2, 3])
+        xp = x[to_padded]
+        valid = np.zeros(6, np.float32)
+        valid[to_canonical] = 1.0
+        got, _ = moe_ffn(xp, layer, cfg, valid_mask=jnp.asarray(valid))
+        np.testing.assert_allclose(
+            np.asarray(got)[to_canonical], np.asarray(want),
+            rtol=1e-6, atol=1e-6)
