@@ -269,6 +269,14 @@ def main(argv: list[str] | None = None) -> int:
                               "overlapped with training); 0 = final only")
     p_train.add_argument("--log-every", type=int, default=1,
                          help="emit a train_step event every N steps")
+    g_mh = p_train.add_argument_group(
+        "multi-host (run the SAME command on every host, varying only "
+        "--process-id; execution.multihost wires jax.distributed)")
+    g_mh.add_argument("--coordinator", default=None,
+                      help="host:port of process 0 — enables "
+                           "multi-controller training (GSPMD plans)")
+    g_mh.add_argument("--num-processes", type=int, default=None)
+    g_mh.add_argument("--process-id", type=int, default=None)
     _add_platform_arg(p_train)
 
     p_rep = sub.add_parser(
@@ -446,6 +454,32 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     from metis_tpu.models import config_for_model_spec
     from metis_tpu.planner.api import plan_hetero as _plan_hetero
 
+    # Multi-controller: wire jax.distributed BEFORE any backend touch.
+    # Every process runs the same plan→train program over the global device
+    # set; only process 0 writes the summary/events.
+    multihost = args.coordinator is not None
+    is_main = True
+    if not multihost and (args.num_processes is not None
+                          or args.process_id is not None):
+        print("--num-processes/--process-id require --coordinator (without "
+              "it every host would silently train an independent copy)",
+              file=sys.stderr)
+        return 2
+    if multihost:
+        if args.num_processes is None or args.process_id is None:
+            print("--coordinator requires --num-processes and --process-id",
+                  file=sys.stderr)
+            return 2
+        from metis_tpu.execution.multihost import initialize_multihost
+
+        info = initialize_multihost(
+            args.coordinator, args.num_processes, args.process_id,
+            platform=args.platform)
+        is_main = info.process_index == 0
+        print(f"multihost: process {info.process_index}/"
+              f"{info.process_count}, {info.global_device_count} global / "
+              f"{info.local_device_count} local devices", file=sys.stderr)
+
     cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
 
     # Resume pins the checkpoint's saved plan: re-running the search could
@@ -514,6 +548,15 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     print(f"best plan ({cost_txt}) -> "
           f"{exe.kind} executable; stages {art.device_groups or '1'}, "
           f"gbs {art.gbs} x {args.steps} steps", file=sys.stderr)
+    if multihost and exe.kind != "gspmd":
+        print(f"--coordinator supports GSPMD (pp=1 rectangular) plans; the "
+              f"chosen plan routes to the {exe.kind} executable.  The "
+              "shard_map pipeline runs multi-controller at the library "
+              "level (execution.multihost); the multi-mesh hetero executor "
+              "is single-controller by design (one controller per stage "
+              "group on real deployments — see execution/multihost.py).",
+              file=sys.stderr)
+        return 2
 
     if args.data:
         tokens = (np.load(args.data, mmap_mode="r")
@@ -598,11 +641,20 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         from metis_tpu.execution.mesh import DP, EP, SP
 
         s0 = dict(art.strategies[0])
-        batches = make_input_pipeline(
-            dataset, art.gbs, mesh=mesh,
-            dp_axis=(DP, EP) if s0.get("ep", 1) > 1 else DP,
-            seq_axis=SP if s0.get("cp", 1) > 1 else None,
-            epochs=None, skip_batches=start_step)
+        dp_ax = (DP, EP) if s0.get("ep", 1) > 1 else DP
+        seq_ax = SP if s0.get("cp", 1) > 1 else None
+        if multihost:
+            # per-host feeding: every controller walks the same schedule
+            # but materializes only its addressable shards
+            from metis_tpu.execution.multihost import global_batch_pipeline
+
+            batches = global_batch_pipeline(
+                dataset, art.gbs, mesh, dp_axis=dp_ax, seq_axis=seq_ax,
+                skip_batches=start_step)
+        else:
+            batches = make_input_pipeline(
+                dataset, art.gbs, mesh=mesh, dp_axis=dp_ax, seq_axis=seq_ax,
+                epochs=None, skip_batches=start_step)
     else:
         # pipeline/hetero steps do their own microbatch placement
         batches = make_input_pipeline(dataset, art.gbs, epochs=None,
@@ -632,8 +684,11 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                     or i + 1 == args.steps):
                 loss = float(loss)
                 losses.append(loss)
-                events.emit("train_step", step=start_step + i + 1, loss=loss,
-                            elapsed_s=round(time.perf_counter() - t0, 3))
+                if is_main:  # one event writer under multi-controller
+                    events.emit("train_step", step=start_step + i + 1,
+                                loss=loss,
+                                elapsed_s=round(
+                                    time.perf_counter() - t0, 3))
             if (can_ckpt and args.checkpoint_every
                     and (i + 1) % args.checkpoint_every == 0):
                 periodic_save(state, start_step + i + 1)
@@ -667,7 +722,8 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                          if args.steps and elapsed > 0 else None),
         "checkpoint": args.checkpoint_dir if can_ckpt else None,
     }
-    _emit(args, json.dumps(summary, indent=2))
+    if is_main:  # one summary writer under multi-controller
+        _emit(args, json.dumps(summary, indent=2))
     return 0
 
 

@@ -80,3 +80,81 @@ def test_two_process_step_matches_single_process(mode, port):
     # numeric parity with the identical single-process run
     expected = _single_process_losses(mode)
     assert outs[0]["losses"] == pytest.approx(expected, rel=1e-4)
+
+
+def test_cli_train_multihost_two_processes(tmp_path):
+    """`metis-tpu train --coordinator ...` runs the SAME command on two
+    real processes (4 virtual devices each) over a pinned GSPMD plan with
+    per-host data feeding; process 0 writes the summary."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from metis_tpu.execution.mesh import PlanArtifact
+    from metis_tpu.profiles.store import (
+        LayerProfile,
+        ModelProfileMeta,
+        ProfileStore,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    L = 6
+    entries = {("A100", 1, bs): LayerProfile(
+        layer_times_ms=(1.0,) * L,
+        layer_memory_mb=(50.0,) * L,
+        fb_sync_ms=0.0) for bs in (1, 2)}
+    meta = ModelProfileMeta(num_layers=L, optimizer_time_ms=1.0,
+                            batch_generator_ms=0.1,
+                            params_per_layer_bytes=(1_000_000,) * L)
+    ProfileStore(entries, meta).dump_to_dir(tmp_path / "profiles")
+    (tmp_path / "hostfile").write_text(
+        "10.0.0.1 slots=4\n10.0.0.2 slots=4\n")
+    (tmp_path / "clusterfile.json").write_text(json.dumps({
+        ip: {"instance_type": "A100", "inter_bandwidth": 10,
+             "intra_bandwidth": 40, "memory": 80}
+        for ip in ("10.0.0.1", "10.0.0.2")}))
+    # pin a GSPMD (pp=1, dp=8) plan through the checkpoint dir's plan file
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    art = PlanArtifact(
+        mesh_axes=("pp", "dp", "ep", "sp", "tp"),
+        mesh_shape=(1, 8, 1, 1, 1),
+        layer_partition=(0, L),
+        strategies=({"dp": 8, "tp": 1},),
+        gbs=8, microbatches=1)
+    (ckpt / "plan.json").write_text(art.to_json())
+
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+           "PYTHONPATH": repo}
+    out = tmp_path / "summary.json"
+    base = [sys.executable, "-m", "metis_tpu.planner.cli", "train",
+            "--hostfile", str(tmp_path / "hostfile"),
+            "--clusterfile", str(tmp_path / "clusterfile.json"),
+            "--profile-dir", str(tmp_path / "profiles"),
+            "--model-name", "mh-cli", "--num-layers", str(L),
+            "--hidden-size", "64", "--seq-len", "16",
+            "--vocab-size", "256", "--num-heads", "4",
+            "--gbs", "8", "--max-bs", "2", "--steps", "2",
+            "--checkpoint-dir", str(ckpt),
+            "--output", str(out), "--platform", "cpu",
+            "--coordinator", "127.0.0.1:12427", "--num-processes", "2"]
+    procs = [subprocess.Popen([*base, "--process-id", str(i)],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              text=True, env=env, cwd=repo)
+             for i in range(2)]
+    try:
+        for i, p in enumerate(procs):
+            _, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    summary = json.loads(out.read_text())
+    assert summary["executable"] == "gspmd"
+    assert summary["steps"] == 2
+    assert summary["final_loss"] is not None
