@@ -250,8 +250,14 @@ def load_plan(directory: str | Path) -> PlanArtifact | None:
 
 
 def _as_restore(leaf):
-    if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding") and \
-            isinstance(leaf.sharding, NamedSharding):
+    # ANY concrete sharding on the reference leaf drives the restore —
+    # not just NamedSharding: a scalar step counter carries a
+    # SingleDeviceSharding, and restoring it "as saved" breaks when the
+    # checkpoint's mesh no longer exists (elastic resume onto a smaller
+    # device set — the saved 8-device sharding cannot deserialize in a
+    # 4-device process).
+    if isinstance(leaf, jax.Array) and \
+            isinstance(getattr(leaf, "sharding", None), jax.sharding.Sharding):
         return ocp.ArrayRestoreArgs(
             sharding=leaf.sharding, global_shape=leaf.shape,
             dtype=leaf.dtype)

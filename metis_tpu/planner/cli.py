@@ -264,6 +264,13 @@ def main(argv: list[str] | None = None) -> int:
                               "memmapped); default: synthetic tokens")
     p_train.add_argument("--checkpoint-dir", default=None,
                          help="save (and resume from) checkpoints here")
+    p_train.add_argument("--replan-on-resume", action="store_true",
+                         help="elastic recovery: ignore the checkpoint's "
+                              "pinned plan, search the CURRENT cluster "
+                              "fresh, and restore the training state "
+                              "cross-mesh onto the new plan (orbax "
+                              "reshards on read) — resume after losing or "
+                              "gaining devices")
     p_train.add_argument("--checkpoint-every", type=int, default=0,
                          help="also checkpoint every N steps (async, "
                               "overlapped with training); 0 = final only")
@@ -488,6 +495,7 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     # — the plan artifact saved alongside the weights is the layout contract
     # (execution.checkpoint module docstring).
     art = plan_cost_ms = None
+    replanned = False
     if args.checkpoint_dir is not None:
         from metis_tpu.execution.checkpoint import load_plan
 
@@ -495,7 +503,15 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
             art = load_plan(args.checkpoint_dir)
         except FileNotFoundError:
             art = None
-        if art is not None:
+        if art is not None and args.replan_on_resume:
+            # elastic recovery: the pinned plan may target devices that no
+            # longer exist — search the CURRENT cluster instead and restore
+            # the state cross-mesh (execution.checkpoint reshards on read)
+            print("--replan-on-resume: ignoring the pinned plan, searching "
+                  "the current cluster", file=sys.stderr)
+            art = None
+            replanned = True
+        elif art is not None:
             print(f"resuming with the plan pinned by {args.checkpoint_dir} "
                   "(search skipped)", file=sys.stderr)
     if art is None:
@@ -618,15 +634,30 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                       f"'{block_layout}' (--schedule/--virtual-stages "
                       "changed?) — refusing to resume", file=sys.stderr)
                 return 1
-            if exe.kind == "hetero":
-                state = restore_hetero_checkpoint(args.checkpoint_dir, state)
-            else:
-                # layout already compared above (single check; the
-                # library-level guard serves non-CLI consumers)
-                restored = restore_checkpoint(
-                    args.checkpoint_dir, as_train_state(state, start_step))
-                state = (restored if exe.kind == "gspmd"
-                         else (restored.params, restored.opt_state))
+            try:
+                if exe.kind == "hetero":
+                    state = restore_hetero_checkpoint(
+                        args.checkpoint_dir, state)
+                else:
+                    # layout already compared above (single check; the
+                    # library-level guard serves non-CLI consumers)
+                    restored = restore_checkpoint(
+                        args.checkpoint_dir,
+                        as_train_state(state, start_step))
+                    state = (restored if exe.kind == "gspmd"
+                             else (restored.params, restored.opt_state))
+            except Exception as e:  # noqa: BLE001 — see replan note
+                if replanned:
+                    # cross-mesh restore reshards arrays, but it cannot
+                    # bridge different STATE STRUCTURES (a per-stage hetero
+                    # state list vs a single TrainState)
+                    print("--replan-on-resume: the checkpoint's state "
+                          f"structure does not fit the re-planned {exe.kind} "
+                          "executable (the old plan likely routed to a "
+                          "different executor family) — "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+                    return 1
+                raise
             print(f"resumed from {args.checkpoint_dir} at step {start_step}",
                   file=sys.stderr)
         except FileNotFoundError:

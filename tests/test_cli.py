@@ -181,3 +181,98 @@ def test_replan_events_logged(fixture_dir, tmp_path):
     assert rc == 0
     lines = [json.loads(l) for l in ev.read_text().splitlines()]
     assert any(e["event"] == "search_finished" for e in lines)
+
+
+def test_train_replan_on_resume_elastic(tmp_path):
+    """Elastic recovery at the driver level: train on an 8-device plan,
+    shrink the cluster to ONE device, and resume with --replan-on-resume —
+    a fresh search on the survivor topology plus a cross-mesh state restore
+    (orbax reshards dp=8 shards onto the dp=1 mesh)."""
+    import json
+
+    from metis_tpu.execution.checkpoint import load_meta, load_plan
+    from metis_tpu.execution.mesh import PlanArtifact
+    from metis_tpu.profiles.store import (
+        LayerProfile,
+        ModelProfileMeta,
+        ProfileStore,
+    )
+
+    L = 6
+    entries = {("A100", 1, bs): LayerProfile(
+        layer_times_ms=(1.0,) * L,
+        layer_memory_mb=(50.0,) * L,
+        fb_sync_ms=0.0) for bs in (1, 2, 4, 8)}
+    meta = ModelProfileMeta(num_layers=L, optimizer_time_ms=1.0,
+                            batch_generator_ms=0.1,
+                            params_per_layer_bytes=(1_000_000,) * L)
+    ProfileStore(entries, meta).dump_to_dir(tmp_path / "profiles")
+
+    def cluster_files(n_slots_per_node, n_nodes):
+        hosts = "".join(f"10.0.0.{i+1} slots={n_slots_per_node}\n"
+                        for i in range(n_nodes))
+        (tmp_path / "hostfile").write_text(hosts)
+        (tmp_path / "clusterfile.json").write_text(json.dumps({
+            f"10.0.0.{i+1}": {"instance_type": "A100",
+                              "inter_bandwidth": 10,
+                              "intra_bandwidth": 40, "memory": 80}
+            for i in range(n_nodes)}))
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    # pin an 8-device GSPMD plan for the first leg
+    (ckpt / "plan.json").write_text(PlanArtifact(
+        mesh_axes=("pp", "dp", "ep", "sp", "tp"),
+        mesh_shape=(1, 8, 1, 1, 1),
+        layer_partition=(0, L),
+        strategies=({"dp": 8, "tp": 1},),
+        gbs=8, microbatches=1).to_json())
+
+    base = ["train",
+            "--profile-dir", str(tmp_path / "profiles"),
+            "--model-name", "elastic", "--num-layers", str(L),
+            "--hidden-size", "64", "--seq-len", "16",
+            "--vocab-size", "256", "--num-heads", "4",
+            "--gbs", "8", "--max-bs", "8", "--checkpoint-dir", str(ckpt),
+            "--output", str(tmp_path / "out.json"),
+            "--platform", "cpu"]
+    carg = ["--hostfile", str(tmp_path / "hostfile"),
+            "--clusterfile", str(tmp_path / "clusterfile.json")]
+
+    cluster_files(4, 2)  # 8 devices
+    assert main([*base, *carg, "--steps", "2",
+                 "--virtual-devices", "8"]) == 0
+    assert load_meta(ckpt).step == 2
+    assert load_plan(ckpt).strategies[0]["dp"] == 8
+
+    # the cluster shrinks to one chip, rehearsed in SUBPROCESSES with only
+    # 4 virtual devices (the in-process backend is already initialized
+    # with 8, so device loss must be modeled out-of-process): the pinned
+    # 8-device plan cannot run; a plain resume must fail,
+    # --replan-on-resume must recover
+    import os
+    import subprocess
+    import sys
+
+    cluster_files(1, 1)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+           "PYTHONPATH": repo}
+
+    def run_cli(extra):
+        return subprocess.run(
+            [sys.executable, "-m", "metis_tpu.planner.cli",
+             *base, *carg, "--steps", "1", *extra],
+            capture_output=True, text=True, env=env, cwd=repo, timeout=300)
+
+    plain = run_cli([])
+    assert plain.returncode != 0, plain.stderr[-500:]
+    replanned = run_cli(["--replan-on-resume"])
+    assert replanned.returncode == 0, replanned.stderr[-1500:]
+    assert load_meta(ckpt).step == 3  # resumed, not restarted
+    new_plan = load_plan(ckpt)
+    assert sum(s["dp"] * s["tp"] for s in new_plan.strategies) <= 4
+    summary = json.loads((tmp_path / "out.json").read_text())
+    assert summary["steps"] == 1 and summary["final_loss"] is not None
