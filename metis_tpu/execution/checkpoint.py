@@ -250,18 +250,22 @@ def load_plan(directory: str | Path) -> PlanArtifact | None:
 
 
 def _as_restore(leaf):
-    # ANY concrete sharding on the reference leaf drives the restore —
-    # not just NamedSharding: a scalar step counter carries a
-    # SingleDeviceSharding, and restoring it "as saved" breaks when the
-    # checkpoint's mesh no longer exists (elastic resume onto a smaller
-    # device set — the saved 8-device sharding cannot deserialize in a
-    # 4-device process).
+    # Mesh-sharded leaves restore straight onto the reference's (target-mesh)
+    # NamedSharding — orbax reshards on read, so the checkpoint's own mesh
+    # never needs to exist in this process (elastic resume onto a smaller
+    # device set).  Every other leaf — e.g. a scalar step counter whose
+    # reference carries a SingleDeviceSharding — restores as a host numpy
+    # array: pinning it to its reference's single device would commit it to
+    # device 0 and make the next jitted step over a multi-device mesh raise
+    # "Received incompatible devices", while restoring it "as saved" would
+    # need the checkpoint's (possibly gone) device set.  An uncommitted host
+    # value is placed by the compiled step like any other donation-free input.
     if isinstance(leaf, jax.Array) and \
-            isinstance(getattr(leaf, "sharding", None), jax.sharding.Sharding):
+            isinstance(getattr(leaf, "sharding", None), NamedSharding):
         return ocp.ArrayRestoreArgs(
             sharding=leaf.sharding, global_shape=leaf.shape,
             dtype=leaf.dtype)
-    return ocp.RestoreArgs()
+    return ocp.RestoreArgs(restore_type=np.ndarray)
 
 
 def _restore_tree(directory: Path, ref: dict) -> dict:

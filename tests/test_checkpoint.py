@@ -179,6 +179,59 @@ class TestTrainStateCheckpoint:
         resumed, loss = step_b(resumed, t, t)
         assert np.isfinite(float(loss))
 
+    def test_elastic_restore_onto_fewer_devices(self, tmp_path):
+        """A checkpoint written on an 8-device (4, 2) mesh restores in a
+        process that only HAS 4 devices (the saved mesh cannot exist) —
+        the elastic shrink path after losing a slice (planner/replan.py).
+        Runs the restore in a subprocess with
+        --xla_force_host_platform_device_count=4; scalar leaves (step,
+        optax count) must come back uncommitted, not pinned to the saved
+        SingleDeviceSharding, so the next jitted step accepts the state."""
+        import os
+        import subprocess
+        import sys
+
+        cfg = tiny_cfg()
+        mesh = dp_tp_mesh(4, 2)
+        step = make_train_step(cfg, mesh)
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        t = batch(jax.random.PRNGKey(3))
+        state, _ = step(state, t, t)
+        save_checkpoint(tmp_path / "ckpt", state, mesh)
+
+        script = f"""
+import os, json
+import numpy as onp
+import jax, jax.numpy as jnp
+from jax.sharding import Mesh
+from metis_tpu.execution import (DP, TP, build_train_state, make_train_step,
+                                 restore_checkpoint)
+from metis_tpu.models import GPTConfig
+
+assert len(jax.devices()) == 4, jax.devices()
+cfg = GPTConfig(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                num_blocks=2, dtype=jnp.float32)
+mesh = Mesh(onp.array(jax.devices()).reshape(2, 2), (DP, TP))
+fresh, _ = build_train_state(jax.random.PRNGKey(1), cfg, mesh)
+resumed = restore_checkpoint({str(tmp_path / "ckpt")!r}, fresh)
+toks = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0, 128)
+resumed, loss = make_train_step(cfg, mesh)(resumed, toks, toks)
+print(json.dumps({{"step": int(resumed.step), "loss": float(loss)}}))
+"""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+               "PYTHONPATH": repo}
+        out = subprocess.run([sys.executable, "-c", script], env=env,
+                             cwd=repo, capture_output=True, text=True,
+                             timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        import json as _json
+
+        report = _json.loads(out.stdout.strip().splitlines()[-1])
+        assert report["step"] == 2  # restored at 1, stepped once
+        assert np.isfinite(report["loss"])
+
     def test_overwrite_cycle_and_prev_fallback(self, tmp_path):
         """Repeated saves to one dir never lose the prior checkpoint: a
         'crash' that leaves only the .prev backup still restores."""
