@@ -130,8 +130,21 @@ class LayerBalancer:
         self.sp_model = SequenceParallelModel(self.act_split)
         self._prefix_cache: dict[tuple, list[float]] = {}
         # Normalized per-layer durations from the tp1_bs1 profile of the first
-        # device type (≅ load_balancer.py:22-27, made deterministic).
-        base = profiles.get(profiles.device_types[0], 1, 1)
+        # device type (≅ load_balancer.py:22-27, made deterministic).  When
+        # the sweep starts above bs=1, the smallest profiled bs at tp=1
+        # substitutes — the weights are normalized per-layer shares, which
+        # are stable in bs, so any single profile anchors them.
+        t0 = profiles.device_types[0]
+        from metis_tpu.core.errors import ProfileMissError
+
+        try:
+            base = profiles.get(t0, 1, 1)
+        except ProfileMissError:
+            bss = sorted(bs for (_, tp, bs) in profiles.configs(t0)
+                         if tp == 1)
+            if not bss:
+                raise
+            base = profiles.get(t0, 1, bss[0])
         total = base.total_time_ms
         self.layer_weights = tuple(t / total for t in base.layer_times_ms)
         self._wprefix = np.concatenate(

@@ -134,14 +134,23 @@ class SearchPruner:
             self._w_bs_sorted = sorted(self._w_by_bs)
 
     def _w_at(self, mbs: int) -> float:
-        """W at the largest profiled bs <= mbs (monotone-time assumption);
-        falls back to the smallest profiled bs below the sweep."""
+        """W at the largest profiled bs <= mbs (monotone-time assumption).
+
+        Below the sweep, W[smallest] would be an OVER-estimate (time is
+        increasing in bs) and could prune true top-K members; scale it by
+        mbs/smallest instead — per-sample time only grows as bs shrinks
+        (fixed per-launch overhead), so time(mbs) >= time(smallest) *
+        mbs/smallest is a genuine lower bound and the exactness guarantee
+        of prune_to_top_k holds even when the sweep starts above bs=1."""
         import bisect
 
         if not self._w_bs_sorted:
             return self.w_min
+        smallest = self._w_bs_sorted[0]
+        if mbs < smallest:
+            return self._w_by_bs[smallest] * (mbs / smallest)
         i = bisect.bisect_right(self._w_bs_sorted, mbs) - 1
-        return self._w_by_bs[self._w_bs_sorted[max(i, 0)]]
+        return self._w_by_bs[self._w_bs_sorted[i]]
 
     def _exec_lower_bound(self, g_max: int, num_stages: int,
                           batches: int) -> float:
@@ -158,7 +167,10 @@ class SearchPruner:
         from metis_tpu.cost.schedule import REMAT_FWD_FRACTION
 
         mbs_floor = max(1, (self.gbs // g_max) // batches)
-        w = max(self._w_at(mbs_floor), self.w_min)
+        # _w_at covers every case: w_min when the by-bs table is empty,
+        # the scaled-down bound below the sweep, the table lookup above it
+        # (w_min <= W[bs] for all bs, so a separate max() floor is dead).
+        w = self._w_at(mbs_floor)
         gpipe_lb = (batches - 1) * w / num_stages + w
         if not self._schedule_search:
             return gpipe_lb

@@ -222,3 +222,10 @@ class TestMeasuredCalibration:
         assert out["exposed_comm_ms"] == pytest.approx(
             max(out["with_reduce_ms"] - out["without_reduce_ms"], 0.0),
             abs=1e-3)
+        # noise guard: when the exposure doesn't stand above jitter the
+        # fraction is capped below 1.0 and the fit is flagged — a noisy
+        # host must never report "all comm perfectly hidden" as measured
+        assert "noise_limited" in out
+        if out["noise_limited"]:
+            assert out["overlap_fraction"] <= 0.9
+        assert out["with_reduce_iqr_ms"] >= 0.0

@@ -60,6 +60,41 @@ def test_topk_parity_with_bound_prune(workload):
     assert pruned_top == full_top
 
 
+def test_topk_parity_when_sweep_excludes_small_bs(workload):
+    """Exactness must survive a profile sweep that starts ABOVE bs=1: a
+    plan whose mbs floor is below the sweep must get a scaled-down bound
+    (time(mbs) >= time(smallest)*mbs/smallest), not W[smallest] verbatim
+    (an over-estimate that can prune true top-K members — ADVICE r3)."""
+    model, _, cluster = workload
+    store = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2, 4],
+                                bss=[4, 8, 16, 32, 64, 128])
+    K = 20
+    full = plan_hetero(cluster, store, model, SearchConfig(gbs=128))
+    pruned = plan_hetero(cluster, store, model,
+                         SearchConfig(gbs=128, prune_to_top_k=K))
+    full_top = [(_plan_key(r), round(r.cost.total_ms, 9))
+                for r in full.plans[:K]]
+    pruned_top = [(_plan_key(r), round(r.cost.total_ms, 9))
+                  for r in pruned.plans[:K]]
+    assert pruned_top == full_top
+
+
+def test_w_at_scales_below_profiled_sweep(workload):
+    """Direct bound check: below the sweep, _w_at returns W[smallest]
+    scaled by mbs/smallest — strictly less than W[smallest]."""
+    from metis_tpu.search.prune import SearchPruner
+
+    model, _, cluster = workload
+    store = synthesize_profiles(model, ["A100"], tps=[1],
+                                bss=[4, 8, 16])
+    pruner = SearchPruner(SearchConfig(gbs=128, prune_to_top_k=5),
+                          cluster, store, model)
+    w4 = pruner._w_at(4)
+    assert pruner._w_at(1) == pytest.approx(w4 / 4)
+    assert pruner._w_at(2) == pytest.approx(w4 / 2)
+    assert pruner._w_at(8) >= w4  # at/above sweep: unchanged lookup
+
+
 def test_beam_finds_near_optimal_best(workload):
     model, store, cluster = workload
     full = plan_hetero(cluster, store, model, SearchConfig(gbs=128))
