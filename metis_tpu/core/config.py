@@ -102,6 +102,11 @@ class SearchConfig:
     # (cost/calibration.measure_dp_overlap); 0.0 = serial, the reference's
     # model and the only strict_compat behavior
     dp_overlap_fraction: float = 0.0
+    # measured fwd share of a profiled fwd+bwd layer time
+    # (profiles.profiler.measure_remat_fraction) — the work a
+    # rematerializing schedule (1f1b/interleaved) runs twice; None uses
+    # the analytic 1/3 (cost/schedule.REMAT_FWD_FRACTION)
+    remat_fwd_fraction: float | None = None
     # Search-scalability pruning (search/prune.py; VERDICT r2 next-step 7).
     # ``prune_to_top_k=K`` enables the EXACT execution-lower-bound prune:
     # candidates that provably cannot enter the best K are skipped (the

@@ -60,6 +60,9 @@ class EstimatorOptions:
     # only the exposed (1 - fraction) share; the latency floor stays fully
     # charged (a ring's alpha cost cannot be hidden by more compute).
     dp_overlap_fraction: float = 0.0
+    # measured fwd share of a fwd+bwd stage time for remat-schedule pricing
+    # (cost/schedule.schedule_execution_ms); None = analytic default
+    remat_fwd_fraction: float | None = None
 
     @staticmethod
     def from_config(cfg: SearchConfig) -> "EstimatorOptions":
@@ -68,6 +71,7 @@ class EstimatorOptions:
             optimizer_factor=cfg.optimizer_factor,
             max_profiled_bs=cfg.max_profiled_bs,
             dp_overlap_fraction=cfg.dp_overlap_fraction,
+            remat_fwd_fraction=cfg.remat_fwd_fraction,
         )
 
     @property
@@ -277,13 +281,14 @@ class HeteroCostEstimator(_EstimatorBase):
             # comm is charged separately in get_cost).
             return (self.profiles.get(stage_types[0], tp, bs)
                     .time_slice(start, end) / strategy.cp)
-        if self.volume.model.num_experts > 0:
-            # Uneven hetero-DP is SOUND for MoE (the router masks pad
-            # tokens out of expert capacity, models/moe.moe_ffn) but not
-            # FASTER: the executor pads every replica to max(split) rows
-            # and expert compute is capacity-shaped — masking frees slots,
-            # not FLOPs.  Price the slowest member type at the PADDED
-            # per-replica batch, which is what every replica executes.
+        if (self.volume.model.num_experts > 0
+                and (strategy.ep > 1 or strategy.zero > 0
+                     or strategy.cp > 1)):
+            # MoE mixed-type stages carrying ep/zero/cp run the pad/mask
+            # SINGLE program (the per-type group split supports none of
+            # those axes — execution.hetero.plan_replica_groups), where
+            # capacity-shaped expert compute pays the PADDED batch on
+            # every replica: price the slowest type at max(split).
             split = self.data_balancer.partition(
                 stage_types, dp, tp, plan.gbs // plan.batches)
             bs = max(split)
@@ -296,6 +301,15 @@ class HeteroCostEstimator(_EstimatorBase):
                     total += self.profiles.get(t, tp, c).time_slice(start, end)
                 slowest = max(slowest, total)
             return slowest / strategy.cp
+        # Mixed-type stages (dense AND MoE without ep/zero/cp) execute as
+        # per-type sub-mesh groups, each computing only its data-balancer
+        # share — no padded rows, and an MoE group's expert capacity
+        # derives from its own token count
+        # (execution.hetero.StageSpec.replica_groups).  Price each replica
+        # at its own type and real batch; the stage finishes with its
+        # slowest replica.  (Until round 4 MoE stages priced the PADDED
+        # batch on every replica — sound for the pad/mask executor but
+        # structurally erasing the uneven-split advantage.)
         split = self.data_balancer.partition(
             stage_types, dp, tp, plan.gbs // plan.batches)
         chunks = replica_chunks(stage_types, dp)
@@ -435,18 +449,30 @@ class HeteroCostEstimator(_EstimatorBase):
         # the schedule is a plan axis (cost/schedule.py): gpipe reproduces
         # the reference fill-drain verbatim; 1f1b adds the remat factor;
         # interleaved prices the implemented group-drain bubble and its
-        # vs-times-more pp boundary crossings
+        # vs-times-more pp boundary crossings.
+        # UNEVEN 1f1b partitions run on the LOCKSTEP shard_map executor
+        # with every stage padded to the largest stage's block count
+        # (execution.pipeline) — each ppermute-barriered tick costs the max
+        # stage's time on EVERY device, so pricing must level the lens to
+        # max(lens) or uneven plans come out systematically under-priced
+        # (for even splits leveling is an identity: the fill-drain formula
+        # already reduces to ticks * max).
+        sched_lens = lens
+        if schedule == "1f1b" and len(set(lens)) > 1:
+            sched_lens = [max(lens)] * len(lens)
         execution = schedule_execution_ms(
-            schedule, lens, plan.batches, virtual_stages)
+            schedule, sched_lens, plan.batches, virtual_stages,
+            remat_fraction=self.options.remat_fwd_fraction)
         pp_cost *= schedule_pp_send_factor(
             schedule, plan.num_stages, virtual_stages)
         # cp_comm_ms / ep_comm_ms report exactly the cp (ring or a2a) /
         # MoE all-to-all traffic's contribution to the schedule's execution
         # total (the with-comm minus without-comm delta, split pro rata), so
         # the breakdown fields reconcile for the validator.
-        lens_nocomm = [l - c for l, c in zip(lens, comm_by_stage)]
+        lens_nocomm = [l - c for l, c in zip(sched_lens, comm_by_stage)]
         comm_delta = execution - schedule_execution_ms(
-            schedule, lens_nocomm, plan.batches, virtual_stages)
+            schedule, lens_nocomm, plan.batches, virtual_stages,
+            remat_fraction=self.options.remat_fwd_fraction)
         comm_total = cp_total + a2a_total
         cp_cost = comm_delta * cp_total / comm_total if comm_total else 0.0
         ep_cost = comm_delta * a2a_total / comm_total if comm_total else 0.0

@@ -8,9 +8,11 @@ path that realizes it (the reference prints plan tuples and stops,
   dp/ep batch sharding, tp via GSPMD, cp via ring attention over the "sp"
   mesh axis, Megatron SP via residual constraints, ZeRO via state sharding;
 - **shard_map pipeline** (``execution.pipeline``) for pp>1 rectangular
-  plans with one (dp, tp) strategy, even layer split, and zero=0 — the
-  fastest single-program pipeline (GPipe or memory-bounded 1F1B via
-  ``schedule=``);
+  plans with one (dp, tp) strategy and zero=0 — the fastest
+  single-program pipeline (GPipe or memory-bounded 1F1B via
+  ``schedule=``).  Even layer splits always; 1f1b additionally takes
+  UNEVEN block partitions (stages padded to the largest stage's count
+  with masked identity layers);
 - **multi-mesh per-stage** (``execution.hetero``) for everything else a
   hetero planner emits: non-uniform layer partitions, per-stage strategies,
   uneven hetero-DP microbatches, ZeRO under pipelining, MoE/ep stages, and
@@ -32,6 +34,7 @@ import jax
 
 from metis_tpu.execution.hetero import (
     make_hetero_train_step,
+    plan_replica_groups,
     plan_replica_rows,
     stage_specs_from_plan,
 )
@@ -54,6 +57,22 @@ class Executable:
     step: Callable
 
 
+def pipeline_block_counts(artifact: PlanArtifact, cfg: GPTConfig,
+                          pp: int) -> tuple[int, ...] | None:
+    """Per-stage transformer-BLOCK counts implied by the artifact's
+    layer partition (profile layers include the embed/head pseudo-layers on
+    the first/last stages), or None when no partition is recorded (implicit
+    even split)."""
+    bounds = artifact.layer_partition
+    if not bounds:
+        return None
+    blocks = []
+    for i in range(len(bounds) - 1):
+        lo, hi = bounds[i], bounds[i + 1]
+        blocks.append(min(hi - 1, cfg.num_blocks) - max(lo - 1, 0))
+    return tuple(blocks)
+
+
 def _uniform_block_split(artifact: PlanArtifact, cfg: GPTConfig,
                          pp: int) -> bool:
     """True when the layer partition gives every stage the same BLOCK count
@@ -63,15 +82,27 @@ def _uniform_block_split(artifact: PlanArtifact, cfg: GPTConfig,
     stages +1 profile layer for the embed/head pseudo-layers while their
     block counts stay equal — exactly the partition the schedule families
     emit, which must route here, not to the hetero executor."""
-    bounds = artifact.layer_partition
-    if not bounds:
+    blocks = pipeline_block_counts(artifact, cfg, pp)
+    if blocks is None:
         return cfg.num_blocks % max(pp, 1) == 0
-    blocks = []
-    for i in range(len(bounds) - 1):
-        lo, hi = bounds[i], bounds[i + 1]
-        blocks.append(min(hi - 1, cfg.num_blocks) - max(lo - 1, 0))
     return (len(set(blocks)) == 1 and blocks[0] > 0
             and cfg.num_blocks % len(blocks) == 0)
+
+
+def _uneven_1f1b_split(artifact: PlanArtifact, cfg: GPTConfig, pp: int,
+                       schedule: str) -> tuple[int, ...] | None:
+    """An uneven block partition the shard_map pipeline can still realize
+    (1f1b pads stages to the largest stage's count with masked identity
+    layers — ``execution.pipeline.pad_blocks_for_partition``); None when
+    the plan must route elsewhere."""
+    if schedule != "1f1b":
+        return None
+    blocks = pipeline_block_counts(artifact, cfg, pp)
+    if (blocks is not None and len(blocks) == pp
+            and len(set(blocks)) > 1
+            and min(blocks) >= 1 and sum(blocks) == cfg.num_blocks):
+        return blocks
+    return None
 
 
 def resolve_schedule(
@@ -147,10 +178,16 @@ def build_executable(
         return _gspmd_executable(cfg, artifact, s0, devices, optimizer)
 
     if (artifact.mesh_shape and uniform and s0["zero"] == 0
-            and not s0["sp"] and s0["cp"] == 1 and s0["ep"] == 1
-            and _uniform_block_split(artifact, cfg, pp)):
-        return _pipeline_executable(cfg, artifact, s0, pp, devices, optimizer,
-                                    schedule, virtual_stages)
+            and not s0["sp"] and s0["cp"] == 1 and s0["ep"] == 1):
+        if _uniform_block_split(artifact, cfg, pp):
+            return _pipeline_executable(
+                cfg, artifact, s0, pp, devices, optimizer,
+                schedule, virtual_stages)
+        counts = _uneven_1f1b_split(artifact, cfg, pp, schedule)
+        if counts is not None:
+            return _pipeline_executable(
+                cfg, artifact, s0, pp, devices, optimizer,
+                schedule, virtual_stages, block_counts=counts)
 
     return _hetero_executable(
         cfg, artifact, strategies, devices, optimizer, cluster, profiles)
@@ -178,7 +215,7 @@ def _gspmd_executable(cfg, artifact, s0, devices, optimizer) -> Executable:
 
 def _pipeline_executable(cfg, artifact, s0, pp, devices,
                          optimizer, schedule="gpipe",
-                         virtual_stages=2) -> Executable:
+                         virtual_stages=2, block_counts=None) -> Executable:
     import numpy as np
     from jax.sharding import Mesh
 
@@ -190,7 +227,8 @@ def _pipeline_executable(cfg, artifact, s0, pp, devices,
         np.array(devs[:need]).reshape(pp, s0["dp"], s0["tp"]), (PP, DP, TP))
     init_fn, raw_step = make_pipeline_train_step(
         cfg, mesh, artifact.microbatches, optimizer=optimizer,
-        schedule=schedule, virtual_stages=virtual_stages)
+        schedule=schedule, virtual_stages=virtual_stages,
+        block_counts=block_counts)
 
     def init(key):
         return init_fn(key)
@@ -208,12 +246,12 @@ def _pipeline_executable(cfg, artifact, s0, pp, devices,
 def _hetero_executable(cfg, artifact, strategies, devices, optimizer, cluster,
                        profiles) -> Executable:
     pp = len(strategies)
-    rows = None
+    rows = groups = None
     if (cluster is not None and profiles is not None
             and artifact.node_sequence):
-        # uneven per-replica microbatches apply to MoE stages too: the
-        # router masks pad tokens out of capacity competition
-        # (execution.hetero._make_stage_fn / models.moe.moe_ffn)
+        # mixed-type stages split into per-type sub-meshes, each computing
+        # only its data balancer share (no padding; an MoE group's expert
+        # capacity derives from its own tokens — hetero.StageSpec docs)
         from metis_tpu.core.types import InterStagePlan, Strategy
 
         inter = InterStagePlan(
@@ -222,13 +260,15 @@ def _hetero_executable(cfg, artifact, strategies, devices, optimizer, cluster,
             batches=artifact.microbatches, gbs=artifact.gbs)
         strats = [Strategy(dp=s["dp"], tp=s["tp"]) for s in strategies]
         rows = plan_replica_rows(inter, strats, cluster, profiles)
+        groups = plan_replica_groups(inter, strats, cluster)
     bounds = artifact.layer_partition
     if not bounds:
         # rectangular artifacts drop the canonical even split; rebuild it
         per = cfg.num_profile_layers // pp
         bounds = tuple(per * i for i in range(pp)) + (cfg.num_profile_layers,)
     stages = stage_specs_from_plan(
-        bounds, strategies, cfg, stage_replica_rows=rows)
+        bounds, strategies, cfg, stage_replica_rows=rows,
+        stage_replica_groups=groups)
     init_fn, raw_step = make_hetero_train_step(
         cfg, stages, devices=devices, optimizer=optimizer)
 

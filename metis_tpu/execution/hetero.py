@@ -62,6 +62,12 @@ class StageSpec:
     pseudo-layer, layer ``num_blocks + 1`` the LM head, matching
     ``GPTConfig.num_profile_layers``).  ``replica_rows`` carries the uneven
     per-replica microbatch rows from the data balancer (None = even split).
+    ``replica_groups`` (sizes in replicas, summing to ``dp``) splits a
+    MIXED-device-type stage into per-type sub-meshes: each group runs its
+    own GSPMD program on its own real row count — no padding, and an MoE
+    group's expert capacity derives from its own tokens (capacity
+    proportional to the group's real-token share, the lever that makes
+    uneven hetero-DP an actual win for MoE stages; VERDICT r3 next-step 7).
     """
 
     blocks: tuple[int, int]
@@ -74,6 +80,7 @@ class StageSpec:
     cp: int = 1  # context parallelism over a dedicated axis
     cp_mode: str = "ring"  # "ring" (K/V rotation) or "a2a" (Ulysses)
     replica_rows: tuple[int, ...] | None = None
+    replica_groups: tuple[int, ...] | None = None
 
     @property
     def devices(self) -> int:
@@ -89,6 +96,7 @@ def stage_specs_from_plan(
     strategies: Sequence,
     cfg: GPTConfig,
     stage_replica_rows: Sequence[Sequence[int] | None] | None = None,
+    stage_replica_groups: Sequence[Sequence[int] | None] | None = None,
 ) -> tuple[StageSpec, ...]:
     """Convert planner output (profile-layer boundaries + per-stage
     strategies) into executable StageSpecs.
@@ -139,12 +147,19 @@ def stage_specs_from_plan(
             if len(rows) != dp:
                 raise ValueError(
                     f"stage {s}: {len(rows)} replica rows for dp={dp}")
+        groups = None
+        if (stage_replica_groups is not None
+                and stage_replica_groups[s] is not None):
+            groups = tuple(stage_replica_groups[s])
+            if sum(groups) != dp:
+                raise ValueError(
+                    f"stage {s}: replica_groups {groups} must sum to dp={dp}")
         out.append(StageSpec(
             blocks=(max(lo - 1, 0), min(hi - 1, cfg.num_blocks)),
             has_embed=lo == 0,
             has_head=hi == n_profile,
             dp=dp, tp=tp, zero=zero, ep=ep, cp=cp, cp_mode=cp_mode,
-            replica_rows=rows))
+            replica_rows=rows, replica_groups=groups))
     return tuple(out)
 
 
@@ -313,6 +328,51 @@ def make_hetero_train_step(
     total_blocks = max(cfg.num_blocks, 1)
     # per-stage share of the global aux mean (see _make_stage_fn docstring)
     aux_w = [s.num_blocks / total_blocks for s in stages]
+
+    # -- per-type sub-mesh groups (StageSpec.replica_groups) --------------
+    # A mixed-type stage splits into one GSPMD program per device-type
+    # group: each group computes ONLY its real rows (no padding — the
+    # pad/mask path charges every replica the padded batch, and an MoE
+    # group's expert capacity now derives from its own token count).
+    # Gradients are summed across groups on the stage's primary mesh, the
+    # optimizer runs there once, and params mirror back out per step — the
+    # state/checkpoint contract is unchanged.
+    import dataclasses as _dc
+
+    units: list[list[dict] | None] = []
+    off_u = 0
+    for i, s in enumerate(stages):
+        eligible = (s.replica_groups is not None and len(s.replica_groups) > 1
+                    and s.zero == 0 and s.cp == 1 and s.ep == 1)
+        if not eligible:
+            units.append(None)
+            off_u += s.devices
+            continue
+        us = []
+        dev_off = off_u
+        rep_off = 0
+        for dp_g in s.replica_groups:
+            chips = devs[dev_off: dev_off + dp_g * s.tp]
+            mesh_u = Mesh(np.array(chips).reshape(dp_g, s.tp), (DP, TP))
+            rows_g = (tuple(s.replica_rows[rep_off: rep_off + dp_g])
+                      if s.replica_rows is not None else None)
+            sub = _dc.replace(
+                s, dp=dp_g, replica_groups=None,
+                replica_rows=(rows_g if rows_g is not None
+                              and len(set(rows_g)) > 1 else None))
+            # weight: the group's share of the microbatch rows (static:
+            # either from the balancer's split or the even dp fraction)
+            w_g = (sum(rows_g) / sum(s.replica_rows)
+                   if s.replica_rows is not None else dp_g / s.dp)
+            us.append({"mesh": mesh_u, "spec": sub, "dp": dp_g,
+                       "rows": rows_g, "w": w_g,
+                       "fn": _make_stage_fn(sub, cfg, attn,
+                                            aux_weight=aux_w[i])})
+            dev_off += dp_g * s.tp
+            rep_off += dp_g
+        units.append(us)
+        off_u += s.devices
+
     fns = []
     for i, s in enumerate(stages):
         stage_attn = attn
@@ -392,13 +452,84 @@ def make_hetero_train_step(
         apply_upd.append(_in_mesh(mesh, jax.jit(
             upd, static_argnums=(3,), donate_argnums=(0, 1, 2))))
 
+    # per-unit jitted programs for grouped stages (mirrors the per-stage
+    # closures above, with the loss/cotangent scaled by the group's row
+    # share so the summed loss reproduces the global batch mean)
+    stage_specs_cache = [_stage_param_specs(s, cfg) for s in stages]
+    for i, us in enumerate(units):
+        if us is None:
+            continue
+        is_first, is_last = i == 0, i == S - 1
+        for u in us:
+            fn_u, w_u = u["fn"], u["w"]
+            mesh_u = u["mesh"]
+
+            def _in_u(f, _m=mesh_u):
+                def run(*args):
+                    with _m:
+                        return f(*args)
+                return run
+
+            if is_last:
+                if is_first:  # single-stage plan: tokens in, params grad only
+                    def lg(params, tok, tgt, _f=fn_u, _w=w_u):
+                        loss, g = jax.value_and_grad(
+                            lambda p: _w * _f(p, tok, tgt))(params)
+                        return loss, g, None
+                else:
+                    def lg(params, x_in, tgt, _f=fn_u, _w=w_u):
+                        loss, grads = jax.value_and_grad(
+                            lambda p, x: _w * _f(p, x, tgt),
+                            argnums=(0, 1))(params, x_in)
+                        return loss, grads[0], grads[1]
+                u["lossgrad"] = _in_u(jax.jit(lg))
+            else:
+                u["fwd"] = _in_u(jax.jit(fn_u))
+                aux_seed_u = (cfg.aux_loss_coef * aux_w[i] * w_u
+                              if is_moe else None)
+                if is_first:
+                    def bw(params, tok, ct, _f=fn_u, _as=aux_seed_u):
+                        _, pull = jax.vjp(lambda p: _f(p, tok), params)
+                        if _as is not None:
+                            ct = (ct, jnp.asarray(_as, jnp.float32))
+                        return pull(ct)[0]
+                else:
+                    def bw(params, x_in, ct, _f=fn_u, _as=aux_seed_u):
+                        _, pull = jax.vjp(_f, params, x_in)
+                        if _as is not None:
+                            ct = (ct, jnp.asarray(_as, jnp.float32))
+                        return pull(ct)
+                u["bwd"] = _in_u(jax.jit(bw))
+
     def _put(x, s: int, spec: P):
         return jax.device_put(x, NamedSharding(meshes[s], spec))
 
+    def _put_rep(x, mesh: Mesh):
+        # replicated on the unit mesh (a raw single-device put would clash
+        # with the mesh-sharded params inside the unit's jit)
+        return jax.device_put(
+            x, NamedSharding(mesh, P(*([None] * x.ndim))))
+
+    def _put_tree(tree, mesh: Mesh, specs):
+        return jax.tree.map(
+            lambda a, sp: jax.device_put(a, NamedSharding(mesh, sp)),
+            tree, specs)
+
+    def _unit_bounds(s: int, rows: int) -> list[int]:
+        """Canonical row offsets of stage ``s``'s groups."""
+        bounds = [0]
+        for u in units[s]:
+            r = (sum(u["rows"]) if u["rows"] is not None
+                 else rows * u["dp"] // stages[s].dp)
+            bounds.append(bounds[-1] + r)
+        return bounds
+
     def _boundary_spec(s: int, rows: int) -> P:
         # activations shard over dp when rows divide evenly, else replicate
-        # (the in-stage pad/gather re-shards anyway)
-        return (P(DP, None, None) if rows % stages[s].dp == 0
+        # (the in-stage pad/gather re-shards anyway); grouped stages take
+        # the canonical array replicated and slice per group
+        return (P(DP, None, None)
+                if units[s] is None and rows % stages[s].dp == 0
                 else P(None, None, None))
 
     def init_fn(key):
@@ -438,6 +569,16 @@ def make_hetero_train_step(
                     f"replica_rows {spec.replica_rows} must sum to the "
                     f"microbatch size {rows}")
 
+        # grouped stages: mirror the stage's params onto each group mesh
+        # once per step (the canonical copy — state, optimizer, checkpoints
+        # — stays on the primary mesh)
+        unit_params = [None] * S
+        for s in range(S):
+            if units[s] is not None:
+                unit_params[s] = [
+                    _put_tree(state[s][0], u["mesh"], stage_specs_cache[s])
+                    for u in units[s]]
+
         # ---- forward fill: store only boundary inputs per (stage, mb)
         toks = [_put(tokens_mbs[m], 0, P(None, None)) for m in range(M)]
         tgts = [_put(targets_mbs[m], S - 1, P(None, None)) for m in range(M)]
@@ -447,32 +588,114 @@ def make_hetero_train_step(
             x = None
             for s in range(S - 1):
                 src = toks[m] if s == 0 else x
-                x = fwd[s](state[s][0], src)
-                if is_moe:
-                    # keep aux on device; one fetch at the end (a per-(stage,
-                    # mb) device_get here would serialize the forward fill)
-                    x, aux = x
-                    aux_vals.append(cfg.aux_loss_coef * aux_w[s] * aux)
+                if units[s] is None:
+                    x = fwd[s](state[s][0], src)
+                    if is_moe:
+                        # keep aux on device; one fetch at the end (a
+                        # per-(stage, mb) device_get here would serialize
+                        # the forward fill)
+                        x, aux = x
+                        aux_vals.append(cfg.aux_loss_coef * aux_w[s] * aux)
+                else:
+                    ub = _unit_bounds(s, rows)
+                    parts = []
+                    for g, u in enumerate(units[s]):
+                        if ub[g + 1] == ub[g]:
+                            continue  # balancer gave this type 0 rows
+                        src_u = _put_rep(src[ub[g]:ub[g + 1]], u["mesh"])
+                        out_u = u["fwd"](unit_params[s][g], src_u)
+                        if is_moe:
+                            out_u, aux = out_u
+                            aux_vals.append(cfg.aux_loss_coef * aux_w[s]
+                                            * u["w"] * aux)
+                        parts.append(out_u)
+                    nxt = NamedSharding(meshes[s + 1], P(None, None, None))
+                    with meshes[s + 1]:
+                        x = jnp.concatenate(
+                            [jax.device_put(p, nxt) for p in parts], axis=0)
+                    x_in[s + 1][m] = x
+                    continue
                 x_in[s + 1][m] = x = _put(x, s + 1, _boundary_spec(s + 1, rows))
 
         # ---- backward drain: per-stage grad accumulation across mbs
         accs = [None] * S
         losses = []
+
+        def _acc(s, g):
+            accs[s] = g if accs[s] is None else add_grads[s](accs[s], g)
+
         for m in reversed(range(M)):
-            if S == 1:
+            if units[-1] is not None:
+                # grouped last stage: per-group loss/grad, losses and
+                # cotangents already scaled by the group's row share
+                ub = _unit_bounds(S - 1, rows)
+                src_last = toks[m] if S == 1 else x_in[-1][m]
+                ct_parts, loss_sum = [], None
+                dev0 = meshes[-1].devices.flat[0]
+                for g, u in enumerate(units[-1]):
+                    if ub[g + 1] == ub[g]:
+                        continue  # 0-row group: no loss, no grads
+                    x_u = _put_rep(src_last[ub[g]:ub[g + 1]], u["mesh"])
+                    t_u = _put_rep(tgts[m][ub[g]:ub[g + 1]], u["mesh"])
+                    loss_u, g_u, ct_u = u["lossgrad"](
+                        unit_params[-1][g], x_u, t_u)
+                    # sum on the primary mesh's device — an async scalar
+                    # transfer, NOT a blocking device_get in the drain (the
+                    # forward fill avoids per-(stage, mb) host syncs for
+                    # the same reason)
+                    loss_dev = jax.device_put(loss_u, dev0)
+                    loss_sum = (loss_dev if loss_sum is None
+                                else loss_sum + loss_dev)
+                    if ct_u is not None:
+                        ct_parts.append(ct_u)
+                    _acc(S - 1, _put_tree(g_u, meshes[-1],
+                                          stage_specs_cache[-1]))
+                losses.append(loss_sum)
+                ct = ct_parts  # list, re-assembled at the next _put below
+            elif S == 1:
                 loss, g = lossgrad[-1](state[0][0], toks[m], tgts[m])
                 ct = None
+                losses.append(loss)
+                _acc(0, g)
             else:
                 loss, g, ct = lossgrad[-1](state[-1][0], x_in[-1][m], tgts[m])
-            losses.append(loss)
-            accs[-1] = g if accs[-1] is None else add_grads[-1](accs[-1], g)
+                losses.append(loss)
+                _acc(S - 1, g)
             for s in range(S - 2, -1, -1):
-                ct = _put(ct, s, _boundary_spec(s, rows))
-                if s == 0:
-                    g = bwd[0](state[0][0], toks[m], ct)
+                if isinstance(ct, list):
+                    spec_s = NamedSharding(meshes[s], P(None, None, None))
+                    with meshes[s]:
+                        ct = jnp.concatenate(
+                            [jax.device_put(p, spec_s) for p in ct], axis=0)
                 else:
-                    g, ct = bwd[s](state[s][0], x_in[s][m], ct)
-                accs[s] = g if accs[s] is None else add_grads[s](accs[s], g)
+                    ct = _put(ct, s, _boundary_spec(s, rows))
+                if units[s] is None:
+                    if s == 0:
+                        g = bwd[0](state[0][0], toks[m], ct)
+                        _acc(0, g)
+                    else:
+                        g, ct = bwd[s](state[s][0], x_in[s][m], ct)
+                        _acc(s, g)
+                else:
+                    ub = _unit_bounds(s, rows)
+                    ct_parts = []
+                    for gi, u in enumerate(units[s]):
+                        if ub[gi + 1] == ub[gi]:
+                            continue  # 0-row group
+                        ct_u = _put_rep(ct[ub[gi]:ub[gi + 1]], u["mesh"])
+                        if s == 0:
+                            tok_u = _put_rep(
+                                toks[m][ub[gi]:ub[gi + 1]], u["mesh"])
+                            g_u = u["bwd"](unit_params[s][gi], tok_u, ct_u)
+                        else:
+                            x_u = _put_rep(
+                                x_in[s][m][ub[gi]:ub[gi + 1]], u["mesh"])
+                            g_u, ct_x = u["bwd"](
+                                unit_params[s][gi], x_u, ct_u)
+                            ct_parts.append(ct_x)
+                        _acc(s, _put_tree(g_u, meshes[s],
+                                          stage_specs_cache[s]))
+                    ct = ct_parts if ct_parts else None
 
         # ---- optimizer step per stage
         for s in range(S):
@@ -487,6 +710,42 @@ def make_hetero_train_step(
         return state, loss
 
     return init_fn, step_fn
+
+
+def plan_replica_groups(
+    inter,
+    strategies: Sequence,
+    cluster,
+) -> list[tuple[int, ...] | None]:
+    """Per-stage device-TYPE group sizes (in replicas) for the sub-mesh
+    split of mixed-type stages (``StageSpec.replica_groups``).  Homogeneous
+    stages — and mixed stages carrying zero/cp/ep axes, which the grouped
+    path doesn't support — return None (single program)."""
+    from metis_tpu.balance.data import replica_chunks
+    from metis_tpu.balance.stage_perf import rank_device_types
+
+    ranks = rank_device_types(cluster, inter.node_sequence)
+    out: list[tuple[int, ...] | None] = []
+    for stage_id, strat in enumerate(strategies):
+        start, end = inter.stage_rank_range(stage_id)
+        types = ranks[start:end]
+        zero = getattr(strat, "zero", 0)
+        cp = getattr(strat, "cp", 1)
+        ep = getattr(strat, "ep", 1)
+        if len(set(types)) == 1 or zero or cp > 1 or ep > 1:
+            out.append(None)
+            continue
+        rep_types = [c[0] for c in replica_chunks(types, strat.dp)]
+        groups: list[int] = []
+        prev = None
+        for t in rep_types:
+            if t == prev:
+                groups[-1] += 1
+            else:
+                groups.append(1)
+                prev = t
+        out.append(tuple(groups) if len(groups) > 1 else None)
+    return out
 
 
 def plan_replica_rows(

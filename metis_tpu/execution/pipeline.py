@@ -218,10 +218,39 @@ def _reduce_pipeline_grads(gacc, loss_sum, M):
 # ---------------------------------------------------------------------------
 
 
+def _masked_blocks_scan(params, x, cfg, mask):
+    """Stage-local block scan shared by the gpipe/1f1b bodies.
+
+    ``mask`` (local [per_stage] bool, sharded over pp) marks which stacked
+    slots hold REAL blocks — uneven layer partitions pad every stage to the
+    largest stage's count with zero layers (``pad_blocks_for_partition``)
+    and a padded slot passes the activation through unchanged.  The select
+    form (not lax.cond) keeps the scan autodiff-safe on the gpipe path.
+    COST: the schedule is lockstep (ppermute barriers), so every tick costs
+    the LARGEST stage's block count on every device whether slots are
+    padded or not — the planner prices uneven 1f1b plans with leveled
+    max(lens) per stage accordingly (cost/estimator.py)."""
+    if mask is None:
+        def step(carry, layer):
+            return tp_block_forward(carry, layer, cfg), None
+        out, _ = jax.lax.scan(step, x, params["blocks"])
+        return out
+
+    def step(carry, layer_m):
+        layer, m = layer_m
+        out = tp_block_forward(carry, layer, cfg)
+        return jnp.where(m, out, carry), None
+
+    out, _ = jax.lax.scan(step, x, (params["blocks"], mask))
+    return out
+
+
 def _pipeline_loss_local(
     params: dict,
     tokens_mbs: jnp.ndarray,   # [M, mbs_local, S]
     targets_mbs: jnp.ndarray,
+    mask=None,                 # local [per_stage] bool, or None (even split)
+    *,
     cfg: GPTConfig,
 ) -> jnp.ndarray:
     """Per-device GPipe body (inside shard_map over (pp, dp, tp))."""
@@ -234,10 +263,7 @@ def _pipeline_loss_local(
     fwd_perm = [(i, i + 1) for i in range(num_stages - 1)]
 
     def blocks_local(x):
-        def step(carry, layer):
-            return tp_block_forward(carry, layer, cfg), None
-        out, _ = jax.lax.scan(step, x, params["blocks"])
-        return out
+        return _masked_blocks_scan(params, x, cfg, mask)
 
     def tick(carry, t):
         buf, loss_sum = carry
@@ -279,6 +305,8 @@ def _pipeline_1f1b_local(
     params: dict,
     tokens_mbs: jnp.ndarray,   # [M, mbs_local, S]
     targets_mbs: jnp.ndarray,
+    mask=None,                 # local [per_stage] bool, or None (even split)
+    *,
     cfg: GPTConfig,
 ) -> tuple[jnp.ndarray, dict]:
     """Per-device memory-bounded 1F1B body: returns ``(loss, grads)``.
@@ -317,10 +345,7 @@ def _pipeline_1f1b_local(
     params = _vary_params_for_manual_vjp(params)
 
     def blocks_local(p, x):
-        def step(carry, layer):
-            return tp_block_forward(carry, layer, cfg), None
-        out, _ = jax.lax.scan(step, x, p["blocks"])
-        return out
+        return _masked_blocks_scan(p, x, cfg, mask)
 
     def stage_fn(p, x_in, tok, tgt):
         """Uniform per-stage program: embed on stage 0, blocks, head loss on
@@ -392,6 +417,47 @@ def _pipeline_1f1b_local(
     (_, _, _, gacc, loss_sum), _ = jax.lax.scan(
         tick, carry0, jnp.arange(ticks))
     return _reduce_pipeline_grads(gacc, loss_sum, M)
+
+
+def uneven_pad_indices(block_counts) -> list[int]:
+    """Padded stacked-axis layout for an uneven layer partition: stage ``s``
+    owns slots ``[s*per_stage, (s+1)*per_stage)`` with its ``counts[s]``
+    real blocks first (global block order preserved) and ``-1`` pad slots
+    after — the contiguous pp sharding of ``gpt_param_specs`` then lands
+    each device exactly its stage's blocks."""
+    per_stage = max(block_counts)
+    idx: list[int] = []
+    off = 0
+    for c in block_counts:
+        idx += list(range(off, off + c)) + [-1] * (per_stage - c)
+        off += c
+    return idx
+
+
+def pad_blocks_for_partition(blocks, block_counts):
+    """Reorder + zero-pad the stacked block leaves per
+    ``uneven_pad_indices`` (pad layers are zeros — never applied: the
+    schedule bodies mask them to identity)."""
+    idx = uneven_pad_indices(block_counts)
+
+    def pad_leaf(a):
+        z = jnp.zeros_like(a[:1])
+        return jnp.concatenate(
+            [a[i:i + 1] if i >= 0 else z for i in idx], axis=0)
+
+    return jax.tree.map(pad_leaf, blocks)
+
+
+def unpad_blocks_for_partition(blocks, block_counts):
+    """Inverse of ``pad_blocks_for_partition``: drop pad slots and restore
+    the canonical global block order (for export/inspection)."""
+    idx = uneven_pad_indices(block_counts)
+    keep = [i for i, b in enumerate(idx) if b >= 0]
+
+    def unpad_leaf(a):
+        return jnp.concatenate([a[i:i + 1] for i in keep], axis=0)
+
+    return jax.tree.map(unpad_leaf, blocks)
 
 
 def interleave_block_order(num_blocks: int, pp: int, vs: int) -> list[int]:
@@ -546,6 +612,7 @@ def make_pipeline_train_step(
     optimizer=None,
     schedule: str = "gpipe",
     virtual_stages: int = 2,
+    block_counts=None,
 ):
     """Jitted pipeline train step over a (pp, dp, tp) mesh.
 
@@ -563,19 +630,45 @@ def make_pipeline_train_step(
     order of params/checkpoints (``interleave_block_order``) — resume
     compares ``CheckpointMeta.block_layout``.
 
-    Requires ``cfg.num_blocks %% pp == 0`` (uniform stages — the stacked
-    layer axis shards evenly; non-uniform stages run on the multi-mesh
-    executor in ``execution.hetero``).
+    ``block_counts`` (optional, len == pp, sum == ``cfg.num_blocks``): an
+    UNEVEN per-stage block partition for the gpipe/1f1b schedules.  Every
+    stage is padded to the largest stage's count with zero layers that the
+    schedule bodies mask to identity (``pad_blocks_for_partition``), so the
+    stacked layer axis still shards evenly.  The schedule stays lockstep,
+    so each tick costs the largest stage's count on every device — the
+    value of an uneven split is FEASIBILITY (running partitions the even
+    split can't express at all), and the planner prices it with leveled
+    max-stage lens (cost/estimator.py).  Without it,
+    ``cfg.num_blocks %% pp == 0`` is required (the interleaved schedule
+    always requires the even split — its chunk permutation has no pad
+    concept; fully per-stage-custom plans run on the multi-mesh executor
+    in ``execution.hetero``).
     Returns (init_fn, step_fn): ``init_fn(key) -> (params, opt_state)`` on
     mesh; ``step_fn(params, opt_state, tokens, targets) -> (params,
     opt_state, loss)`` with tokens/targets [gbs_local..., seq] already
     microbatch-major: [M, batch, seq].
     """
     pp = mesh.shape[PP]
-    if cfg.num_blocks % pp:
+    counts = None
+    if block_counts is not None:
+        counts = tuple(int(c) for c in block_counts)
+        if (len(counts) != pp or sum(counts) != cfg.num_blocks
+                or min(counts) < 1):
+            raise ValueError(
+                f"block_counts={counts} must have one entry >= 1 per "
+                f"pp={pp} stage summing to num_blocks={cfg.num_blocks}")
+        if len(set(counts)) == 1:
+            counts = None  # even: the unpadded fast path
+    if counts is None:
+        if cfg.num_blocks % pp:
+            raise ValueError(
+                f"num_blocks={cfg.num_blocks} must divide evenly into "
+                f"pp={pp} stages for the uniform pipeline (pass "
+                "block_counts for an uneven gpipe/1f1b split)")
+    elif schedule == "interleaved":
         raise ValueError(
-            f"num_blocks={cfg.num_blocks} must divide evenly into pp={pp} "
-            "stages for the uniform pipeline")
+            "interleaved schedule requires an even block split "
+            f"(got block_counts={counts})")
     if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if schedule == "interleaved":
@@ -606,9 +699,15 @@ def make_pipeline_train_step(
     else:
         local = partial(_pipeline_interleaved_local, cfg=cfg,
                         vs=virtual_stages)
+    # uneven split: the per-slot real-block mask rides along as an extra
+    # sharded operand (a closure capture would be pp-replicated; the mask
+    # must vary per stage)
+    mask_global = (jnp.asarray([b >= 0 for b in uneven_pad_indices(counts)])
+                   if counts is not None else None)
+    mask_specs = (P(PP),) if counts is not None else ()
     sharded_step = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(specs, data_spec, data_spec),
+        in_specs=(specs, data_spec, data_spec) + mask_specs,
         out_specs=(P(), specs),
     )
 
@@ -617,7 +716,8 @@ def make_pipeline_train_step(
             raise ValueError(
                 f"expected {num_microbatches} microbatches, got "
                 f"{tokens_mbs.shape[0]} (use microbatch_split)")
-        loss, grads = sharded_step(params, tokens_mbs, targets_mbs)
+        extra = (mask_global,) if mask_global is not None else ()
+        loss, grads = sharded_step(params, tokens_mbs, targets_mbs, *extra)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -635,6 +735,12 @@ def make_pipeline_train_step(
                 cfg.num_blocks, pp, virtual_stages))
             full = {**full,
                     "blocks": jax.tree.map(lambda a: a[order], full["blocks"])}
+        elif counts is not None:
+            # uneven split: pad each stage's slice to the largest stage's
+            # count with masked zero layers (params/opt_state/checkpoints
+            # all live in this padded layout consistently)
+            full = {**full, "blocks": pad_blocks_for_partition(
+                full["blocks"], counts)}
         params = shard_params(full, mesh, specs)
         opt_state = optimizer.init(params)
         return params, opt_state

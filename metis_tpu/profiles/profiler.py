@@ -467,6 +467,56 @@ def profile_model(
     return LayerProfiler(model, device_type, devices, config).run(tps, bss)
 
 
+def measure_remat_fraction(
+    model: ModelSpec,
+    device=None,
+    bs: int = 2,
+    warmup: int = 1,
+    iters: int = 5,
+    seed: int = 0,
+) -> float:
+    """Measured fwd share of a transformer block's fwd+bwd time on this
+    backend — the work a rematerializing pipeline schedule (1f1b /
+    interleaved) runs twice (``cost/schedule.py``).
+
+    The analytic default (1/3, the fwd:bwd FLOP ratio) systematically
+    over-prices remat schedules on backends where XLA's fused backward runs
+    faster than 2x forward; this measures the real split with the same
+    isolated-closure technique the layer profiler uses, so the number feeds
+    straight into ``SearchConfig.remat_fwd_fraction``.  Clamped to
+    [0.15, 0.6] — outside that band the measurement is jitter, not physics
+    (fwd cannot be near-free nor dominate a step that includes its own
+    backward)."""
+    from metis_tpu.models.llama import LlamaConfig, llama_block_forward
+
+    dev = device if device is not None else jax.devices()[0]
+    cfg = config_for_model_spec(model)
+    key = jax.random.PRNGKey(seed)
+    params = jax.device_put(init_params_for(key, cfg), dev)
+    layer = jax.tree.map(lambda a: a[0], params["blocks"])
+    x = jax.device_put(
+        jax.random.normal(key, (bs, cfg.seq_len, cfg.hidden), cfg.dtype), dev)
+
+    def fwd_only(layer, x):
+        if isinstance(cfg, MoEConfig):
+            out, aux = moe_block_forward(x, layer, cfg, causal_attention)
+            return out.astype(jnp.float32).sum() + aux
+        if isinstance(cfg, LlamaConfig):
+            return llama_block_forward(x, layer, cfg, causal_attention) \
+                .astype(jnp.float32).sum()
+        return block_forward(x, layer, cfg, causal_attention) \
+            .astype(jnp.float32).sum()
+
+    def fwd_bwd(layer, x):
+        return jax.value_and_grad(fwd_only, argnums=(0, 1))(layer, x)
+
+    fwd_ms = _median_ms(jax.jit(fwd_only), (layer, x), warmup, iters)
+    fb_ms = _median_ms(jax.jit(fwd_bwd), (layer, x), warmup, iters)
+    if fb_ms <= 0:
+        return 1.0 / 3.0
+    return float(np.clip(fwd_ms / fb_ms, 0.15, 0.6))
+
+
 def profile_to_dir(
     model: ModelSpec,
     out_dir: str | Path,

@@ -122,6 +122,14 @@ class SearchPruner:
         self._schedule_search = (config.enable_schedule_search
                                  and not config.strict_compat
                                  and model.num_experts == 0)
+        from metis_tpu.cost.schedule import REMAT_FWD_FRACTION
+
+        # the interleaved-floor bound must use the SAME remat fraction the
+        # estimator prices with, or a calibrated r < 1/3 would let true
+        # top-K members be pruned
+        self._remat = (config.remat_fwd_fraction
+                       if config.remat_fwd_fraction is not None
+                       else REMAT_FWD_FRACTION)
         if self.top_k is not None:
             cp_div = (config.max_cp_degree
                       if config.enable_cp and model.num_experts == 0 else 1)
@@ -164,8 +172,6 @@ class SearchPruner:
         its own floor is ``exec > (1+r) * B * max_lens`` (ticks exceed
         vs*S per group, each >= max_lens/vs), so the all-schedules bound
         is the minimum of the two."""
-        from metis_tpu.cost.schedule import REMAT_FWD_FRACTION
-
         mbs_floor = max(1, (self.gbs // g_max) // batches)
         # _w_at covers every case: w_min when the by-bs table is empty,
         # the scaled-down bound below the sweep, the table lookup above it
@@ -175,7 +181,7 @@ class SearchPruner:
         if not self._schedule_search:
             return gpipe_lb
         interleaved_floor = (
-            (1 + REMAT_FWD_FRACTION) * batches * w / num_stages)
+            (1 + self._remat) * batches * w / num_stages)
         return min(gpipe_lb, interleaved_floor)
 
     def composition_batches(

@@ -51,6 +51,42 @@ class TestRouting:
         first, second = _train_two_steps(exe, art.gbs)
         assert np.isfinite(first) and second < first
 
+    def test_uneven_1f1b_partition_routes_pipeline(self):
+        """A 1f1b artifact whose layer partition gives stages UNEVEN block
+        counts still routes to the shard_map pipeline (padded masked
+        layers), not the hetero executor — the hetero path would silently
+        run a gpipe-shaped schedule instead of the priced 1f1b."""
+        cfg = GPTConfig(vocab_size=256, seq_len=16, hidden=64, num_heads=4,
+                        num_blocks=3, ffn_multiplier=2, dtype=jnp.float32)
+        # profile layers: embed + 3 blocks + head = 5; bounds (0, 3, 5)
+        # give stage0 [embed, b0, b1] and stage1 [b2, head]: blocks (2, 1)
+        art = PlanArtifact(
+            mesh_axes=("pp", "dp", "tp"), mesh_shape=(2, 2, 1),
+            layer_partition=(0, 3, 5),
+            strategies=({"dp": 2, "tp": 1},),
+            gbs=8, microbatches=2, schedule="1f1b")
+        exe = build_executable(cfg, art)
+        assert exe.kind == "pipeline"
+        state = exe.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, cfg.seq_len), 0, cfg.vocab_size)
+        state, first = exe.step(state, tokens, tokens)
+        state, second = exe.step(state, tokens, tokens)
+        assert np.isfinite(float(first)) and float(second) < float(first)
+
+    def test_uneven_gpipe_partition_still_routes_hetero(self):
+        """The same uneven partition WITHOUT the 1f1b tag keeps its
+        existing multi-mesh route (per-stage programs realize it natively)."""
+        cfg = GPTConfig(vocab_size=256, seq_len=16, hidden=64, num_heads=4,
+                        num_blocks=3, ffn_multiplier=2, dtype=jnp.float32)
+        art = PlanArtifact(
+            mesh_axes=("pp", "dp", "tp"), mesh_shape=(2, 2, 1),
+            layer_partition=(0, 3, 5),
+            strategies=({"dp": 2, "tp": 1},),
+            gbs=8, microbatches=2)
+        exe = build_executable(cfg, art)
+        assert exe.kind == "hetero"
+
     def test_pp2_interleaved_schedule_trains(self):
         """schedule="interleaved" rides the pipeline route (CFG: 4 blocks =
         2 stages x 2 virtual chunks) and trains."""

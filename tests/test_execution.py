@@ -301,6 +301,62 @@ class TestPipelinePath:
         with pytest.raises(ValueError, match="divide evenly"):
             make_pipeline_train_step(bad, mesh, 2)
 
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_uneven_block_counts_match_single_device(self, schedule):
+        """An UNEVEN layer partition (block_counts) on the shard_map
+        pipeline reproduces the single-device loss: the padded zero layers
+        are masked to identity and the real blocks keep their global order
+        (VERDICT r3 next-step 6 — 1f1b on partitions the even split
+        rejects)."""
+        cfg = GPTConfig(vocab_size=64, seq_len=8, hidden=32, num_heads=2,
+                        num_blocks=3, dtype=jnp.float32)
+        mesh = _mesh((2, 2, 1), (PP, DP, TP))
+        M = 2
+        tokens = jax.random.randint(jax.random.PRNGKey(7), (4, cfg.seq_len),
+                                    0, cfg.vocab_size)
+        init_fn, step = make_pipeline_train_step(
+            cfg, mesh, M, schedule=schedule, block_counts=(2, 1))
+        params, opt_state = init_fn(jax.random.PRNGKey(3))
+        _, _, loss = step(params, opt_state, microbatch_split(tokens, M),
+                          microbatch_split(tokens, M))
+        # oracle: same seed, unpadded single-device params
+        full = init_params(jax.random.PRNGKey(3), cfg)
+        expected = float(next_token_loss(full, tokens, tokens, cfg))
+        assert float(loss) == pytest.approx(expected, rel=1e-5)
+
+    def test_uneven_interleaved_rejected(self):
+        cfg = GPTConfig(vocab_size=64, seq_len=8, hidden=32, num_heads=2,
+                        num_blocks=3, dtype=jnp.float32)
+        mesh = _mesh((2, 2, 1), (PP, DP, TP))
+        with pytest.raises(ValueError, match="even block split"):
+            make_pipeline_train_step(cfg, mesh, 2, schedule="interleaved",
+                                     block_counts=(2, 1))
+
+    def test_uneven_1f1b_trains_and_pads_are_inert(self):
+        """Training steps under an uneven 1f1b split reduce the loss and
+        never move the padded zero layers (their grads are masked out)."""
+        cfg = GPTConfig(vocab_size=64, seq_len=8, hidden=32, num_heads=2,
+                        num_blocks=3, dtype=jnp.float32)
+        mesh = _mesh((2, 2, 1), (PP, DP, TP))
+        M = 2
+        init_fn, step = make_pipeline_train_step(
+            cfg, mesh, M, schedule="1f1b", block_counts=(2, 1))
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (4, cfg.seq_len),
+                                  0, cfg.vocab_size)
+        tok_mbs = microbatch_split(toks, M)
+        loss0 = None
+        for _ in range(8):
+            params, opt_state, loss = step(params, opt_state, tok_mbs,
+                                           tok_mbs)
+            loss0 = loss0 if loss0 is not None else float(loss)
+        assert float(loss) < loss0
+        # pad slot (stage 1, second slot = padded index 3) stayed zero
+        pad = jax.tree.leaves(
+            jax.tree.map(lambda a: np.abs(np.asarray(a[3])).max(),
+                         params["blocks"]))
+        assert max(pad) == 0.0
+
 
 class TestPlanArtifact:
     def test_roundtrip(self):

@@ -322,6 +322,139 @@ class TestMoEStages:
         assert loss == pytest.approx(expected, rel=1e-4)
 
 
+class TestReplicaGroups:
+    """Per-type sub-mesh groups (StageSpec.replica_groups — VERDICT r3
+    next-step 7): a mixed-type stage splits into one GSPMD program per
+    type group, each computing ONLY its real rows; gradients sum across
+    groups on the primary mesh.  Numerically identical to the
+    single-program run."""
+
+    def test_grouped_dense_stage_matches_single_device(self):
+        tokens = _data(16)
+        stages = [
+            StageSpec(blocks=(0, 2), has_embed=True, has_head=False,
+                      dp=4, tp=1, replica_rows=(3, 3, 1, 1),
+                      replica_groups=(2, 2)),
+            StageSpec(blocks=(2, 4), has_embed=False, has_head=True,
+                      dp=2, tp=2),
+        ]
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_grouped_last_stage_matches_single_device(self):
+        """Groups on the LOSS stage: per-group losses/cotangents are scaled
+        by row share so the summed loss is the global batch mean."""
+        tokens = _data(16)
+        stages = [
+            StageSpec(blocks=(0, 2), has_embed=True, has_head=False,
+                      dp=2, tp=2),
+            StageSpec(blocks=(2, 4), has_embed=False, has_head=True,
+                      dp=4, tp=1, replica_rows=(3, 3, 1, 1),
+                      replica_groups=(2, 2)),
+        ]
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_grouped_uneven_within_group_falls_back_to_pad(self):
+        """Rows uneven WITHIN a group compose with the in-group pad/mask
+        mechanism (sub-spec keeps replica_rows)."""
+        tokens = _data(16)
+        stages = [
+            StageSpec(blocks=(0, 4), has_embed=True, has_head=True,
+                      dp=4, tp=1, replica_rows=(4, 2, 1, 1),
+                      replica_groups=(2, 2)),
+        ]
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_grouped_moe_stage_matches_single_program(self):
+        """Grouped MoE stage: each group's expert capacity derives from its
+        OWN token count (no pad rows at all) — loss parity below capacity
+        pressure with the single-program moe loss."""
+        from metis_tpu.models.moe import (
+            MoEConfig,
+            init_moe_params,
+            moe_next_token_loss,
+        )
+
+        cfg = MoEConfig(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                        num_blocks=4, ffn_multiplier=2, num_experts=2,
+                        top_k=1, capacity_factor=8.0, dtype=jnp.float32)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (4, cfg.seq_len), 0, cfg.vocab_size)
+        expected = float(moe_next_token_loss(
+            init_moe_params(jax.random.PRNGKey(0), cfg), toks, toks, cfg))
+
+        stages = stage_specs_from_plan(
+            [0, 3, cfg.num_profile_layers],
+            [{"dp": 2, "tp": 1}, {"dp": 2, "tp": 2}], cfg,
+            stage_replica_rows=[(3, 1), None],
+            stage_replica_groups=[(1, 1), None])
+        assert stages[0].replica_groups == (1, 1)
+        init_fn, step_fn = make_hetero_train_step(
+            cfg, stages, devices=jax.devices()[:6])
+        state = init_fn(jax.random.PRNGKey(0))
+        mbs = toks.reshape(1, 4, -1)
+        _, loss = step_fn(state, mbs, mbs)
+        assert loss == pytest.approx(expected, rel=1e-4)
+
+    def test_grouped_stage_trains(self):
+        tokens = _data(16)
+        stages = [
+            StageSpec(blocks=(0, 4), has_embed=True, has_head=True,
+                      dp=4, tp=1, replica_rows=(3, 3, 1, 1),
+                      replica_groups=(2, 2)),
+        ]
+        init_fn, step = make_hetero_train_step(CFG, stages)
+        state = init_fn(jax.random.PRNGKey(0))
+        mbs = tokens.reshape(2, 8, CFG.seq_len)
+        losses = []
+        for _ in range(4):
+            state, loss = step(state, mbs, mbs)
+            losses.append(loss)
+        assert losses[-1] < losses[0]
+
+    def test_grouped_zero_row_group_is_skipped(self):
+        """A type group the data balancer gives ZERO rows contributes no
+        loss and no gradients — an empty-batch mean would be NaN and poison
+        the step (found driving the train CLI on a small gbs)."""
+        tokens = _data(8)
+        stages = [
+            StageSpec(blocks=(0, 4), has_embed=True, has_head=True,
+                      dp=8, tp=1, replica_rows=(1, 1, 1, 1, 0, 0, 0, 0),
+                      replica_groups=(4, 4)),
+        ]
+        got = _hetero_losses(stages, tokens, microbatches=2)
+        want = _reference_losses(tokens, steps=2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_plan_replica_groups_detection(self):
+        from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+        from metis_tpu.core.types import InterStagePlan, Strategy
+        from metis_tpu.execution.hetero import plan_replica_groups
+
+        cluster = ClusterSpec(
+            nodes=(NodeSpec("A", 4), NodeSpec("B", 4)),
+            devices={"A": DeviceSpec("A", 80, 100, 25),
+                     "B": DeviceSpec("B", 15, 50, 10)})
+        inter = InterStagePlan(node_sequence=("A", "B"),
+                               device_groups=(8,), batches=2, gbs=16)
+        # one mixed stage of 8 devices: 4 A-replicas then 4 B-replicas
+        groups = plan_replica_groups(inter, [Strategy(dp=8, tp=1)], cluster)
+        assert groups == [(4, 4)]
+        # homogeneous stages and zero/cp/ep stages stay single-program
+        inter2 = InterStagePlan(node_sequence=("A", "B"),
+                                device_groups=(4, 4), batches=2, gbs=16)
+        assert plan_replica_groups(
+            inter2, [Strategy(dp=4, tp=1), Strategy(dp=4, tp=1)],
+            cluster) == [None, None]
+        assert plan_replica_groups(
+            inter, [Strategy(dp=8, tp=1, zero=1)], cluster) == [None]
+
+
 class TestCpStages:
     """cp (ring attention) stages under pipelining: a stage's mesh carries a
     dedicated sp axis and its attention runs the K/V-rotating ring."""

@@ -152,6 +152,17 @@ class TestContentionCalibration:
         assert factors["pipeline"] == pytest.approx(10.0)
         assert all(h.error_pct == pytest.approx(0.0) for h in held)
 
+    def test_fit_points_2_uses_geometric_mean(self):
+        from metis_tpu.validation import contention_calibrated
+
+        reports = [self._report(1, 10.0, 40.0),   # fit: ratio 4
+                   self._report(1, 10.0, 90.0),   # fit: ratio 9
+                   self._report(1, 10.0, 60.0)]   # holdout
+        factors, held = contention_calibrated(reports, fit_points=2)
+        assert factors == {None: pytest.approx(6.0)}  # sqrt(4*9)
+        assert len(held) == 1
+        assert held[0].predicted_ms == pytest.approx(60.0)
+
     def test_empty(self):
         from metis_tpu.validation import contention_calibrated
 
