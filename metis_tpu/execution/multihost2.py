@@ -1,0 +1,338 @@
+"""Per-slice-controller hetero execution — the multi-controller shape of the
+multi-mesh executor (SURVEY.md §7 hard part 3; VERDICT r3 next-step 5b).
+
+``execution.hetero`` runs a non-uniform plan single-controller: one process
+owns every stage's mesh and moves boundary activations with
+``jax.device_put``.  On the north-star deployment (v4-32 + v5e-16) that is
+impossible — the slices are DIFFERENT jax backends (different chip
+generations cannot join one runtime), so the real topology is one
+CONTROLLER PER SLICE: each controller owns one stage group's mesh, feeds its
+own stage, and the boundary activations/cotangents flow host-to-host over
+DCN.  This module realizes that slice with two plain OS processes:
+
+- each worker owns stage ``i``'s devices ONLY (its own jax runtime — no
+  ``jax.distributed``: the stages never share a collective, which is the
+  whole point; a v4 and a v5e slice could not share one anyway);
+- the stage programs are the SAME jitted closures the single-controller
+  executor builds (``hetero._make_stage_fn`` + per-stage vjp) — this module
+  adds transport, not math;
+- boundary tensors move over a TCP socket pair (host-mediated, exactly how
+  a DCN transfer between incompatible slices is realized);
+- the schedule mirrors the single-controller executor tick for tick:
+  forward fill (all microbatches, storing only boundary inputs), backward
+  drain in reverse, one optimizer step per stage — so the loss stream is
+  numerically IDENTICAL to ``make_hetero_train_step`` on the same plan
+  (pinned by tests/test_multihost2.py).
+
+The worker entry: ``python -m metis_tpu.execution.multihost2 <stage_id>
+<num_stages> <port>`` — tests spawn one worker per stage.
+"""
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+import sys
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# boundary transport: length-framed numpy arrays over TCP
+# ---------------------------------------------------------------------------
+
+
+def send_array(sock: socket.socket, arr: np.ndarray) -> None:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    payload = buf.getvalue()
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def recv_array(sock: socket.socket) -> np.ndarray:
+    header = _recv_exact(sock, 8)
+    (n,) = struct.unpack("<Q", header)
+    return np.load(io.BytesIO(_recv_exact(sock, n)), allow_pickle=False)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("boundary peer closed the socket")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _connect_ring(stage_id: int, num_stages: int, base_port: int,
+                  timeout_s: float = 60.0):
+    """(to_prev, to_next) sockets for this stage.  Link ``i`` (ports
+    base_port + i) joins stage i (listener) and stage i+1 (dialer)."""
+    to_prev = to_next = None
+    if stage_id < num_stages - 1:
+        srv = socket.create_server(("127.0.0.1", base_port + stage_id))
+        srv.settimeout(timeout_s)
+        to_next, _ = srv.accept()
+        srv.close()
+    if stage_id > 0:
+        deadline = timeout_s
+        while True:
+            try:
+                to_prev = socket.create_connection(
+                    ("127.0.0.1", base_port + stage_id - 1), timeout=2.0)
+                break
+            except OSError:
+                deadline -= 0.2
+                if deadline <= 0:
+                    raise
+                import time
+
+                time.sleep(0.2)
+    # boundary transfers must BLOCK: the peer may sit in a minutes-long
+    # first-call XLA compile before its first send — a lingering
+    # connect/accept timeout on the socket would kill the run
+    for s in (to_prev, to_next):
+        if s is not None:
+            s.settimeout(None)
+    return to_prev, to_next
+
+
+# ---------------------------------------------------------------------------
+# the fixed 2-stage workload (shared with the single-controller parity leg)
+# ---------------------------------------------------------------------------
+
+WORKLOAD = dict(vocab_size=256, seq_len=16, hidden=64, num_heads=4,
+                num_blocks=3, ffn_multiplier=2)
+PARTITION = (0, 2, 5)   # profile layers: stage0 = embed+1 block, stage1 = 2 blocks+head
+STRATEGIES = ({"dp": 2, "tp": 1}, {"dp": 1, "tp": 2})
+GBS, MICROBATCHES, STEPS = 8, 2, 3
+
+
+def workload_plan(cfg=None):
+    """(cfg, stage_specs) for the fixed parity workload."""
+    import jax.numpy as jnp
+
+    from metis_tpu.execution.hetero import stage_specs_from_plan
+    from metis_tpu.models import GPTConfig
+
+    if cfg is None:
+        cfg = GPTConfig(dtype=jnp.float32, **WORKLOAD)
+    return cfg, stage_specs_from_plan(PARTITION, STRATEGIES, cfg)
+
+
+def workload_batches():
+    """Deterministic [steps][M, rows, seq] token microbatches — every
+    controller derives the same schedule from the same seed (the
+    multi-controller feeding contract, execution/multihost.py)."""
+    import jax
+
+    toks = jax.random.randint(
+        jax.random.PRNGKey(17),
+        (STEPS, MICROBATCHES, GBS // MICROBATCHES, WORKLOAD["seq_len"]),
+        0, WORKLOAD["vocab_size"])
+    return np.asarray(toks)
+
+
+def run_single_controller_losses() -> list[float]:
+    """The identical run under the single-process multi-mesh executor — the
+    numeric parity oracle (needs >= 4 local devices)."""
+    import jax
+
+    from metis_tpu.execution.hetero import make_hetero_train_step
+
+    cfg, stages = workload_plan()
+    init_fn, step = make_hetero_train_step(cfg, stages)
+    state = init_fn(jax.random.PRNGKey(0))
+    losses = []
+    for toks in workload_batches():
+        state, loss = step(state, toks, toks)
+        losses.append(float(loss))
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# the per-stage controller
+# ---------------------------------------------------------------------------
+
+
+def run_stage_worker(stage_id: int, num_stages: int, base_port: int) -> dict:
+    """One controller owning stage ``stage_id``'s mesh: runs the shared
+    workload with boundary tensors over sockets.  Returns a report dict.
+
+    The slice implements exactly TWO stages (first + last roles; a middle
+    stage would need a forward relay and an input-cotangent path this
+    worker does not have) — matching the fixed 2-stage workload."""
+    if num_stages != 2:
+        raise ValueError(
+            f"the per-slice-controller slice implements exactly 2 stages, "
+            f"got num_stages={num_stages}")
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from metis_tpu.execution.hetero import (
+        _make_stage_fn,
+        _slice_stage_params,
+        _stage_param_specs,
+    )
+    from metis_tpu.execution.mesh import DP, TP
+    from metis_tpu.execution.train import build_optimizer
+    from metis_tpu.models import init_params
+    from metis_tpu.models.gpt import default_attention
+
+    cfg, stages = workload_plan()
+    spec = stages[stage_id]
+    devs = jax.devices()[: spec.devices]
+    if len(devs) < spec.devices:
+        raise RuntimeError(
+            f"stage {stage_id} needs {spec.devices} devices, "
+            f"have {len(jax.devices())}")
+    mesh = Mesh(np.array(devs).reshape(spec.dp, spec.tp), (DP, TP))
+
+    # identical init to the single-controller executor: one full
+    # init_params from the shared seed, slice this stage's leaves
+    full = init_params(jax.random.PRNGKey(0), cfg)
+    specs = _stage_param_specs(spec, cfg)
+    params = jax.tree.map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+        _slice_stage_params(full, spec), specs)
+    optimizer = build_optimizer()
+    with mesh:
+        opt_state = optimizer.init(params)
+
+    total_blocks = max(cfg.num_blocks, 1)
+    fn = _make_stage_fn(spec, cfg, default_attention(cfg),
+                        aux_weight=spec.num_blocks / total_blocks)
+    is_first = stage_id == 0
+    is_last = stage_id == num_stages - 1
+
+    def _in_mesh(f):
+        def run(*args):
+            with mesh:
+                return f(*args)
+        return run
+
+    if is_last:
+        def lg(params, x_in, tgt):
+            loss, grads = jax.value_and_grad(fn, argnums=(0, 1))(
+                params, x_in, tgt)
+            return loss, grads[0], grads[1]
+        lossgrad = _in_mesh(jax.jit(lg))
+    else:
+        fwd = _in_mesh(jax.jit(fn))
+
+        def bw(params, tok, ct):
+            _, pull = jax.vjp(lambda p: fn(p, tok), params)
+            return pull(ct)[0]
+        bwd = _in_mesh(jax.jit(bw))
+
+    def upd(params, opt_state, acc):
+        grads = jax.tree.map(lambda g: g / MICROBATCHES, acc)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+    apply_upd = _in_mesh(jax.jit(upd, donate_argnums=(0, 1, 2)))
+
+    add = _in_mesh(jax.jit(
+        lambda a, g: jax.tree.map(jnp.add, a, g), donate_argnums=(0,)))
+
+    to_prev, to_next = _connect_ring(stage_id, num_stages, base_port)
+    batches = workload_batches()
+    losses: list[float] = []
+    M = MICROBATCHES
+    for toks in batches:
+        # ---- forward fill (boundary inputs only, as the single-controller
+        # executor stores them)
+        x_in: list = [None] * M
+        for m in range(M):
+            if is_first:
+                x = fwd(params, jnp.asarray(toks[m]))
+                send_array(to_next, jax.device_get(x))
+            else:
+                x_in[m] = jax.device_put(
+                    recv_array(to_prev),
+                    NamedSharding(mesh, P(None, None, None)))
+        # ---- backward drain, reversed (same accumulation order)
+        acc = None
+        step_losses = []
+        for m in reversed(range(M)):
+            if is_last:
+                loss, g, ct = lossgrad(params, x_in[m], jnp.asarray(toks[m]))
+                step_losses.append(float(jax.device_get(loss)))
+                send_array(to_prev, jax.device_get(ct))
+            else:
+                ct = jax.device_put(
+                    recv_array(to_next),
+                    NamedSharding(mesh, P(None, None, None)))
+                g = bwd(params, jnp.asarray(toks[m]), ct)
+            acc = g if acc is None else add(acc, g)
+        params, opt_state = apply_upd(params, opt_state, acc)
+        if is_last:
+            losses.append(float(np.mean(step_losses)))
+
+    for s in (to_prev, to_next):
+        if s is not None:
+            s.close()
+    return {
+        "stage": stage_id,
+        "stages": num_stages,
+        "local_devices": len(jax.devices()),
+        "losses": losses,  # non-last stages report []
+    }
+
+
+def spawn_hetero_workers(base_port: int, timeout_s: float = 420.0
+                         ) -> list[dict]:
+    """Spawn one controller process per stage of the fixed workload and
+    return their reports.  Each worker sees ONLY its stage's device count
+    (xla_force_host_platform_device_count) — there is no shared runtime to
+    fall back on, so passing the parity test genuinely demonstrates the
+    per-slice-controller topology."""
+    import os
+    import subprocess
+
+    _, stages = _plan_shape()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    procs = []
+    for i, ndev in enumerate(stages):
+        env = {**os.environ,
+               "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": f"--xla_force_host_platform_device_count={ndev}",
+               "PYTHONPATH": repo}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "metis_tpu.execution.multihost2",
+             str(i), str(len(stages)), str(base_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout_s)
+            if p.returncode != 0:
+                raise RuntimeError(f"hetero worker failed:\n{err[-1500:]}")
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return outs
+
+
+def _plan_shape() -> tuple[tuple, list[int]]:
+    """(strategies, per-stage device counts) without touching a backend —
+    the spawner must not initialize jax in the parent."""
+    counts = [s["dp"] * s["tp"] for s in STRATEGIES]
+    return STRATEGIES, counts
+
+
+if __name__ == "__main__":
+    _stage, _n, _port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run_stage_worker(_stage, _n, _port)), flush=True)

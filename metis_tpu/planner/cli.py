@@ -564,14 +564,13 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     print(f"best plan ({cost_txt}) -> "
           f"{exe.kind} executable; stages {art.device_groups or '1'}, "
           f"gbs {art.gbs} x {args.steps} steps", file=sys.stderr)
-    if multihost and exe.kind != "gspmd":
-        print(f"--coordinator supports GSPMD (pp=1 rectangular) plans; the "
-              f"chosen plan routes to the {exe.kind} executable.  The "
-              "shard_map pipeline runs multi-controller at the library "
-              "level (execution.multihost); the multi-mesh hetero executor "
-              "is single-controller by design (one controller per stage "
-              "group on real deployments — see execution/multihost.py).",
-              file=sys.stderr)
+    if multihost and exe.kind == "hetero":
+        print(f"--coordinator supports GSPMD (pp=1) and shard_map-pipeline "
+              f"(pp>1 rectangular) plans; the chosen plan routes to the "
+              f"{exe.kind} executable.  The multi-mesh hetero executor runs "
+              "one controller per stage group on real deployments "
+              "(execution/multihost2.py realizes that slice; the train CLI "
+              "drives single-controller hetero only).", file=sys.stderr)
         return 2
 
     if args.data:
@@ -603,8 +602,16 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         params, opt_state = state
         import jax.numpy as jnp
 
+        step_arr = jnp.asarray(step, jnp.int32)
+        if multihost and mesh is not None:
+            # orbax refuses host-local arrays in a multi-controller run —
+            # replicate the step scalar over the global mesh
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            step_arr = jax.device_put(
+                step_arr, NamedSharding(mesh, PartitionSpec()))
         return TrainState(params=params, opt_state=opt_state,
-                          step=jnp.asarray(step, jnp.int32))
+                          step=step_arr)
 
     # the interleaved schedule permutes the physical block order of
     # params/checkpoints; record it and refuse a resume under a different
@@ -615,9 +622,19 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     # and scramble the stacked block axis.
     pp_extent = (art.mesh_shape[art.mesh_axes.index("pp")]
                  if "pp" in art.mesh_axes else 1)
-    block_layout = ("canonical" if exe.kind != "pipeline"
-                    or schedule != "interleaved"
-                    else f"interleaved:{pp_extent}x{virtual_stages}")
+    block_layout = "canonical"
+    if exe.kind == "pipeline":
+        if schedule == "interleaved":
+            block_layout = f"interleaved:{pp_extent}x{virtual_stages}"
+        else:
+            # an uneven 1f1b split pads/reorders the stacked block axis
+            # (execution.pipeline.pad_blocks_for_partition) — a layout too
+            from metis_tpu.execution.builder import _uneven_1f1b_split
+
+            counts = _uneven_1f1b_split(art, cfg, pp_extent, schedule)
+            if counts is not None:
+                block_layout = ("uneven:" + str(pp_extent) + "x"
+                                + "-".join(str(c) for c in counts))
 
     state = exe.init(jax.random.PRNGKey(0))
     start_step = 0
@@ -686,8 +703,20 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
             batches = make_input_pipeline(
                 dataset, art.gbs, mesh=mesh, dp_axis=dp_ax, seq_axis=seq_ax,
                 epochs=None, skip_batches=start_step)
+    elif multihost and exe.kind == "pipeline":
+        # multi-controller pipeline: the step consumes GLOBAL [gbs, seq]
+        # arrays (its internal microbatch_split reshape and the shard_map
+        # in_specs then reshard SPMD); per-host feeding materializes only
+        # this controller's dp shards
+        from metis_tpu.execution.mesh import DP as _DP
+        from metis_tpu.execution.multihost import global_batch_pipeline
+
+        batches = global_batch_pipeline(
+            dataset, art.gbs, mesh, dp_axis=_DP,
+            skip_batches=start_step)
     else:
-        # pipeline/hetero steps do their own microbatch placement
+        # single-controller pipeline/hetero steps do their own microbatch
+        # placement
         batches = make_input_pipeline(dataset, art.gbs, epochs=None,
                                       skip_batches=start_step)
 

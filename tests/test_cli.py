@@ -133,6 +133,63 @@ def test_train_subcommand_end_to_end(fixture_dir, tmp_path):
         assert load_meta(ckpt).step == 5
 
 
+def test_train_coordinator_runs_pipeline_plan(fixture_dir, tmp_path):
+    """`train --coordinator` runs a shard_map-PIPELINE plan end to end
+    (VERDICT r3 next-step 5a — the refusal previously covered every
+    non-gspmd route): 2 controller processes x 4 virtual CPU devices, the
+    plan pinned to pp=2 via a pre-seeded plan artifact, per-host feeding
+    through global_batch_pipeline.  Both processes finish; process 0
+    writes the summary with finite losses."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from metis_tpu.core.types import UniformPlan
+    from metis_tpu.execution.mesh import PlanArtifact
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    art = PlanArtifact.from_uniform_plan(
+        UniformPlan(dp=2, pp=2, tp=2, mbs=2, gbs=8))
+    (ckpt / "plan.json").write_text(art.to_json())
+    out = tmp_path / "summary.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = ["train", "--hostfile", str(fixture_dir / "hostfile"),
+            "--clusterfile", str(fixture_dir / "cluster.json"),
+            "--profile-dir", str(fixture_dir / "profiles"),
+            *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+            "--checkpoint-dir", str(ckpt), "--steps", "2",
+            "--coordinator", "127.0.0.1:12471", "--num-processes", "2",
+            "--platform", "cpu"]
+    procs = []
+    for pid in range(2):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+               "PYTHONPATH": repo}
+        cmd = [_sys.executable, "-m", "metis_tpu.planner.cli",
+               *base, "--process-id", str(pid)]
+        if pid == 0:
+            cmd += ["--output", str(out)]
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=repo))
+    try:
+        for p in procs:
+            _, err = p.communicate(timeout=420)
+            assert p.returncode == 0, err[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    import math
+
+    summary = json.loads(out.read_text())
+    assert summary["executable"] == "pipeline"
+    assert summary["steps"] == 2
+    assert math.isfinite(summary["final_loss"])
+
+
 def test_train_refuses_layout_mismatch_resume(fixture_dir, tmp_path):
     """A checkpoint written under one block layout must not resume under
     another (the interleaved schedule permutes the physical block order)."""

@@ -1,0 +1,64 @@
+"""Per-slice-controller hetero execution (execution/multihost2.py —
+VERDICT r3 next-step 5b): two REAL processes, each owning ONE stage's mesh
+(its own jax runtime, no shared coordinator), boundary activations and
+cotangents over sockets, checked for loss parity against the identical
+single-process multi-mesh run."""
+import numpy as np
+import pytest
+
+
+def test_two_controller_hetero_matches_single_process():
+    from metis_tpu.execution.multihost2 import (
+        run_single_controller_losses,
+        spawn_hetero_workers,
+    )
+
+    outs = spawn_hetero_workers(base_port=12461)
+    assert len(outs) == 2
+    by_stage = {o["stage"]: o for o in outs}
+    # each controller saw ONLY its stage's devices (2 each here) — there is
+    # no global runtime that could have run the plan single-controller
+    assert by_stage[0]["local_devices"] == 2
+    assert by_stage[1]["local_devices"] == 2
+    # the loss lives on the last stage's controller
+    losses = by_stage[1]["losses"]
+    assert len(losses) == 3
+    assert all(np.isfinite(losses))
+    assert by_stage[0]["losses"] == []
+
+    oracle = run_single_controller_losses()
+    assert losses == pytest.approx(oracle, rel=1e-5)
+
+
+def test_boundary_transport_roundtrip():
+    """The length-framed numpy transport survives odd shapes and dtypes."""
+    import socket
+    import threading
+
+    from metis_tpu.execution.multihost2 import recv_array, send_array
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    arrays = [np.arange(7, dtype=np.int32),
+              np.random.default_rng(0).normal(size=(3, 5, 2)).astype(
+                  np.float32),
+              np.zeros((1,), np.bool_)]
+    got = []
+
+    def server():
+        conn, _ = srv.accept()
+        for _ in arrays:
+            got.append(recv_array(conn))
+        conn.close()
+
+    t = threading.Thread(target=server)
+    t.start()
+    cli = socket.create_connection(("127.0.0.1", port))
+    for a in arrays:
+        send_array(cli, a)
+    cli.close()
+    t.join(timeout=30)
+    srv.close()
+    for a, b in zip(arrays, got):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
