@@ -952,6 +952,23 @@ def main() -> None:
                                    "live_attempt": live}
         except (OSError, json.JSONDecodeError):
             pass
+    # deviceless Mosaic-compilation evidence (tools/mosaic_aot_check.py —
+    # the committed artifact; kernels compiled against a v5e topology from
+    # libtpu, no chip needed)
+    aot_path = Path(__file__).resolve().parent / "calibration" / \
+        "mosaic_aot.json"
+    if aot_path.exists():
+        try:
+            aot = json.loads(aot_path.read_text())
+            record["mosaic_aot"] = {
+                "status": aot.get("status"),
+                "topology": aot.get("topology"),
+                "kernels": {k: v.get("ok")
+                            for k, v in aot.get("kernels", {}).items()},
+                "at": aot.get("at"),
+            }
+        except (OSError, json.JSONDecodeError):
+            pass
     # The driver captures only a ~2000-char tail of stdout (round 2/3
     # artifacts came back "parsed": null) — persist the FULL record to a
     # repo file and keep the final stdout line compact enough to survive
@@ -994,6 +1011,13 @@ def _headline(record: dict) -> dict:
             "exact_prune_parity_top20_64dev"),
         "tpu_step": _tpu_brief(record, "tpu_step"),
         "tpu_validation": _tpu_brief(record, "tpu_validation"),
+        "mosaic_aot": (record.get("mosaic_aot") or {}).get("status"),
+        # failure visibility: a crashed section or an unwritable record
+        # file must be distinguishable from "not computed" in the tail
+        "section_errors": {
+            k: v["error"] for k, v in record.items()
+            if isinstance(v, dict) and "error" in v} or None,
+        "bench_out_write_failed": record.get("bench_out_write_failed"),
         "full_record": "bench_out.json",
     }
 

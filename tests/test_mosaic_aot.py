@@ -1,0 +1,40 @@
+"""Deviceless Mosaic compilation of the pallas kernels (VERDICT r3 #4).
+
+Interpret-mode tests (test_flash_attention.py, test_ring_attention.py) pin
+the MATH; these pin that the TPU pallas compiler ACCEPTS the kernels —
+tiling/layout/scratch rules differ from interpret mode, and every prior
+round shipped kernels Mosaic had never seen.  Uses a compile-only v5e
+topology from libtpu (no chip needed); skips when libtpu can't provide one.
+"""
+import pytest
+
+
+@pytest.fixture(scope="module")
+def topo():
+    try:
+        from jax.experimental import topologies
+
+        return topologies.get_topology_desc("v5e:2x2", platform="tpu")
+    except Exception as e:  # noqa: BLE001 — env-dependent
+        pytest.skip(f"no compile-only TPU topology: {e}")
+
+
+def test_all_kernels_mosaic_compile(topo, tmp_path):
+    """The tool's full sweep: flash fwd (causal + stats), blockwise bwd,
+    and ring attention over a 4-device sp mesh."""
+    import json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import mosaic_aot_check
+
+    out = tmp_path / "aot.json"
+    rc = mosaic_aot_check.main(["--out", str(out)])
+    record = json.loads(out.read_text())
+    assert rc == 0, record
+    assert record["status"] == "all kernels Mosaic-compiled"
+    assert set(record["kernels"]) >= {
+        "flash_fwd_causal", "flash_fwd_stats", "flash_bwd",
+        "ring_attention_sp4"}
+    assert all(v["ok"] for v in record["kernels"].values())

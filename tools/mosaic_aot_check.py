@@ -1,0 +1,208 @@
+"""Deviceless Mosaic compilation check for the pallas kernels.
+
+Every test and bench path runs the flash/ring kernels with
+``interpret=True`` on CPU (the TPU tunnel has been wedged since round 1),
+so interpret-mode correctness never established that MOSAIC — the TPU
+pallas compiler, with its own tiling/layout/scratch rules — accepts the
+kernels.  This tool retires that risk without a TPU device (VERDICT r3
+next-step 4): it builds a compile-only TPU topology from libtpu
+(``jax.experimental.topologies.get_topology_desc`` — no chip needed, the
+PJRT topology carries the compiler), AOT-lowers and compiles each kernel
+entry point against it, and records per-kernel success or the precise
+compiler error.
+
+Run:  python tools/mosaic_aot_check.py [--out calibration/mosaic_aot.json]
+
+The committed JSON artifact is the round's evidence: either Mosaic-compiled
+kernel fingerprints exist, or the specific incompatibility is on record
+(not just "no TPU visible").
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# Shapes mirror the bench/tpu_step workload: bf16, 128-head-dim, long seq.
+BH, SEQ, HEAD_DIM = 4, 1024, 128
+TOPOLOGY_CANDIDATES = (
+    # (topology_name, kwargs) — v5e first (the tunnel chip), then v4.
+    ("v5e:2x2", {}),
+    ("v5litepod-4", {}),
+    ("v4:2x2x1", {}),
+)
+
+
+def _topology():
+    from jax.experimental import topologies
+
+    errs = []
+    for name, kw in TOPOLOGY_CANDIDATES:
+        try:
+            topo = topologies.get_topology_desc(name, platform="tpu", **kw)
+            return name, topo, errs
+        except Exception as e:  # noqa: BLE001 — record every failure mode
+            errs.append(f"{name}: {type(e).__name__}: {e}"[:300])
+    return None, None, errs
+
+
+def _kernel_cases(dev):
+    """(name, build() -> (fn, args)) for each pallas entry point."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    # ops/__init__ re-exports a FUNCTION named flash_attention that shadows
+    # the module on attribute imports
+    fa = importlib.import_module("metis_tpu.ops.flash_attention")
+
+    def qkv(dtype=jnp.bfloat16):
+        ks = [jax.ShapeDtypeStruct((BH, SEQ, HEAD_DIM), dtype)] * 3
+        return ks
+
+    def fwd_case():
+        fn = functools.partial(
+            fa._fa_call, causal=True, block_q=128, block_kv=128,
+            interpret=False, normalize=True, return_stats=False)
+        return fn, qkv()
+
+    def fwd_stats_case():
+        fn = functools.partial(
+            fa._fa_call, causal=False, block_q=128, block_kv=128,
+            interpret=False, normalize=False, return_stats=True)
+        return fn, qkv()
+
+    def bwd_case():
+        import jax.numpy as jnp
+
+        def run(q, k, v, do, lse, delta):
+            return fa._fa_bwd_call(q, k, v, do, lse, delta, causal=True,
+                                   block_q=128, block_kv=128,
+                                   interpret=False)
+        q_steps = SEQ // 128
+        stats = jax.ShapeDtypeStruct((BH * q_steps, 1, 128), jnp.float32)
+        return run, qkv() + [jax.ShapeDtypeStruct(
+            (BH, SEQ, HEAD_DIM), jnp.bfloat16), stats, stats]
+
+    return [("flash_fwd_causal", fwd_case),
+            ("flash_fwd_stats", fwd_stats_case),
+            ("flash_bwd", bwd_case)]
+
+
+def _ring_case(topo):
+    """Ring attention end to end: the per-step flash kernels inside
+    shard_map over a 4-device 'sp' mesh of the compile-only topology —
+    Mosaic + the collective lowering together."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ra = importlib.import_module("metis_tpu.ops.ring_attention")
+    n = min(4, len(topo.devices))
+    mesh = topologies.make_mesh(topo, (n,), ("sp",))
+    attn = ra.make_ring_attention(mesh, "sp")
+    shape = jax.ShapeDtypeStruct((2, BH, SEQ, HEAD_DIM), jnp.bfloat16)
+    shard = NamedSharding(mesh, P(None, None, "sp", None))
+
+    def run(q, k, v):
+        return attn(q, k, v)
+
+    return run, [shape] * 3, tuple([shard] * 3)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(REPO / "calibration" /
+                                         "mosaic_aot.json"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # never touch a (possibly wedged) real backend: this is compile-only
+    jax.config.update("jax_platforms", "cpu")
+
+    record: dict = {
+        "jax": jax.__version__,
+        "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "shapes": {"bh": BH, "seq": SEQ, "head_dim": HEAD_DIM,
+                   "dtype": "bfloat16", "block": 128},
+    }
+    topo_name, topo, errs = _topology()
+    record["topology_errors"] = errs
+    if topo is None:
+        record["status"] = ("no compile-only TPU topology available from "
+                            "libtpu — every candidate failed (see "
+                            "topology_errors)")
+        _write(args.out, record)
+        print(json.dumps({"status": record["status"]}))
+        return 1
+    record["topology"] = topo_name
+    dev = topo.devices[0]
+    # tie the computation to the compile-only TPU device via shardings —
+    # shape-struct-only lowering assumes the default (CPU) device and then
+    # refuses a TPU device assignment at compile time
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh1 = Mesh([dev], ("d",))
+    shard = NamedSharding(mesh1, PartitionSpec())
+
+    # every build runs INSIDE the per-kernel try below — a case that fails
+    # to even construct is a recorded result, not a tool crash
+    cases = [(name, lambda b=build: b() + (None,))
+             for name, build in _kernel_cases(dev)]
+    cases.append(("ring_attention_sp4", lambda: _ring_case(topo)))
+
+    results = {}
+    for name, build in cases:
+        t0 = time.perf_counter()
+        try:
+            fn, arg_shapes, in_shards = build()
+            shards = (in_shards if in_shards is not None
+                      else tuple(shard for _ in arg_shapes))
+            lowered = jax.jit(
+                fn, in_shardings=shards,
+            ).trace(*arg_shapes).lower(lowering_platforms=("tpu",))
+            compiled = lowered.compile()
+            ca = compiled.cost_analysis()
+            entry = {
+                "ok": True,
+                "compile_s": round(time.perf_counter() - t0, 2),
+                "hlo_chars": len(compiled.as_text()),
+            }
+            if isinstance(ca, dict) and ca.get("flops"):
+                entry["flops"] = ca["flops"]
+            results[name] = entry
+        except Exception as e:  # noqa: BLE001 — the error IS the result
+            results[name] = {
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:1500],
+            }
+    record["kernels"] = results
+    record["status"] = ("all kernels Mosaic-compiled"
+                        if all(r["ok"] for r in results.values())
+                        else "some kernels failed Mosaic compilation")
+    _write(args.out, record)
+    print(json.dumps({"status": record["status"],
+                      "topology": topo_name,
+                      "kernels": {k: v["ok"] for k, v in results.items()}}))
+    return 0 if all(r["ok"] for r in results.values()) else 1
+
+
+def _write(path, record):
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(record, indent=1))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
