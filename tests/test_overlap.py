@@ -169,6 +169,67 @@ class TestContentionCalibration:
         assert contention_calibrated([]) == ({}, [])
 
 
+class TestAffineLooCalibration:
+    def _report(self, predicted, measured, pp=1):
+        from metis_tpu.validation import ValidationReport
+
+        return ValidationReport(
+            plan=UniformPlan(dp=8 // pp, pp=pp, tp=1, mbs=1, gbs=8),
+            predicted_ms=predicted, measured_ms=measured, steps=3)
+
+    def test_recovers_exact_affine(self):
+        """measured = 3*pred + 50 exactly -> every LOO error is ~0."""
+        from metis_tpu.validation import affine_loo_calibrated
+
+        reports = [self._report(p, 3.0 * p + 50.0)
+                   for p in (10.0, 20.0, 40.0, 80.0)]
+        fit, loo = affine_loo_calibrated(reports)
+        assert fit["mode"] == "affine_loo"
+        assert fit["factor"] == pytest.approx(3.0)
+        assert fit["overhead_ms"] == pytest.approx(50.0)
+        assert all(abs(r.error_pct) < 1e-6 for r in loo)
+        assert len(loo) == 4  # every plan held out
+
+    def test_dispatch_flat_regime_degrades_to_overhead_only(self):
+        """Measured times flat while predictions vary (the toy-scale CPU
+        regime): the nonneg constraint lands on a~0 + constant, and LOO
+        errors are the measurement noise, not the prediction spread."""
+        from metis_tpu.validation import affine_loo_calibrated
+
+        reports = [self._report(p, m) for p, m in
+                   ((10.0, 200.0), (30.0, 205.0), (60.0, 195.0),
+                    (90.0, 201.0))]
+        fit, loo = affine_loo_calibrated(reports)
+        for r in loo:
+            assert abs(r.error_pct) < 10.0
+        # a 1-point scalar fit would score the 90-pred plan at ~20x off
+        assert fit["factor"] < 1.0
+
+    def test_batches_regressor(self):
+        """measured = 2*pred + 10*batches with the batches regressor."""
+        from metis_tpu.validation import HeteroValidationReport
+        from metis_tpu.validation import affine_loo_calibrated
+
+        reports = [HeteroValidationReport(
+            plan_dict={"batches": b}, predicted_ms=p,
+            measured_ms=2.0 * p + 10.0 * b, steps=3)
+            for p, b in ((10.0, 2), (25.0, 4), (40.0, 8), (60.0, 2))]
+        fit, loo = affine_loo_calibrated(
+            reports, regressor=lambda r: r.plan_dict["batches"])
+        assert fit["factor"] == pytest.approx(2.0)
+        assert fit["overhead_ms"] == pytest.approx(10.0)
+        assert all(abs(r.error_pct) < 1e-6 for r in loo)
+
+    def test_small_sets_fall_back_to_scalar(self):
+        from metis_tpu.validation import affine_loo_calibrated
+
+        fit, held = affine_loo_calibrated(
+            [self._report(10.0, 70.0), self._report(12.0, 84.0)])
+        assert fit["mode"] == "scalar"
+        assert len(held) == 1
+        assert held[0].error_pct == pytest.approx(0.0)
+
+
 class TestDispatchAffineCalibration:
     def _hreport(self, batches, predicted, measured):
         from metis_tpu.validation import HeteroValidationReport
