@@ -425,12 +425,38 @@ def _cmd_validate(args: argparse.Namespace, profiles, model, config) -> int:
     reports = validate_planner_choice(
         result.plans, model, top_k=args.validate_top_k,
         steps=args.steps, warmup=args.warmup)
-    payload = json.dumps([r.to_json_dict() for r in reports], indent=2)
-    _emit(args, payload)
+    out = {"plans": [r.to_json_dict() for r in reports]}
+    # leave-one-out affine calibration (validation.affine_loo_calibrated):
+    # separates systematic environment factors (contention, dispatch
+    # overhead) from model fidelity — every calibrated error is scored by
+    # a fit that excluded that plan.  Fit PER EXECUTOR FAMILY: the GSPMD
+    # and shard_map-pipeline paths have different (factor, overhead)
+    # regimes, and one cross-family affine would report environment
+    # mismatch as model error (the bench validation does the same).
+    from metis_tpu.validation import affine_loo_calibrated
+
+    fams: dict = {}
+    for r in reports:
+        fams.setdefault("pipeline" if r.plan.pp > 1 else "gspmd",
+                        []).append(r)
+    if any(len(rs) >= 2 for rs in fams.values()):
+        out["calibration"] = {}
+        loo_all = []
+        for famname, rs in fams.items():
+            fit, loo = affine_loo_calibrated(rs)
+            out["calibration"][famname] = fit
+            loo_all.extend(loo)
+        if loo_all:
+            out["calibrated_plans"] = [r.to_json_dict() for r in loo_all]
+            out["calibrated_mean_abs_error_pct"] = round(
+                sum(r.abs_error_pct for r in loo_all) / len(loo_all), 1)
+    _emit(args, json.dumps(out, indent=2))
     if reports:
         mean_err = sum(r.abs_error_pct for r in reports) / len(reports)
+        extra = (f", calibrated {out['calibrated_mean_abs_error_pct']}%"
+                 if "calibrated_mean_abs_error_pct" in out else "")
         print(f"validated {len(reports)} plans, mean abs error "
-              f"{mean_err:.1f}%", file=sys.stderr)
+              f"{mean_err:.1f}%{extra}", file=sys.stderr)
     else:
         print(
             f"no executable plans to validate ({result.num_costed} costed, "

@@ -190,6 +190,30 @@ def test_train_coordinator_runs_pipeline_plan(fixture_dir, tmp_path):
     assert math.isfinite(summary["final_loss"])
 
 
+def test_validate_subcommand_end_to_end(fixture_dir, tmp_path):
+    """`metis-tpu validate` measures the top plans and (with >= 3 of them)
+    reports leave-one-out calibrated errors — the C19 loop as a driver
+    surface."""
+    out = tmp_path / "val.json"
+    rc = main(["validate", "--hostfile", str(fixture_dir / "hostfile_small"),
+               "--clusterfile", str(fixture_dir / "cluster.json"),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+               "--validate-top-k", "3", "--steps", "2", "--warmup", "1",
+               "--output", str(out), "--platform", "cpu"])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    assert payload["plans"]
+    for p in payload["plans"]:
+        assert p["measured_ms"] > 0
+    if "calibration" in payload:
+        # per-executor-family fits (one cross-family affine would report
+        # environment mismatch as model error)
+        for fit in payload["calibration"].values():
+            assert fit["mode"] in ("affine_loo", "scalar")
+        assert "calibrated_mean_abs_error_pct" in payload
+
+
 def test_train_refuses_layout_mismatch_resume(fixture_dir, tmp_path):
     """A checkpoint written under one block layout must not resume under
     another (the interleaved schedule permutes the physical block order)."""
