@@ -948,8 +948,8 @@ def main() -> None:
     # deviceless Mosaic-compilation evidence (tools/mosaic_aot_check.py —
     # the committed artifact; kernels compiled against a v5e topology from
     # libtpu, no chip needed)
-    aot_path = Path(__file__).resolve().parent / "calibration" / \
-        "mosaic_aot.json"
+    cal = Path(__file__).resolve().parent / "calibration"
+    aot_path = cal / "mosaic_aot.json"
     if aot_path.exists():
         try:
             aot = json.loads(aot_path.read_text())
@@ -962,6 +962,27 @@ def main() -> None:
             }
         except (OSError, json.JSONDecodeError):
             pass
+    # deep-capture artifacts (tools/tpu_deep_capture.py): committed
+    # hardware-measured profiles / remat fraction / on-chip validation
+    # sweep / flash tiling sweep, each carrying its capture timestamp
+    deep: dict = {}
+    for key, fname in (("remat", "tpu_remat_fraction.json"),
+                       ("validation_sweep", "tpu_validation_sweep.json"),
+                       ("flash_blocks", "tpu_flash_blocks.json")):
+        p = cal / fname
+        if p.exists():
+            try:
+                deep[key] = json.loads(p.read_text())
+            except (OSError, json.JSONDecodeError):
+                pass
+    prof_dir = cal / "tpu_v5e_profiles"
+    if prof_dir.is_dir():
+        files = sorted(p.name for p in prof_dir.glob("*.json"))
+        if files:
+            deep["profiles"] = {"dir": "calibration/tpu_v5e_profiles",
+                                "files": files}
+    if deep:
+        record["tpu_deep"] = deep
     # The driver captures only a ~2000-char tail of stdout (round 2/3
     # artifacts came back "parsed": null) — persist the FULL record to a
     # repo file and keep the final stdout line compact enough to survive
@@ -1008,6 +1029,11 @@ def _headline(record: dict) -> dict:
             "exact_prune_parity_top20_64dev"),
         "tpu_step": _tpu_brief(record, "tpu_step"),
         "tpu_validation": _tpu_brief(record, "tpu_validation"),
+        "tpu_sweep_mean_err_pct": ((record.get("tpu_deep") or {})
+                                   .get("validation_sweep") or {})
+        .get("mean_abs_error_pct"),
+        "tpu_flash_best": ((record.get("tpu_deep") or {})
+                           .get("flash_blocks") or {}).get("best"),
         "mosaic_aot": (record.get("mosaic_aot") or {}).get("status"),
         # failure visibility: a crashed section or an unwritable record
         # file must be distinguishable from "not computed" in the tail

@@ -248,8 +248,8 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_attention_local(q, k, v, axis_name: str, impl: str = "pallas",
-                         interpret: bool = False, block_q: int = 128,
-                         block_kv: int = 128):
+                         interpret: bool = False, block_q: int = 512,
+                         block_kv: int = 512):
     """The per-device body: causal attention with K/V rotating over
     ``axis_name``.  Call inside shard_map with q/k/v sequence-sharded on that
     axis.  q, k, v: [b, h, s_local, d].  With ``impl="pallas"``, tileable

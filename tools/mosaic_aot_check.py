@@ -31,6 +31,10 @@ sys.path.insert(0, str(REPO))
 
 # Shapes mirror the bench/tpu_step workload: bf16, 128-head-dim, long seq.
 BH, SEQ, HEAD_DIM = 4, 1024, 128
+# Compile at the SHIPPED default tiling (ops/flash_attention.py — (512, 512),
+# tuned on-chip, calibration/tpu_flash_blocks.json): the gate must certify
+# the configuration callers actually run, not a legacy one.
+BLOCK = 512
 TOPOLOGY_CANDIDATES = (
     # (topology_name, kwargs) — v5e first (the tunnel chip), then v4.
     ("v5e:2x2", {}),
@@ -69,13 +73,13 @@ def _kernel_cases(dev):
 
     def fwd_case():
         fn = functools.partial(
-            fa._fa_call, causal=True, block_q=128, block_kv=128,
+            fa._fa_call, causal=True, block_q=BLOCK, block_kv=BLOCK,
             interpret=False, normalize=True, return_stats=False)
         return fn, qkv()
 
     def fwd_stats_case():
         fn = functools.partial(
-            fa._fa_call, causal=False, block_q=128, block_kv=128,
+            fa._fa_call, causal=False, block_q=BLOCK, block_kv=BLOCK,
             interpret=False, normalize=False, return_stats=True)
         return fn, qkv()
 
@@ -84,10 +88,10 @@ def _kernel_cases(dev):
 
         def run(q, k, v, do, lse, delta):
             return fa._fa_bwd_call(q, k, v, do, lse, delta, causal=True,
-                                   block_q=128, block_kv=128,
+                                   block_q=BLOCK, block_kv=BLOCK,
                                    interpret=False)
-        q_steps = SEQ // 128
-        stats = jax.ShapeDtypeStruct((BH * q_steps, 1, 128), jnp.float32)
+        q_steps = SEQ // BLOCK
+        stats = jax.ShapeDtypeStruct((BH * q_steps, 1, BLOCK), jnp.float32)
         return run, qkv() + [jax.ShapeDtypeStruct(
             (BH, SEQ, HEAD_DIM), jnp.bfloat16), stats, stats]
 
@@ -135,7 +139,7 @@ def main(argv=None) -> int:
         "jax": jax.__version__,
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "shapes": {"bh": BH, "seq": SEQ, "head_dim": HEAD_DIM,
-                   "dtype": "bfloat16", "block": 128},
+                   "dtype": "bfloat16", "block": BLOCK},
     }
     topo_name, topo, errs = _topology()
     record["topology_errors"] = errs

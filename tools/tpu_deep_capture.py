@@ -1,0 +1,248 @@
+"""Deep hardware capture: run while the TPU tunnel is alive, persist everything.
+
+The tunnel wedges unpredictably (calibration/tpu_probe_log.jsonl), so each
+section is independent and every artifact is written as soon as it is
+measured:
+
+1. calibration/tpu_v5e_profiles/     — real per-layer profiles through the
+   measured profiler (the artifact the reference only documents how to
+   collect by hand, README.md:142-186; ours is one call), in the reference
+   filename/JSON contract so ProfileStore.from_dir round-trips them.
+2. calibration/tpu_remat_fraction.json — measured fwd share of a block's
+   fwd+bwd on the chip; feeds SearchConfig.remat_fwd_fraction (the 1f1b /
+   interleaved remat term priced by cost/schedule.py).
+3. calibration/tpu_validation_sweep.json — plan the profiled model on a
+   single-chip cluster and validate the top-K plans on hardware: the
+   north-star predicted-vs-measured error (reference's dead
+   model/cost_validation.py:15, resurrected and fed real silicon).
+4. calibration/tpu_flash_blocks.json — flash kernel (Mosaic, not interpret)
+   block_q x block_kv sweep vs the XLA dense path, fwd+bwd, two sequence
+   lengths; picks the fastest tiling for v5e.
+
+Usage: python tools/tpu_deep_capture.py [section ...]   (default: all)
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+CAL = REPO / "calibration"
+
+# hidden 1024 (not 2048): the tunnel chip's free HBM is well under the 16GB
+# nameplate — hidden-2048 profiling hit RESOURCE_EXHAUSTED partway through.
+MODEL_KW = dict(name="gpt-v5e-profiled", num_layers=10, hidden_size=1024,
+                sequence_length=1024, vocab_size=32768, num_heads=8)
+BSS = (1, 2, 4, 8)
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _device():
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        raise RuntimeError("no TPU visible")
+    return dev
+
+
+def capture_profiles() -> None:
+    from metis_tpu.core.config import ModelSpec
+    from metis_tpu.profiles.profiler import ProfilerConfig, profile_model
+
+    dev = _device()
+    model = ModelSpec(**MODEL_KW)
+    t0 = time.perf_counter()
+    store = profile_model(model, tps=(1,), bss=BSS,
+                          config=ProfilerConfig(warmup=2, iters=5),
+                          devices=[dev])
+    out = CAL / "tpu_v5e_profiles"
+    out.mkdir(exist_ok=True)
+    paths = store.dump_to_dir(out, extra_model_fields={
+        "captured_at": _now(),
+        "device_kind": dev.device_kind,
+        "profiling_wall_s": round(time.perf_counter() - t0, 1),
+    })
+    print(f"profiles: {len(paths)} files -> {out}")
+
+
+def capture_remat() -> None:
+    from metis_tpu.core.config import ModelSpec
+    from metis_tpu.profiles.profiler import measure_remat_fraction
+
+    dev = _device()
+    frac = measure_remat_fraction(ModelSpec(**MODEL_KW), device=dev, bs=2,
+                                  warmup=2, iters=7)
+    rec = {"remat_fwd_fraction": frac, "device_kind": dev.device_kind,
+           "model": MODEL_KW, "captured_at": _now()}
+    (CAL / "tpu_remat_fraction.json").write_text(json.dumps(rec, indent=1))
+    print(f"remat_fwd_fraction (v5e): {frac:.4f}")
+
+
+def capture_validation_sweep(top_k: int = 6) -> None:
+    from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+    from metis_tpu.core.config import ModelSpec, SearchConfig
+    from metis_tpu.planner import plan_uniform
+    from metis_tpu.profiles.store import ProfileStore
+    from metis_tpu.validation import validate_planner_choice
+
+    dev = _device()
+    model = ModelSpec(**MODEL_KW)
+    store = ProfileStore.from_dir(CAL / "tpu_v5e_profiles")
+    dtype = store.device_types[0]
+    cluster = ClusterSpec(nodes=(NodeSpec(dtype, 1),),
+                          devices={dtype: DeviceSpec(dtype, 16, 100, 25)})
+    # gbs=8 (not 16): the shared chip's free HBM OOMed on the mbs-16 plan's
+    # fp32 logits + adam state; every gbs-8 plan fits
+    result = plan_uniform(cluster, store, model,
+                          SearchConfig(gbs=8, max_profiled_tp=1,
+                                       max_profiled_bs=max(BSS)),
+                          include_oom=True)
+    reports = validate_planner_choice(result.plans, model, [dev],
+                                      top_k=top_k, steps=8, warmup=2)
+    if not reports:
+        (CAL / "tpu_validation_sweep.json").write_text(json.dumps(
+            {"device": dev.device_kind, "model": MODEL_KW,
+             "no_validatable_plans": True, "captured_at": _now()}, indent=1))
+        print("validation sweep: no validatable plans")
+        return
+    errs = [r.abs_error_pct for r in reports]
+    rec = {
+        "device": dev.device_kind,
+        "model": MODEL_KW,
+        "profiles": "calibration/tpu_v5e_profiles (measured on this chip)",
+        "plans": [r.to_json_dict() for r in reports],
+        "mean_abs_error_pct": round(sum(errs) / len(errs), 1),
+        "max_abs_error_pct": round(max(errs), 1),
+        "captured_at": _now(),
+    }
+    (CAL / "tpu_validation_sweep.json").write_text(json.dumps(rec, indent=1))
+    print(f"validation sweep: {len(reports)} plans, "
+          f"mean |err| {rec['mean_abs_error_pct']}%, "
+          f"max {rec['max_abs_error_pct']}%")
+
+
+def capture_flash_blocks() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from metis_tpu.ops.flash_attention import (
+        dense_causal_attention, flash_attention)
+
+    dev = _device()
+    b, h, d = 4, 8, 128
+    results: dict = {"device": dev.device_kind, "shape_bhd": [b, h, d],
+                     "captured_at": _now(), "sweep": []}
+
+    def timed(fn, *args, iters=32):
+        # The tunnel charges ~4.6ms dispatch per host->device call (measured
+        # null-op floor) — larger than the kernels under test.  Run the
+        # iteration loop ON DEVICE (fori_loop chaining through the first
+        # arg) so one dispatch covers all iters; warm up with device_get,
+        # not block_until_ready (the tunnel's block_until_ready returns
+        # before remote execution finishes and compile time would leak in).
+        import jax.lax as lax
+
+        def body(_, x):
+            return fn(x, *args[1:])
+
+        looped = jax.jit(lambda x: lax.fori_loop(0, iters, body, x))
+        for _ in range(2):
+            float(jax.device_get(looped(args[0]).sum()))
+        t0 = time.perf_counter()
+        float(jax.device_get(looped(args[0]).sum()))
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    for seq in (1024, 2048):
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                     (b, h, seq, d), jnp.bfloat16)
+                   for i in range(3))
+
+        def fwdbwd(attn):
+            def loss(q):
+                return attn(q, k, v).astype(jnp.float32).sum()
+
+            g = jax.jit(jax.grad(loss))
+            return lambda q: g(q)
+
+        dense_ms = timed(fwdbwd(dense_causal_attention), q)
+        results["sweep"].append(
+            {"seq": seq, "impl": "dense_xla", "ms": round(dense_ms, 3)})
+        for bq in (128, 256, 512):
+            for bkv in (128, 256, 512):
+                if bq > seq or bkv > seq:
+                    continue
+
+                def attn(q, k, v, bq=bq, bkv=bkv):
+                    return flash_attention(q, k, v, causal=True,
+                                           block_q=bq, block_kv=bkv)
+
+                try:
+                    ms = timed(fwdbwd(attn), q)
+                    entry = {"seq": seq, "impl": "flash", "block_q": bq,
+                             "block_kv": bkv, "ms": round(ms, 3),
+                             "vs_dense": round(dense_ms / ms, 2)}
+                except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                    entry = {"seq": seq, "impl": "flash", "block_q": bq,
+                             "block_kv": bkv,
+                             "failed": f"{type(e).__name__}: {e}"[:120]}
+                results["sweep"].append(entry)
+
+    flash_ok = [e for e in results["sweep"]
+                if e["impl"] == "flash" and "ms" in e]
+    # per-seq winners — ms is not comparable across seqs for O(seq^2)
+    # attention, so a single cross-seq "best" would just be one seq's winner
+    by_seq = {}
+    for e in flash_ok:
+        cur = by_seq.get(e["seq"])
+        if cur is None or e["ms"] < cur["ms"]:
+            by_seq[e["seq"]] = e
+    if by_seq:
+        results["best"] = {str(s): by_seq[s] for s in sorted(by_seq)}
+    (CAL / "tpu_flash_blocks.json").write_text(json.dumps(results, indent=1))
+    print(f"flash blocks: {len(flash_ok)} configs timed; "
+          f"best {results.get('best')}")
+
+
+SECTIONS = {
+    "profiles": capture_profiles,
+    "remat": capture_remat,
+    "validation": capture_validation_sweep,
+    "flash": capture_flash_blocks,
+}
+
+
+def main() -> int:
+    import subprocess
+
+    wanted = sys.argv[1:] or list(SECTIONS)
+    if len(wanted) == 1:
+        name = wanted[0]
+        t0 = time.perf_counter()
+        try:
+            SECTIONS[name]()
+        except Exception as e:  # noqa: BLE001 — independent sections
+            print(f"{name} FAILED: {type(e).__name__}: {e}")
+            return 1
+        finally:
+            print(f"[{name}: {time.perf_counter() - t0:.0f}s]")
+        return 0
+    # One subprocess per section: a device OOM poisons the backend for the
+    # rest of the process (observed: every later section fails instantly),
+    # so isolation keeps one failure from erasing the others' artifacts.
+    failures = 0
+    for name in wanted:
+        rc = subprocess.run([sys.executable, __file__, name]).returncode
+        failures += rc != 0
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
