@@ -138,12 +138,20 @@ class _EstimatorBase:
 
     def _batch_gen_ms(self, count: int, device_type: str | None = None) -> float:
         """Input-pipeline cost; native mode reads the feeding stage's device
-        type (the host attached to stage 0's chips generates batches)."""
+        type (the host attached to stage 0's chips generates batches).
+
+        Strict-compat charges it per microbatch (``count``x), matching the
+        reference (``cost_estimator.py:34-35``).  Native mode charges it ONCE
+        per step: our executors build the global batch on host and
+        microbatch-split on device (``execution.microbatch_split`` feeding a
+        ``lax.scan``), so the pipeline does not re-run per microbatch.  The
+        on-chip validation sweep pinned this: measured step time is flat in
+        the microbatch count while per-microbatch charging bent predictions
+        up at small mbs (calibration/tpu_validation_sweep.json)."""
         if self.options.strict_compat or device_type is None:
             per = self.profiles.model.batch_generator_ms
-        else:
-            per = self.profiles.type_meta[device_type].batch_generator_ms
-        return per * count
+            return per * count
+        return self.profiles.type_meta[device_type].batch_generator_ms
 
 
 class UniformCostEstimator(_EstimatorBase):

@@ -174,3 +174,38 @@ class TestEstimators:
         plan = InterStagePlan(("tpu_v4", "tpu_v5e"), (32, 16), 8, 128)
         cost = est.get_cost(plan, (Strategy(8, 4), Strategy(4, 4)), (0, 6, 10))
         assert cost.total_ms > 0
+
+
+class TestBatchGenCharging:
+    """Native mode charges the input pipeline once per step; strict-compat
+    keeps the reference's per-microbatch charge (``cost_estimator.py:34-35``).
+    Pinned by the on-chip validation sweep: measured step time is flat in the
+    microbatch count (calibration/tpu_validation_sweep.json)."""
+
+    def test_strict_scales_with_microbatches(self, cluster, profiles, volume):
+        est = UniformCostEstimator(
+            cluster, profiles, volume, EstimatorOptions(strict_compat=True))
+        c_mbs4 = est.get_cost(UniformPlan(dp=4, pp=1, tp=2, mbs=4, gbs=128), "A100")
+        c_mbs8 = est.get_cost(UniformPlan(dp=4, pp=1, tp=2, mbs=8, gbs=128), "A100")
+        # 8 microbatches vs 4 -> 2x the charge
+        assert c_mbs4.batch_gen_ms == pytest.approx(2 * c_mbs8.batch_gen_ms)
+        assert c_mbs8.batch_gen_ms > 0
+
+    def test_native_charges_once_per_step(self, cluster, profiles, volume):
+        est = UniformCostEstimator(
+            cluster, profiles, volume, EstimatorOptions(strict_compat=False))
+        costs = [
+            est.get_cost(UniformPlan(dp=4, pp=1, tp=2, mbs=m, gbs=128), "A100")
+            for m in (2, 4, 8)]
+        assert costs[0].batch_gen_ms > 0
+        for c in costs[1:]:
+            assert c.batch_gen_ms == pytest.approx(costs[0].batch_gen_ms)
+
+    def test_native_hetero_charges_once(self, cluster, profiles, volume):
+        est = HeteroCostEstimator(
+            cluster, profiles, volume, EstimatorOptions(strict_compat=False))
+        plan_b8 = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
+        plan_b4 = InterStagePlan(("T4", "A100"), (8, 8), 4, 128)
+        c8 = est.get_cost(plan_b8, (Strategy(4, 2), Strategy(4, 2)), (0, 4, 10))
+        c4 = est.get_cost(plan_b4, (Strategy(4, 2), Strategy(4, 2)), (0, 4, 10))
+        assert c8.batch_gen_ms == pytest.approx(c4.batch_gen_ms)

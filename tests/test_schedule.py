@@ -312,10 +312,15 @@ class TestPlannerIntegration:
         assert len(set(blocks)) > 1 and sum(blocks) == 8
 
     def test_uneven_1f1b_wins_memory_tight_workload(self):
-        """At 1 GB/device the gpipe families' M-microbatch activation peak
-        is infeasible and the uneven 5-stage 1f1b plan is the search
-        OPTIMUM — the plan class the divisibility gate used to lose."""
-        res = self._plan10(1.0, slots=5)
+        """At 0.5 GB/device the gpipe families' M-microbatch activation peak
+        is infeasible (every gpipe plan prunes) and the uneven 5-stage 1f1b
+        plan is the search OPTIMUM — the plan class the divisibility gate
+        used to lose.  (1 GB was the old point: there a 4-microbatch gpipe
+        plan stayed feasible and the 1f1b "win" rode on the 0.2 ms
+        per-microbatch batch-gen charge that native pricing no longer
+        levies — a pricing artifact, not the memory-feasibility win this
+        test is about.)"""
+        res = self._plan10(0.5, slots=5)
         assert res.best is not None
         assert res.best.intra.schedule == "1f1b"
         assert res.best.inter.num_stages == 5
