@@ -40,6 +40,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # large-negative mask value; -inf would make exp(m-m) = nan
 
+# Shipped default tiling — measured on-chip (tools/tpu_deep_capture.py,
+# calibration/tpu_flash_blocks.json, TPU v5 lite): within noise of the
+# per-seq optimum at seq 1024 AND 2048.  The single source of truth:
+# ring_attention.py and tools/mosaic_aot_check.py import these, so a retune
+# here propagates everywhere (including the AOT compile gate).
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_KV = 1024
+
 
 def _out_vma(*arrays) -> frozenset:
     """Union of the inputs' varying-mesh-axes — pallas outputs inside a
@@ -415,20 +423,21 @@ def _dense_full_attention(q, k, v):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal=True, block_q=512, block_kv=512,
-                    interpret=False):
+def flash_attention(q, k, v, *, causal=True, block_q=DEFAULT_BLOCK_Q,
+                    block_kv=DEFAULT_BLOCK_KV, interpret=False):
     """Blockwise attention on [b, h, s, d] inputs; differentiable.
 
     Falls back to the dense jnp path when shapes don't tile (seq without a
     multiple-of-8 divisor, or head_dim not a multiple of 8) so callers can use
     it unconditionally as an ``AttnFn``.
 
-    Default tiling (512, 512) is measured, not guessed: the on-chip sweep
+    Default tiling (512, 1024) is measured, not guessed: the on-chip sweep
     (``tools/tpu_deep_capture.py``, calibration/tpu_flash_blocks.json,
-    TPU v5 lite, fwd+bwd, on-device loop timing) has it fastest at both
-    seq 1024 and 2048 — 1.3-1.7x the XLA dense path and ~2x the (128, 128)
-    tiling this module shipped with.  ``_pick_block`` clamps per-shape, so
-    short sequences still tile correctly.
+    TPU v5 lite, fwd+bwd, on-device loop timing, 128-through-1024 grid) has
+    it within noise of the per-seq optimum at both seq 1024 and 2048 —
+    1.28-1.82x the XLA dense path and ~2x the (128, 128) tiling this module
+    shipped with.  ``_pick_block`` clamps per-shape, so short sequences
+    still tile correctly.
     """
     blocks = _shapes_supported(q, k, block_q, block_kv)
     if blocks is None:
@@ -437,8 +446,8 @@ def flash_attention(q, k, v, *, causal=True, block_q=512, block_kv=512,
     return _flash(q, k, v, causal, blocks[0], blocks[1], interpret)
 
 
-def flash_attention_stats(q, k, v, *, causal=False, block_q=512,
-                          block_kv=512, interpret=False):
+def flash_attention_stats(q, k, v, *, causal=False, block_q=DEFAULT_BLOCK_Q,
+                          block_kv=DEFAULT_BLOCK_KV, interpret=False):
     """Forward-only blockwise attention returning the raw online-softmax
     state ``(acc, m, l)``: acc [b, h, s, d] fp32 *unnormalized*, m and l
     [b, h, s] fp32.  States from disjoint KV shards merge with
@@ -474,7 +483,8 @@ def finalize_stats(state):
     return acc / jnp.where(l == 0.0, 1.0, l)[..., None]
 
 
-def flash_attn_fn(*, interpret=False, block_q=512, block_kv=512):
+def flash_attn_fn(*, interpret=False, block_q=DEFAULT_BLOCK_Q,
+                  block_kv=DEFAULT_BLOCK_KV):
     """An ``AttnFn`` (q, k, v -> context) for models.gpt, causal."""
     def attn(q, k, v):
         return flash_attention(q, k, v, causal=True, block_q=block_q,

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metis_tpu.ops.flash_attention import (
+    DEFAULT_BLOCK_KV,
+    DEFAULT_BLOCK_Q,
     NEG_INF,
     _fa_bwd_call,
     _fold,
@@ -248,8 +250,9 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_attention_local(q, k, v, axis_name: str, impl: str = "pallas",
-                         interpret: bool = False, block_q: int = 512,
-                         block_kv: int = 512):
+                         interpret: bool = False,
+                         block_q: int = DEFAULT_BLOCK_Q,
+                         block_kv: int = DEFAULT_BLOCK_KV):
     """The per-device body: causal attention with K/V rotating over
     ``axis_name``.  Call inside shard_map with q/k/v sequence-sharded on that
     axis.  q, k, v: [b, h, s_local, d].  With ``impl="pallas"``, tileable

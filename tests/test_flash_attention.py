@@ -45,6 +45,28 @@ def test_forward_uneven_blocks():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_forward_wide_kv_blocks():
+    # block_kv > block_q — the ORIENTATION of the shipped default tiling
+    # (DEFAULT_BLOCK_Q=512 < DEFAULT_BLOCK_KV=1024): a KV block then spans
+    # multiple Q blocks, so the causal skip predicate must keep diagonal
+    # blocks that are only PARTIALLY in the future, and the element mask
+    # must zero exactly the upper-triangular remainder.  fwd AND grad.
+    q, k, v = _qkv(jax.random.PRNGKey(5), s=64, d=16)
+    got = flash_attention(q, k, v, block_q=16, block_kv=32, interpret=True)
+    want = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_f(fn):
+        return lambda q: fn(q).sum()
+
+    gf = jax.grad(loss_f(lambda q: flash_attention(
+        q, k, v, block_q=16, block_kv=32, interpret=True)))(q)
+    gd = jax.grad(loss_f(lambda q: causal_attention(q, k, v)))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_forward_noncausal():
     q, k, v = _qkv(jax.random.PRNGKey(2), s=64, d=16)
     got = flash_attention(q, k, v, causal=False, block_q=32, block_kv=32,

@@ -31,10 +31,13 @@ sys.path.insert(0, str(REPO))
 
 # Shapes mirror the bench/tpu_step workload: bf16, 128-head-dim, long seq.
 BH, SEQ, HEAD_DIM = 4, 1024, 128
-# Compile at the SHIPPED default tiling (ops/flash_attention.py — (512, 512),
-# tuned on-chip, calibration/tpu_flash_blocks.json): the gate must certify
-# the configuration callers actually run, not a legacy one.
-BLOCK = 512
+# Compile at the SHIPPED default tiling — imported from the single source
+# of truth (ops/flash_attention.py), so the gate always certifies the
+# configuration callers actually run, even after a retune.
+def _default_blocks():
+    from metis_tpu.ops.flash_attention import (
+        DEFAULT_BLOCK_KV, DEFAULT_BLOCK_Q)
+    return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV
 TOPOLOGY_CANDIDATES = (
     # (topology_name, kwargs) — v5e first (the tunnel chip), then v4.
     ("v5e:2x2", {}),
@@ -73,13 +76,13 @@ def _kernel_cases(dev):
 
     def fwd_case():
         fn = functools.partial(
-            fa._fa_call, causal=True, block_q=BLOCK, block_kv=BLOCK,
+            fa._fa_call, causal=True, block_q=BLOCK_Q, block_kv=BLOCK_KV,
             interpret=False, normalize=True, return_stats=False)
         return fn, qkv()
 
     def fwd_stats_case():
         fn = functools.partial(
-            fa._fa_call, causal=False, block_q=BLOCK, block_kv=BLOCK,
+            fa._fa_call, causal=False, block_q=BLOCK_Q, block_kv=BLOCK_KV,
             interpret=False, normalize=False, return_stats=True)
         return fn, qkv()
 
@@ -88,10 +91,10 @@ def _kernel_cases(dev):
 
         def run(q, k, v, do, lse, delta):
             return fa._fa_bwd_call(q, k, v, do, lse, delta, causal=True,
-                                   block_q=BLOCK, block_kv=BLOCK,
+                                   block_q=BLOCK_Q, block_kv=BLOCK_KV,
                                    interpret=False)
-        q_steps = SEQ // BLOCK
-        stats = jax.ShapeDtypeStruct((BH * q_steps, 1, BLOCK), jnp.float32)
+        q_steps = SEQ // BLOCK_Q
+        stats = jax.ShapeDtypeStruct((BH * q_steps, 1, BLOCK_Q), jnp.float32)
         return run, qkv() + [jax.ShapeDtypeStruct(
             (BH, SEQ, HEAD_DIM), jnp.bfloat16), stats, stats]
 
@@ -134,12 +137,14 @@ def main(argv=None) -> int:
 
     # never touch a (possibly wedged) real backend: this is compile-only
     jax.config.update("jax_platforms", "cpu")
+    global BLOCK_Q, BLOCK_KV
+    BLOCK_Q, BLOCK_KV = _default_blocks()
 
     record: dict = {
         "jax": jax.__version__,
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "shapes": {"bh": BH, "seq": SEQ, "head_dim": HEAD_DIM,
-                   "dtype": "bfloat16", "block": BLOCK},
+                   "dtype": "bfloat16", "block_q": BLOCK_Q, "block_kv": BLOCK_KV},
     }
     topo_name, topo, errs = _topology()
     record["topology_errors"] = errs

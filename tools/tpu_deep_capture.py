@@ -175,8 +175,8 @@ def capture_flash_blocks() -> None:
         dense_ms = timed(fwdbwd(dense_causal_attention), q)
         results["sweep"].append(
             {"seq": seq, "impl": "dense_xla", "ms": round(dense_ms, 3)})
-        for bq in (128, 256, 512):
-            for bkv in (128, 256, 512):
+        for bq in (128, 256, 512, 1024):
+            for bkv in (128, 256, 512, 1024):
                 if bq > seq or bkv > seq:
                     continue
 
