@@ -129,3 +129,135 @@ def test_hetero_planner_to_validator_composes():
     assert report.predicted_ms == pytest.approx(ranked.cost.total_ms)
     assert report.to_json_dict()["plan"]["strategies"]
     assert 0.001 < report.predicted_ms / report.measured_ms < 1000
+
+
+class TestFeaturesLooCalibrated:
+    """LOO nonnegative least squares over arbitrary feature columns — the
+    stage-aware contention model for the multi-mesh hetero executor."""
+
+    @staticmethod
+    def _report(pred, meas, batches, stages):
+        from metis_tpu.validation import HeteroValidationReport
+
+        return HeteroValidationReport(
+            plan_dict={"batches": batches, "num_stages": stages},
+            predicted_ms=pred, measured_ms=meas, steps=3)
+
+    @staticmethod
+    def _features():
+        return ([lambda r: r.predicted_ms * r.plan_dict["num_stages"],
+                 lambda r: r.plan_dict["batches"] * r.plan_dict["num_stages"]],
+                ["pred_x_stages", "batches_x_stages"])
+
+    def test_recovers_generating_model(self):
+        from metis_tpu.validation import features_loo_calibrated
+
+        # measured = 3 * pred * stages + 50 * batches * stages, exactly
+        pts = [(100.0, 2, 2), (120.0, 4, 2), (110.0, 4, 3), (90.0, 2, 3),
+               (105.0, 8, 2), (95.0, 8, 3)]
+        reports = [self._report(p, 3 * p * s + 50 * b * s, b, s)
+                   for p, b, s in pts]
+        feats, names = self._features()
+        fit, held = features_loo_calibrated(reports, feats, names)
+        assert fit["mode"] == "features_loo"
+        assert fit["coefficients"]["pred_x_stages"] == pytest.approx(3.0, abs=1e-6)
+        assert fit["coefficients"]["batches_x_stages"] == pytest.approx(50.0, abs=1e-4)
+        # noiseless generating model => every held-out error ~0
+        assert all(r.abs_error_pct < 1e-6 for r in held)
+
+    def test_held_out_scoring_excludes_self(self):
+        from metis_tpu.validation import features_loo_calibrated
+
+        # 5 consistent points + 1 wild outlier: the outlier's held-out error
+        # must stay large — it is scored by the fit that EXCLUDED it, so it
+        # cannot vote for itself.  (A least-squares fit is not robust: the
+        # outlier legitimately drags the OTHER points' LOO fits, so no
+        # assertion is made about them; the noiseless case above already
+        # pins that consistent data scores ~0.)
+        pts = [(100.0, 2, 2), (120.0, 4, 2), (110.0, 4, 3), (90.0, 2, 3),
+               (105.0, 8, 2)]
+        reports = [self._report(p, 3 * p * s + 50 * b * s, b, s)
+                   for p, b, s in pts]
+        reports.append(self._report(100.0, 5000.0, 2, 2))  # outlier
+        feats, names = self._features()
+        _, held = features_loo_calibrated(reports, feats, names)
+        assert held[-1].abs_error_pct > 50
+
+    def test_nonnegative_coefficients(self):
+        from metis_tpu.validation import features_loo_calibrated
+
+        # anti-correlated feature: plain lstsq would go negative; nnls clamps
+        pts = [(100.0, 2, 2), (120.0, 4, 2), (110.0, 4, 3), (90.0, 2, 3)]
+        reports = [self._report(p, 2 * p * s, b, s) for p, b, s in pts]
+        feats, names = self._features()
+        fit, _ = features_loo_calibrated(reports, feats, names)
+        assert all(c >= 0 for c in fit["coefficients"].values())
+
+    def test_small_sample_falls_back(self):
+        from metis_tpu.validation import features_loo_calibrated
+
+        reports = [self._report(100.0, 300.0, 2, 2),
+                   self._report(120.0, 380.0, 4, 2),
+                   self._report(110.0, 340.0, 4, 3)]
+        feats, names = self._features()
+        fit, held = features_loo_calibrated(reports, feats, names)
+        # 3 reports < len(features) + 2: must fall back, not interpolate
+        assert fit["mode"] != "features_loo"
+
+
+class TestSelectLooCalibrated:
+    @staticmethod
+    def _report(pred, meas, batches, stages):
+        from metis_tpu.validation import HeteroValidationReport
+
+        return HeteroValidationReport(
+            plan_dict={"batches": batches, "num_stages": stages},
+            predicted_ms=pred, measured_ms=meas, steps=3)
+
+    def test_picks_generating_candidate_and_reports_all(self):
+        from metis_tpu.validation import select_loo_calibrated
+
+        # data generated by the stage-contention model: selection must pick
+        # it and must expose every candidate's held-out mean
+        pts = [(100.0, 2, 2), (120.0, 4, 2), (110.0, 4, 3), (90.0, 2, 3),
+               (105.0, 8, 2), (95.0, 8, 3)]
+        reports = [self._report(p, 3 * p * s + 50 * b * s, b, s)
+                   for p, b, s in pts]
+        fit, held = select_loo_calibrated(reports)
+        assert fit["mode"] == "select_loo"
+        assert fit["selected"] == "stage_contention"
+        assert set(fit["candidate_means_pct"]) == {
+            "scalar", "affine_const", "affine_batches", "stage_contention"}
+        assert all(r.abs_error_pct < 1e-6 for r in held)
+
+    def test_picks_affine_when_overhead_constant(self):
+        from metis_tpu.validation import select_loo_calibrated
+
+        pts = [(100.0, 2, 2), (120.0, 4, 2), (110.0, 4, 2), (90.0, 2, 2),
+               (105.0, 8, 2), (95.0, 8, 2)]
+        reports = [self._report(p, 4 * p + 300.0, b, s) for p, b, s in pts]
+        fit, held = select_loo_calibrated(reports)
+        # affine_const generates the data; stage_contention on all-2-stage
+        # data is (2*pred, 2*batches) — no constant column, so it cannot
+        # represent the 300ms offset; batches varies so affine_batches
+        # cannot absorb it as a pseudo-constant either
+        assert fit["selected"] == "affine_const"
+        assert all(r.abs_error_pct < 1e-6 for r in held)
+
+    def test_too_few_reports_returns_fallback_unrelabeled(self):
+        from metis_tpu.validation import select_loo_calibrated
+
+        # 3 reports: every 2-column candidate would silently fall back to
+        # the same affine model — selection must NOT score phantom
+        # candidates or stamp the fallback as "select_loo"
+        reports = [self._report(100.0, 300.0, 2, 2),
+                   self._report(120.0, 380.0, 4, 2),
+                   self._report(110.0, 340.0, 4, 3)]
+        fit, held = select_loo_calibrated(reports)
+        if fit["mode"] == "select_loo":
+            # only genuinely-fit candidates may appear (scalar, k=1, is the
+            # single candidate with enough reports at n=3)
+            assert set(fit["candidate_means_pct"]) == {"scalar"}
+            assert fit["selected"] == "scalar"
+        else:
+            assert "selected" not in fit
