@@ -510,8 +510,45 @@ def measure_remat_fraction(
     def fwd_bwd(layer, x):
         return jax.value_and_grad(fwd_only, argnums=(0, 1))(layer, x)
 
-    fwd_ms = _median_ms(jax.jit(fwd_only), (layer, x), warmup, iters)
-    fb_ms = _median_ms(jax.jit(fwd_bwd), (layer, x), warmup, iters)
+    # Loop ON DEVICE: a single block's fwd is sub-ms-to-few-ms, far below a
+    # remote tunnel's per-dispatch cost (~4.6ms measured, r4) — the
+    # two-point queue form then measures the host's dispatch RATE for both
+    # closures and the ratio collapses toward 1 (observed: the on-chip
+    # artifact pinned at the 0.6 clamp).  One fori_loop dispatch amortizes
+    # it away; the loss feeds back into the carry at 1e-30 scale so the
+    # body has a data dependency XLA cannot dead-code-eliminate while the
+    # iterates stay numerically fixed.
+    # The in-loop trip count is decoupled from the ``iters`` sample count:
+    # the single dispatch + the final scalar transfer cost ~the tunnel's
+    # per-call overhead ONCE per sample, so >=32 trips amortize it to
+    # <0.2ms/trip — dividing by a small ``iters`` would leave ~1ms/trip of
+    # constant overhead in BOTH closures and bias the ratio toward 1.
+    trips = max(iters, 32)
+
+    def looped(fn):
+        def body(_, carry):
+            out = fn(layer, carry)
+            # EVERY leaf feeds the carry: with only the forward value live,
+            # XLA dead-code-eliminates the untouched gradients and
+            # fwd_bwd would time just its forward (the 0.6-clamp artifact
+            # this function exists to avoid)
+            s = sum(jnp.sum(leaf).astype(jnp.float32)
+                    for leaf in jax.tree.leaves(out))
+            return carry + (s * 1e-30).astype(carry.dtype)
+
+        run = jax.jit(
+            lambda x0: jax.lax.fori_loop(0, trips, body, x0).sum())
+        for _ in range(max(warmup, 1)):
+            float(jax.device_get(run(x)))  # device_get: tunnel-safe sync
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(jax.device_get(run(x)))
+            samples.append((time.perf_counter() - t0) / trips * 1e3)
+        return float(np.median(samples))
+
+    fwd_ms = looped(fwd_only)
+    fb_ms = looped(fwd_bwd)
     if fb_ms <= 0:
         return 1.0 / 3.0
     return float(np.clip(fwd_ms / fb_ms, 0.15, 0.6))
