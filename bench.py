@@ -505,13 +505,21 @@ def tpu_step(record: dict) -> None:
 # ---------------------------------------------------------------------------
 
 
-def repeat_measure_fit(measure_and_fit, repeats: int = 3):
+def repeat_measure_fit(measure_and_fit, repeats: int = 3, apply_fit=None):
     """Run a (measure plans, fit calibration, hold out) closure ``repeats``
-    times and return ``(median_run, means)`` — the median-by-held-out-mean
-    run is the canonical record, the per-repeat means expose the spread
-    (a lucky single run must not masquerade as fidelity — VERDICT r3 #3).
+    times and return ``(median_run, means, selection_free)`` — the
+    median-by-held-out-mean run is the canonical record, the per-repeat
+    means expose the spread (a lucky single run must not masquerade as
+    fidelity — VERDICT r3 #3).
     ``measure_and_fit() -> (fit, held_out, reports)`` with held_out
-    carrying ``abs_error_pct``."""
+    carrying ``abs_error_pct``.
+
+    When ``apply_fit(fit, reports) -> scored_reports`` is given, each
+    repeat's frozen fit is additionally applied VERBATIM to the next
+    repeat's raw reports (cyclically) — fit and selection from one
+    measurement episode, scoring on a disjoint episode, so the returned
+    ``selection_free`` means carry none of the per-run LOO model-selection
+    optimism (VERDICT r4 weak #3)."""
     runs = []
     for _ in range(repeats):
         fit, held_out, reports = measure_and_fit()
@@ -521,7 +529,38 @@ def repeat_measure_fit(measure_and_fit, repeats: int = 3):
     means = [m for (_, m) in runs if m is not None]
     mid = sorted(range(len(runs)),
                  key=lambda i: runs[i][1] or 0.0)[len(runs) // 2]
-    return runs[mid][0], means
+
+    selection_free = None
+    if apply_fit is not None and len(runs) >= 2:
+        sf_means, sf_max, failed = [], 0.0, []
+        for i in range(len(runs)):
+            (fit_i, _, _), _ = runs[i]
+            (_, _, reports_next), _ = runs[(i + 1) % len(runs)]
+            try:
+                scored = apply_fit(fit_i, reports_next)
+            except Exception as e:  # noqa: BLE001 — record, don't hide
+                failed.append(f"{type(e).__name__}: {e}"[:120])
+                continue
+            if not scored:
+                failed.append("empty scored set")
+                continue
+            errs = [r.abs_error_pct for r in scored]
+            sf_means.append(round(sum(errs) / len(errs), 1))
+            sf_max = max(sf_max, max(errs))
+        selection_free = {
+            "note": "each repeat's frozen fit applied verbatim to the "
+                    "NEXT repeat's measurements — no refit, no "
+                    "selection on the scored episode",
+            "repeat_means_pct": sf_means,
+            "mean_abs_error_pct": (sorted(sf_means)[len(sf_means) // 2]
+                                   if sf_means else None),
+            "max_abs_error_pct": round(sf_max, 1) if sf_means else None,
+        }
+        if failed:
+            # no silent truncation: a missing fold is visible, and an
+            # all-folds failure reads as an error, not "not computed"
+            selection_free["failed_folds"] = failed
+    return runs[mid][0], means, selection_free
 
 
 def validation_error(record: dict) -> None:
@@ -626,8 +665,18 @@ def validation_error(record: dict) -> None:
                     held_out.extend(held)
             return factors, held_out, reports
 
-        (factors, held_out, reports), means = repeat_measure_fit(
-            measure_and_fit_uniform)
+        from metis_tpu.validation import apply_frozen_fit
+
+        def apply_uniform_fit(factors_i, reports_j):
+            scored = []
+            for famname, fam_fit in factors_i.items():
+                rs = [r for r in reports_j if exec_family(r) == famname]
+                if rs:
+                    scored.extend(apply_frozen_fit(fam_fit, rs))
+            return scored
+
+        (factors, held_out, reports), means, sf_uniform = repeat_measure_fit(
+            measure_and_fit_uniform, apply_fit=apply_uniform_fit)
         fitted_on = [r.to_json_dict() for r in reports
                      if not any(h.plan is r.plan for h in held_out)]
         record["validation"] = {
@@ -657,6 +706,7 @@ def validation_error(record: dict) -> None:
                                   if held_out else None),
             "mean_abs_error_pct": (sorted(means)[len(means) // 2]
                                    if means else None),
+            "selection_free": sf_uniform,
         }
 
     except Exception as e:
@@ -708,8 +758,9 @@ def validation_error(record: dict) -> None:
             fit_h, held_out_h = select_loo_calibrated(reports_h)
             return fit_h, held_out_h, reports_h
 
-        (fit_h, held_out_h, reports_h), means_h = repeat_measure_fit(
-            measure_and_fit_hetero)
+        (fit_h, held_out_h, reports_h), means_h, sf_hetero = \
+            repeat_measure_fit(measure_and_fit_hetero,
+                               apply_fit=apply_frozen_fit)
         record["validation"]["hetero_fit"] = {
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in fit_h.items()}
@@ -731,6 +782,7 @@ def validation_error(record: dict) -> None:
                 max(r.abs_error_pct for r in held_out_h), 1)
             record["validation"]["hetero_mean_abs_error_pct"] = \
                 sorted(means_h)[len(means_h) // 2]
+        record["validation"]["hetero_selection_free"] = sf_hetero
     except Exception as e:
         # the homogeneous results above are already recorded — keep them
         record["validation"]["hetero_skipped"] = \
@@ -1007,6 +1059,8 @@ def main() -> None:
     deep: dict = {}
     for key, fname in (("remat", "tpu_remat_fraction.json"),
                        ("validation_sweep", "tpu_validation_sweep.json"),
+                       ("validation_matrix", "tpu_validation_matrix.json"),
+                       ("flagship", "tpu_flagship.json"),
                        ("flash_blocks", "tpu_flash_blocks.json")):
         p = cal / fname
         if p.exists():
@@ -1014,12 +1068,13 @@ def main() -> None:
                 deep[key] = json.loads(p.read_text())
             except (OSError, json.JSONDecodeError):
                 pass
-    prof_dir = cal / "tpu_v5e_profiles"
-    if prof_dir.is_dir():
-        files = sorted(p.name for p in prof_dir.glob("*.json"))
-        if files:
-            deep["profiles"] = {"dir": "calibration/tpu_v5e_profiles",
-                                "files": files}
+    for key, sub in (("profiles", "tpu_v5e_profiles"),
+                     ("profiles_flash", "tpu_v5e_profiles_flash")):
+        prof_dir = cal / sub
+        if prof_dir.is_dir():
+            files = sorted(p.name for p in prof_dir.glob("*.json"))
+            if files:
+                deep[key] = {"dir": f"calibration/{sub}", "files": files}
     if deep:
         record["tpu_deep"] = deep
     # The driver captures only a ~2000-char tail of stdout (round 2/3
@@ -1058,9 +1113,14 @@ def _headline(record: dict) -> dict:
         "uniform_mean_abs_error_pct": val.get("mean_abs_error_pct"),
         "uniform_repeat_means_pct": val.get("repeat_means_pct"),
         "uniform_max_abs_error_pct": val.get("max_abs_error_pct"),
+        "uniform_selection_free_mean_pct": (
+            (val.get("selection_free") or {}).get("mean_abs_error_pct")),
         "hetero_mean_abs_error_pct": val.get("hetero_mean_abs_error_pct"),
         "hetero_repeat_means_pct": val.get("hetero_repeat_means_pct"),
         "hetero_max_abs_error_pct": val.get("hetero_max_abs_error_pct"),
+        "hetero_selection_free_mean_pct": (
+            (val.get("hetero_selection_free") or {}).get(
+                "mean_abs_error_pct")),
         "validation_skipped": val.get("skipped"),
         "northstar_gap_pct": ns.get("gap_vs_exhaustive_pct"),
         "northstar_beam_s": ns.get("beam_s"),
@@ -1071,6 +1131,14 @@ def _headline(record: dict) -> dict:
         "tpu_sweep_mean_err_pct": ((record.get("tpu_deep") or {})
                                    .get("validation_sweep") or {})
         .get("mean_abs_error_pct"),
+        "tpu_matrix_mean_err_pct": ((record.get("tpu_deep") or {})
+                                    .get("validation_matrix") or {})
+        .get("mean_abs_error_pct"),
+        "tpu_matrix_max_err_pct": ((record.get("tpu_deep") or {})
+                                   .get("validation_matrix") or {})
+        .get("max_abs_error_pct"),
+        "tpu_flagship": (((record.get("tpu_deep") or {})
+                          .get("flagship") or {}).get("flagship")),
         "tpu_flash_best": ((record.get("tpu_deep") or {})
                            .get("flash_blocks") or {}).get("best"),
         "mosaic_aot": (record.get("mosaic_aot") or {}).get("status"),

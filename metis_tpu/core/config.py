@@ -35,6 +35,12 @@ class ModelSpec:
     # GPT shape (``arguments.py:23-28``)
     family: str = "gpt"
     num_kv_heads: int = 0  # GQA KV heads for family="llama"; 0 -> num_heads
+    # attention implementation the executors AND the profiler use: "dense"
+    # (materialized scores) or "flash" (pallas blockwise kernel).  Part of the
+    # model spec, not a runtime flag, so profiles/plans/validation all
+    # describe the execution that actually runs (the reference's profile
+    # contract intent, ``README.md:41-59``).
+    attn: str = "dense"
 
     def __post_init__(self) -> None:
         if self.num_layers < 3:
@@ -49,6 +55,8 @@ class ModelSpec:
             raise ValueError(f"unknown model family {self.family!r}")
         if self.num_kv_heads and self.num_heads % self.num_kv_heads != 0:
             raise ValueError("num_kv_heads must divide num_heads")
+        if self.attn not in ("dense", "flash"):
+            raise ValueError(f"unknown attention impl {self.attn!r}")
 
     @property
     def head_dim(self) -> int:
@@ -83,7 +91,12 @@ class SearchConfig:
     min_group_scale_variance: float = 1.0
     max_permute_len: int = 6
     mem_coef: float = 5.0  # ref load_balancer.py:31 fudge factor
-    optimizer_factor: float = 2.0  # ref data_loader.py:19 doubles profiled opt time
+    # Optimizer-time multiplier.  None = auto: 2.0 under strict_compat (the
+    # reference doubles the profiled time at load, data_loader.py:19), 1.0
+    # native (the executors run the profiled adamw update exactly once per
+    # step inside the same jit — the on-chip sweep pins the doubling as a
+    # +5% bias, calibration/tpu_validation_sweep.json).
+    optimizer_factor: float | None = None
     max_partition_attempts: int = 3  # ref load_balancer.py:123
     strict_compat: bool = False
     # TPU extensions (no reference counterpart):

@@ -50,7 +50,10 @@ from metis_tpu.cost.volume import TransformerVolume
 @dataclass(frozen=True)
 class EstimatorOptions:
     strict_compat: bool = False
-    optimizer_factor: float = 2.0   # ref data_loader.py:19
+    # None = auto: 2.0 strict_compat (ref data_loader.py:19), 1.0 native
+    # (the executors run the adamw update once per step — see
+    # SearchConfig.optimizer_factor)
+    optimizer_factor: float | None = None
     max_profiled_bs: int = 16       # ref cost_estimator.py:166 cap
     dp_over_pp_rows: bool = True    # homo: whole pp-row treated as one dp group
     # Measured fraction of the dp gradient all-reduce hidden under backward
@@ -63,6 +66,12 @@ class EstimatorOptions:
     # measured fwd share of a fwd+bwd stage time for remat-schedule pricing
     # (cost/schedule.schedule_execution_ms); None = analytic default
     remat_fwd_fraction: float | None = None
+    # Native mode: affine-smooth the profile's bs axis and charge the fitted
+    # per-program fixed cost once per step instead of once per microbatch
+    # (ProfileStore.affine_view — the executors scan microbatches inside one
+    # jit).  Ignored under strict_compat (the reference charges the raw
+    # profiled time per microbatch).
+    mb_affine: bool = True
 
     @staticmethod
     def from_config(cfg: SearchConfig) -> "EstimatorOptions":
@@ -109,9 +118,26 @@ class _EstimatorBase:
         options: EstimatorOptions,
     ):
         self.cluster = cluster
-        self.profiles = profiles
         self.volume = volume
         self.options = options
+        self._step_overhead: dict[tuple[str, int], float] = {}
+        if options.mb_affine and not options.strict_compat:
+            profiles, self._step_overhead = profiles.affine_view()
+        self.profiles = profiles
+
+    def _step_overhead_ms(
+            self, pairs: Sequence[tuple[str, int]]) -> float:
+        """The fitted per-program fixed cost, charged once per step, maxed
+        over the (device_type, tp) configurations the plan ACTUALLY runs
+        (the slowest participant bounds the critical path).  May be
+        negative: a superlinear-in-bs profile fits a negative intercept,
+        and the affine extrapolation — not the \"fixed overhead\" story —
+        is the contract (it is what makes the predicted step flat in the
+        microbatch count, matching the on-chip measurement)."""
+        if not self._step_overhead:
+            return 0.0
+        return max((self._step_overhead.get(p, 0.0) for p in set(pairs)),
+                   default=0.0)
 
     def _dp_cost_ms(self, param_bytes: float, bw_gbps: float, dp: int) -> float:
         if dp <= 1:
@@ -134,7 +160,10 @@ class _EstimatorBase:
             raw = self.profiles.model.optimizer_time_ms
         else:
             raw = self.profiles.type_meta[device_type].optimizer_time_ms
-        return raw * self.options.optimizer_factor
+        factor = self.options.optimizer_factor
+        if factor is None:
+            factor = 2.0 if self.options.strict_compat else 1.0
+        return raw * factor
 
     def _batch_gen_ms(self, count: int, device_type: str | None = None) -> float:
         """Input-pipeline cost; native mode reads the feeding stage's device
@@ -193,7 +222,8 @@ class UniformCostEstimator(_EstimatorBase):
             self.cluster.nodes[0].device_type if self.options.strict_compat
             else device_type)
         oom = self.cluster.memory_mb(cap_type) < max(stage_memory)
-        execution = (num_mbs - 1) * max(lens) + sum(lens)
+        execution = ((num_mbs - 1) * max(lens) + sum(lens)
+                     + self._step_overhead_ms([(device_type, plan.tp)]))
         optimizer = self._optimizer_ms(device_type) / plan.pp / plan.tp
         # only the measured exposed share of the gradient sync rides the
         # critical path (overlap calibration; serial under strict_compat)
@@ -484,6 +514,29 @@ class HeteroCostEstimator(_EstimatorBase):
         comm_total = cp_total + a2a_total
         cp_cost = comm_delta * cp_total / comm_total if comm_total else 0.0
         ep_cost = comm_delta * a2a_total / comm_total if comm_total else 0.0
+        # fitted per-program fixed cost (after comm_delta so the cp/ep
+        # breakdown split excludes it); pairs limited to the (type, tp)
+        # configurations the stages actually run.  Charged once per step
+        # for RECTANGULAR plans (builder routes them to the gspmd /
+        # shard_map-pipeline executors, which scan microbatches inside one
+        # jit) but once per MICROBATCH for non-rectangular plans — the
+        # multi-mesh executor dispatches each stage's program per
+        # microbatch from a Python loop (execution/hetero.py), so its
+        # per-program cost recurs plan.batches times.
+        overhead_pairs: list[tuple[str, int]] = []
+        for stage_id, strat in enumerate(strategies):
+            r0, r1 = plan.stage_rank_range(stage_id)
+            overhead_pairs.extend((t, strat.tp) for t in set(ranks[r0:r1]))
+        rectangular = (
+            len({(s.dp, s.tp, s.cp, s.ep) for s in strategies}) == 1
+            and len(set(ranks)) <= 1)
+        overhead = self._step_overhead_ms(overhead_pairs)
+        if rectangular:
+            execution += overhead  # signed: the affine extrapolation
+        else:
+            # a real dispatch cannot cost negative time — a noise-negative
+            # intercept must not get amplified by the microbatch count
+            execution += max(overhead, 0.0) * plan.batches
         first_stage_type = ranks[0] if ranks else None
         batch_gen = self._batch_gen_ms(plan.batches, first_stage_type)
 

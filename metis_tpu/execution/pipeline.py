@@ -30,7 +30,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metis_tpu.execution.mesh import DP, PP, TP, gpt_param_specs, shard_params
-from metis_tpu.models.gpt import GPTConfig, _layer_norm, causal_attention, init_params
+from metis_tpu.models.gpt import (
+    GPTConfig, _layer_norm, default_attention, init_params)
 
 # ---------------------------------------------------------------------------
 # Megatron-style manual-collective layers (for use inside shard_map)
@@ -70,7 +71,9 @@ def tp_block_forward(x: jnp.ndarray, layer: dict, cfg: GPTConfig,
         b, s, k_local = t.shape
         return t.reshape(b, s, k_local // hd, hd).transpose(0, 2, 1, 3)
 
-    ctx = causal_attention(heads(q), heads(k), heads(v))
+    # cfg.attn-resolved (dense or flash) — heads are tp-local here, so the
+    # kernel sees [b, nh/t, s, hd] and tiles per shard
+    ctx = default_attention(cfg)(heads(q), heads(k), heads(v))
     b, nh_local, s, _ = ctx.shape
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh_local * hd)
     # row-parallel proj: partial sums -> psum

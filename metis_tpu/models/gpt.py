@@ -70,6 +70,7 @@ class GPTConfig:
             num_heads=spec.num_heads,
             num_blocks=spec.num_blocks,
             ffn_multiplier=spec.ffn_multiplier,
+            attn=spec.attn,
         )
         return replace(cfg, **overrides) if overrides else cfg
 

@@ -59,6 +59,7 @@ class LlamaConfig(GPTConfig):
             num_blocks=spec.num_blocks,
             ffn_multiplier=spec.ffn_multiplier,
             num_kv_heads=spec.num_kv_heads,
+            attn=spec.attn,
         )
         return replace(cfg, **overrides) if overrides else cfg
 

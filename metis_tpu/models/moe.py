@@ -67,6 +67,7 @@ class MoEConfig(GPTConfig):
             ffn_multiplier=spec.ffn_multiplier,
             num_experts=spec.num_experts,
             top_k=spec.expert_top_k,
+            attn=spec.attn,
         )
         from dataclasses import replace
         return replace(cfg, **overrides) if overrides else cfg

@@ -424,7 +424,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal=True, block_q=DEFAULT_BLOCK_Q,
-                    block_kv=DEFAULT_BLOCK_KV, interpret=False):
+                    block_kv=DEFAULT_BLOCK_KV, interpret=None):
     """Blockwise attention on [b, h, s, d] inputs; differentiable.
 
     Falls back to the dense jnp path when shapes don't tile (seq without a
@@ -443,6 +443,11 @@ def flash_attention(q, k, v, *, causal=True, block_q=DEFAULT_BLOCK_Q,
     if blocks is None:
         return dense_causal_attention(q, k, v) if causal else \
             _dense_full_attention(q, k, v)
+    if interpret is None:
+        # auto: Mosaic on TPU, interpreter on CPU — so ``attn="flash"``
+        # model configs run unmodified on the virtual CPU meshes the test
+        # and planning story uses (SURVEY.md §4)
+        interpret = jax.default_backend() == "cpu"
     return _flash(q, k, v, causal, blocks[0], blocks[1], interpret)
 
 
@@ -483,7 +488,7 @@ def finalize_stats(state):
     return acc / jnp.where(l == 0.0, 1.0, l)[..., None]
 
 
-def flash_attn_fn(*, interpret=False, block_q=DEFAULT_BLOCK_Q,
+def flash_attn_fn(*, interpret=None, block_q=DEFAULT_BLOCK_Q,
                   block_kv=DEFAULT_BLOCK_KV):
     """An ``AttnFn`` (q, k, v -> context) for models.gpt, causal."""
     def attn(q, k, v):

@@ -30,15 +30,38 @@ from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.planner.api import plan_hetero, plan_tpu, plan_uniform
 
 
+# --model-size presets: shape defaults a size name expands to; explicit shape
+# flags always win.  "1.5B" matches the reference launcher byte-for-byte
+# (``scripts/cost_het_cluster.sh:22-29`` — its ATTENTION_HEAD_SIZE is the
+# head *count*); the rest are the standard GPT-3-family shapes, num_layers in
+# the profile contract's unit (blocks + embed/head pseudo-layers).
+MODEL_SIZE_PRESETS: dict[str, dict] = {
+    "1.5B": dict(num_layers=10, hidden_size=4096, seq_len=1024,
+                 vocab_size=51200, num_heads=32),
+    "2.7B": dict(num_layers=34, hidden_size=2560, seq_len=2048,
+                 vocab_size=51200, num_heads=32),
+    "6.7B": dict(num_layers=34, hidden_size=4096, seq_len=2048,
+                 vocab_size=51200, num_heads=32),
+    "13B": dict(num_layers=42, hidden_size=5120, seq_len=2048,
+                vocab_size=51200, num_heads=40),
+    "175B": dict(num_layers=98, hidden_size=12288, seq_len=2048,
+                 vocab_size=51200, num_heads=96),
+}
+
+
 def _add_model_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("model")
     g.add_argument("--model-name", default="gpt")
-    g.add_argument("--num-layers", type=int, required=True,
+    g.add_argument("--model-size", choices=sorted(MODEL_SIZE_PRESETS),
+                   default=None,
+                   help="shape preset (reference scripts/cost_het_cluster.sh);"
+                        " explicit shape flags override preset fields")
+    g.add_argument("--num-layers", type=int, default=None,
                    help="profiled layers incl. embed + head pseudo-layers")
-    g.add_argument("--hidden-size", type=int, required=True)
-    g.add_argument("--seq-len", type=int, required=True)
-    g.add_argument("--vocab-size", type=int, required=True)
-    g.add_argument("--num-heads", type=int, required=True)
+    g.add_argument("--hidden-size", type=int, default=None)
+    g.add_argument("--seq-len", type=int, default=None)
+    g.add_argument("--vocab-size", type=int, default=None)
+    g.add_argument("--num-heads", type=int, default=None)
     g.add_argument("--num-experts", type=int, default=0,
                    help="MoE expert count (0 = dense model)")
     g.add_argument("--expert-top-k", type=int, default=1)
@@ -47,6 +70,10 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
                         "(RMSNorm/RoPE/GQA/SwiGLU)")
     g.add_argument("--num-kv-heads", type=int, default=0,
                    help="GQA KV heads (llama family; 0 = num_heads)")
+    g.add_argument("--attn", choices=("dense", "flash"), default="dense",
+                   help="attention implementation the executors AND the "
+                        "profiler use — part of the model spec so profiles "
+                        "and plans describe the execution that actually runs")
 
 
 def _add_platform_arg(p: argparse.ArgumentParser) -> None:
@@ -127,17 +154,29 @@ def _add_cluster_args(p: argparse.ArgumentParser) -> None:
 
 
 def _model_from_args(args: argparse.Namespace) -> ModelSpec:
+    preset = MODEL_SIZE_PRESETS.get(args.model_size or "", {})
+    shape = {
+        k: getattr(args, k) if getattr(args, k) is not None else preset.get(k)
+        for k in ("num_layers", "hidden_size", "seq_len", "vocab_size",
+                  "num_heads")
+    }
+    missing = [k for k, v in shape.items() if v is None]
+    if missing:
+        raise SystemExit(
+            f"missing model shape flags {missing}: pass them explicitly or "
+            f"pick a --model-size preset ({', '.join(sorted(MODEL_SIZE_PRESETS))})")
     return ModelSpec(
         name=args.model_name,
-        num_layers=args.num_layers,
-        hidden_size=args.hidden_size,
-        sequence_length=args.seq_len,
-        vocab_size=args.vocab_size,
-        num_heads=args.num_heads,
+        num_layers=shape["num_layers"],
+        hidden_size=shape["hidden_size"],
+        sequence_length=shape["seq_len"],
+        vocab_size=shape["vocab_size"],
+        num_heads=shape["num_heads"],
         num_experts=args.num_experts,
         expert_top_k=args.expert_top_k,
         family=args.family,
         num_kv_heads=args.num_kv_heads,
+        attn=args.attn,
     )
 
 
@@ -284,6 +323,21 @@ def main(argv: list[str] | None = None) -> int:
                            "multi-controller training (GSPMD plans)")
     g_mh.add_argument("--num-processes", type=int, default=None)
     g_mh.add_argument("--process-id", type=int, default=None)
+    g_sc = p_train.add_argument_group(
+        "per-slice controller (one controller PER STAGE GROUP, no shared "
+        "jax runtime — the v4+v5e mixed-generation topology, SURVEY.md §7 "
+        "hard part 3; run the same command per slice varying only "
+        "--slice-controller)")
+    g_sc.add_argument("--slice-controller", type=int, default=None,
+                      metavar="STAGE",
+                      help="run ONLY this stage of the chosen/pinned hetero "
+                           "plan as an independent controller; boundary "
+                           "activations/cotangents flow over --peers "
+                           "sockets (execution.multihost2)")
+    g_sc.add_argument("--peers", default=None,
+                      help="comma-separated host:port boundary links, one "
+                           "per stage boundary: link i is LISTENED on by "
+                           "stage i and DIALED by stage i+1")
     _add_platform_arg(p_train)
 
     p_rep = sub.add_parser(
@@ -376,7 +430,8 @@ def _cmd_profile(args: argparse.Namespace) -> int:
         tps=tuple(int(t) for t in args.tps.split(",")),
         bss=tuple(int(b) for b in args.bss.split(",")),
         config=ProfilerConfig(warmup=args.warmup, iters=args.iters))
-    store.dump_to_dir(args.output_dir)
+    store.dump_to_dir(args.output_dir,
+                      {"model_name": model.name, "attn": model.attn})
     print(f"profiled {model.name} -> {args.output_dir} "
           f"({', '.join(store.device_types)})", file=sys.stderr)
     return 0
@@ -492,6 +547,17 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     # set; only process 0 writes the summary/events.
     multihost = args.coordinator is not None
     is_main = True
+    slice_stage = getattr(args, "slice_controller", None)
+    if slice_stage is not None:
+        if multihost:
+            print("--slice-controller and --coordinator are different "
+                  "deployment shapes (one controller per stage group vs one "
+                  "jax.distributed runtime) — pick one", file=sys.stderr)
+            return 2
+        if args.peers is None:
+            print("--slice-controller requires --peers (one host:port "
+                  "boundary link per stage boundary)", file=sys.stderr)
+            return 2
     if not multihost and (args.num_processes is not None
                           or args.process_id is not None):
         print("--num-processes/--process-id require --coordinator (without "
@@ -550,6 +616,73 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         art = PlanArtifact.from_ranked_plan(result.best)
         plan_cost_ms = result.best.cost.total_ms
     cfg = config_for_model_spec(model)
+
+    if slice_stage is not None:
+        # per-slice-controller route: this process runs ONE stage of the
+        # plan as an independent controller (its own jax runtime, boundary
+        # tensors over sockets) — the deployment shape mixed-generation
+        # clusters need (a v4 and a v5e slice cannot join one runtime).
+        # Checkpointing is per-run for now: slice controllers train from
+        # init (resume would need per-stage checkpoint exchange).
+        import dataclasses as _dc
+        import json as _json
+
+        from metis_tpu.execution.builder import resolve_schedule
+        from metis_tpu.execution.multihost2 import (
+            parse_link_addrs,
+            run_artifact_stage_worker,
+        )
+
+        # same resolution rule as the single-controller path: the plan's
+        # priced schedule by default, explicit --schedule/--virtual-stages
+        # override — an explicit `--schedule gpipe` on a 1f1b-priced
+        # artifact is an informed choice the worker must honor
+        sched, vs = resolve_schedule(art, args.schedule, args.virtual_stages)
+        art = _dc.replace(art, schedule=sched, virtual_stages=vs)
+
+        if art.node_sequence:
+            # mixed-device-type stages get uneven data-balancer rows /
+            # per-type sub-mesh groups in the single-runtime executor —
+            # physically impossible under one-controller-per-slice (one jax
+            # runtime cannot span device types); refuse rather than
+            # silently diverge from the plan's cost basis
+            from metis_tpu.core.types import InterStagePlan, Strategy
+            from metis_tpu.execution.hetero import plan_replica_rows
+
+            inter = InterStagePlan(
+                node_sequence=tuple(art.node_sequence),
+                device_groups=tuple(art.device_groups),
+                batches=art.microbatches, gbs=art.gbs)
+            strats = [Strategy(dp=s["dp"], tp=s["tp"])
+                      for s in art.strategies]
+            rows = plan_replica_rows(inter, strats, cluster, profiles)
+            mixed = [i for i, r in enumerate(rows) if r is not None]
+            if mixed:
+                print(f"stages {mixed} span multiple device types (uneven "
+                      "data-balancer rows) — a slice controller owns one "
+                      "jax runtime and cannot realize a mixed-type stage; "
+                      "re-plan with per-slice stage groups or run "
+                      "single-controller", file=sys.stderr)
+                return 2
+
+        links = parse_link_addrs(args.peers)
+        print(f"slice controller: stage {slice_stage} of "
+              f"{len(art.strategies)}, links {links}", file=sys.stderr)
+        report = run_artifact_stage_worker(
+            art, model, slice_stage, links, args.steps, data_path=args.data)
+        summary = {
+            "executable": "slice-controller",
+            "stage": report["stage"],
+            "stages": report["stages"],
+            "local_devices": report["local_devices"],
+            "steps": report["steps"],
+            "first_loss": report["losses"][0] if report["losses"] else None,
+            "final_loss": report["losses"][-1] if report["losses"] else None,
+            "losses": report["losses"],
+        }
+        _emit(args, _json.dumps(summary, indent=2))
+        return 0
+
     # default: run the schedule the chosen/pinned plan was PRICED with
     # (a searched axis — cost/schedule.py); explicit flags override.  One
     # resolution rule shared with build_executable so the checkpoint layout

@@ -57,12 +57,11 @@ from metis_tpu.execution.train import (
     loss_fn_for,
     param_specs_for,
 )
-from metis_tpu.models import config_for_model_spec
+from metis_tpu.models import config_for_model_spec, resolve_attention
 from metis_tpu.models.gpt import (
     GPTConfig,
     embed,
     block_forward,
-    causal_attention,
     head_logits,
 )
 from metis_tpu.models.moe import MoEConfig, moe_block_forward
@@ -202,6 +201,9 @@ class LayerProfiler:
             family_embed, _, family_head, _ = family_ops(cfg)
         else:
             family_embed, family_head = embed, head_logits
+        # the attention impl cfg.attn selects (dense or flash) — measure the
+        # graph the executors run, not an unconditional dense stand-in
+        attn = resolve_attention(cfg)
 
         def embed_fb(embed_params, tokens):
             # Close over ONLY the embed subtree: differentiating the full
@@ -218,17 +220,17 @@ class LayerProfiler:
         def block_fb(layer, x):
             def f(layer, x):
                 if isinstance(cfg, MoEConfig):
-                    out, aux = moe_block_forward(x, layer, cfg, causal_attention)
+                    out, aux = moe_block_forward(x, layer, cfg, attn)
                     # aux keeps the router's softmax/stats in the measured graph
                     return out.astype(jnp.float32).sum() + aux
                 if isinstance(cfg, LlamaConfig):
                     return (
-                        llama_block_forward(x, layer, cfg, causal_attention)
+                        llama_block_forward(x, layer, cfg, attn)
                         .astype(jnp.float32)
                         .sum()
                     )
                 return (
-                    block_forward(x, layer, cfg, causal_attention)
+                    block_forward(x, layer, cfg, attn)
                     .astype(jnp.float32)
                     .sum()
                 )
@@ -239,11 +241,11 @@ class LayerProfiler:
             def step(carry, layer):
                 if isinstance(cfg, MoEConfig):
                     return moe_block_forward(x=carry, layer=layer, cfg=cfg,
-                                             attn_impl=causal_attention)
+                                             attn_impl=attn)
                 if isinstance(cfg, LlamaConfig):
                     return (llama_block_forward(carry, layer, cfg,
-                                                causal_attention), 0.0)
-                return (block_forward(carry, layer, cfg, causal_attention),
+                                                attn), 0.0)
+                return (block_forward(carry, layer, cfg, attn),
                         0.0)
 
             out, auxs = jax.lax.scan(step, x, layers)
@@ -491,6 +493,7 @@ def measure_remat_fraction(
 
     dev = device if device is not None else jax.devices()[0]
     cfg = config_for_model_spec(model)
+    attn = resolve_attention(cfg)
     key = jax.random.PRNGKey(seed)
     params = jax.device_put(init_params_for(key, cfg), dev)
     layer = jax.tree.map(lambda a: a[0], params["blocks"])
@@ -499,12 +502,12 @@ def measure_remat_fraction(
 
     def fwd_only(layer, x):
         if isinstance(cfg, MoEConfig):
-            out, aux = moe_block_forward(x, layer, cfg, causal_attention)
+            out, aux = moe_block_forward(x, layer, cfg, attn)
             return out.astype(jnp.float32).sum() + aux
         if isinstance(cfg, LlamaConfig):
-            return llama_block_forward(x, layer, cfg, causal_attention) \
+            return llama_block_forward(x, layer, cfg, attn) \
                 .astype(jnp.float32).sum()
-        return block_forward(x, layer, cfg, causal_attention) \
+        return block_forward(x, layer, cfg, attn) \
             .astype(jnp.float32).sum()
 
     def fwd_bwd(layer, x):
@@ -565,4 +568,5 @@ def profile_to_dir(
     """Profile and write reference-schema JSON files (the end-to-end path:
     profile on this host -> plan anywhere)."""
     store = profile_model(model, tps, bss, device_type, config=config)
-    return store.dump_to_dir(out_dir, {"model_name": model.name})
+    return store.dump_to_dir(
+        out_dir, {"model_name": model.name, "attn": model.attn})

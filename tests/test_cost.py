@@ -209,3 +209,91 @@ class TestBatchGenCharging:
         c8 = est.get_cost(plan_b8, (Strategy(4, 2), Strategy(4, 2)), (0, 4, 10))
         c4 = est.get_cost(plan_b4, (Strategy(4, 2), Strategy(4, 2)), (0, 4, 10))
         assert c8.batch_gen_ms == pytest.approx(c4.batch_gen_ms)
+
+
+class TestMbAffine:
+    """Affine smoothing of the profile bs axis (ProfileStore.affine_view):
+    native mode prices a step as ``num_mbs * slope * mbs + intercept`` with
+    the fitted per-program fixed cost charged once per step — the executors
+    scan microbatches inside one jit, so a per-microbatch charge of the
+    isolated-closure profile time bends predictions with the microbatch
+    count (on-chip sweep: +12.8% at 1 microbatch, −6% at 2, +8.6% at 8 —
+    calibration/tpu_validation_sweep.json, round 4)."""
+
+    def test_affine_view_is_linear_in_bs(self, profiles):
+        smoothed, overhead = profiles.affine_view()
+        for (t, tp, bs) in smoothed.configs():
+            if bs == 1:
+                base = smoothed.get(t, tp, 1).layer_times_ms
+                for b2 in (2, 4, 8):
+                    if smoothed.has(t, tp, b2):
+                        got = smoothed.get(t, tp, b2).layer_times_ms
+                        for x1, x2 in zip(base, got):
+                            assert x2 == pytest.approx(b2 * x1, rel=1e-9)
+        assert set(overhead) == {(t, tp) for (t, tp, _) in profiles.configs()}
+
+    def test_affine_view_preserves_memory(self, profiles):
+        smoothed, _ = profiles.affine_view()
+        for key in profiles.configs():
+            assert (smoothed.get(*key).layer_memory_mb
+                    == profiles.get(*key).layer_memory_mb)
+
+    def test_affine_fit_recovers_linear_profile(self):
+        """On synthetic exactly-affine data the fit is exact: slope*bs entries
+        and the intercept sum per (type, tp)."""
+        from metis_tpu.profiles.store import (
+            DeviceTypeMeta, LayerProfile, ModelProfileMeta, ProfileStore)
+
+        a, b = 3.0, 2.0
+        entries = {
+            ("X", 1, bs): LayerProfile(
+                layer_times_ms=(a + b * bs,) * 4,
+                layer_memory_mb=(1.0,) * 4, fb_sync_ms=0.0)
+            for bs in (1, 2, 4, 8)
+        }
+        meta = ModelProfileMeta(4, 1.0, 1.0, (10,) * 4)
+        store = ProfileStore(entries, meta, {"X": DeviceTypeMeta(1.0, 1.0)})
+        smoothed, overhead = store.affine_view()
+        assert overhead[("X", 1)] == pytest.approx(4 * a)
+        assert smoothed.get("X", 1, 4).layer_times_ms == pytest.approx((b * 4,) * 4)
+
+    def test_native_step_flat_in_mbs_for_linear_profiles(self, cluster, volume, model):
+        """With affine profiles, a pp=1 plan's predicted total is flat across
+        the microbatch size — matching the measured on-chip behavior."""
+        from metis_tpu.profiles import synthesize_profiles
+
+        profs = synthesize_profiles(model, ["A100"], tps=[1], bss=[1, 2, 4, 8])
+        est = UniformCostEstimator(
+            cluster, profs, volume, EstimatorOptions(strict_compat=False))
+        totals = [
+            est.get_cost(UniformPlan(dp=1, pp=1, tp=1, mbs=m, gbs=8), "A100").total_ms
+            for m in (1, 2, 4, 8)]
+        for t in totals[1:]:
+            assert t == pytest.approx(totals[0], rel=0.02)
+
+    def test_strict_compat_unaffected(self, cluster, profiles, volume):
+        """Strict-compat never smooths — reference per-microbatch parity."""
+        est = UniformCostEstimator(
+            cluster, profiles, volume, EstimatorOptions(strict_compat=True))
+        assert est._step_overhead == {}
+        assert est.profiles is profiles
+
+    def test_optimizer_factor_auto(self, cluster, profiles, volume):
+        """None = auto: 2.0 strict (ref data_loader.py:19 doubling), 1.0
+        native (executors run adamw once per step); explicit value wins."""
+        plan = UniformPlan(dp=1, pp=1, tp=1, mbs=4, gbs=4)
+        strict = UniformCostEstimator(
+            cluster, profiles, volume,
+            EstimatorOptions(strict_compat=True)).get_cost(plan, "A100")
+        native = UniformCostEstimator(
+            cluster, profiles, volume,
+            EstimatorOptions(strict_compat=False)).get_cost(plan, "A100")
+        forced = UniformCostEstimator(
+            cluster, profiles, volume,
+            EstimatorOptions(strict_compat=False, optimizer_factor=2.0),
+        ).get_cost(plan, "A100")
+        assert strict.optimizer_ms == pytest.approx(
+            2 * profiles.model.optimizer_time_ms)
+        assert native.optimizer_ms == pytest.approx(
+            profiles.type_meta["A100"].optimizer_time_ms)
+        assert forced.optimizer_ms == pytest.approx(2 * native.optimizer_ms)
