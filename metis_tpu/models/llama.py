@@ -144,7 +144,11 @@ def llama_block_forward(
     q = rope(heads(q, nh), cfg.rope_theta, pos_offset)
     k = rope(heads(k, kvh), cfg.rope_theta, pos_offset)
     v = heads(v, kvh)
-    if kvh != nh:  # GQA: repeat K/V heads up to the query head count
+    if kvh != nh and not getattr(attn_impl, "supports_gqa", False):
+        # GQA: repeat K/V heads up to the query head count — only for attn
+        # impls that cannot consume grouped K/V directly (the flash kernel
+        # serves query-head groups from the unexpanded layout, saving the
+        # (nh/kvh)x KV expansion in HBM)
         rep = nh // kvh
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)

@@ -184,16 +184,20 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
-                       block_q, block_kv, q_steps):
-    """dK/dV pass: grid (bh, kv_blocks, q_blocks); dk/dv accumulate across
-    the Q dimension in VMEM scratch:
+                       block_q, block_kv, q_steps, members=1):
+    """dK/dV pass: grid (bh_kv, kv_blocks, members * q_blocks); dk/dv
+    accumulate across the Q dimension in VMEM scratch:
         dv += p^T @ dO
         dk += ds^T @ Q
-    """
+    ``members`` > 1 is the GQA case: the innermost grid dim additionally
+    enumerates the ``members`` query heads sharing this KV head, so their
+    contributions accumulate in the SAME scratch pass — the kv output block
+    is still written exactly once (no output revisiting)."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    ji = pl.program_id(2)
+    qi = ji % q_steps if members > 1 else ji
 
-    @pl.when(qi == 0)
+    @pl.when(ji == 0)
     def _init():
         dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
@@ -230,21 +234,29 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == q_steps - 1)
+    @pl.when(ji == members * q_steps - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _fa_bwd_call(q, k, v, do, lse, delta, causal, block_q, block_kv,
-                 interpret):
-    """Blockwise backward on folded [bh, s, d] tensors; lse/delta [bh, s].
-    Returns (dq, dk, dv) in the input dtypes.  O(block) memory per grid
-    step — the [s, s] score matrix is never materialized (VERDICT r1
-    weak #2 / ADVICE r1: the dense-recompute VJP forfeited flash
-    attention's memory ceiling for training)."""
+                 interpret, q_heads=None, kv_heads=None):
+    """Blockwise backward on folded tensors: q/do [bh_q, s, d], k/v
+    [bh_kv, s, d], lse/delta [bh_q, s].  Returns (dq, dk, dv) in the input
+    dtypes.  O(block) memory per grid step — the [s, s] score matrix is
+    never materialized (VERDICT r1 weak #2 / ADVICE r1: the dense-recompute
+    VJP forfeited flash attention's memory ceiling for training).
+
+    GQA (``q_heads > kv_heads``): K/V rows are indexed at ``g = q_heads //
+    kv_heads`` query heads per KV head — K/V are never expanded in HBM.
+    The dK/dV grid enumerates the g group members innermost so their
+    contributions accumulate in one scratch pass per KV block."""
     bh, s_q, d = q.shape
-    s_kv = k.shape[1]
+    bh_kv, s_kv = k.shape[0], k.shape[1]
+    nh = q_heads if q_heads is not None else 1
+    kvh = kv_heads if kv_heads is not None else 1
+    g = nh // kvh
     kv_steps = s_kv // block_kv
     q_steps = s_q // block_q
     sm_scale = 1.0 / math.sqrt(d)
@@ -254,17 +266,20 @@ def _fa_bwd_call(q, k, v, do, lse, delta, causal, block_q, block_kv,
     delta3 = delta.reshape(bh * q_steps, 1, block_q)
     stat_spec_q = pl.BlockSpec(
         (1, 1, block_q), lambda b, i, j, _qs=q_steps: (b * _qs + i, 0, 0))
-    stat_spec_kv = pl.BlockSpec(
-        (1, 1, block_q), lambda b, i, j, _qs=q_steps: (b * _qs + j, 0, 0))
 
+    if g == 1:
+        dq_kv_map = lambda b, i, j: (b, j, 0)  # noqa: E731
+    else:
+        dq_kv_map = lambda b, i, j: (  # noqa: E731
+            (b // nh) * kvh + (b % nh) // g, j, 0)
     dq = pl.pallas_call(
         partial(_fa_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                 block_q=block_q, block_kv=block_kv, kv_steps=kv_steps),
         grid=(bh, q_steps, kv_steps),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d), dq_kv_map),
+            pl.BlockSpec((1, block_kv, d), dq_kv_map),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             stat_spec_q,
             stat_spec_q,
@@ -276,15 +291,30 @@ def _fa_bwd_call(q, k, v, do, lse, delta, causal, block_q, block_kv,
         interpret=interpret,
     )(q, k, v, do, lse3, delta3)
 
+    if g == 1:
+        q_row = lambda b, i, j: (b, j, 0)  # noqa: E731
+        stat_kv_map = lambda b, i, j, _qs=q_steps: (  # noqa: E731
+            b * _qs + j, 0, 0)
+    else:
+        # grid dim 0 walks KV rows; dim 2 = (group member, q block)
+        def _qrow(b, j):
+            return (b // kvh) * nh + (b % kvh) * g + j // q_steps
+
+        q_row = lambda b, i, j: (_qrow(b, j), j % q_steps, 0)  # noqa: E731
+        stat_kv_map = lambda b, i, j, _qs=q_steps: (  # noqa: E731
+            _qrow(b, j) * _qs + j % _qs, 0, 0)
+    stat_spec_kv = pl.BlockSpec((1, 1, block_q), stat_kv_map)
+
     dk, dv = pl.pallas_call(
         partial(_fa_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                block_q=block_q, block_kv=block_kv, q_steps=q_steps),
-        grid=(bh, s_kv // block_kv, q_steps),
+                block_q=block_q, block_kv=block_kv, q_steps=q_steps,
+                members=g),
+        grid=(bh_kv, kv_steps, g * q_steps),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_row),
             pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_row),
             stat_spec_kv,
             stat_spec_kv,
         ],
@@ -294,9 +324,9 @@ def _fa_bwd_call(q, k, v, do, lse, delta, causal, block_q, block_kv,
         ],
         out_shape=[
             jax.ShapeDtypeStruct(
-                (bh, s_kv, d), k.dtype, vma=_out_vma(q, k, v, do)),
+                (bh_kv, s_kv, d), k.dtype, vma=_out_vma(q, k, v, do)),
             jax.ShapeDtypeStruct(
-                (bh, s_kv, d), v.dtype, vma=_out_vma(q, k, v, do)),
+                (bh_kv, s_kv, d), v.dtype, vma=_out_vma(q, k, v, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_kv, d), jnp.float32),
@@ -311,10 +341,16 @@ _LANES = 128  # lane-replicated scratch width for the (m, l) running stats
 
 
 def _fa_call(q, k, v, causal, block_q, block_kv, interpret, normalize,
-             return_stats):
-    """q, k, v: [bh, s, d] (heads already folded into the leading dim)."""
+             return_stats, q_heads=None, kv_heads=None):
+    """q: [bh_q, s, d], k/v: [bh_kv, s, d] (heads folded into the leading
+    dim).  With ``q_heads > kv_heads`` (GQA) the K/V block specs index
+    ``g = q_heads // kv_heads`` query rows at each KV row — the expansion
+    never touches HBM."""
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
+    nh = q_heads if q_heads is not None else 1
+    kvh = kv_heads if kv_heads is not None else 1
+    g = nh // kvh
     kv_steps = s_kv // block_kv
     grid = (bh, s_q // block_q, kv_steps)
 
@@ -345,13 +381,18 @@ def _fa_call(q, k, v, causal, block_q, block_kv, interpret, normalize,
             (1, 1, block_q),
             lambda b, i, j, _qs=q_steps: (b * _qs + i, 0, 0))] * 2
 
+    if g == 1:
+        kv_map = lambda b, i, j: (b, j, 0)  # noqa: E731
+    else:
+        kv_map = lambda b, i, j: (  # noqa: E731
+            (b // nh) * kvh + (b % nh) // g, j, 0)
     res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d), kv_map),
+            pl.BlockSpec((1, block_kv, d), kv_map),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -366,8 +407,10 @@ def _fa_call(q, k, v, causal, block_q, block_kv, interpret, normalize,
 
 
 def _shapes_supported(q, k, block_q, block_kv):
-    bh_q, s_q, d = q.shape[0] * q.shape[1], q.shape[2], q.shape[3]
+    s_q, d = q.shape[2], q.shape[3]
     s_kv = k.shape[2]
+    if q.shape[1] % max(k.shape[1], 1) != 0:
+        return None  # GQA needs the query heads to tile the KV heads
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
     if bq is None or bkv is None or d % 8 != 0:
@@ -384,14 +427,16 @@ def _fold(t):  # [b, h, s, d] -> [b*h, s, d]
 def _flash(q, k, v, causal, bq, bkv, interpret):
     b, h = q.shape[:2]
     out = _fa_call(_fold(q), _fold(k), _fold(v), causal, bq, bkv,
-                   interpret, normalize=True, return_stats=False)
+                   interpret, normalize=True, return_stats=False,
+                   q_heads=h, kv_heads=k.shape[1])
     return out.reshape(b, h, *out.shape[1:])
 
 
 def _flash_fwd(q, k, v, causal, bq, bkv, interpret):
     b, h = q.shape[:2]
     out, m, l = _fa_call(_fold(q), _fold(k), _fold(v), causal, bq, bkv,
-                         interpret, normalize=True, return_stats=True)
+                         interpret, normalize=True, return_stats=True,
+                         q_heads=h, kv_heads=k.shape[1])
     # logsumexp per row; fully-masked rows (l == 0) get +BIG so the backward's
     # recomputed p = exp(s - lse) is exactly 0 there
     lse = jnp.where(
@@ -407,7 +452,7 @@ def _flash_bwd(causal, bq, bkv, interpret, residuals, g):
     delta = jnp.sum(do_f.astype(jnp.float32) * out_f.astype(jnp.float32), -1)
     dq, dk, dv = _fa_bwd_call(
         _fold(q), _fold(k), _fold(v), do_f, lse, delta, causal, bq, bkv,
-        interpret)
+        interpret, q_heads=h, kv_heads=k.shape[1])
     shape = lambda t, ref: t.reshape(ref.shape)  # noqa: E731
     return shape(dq, q), shape(dk, k), shape(dv, v)
 
@@ -427,6 +472,14 @@ def flash_attention(q, k, v, *, causal=True, block_q=DEFAULT_BLOCK_Q,
                     block_kv=DEFAULT_BLOCK_KV, interpret=None):
     """Blockwise attention on [b, h, s, d] inputs; differentiable.
 
+    GQA-native: ``k``/``v`` may carry FEWER heads than ``q`` (any
+    ``q_heads % kv_heads == 0``) — each KV head serves its group of query
+    heads straight from the unexpanded [b, kv_heads, s, d] layout via the
+    kernel's index maps, so the (q_heads / kv_heads)x KV expansion never
+    touches HBM in either the forward or the backward (the dK/dV grid
+    enumerates the group members innermost, accumulating them in one
+    VMEM scratch pass per KV block).
+
     Falls back to the dense jnp path when shapes don't tile (seq without a
     multiple-of-8 divisor, or head_dim not a multiple of 8) so callers can use
     it unconditionally as an ``AttnFn``.
@@ -441,6 +494,11 @@ def flash_attention(q, k, v, *, causal=True, block_q=DEFAULT_BLOCK_Q,
     """
     blocks = _shapes_supported(q, k, block_q, block_kv)
     if blocks is None:
+        if q.shape[1] != k.shape[1] and q.shape[1] % k.shape[1] == 0:
+            # GQA on untileable shapes: the dense fallback needs expanded KV
+            rep = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
         return dense_causal_attention(q, k, v) if causal else \
             _dense_full_attention(q, k, v)
     if interpret is None:
@@ -494,4 +552,7 @@ def flash_attn_fn(*, interpret=None, block_q=DEFAULT_BLOCK_Q,
     def attn(q, k, v):
         return flash_attention(q, k, v, causal=True, block_q=block_q,
                                block_kv=block_kv, interpret=interpret)
+    # capability marker: GQA callers (models.llama) may pass unexpanded
+    # [b, kv_heads, s, d] K/V instead of repeating heads in HBM
+    attn.supports_gqa = True
     return attn

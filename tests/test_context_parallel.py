@@ -113,18 +113,26 @@ class TestCpCostEstimation:
             node_sequence=("tpu_v5e",), device_groups=(8,), batches=4, gbs=32)
         return est.get_cost(plan, strategies, (0, model.num_layers))
 
-    def test_cp_halves_compute_adds_ring(self, cluster, profiles, model):
+    def test_cp_shards_compute_adds_ring(self, cluster, profiles, model):
+        """At a FIXED device count, trading dp for cp keeps the per-device
+        token count (and so the marginal compute) equal and adds the ring
+        comm on top — cp buys the MEMORY of long sequences, not speed.
+        (Until round 5 this asserted cp2 < base: an artifact of the raw
+        profile's per-call intercept making t(2*bs)/2 < t(bs); the affine
+        smoothing of the bs axis removed it — ProfileStore.affine_view.)"""
         base = self._cost(cluster, profiles, model, (Strategy(dp=8, tp=1),))
         cp2 = self._cost(cluster, profiles, model, (Strategy(dp=4, tp=1, cp=2),))
         assert cp2.cp_comm_ms > 0
         assert base.cp_comm_ms == 0
-        assert cp2.execution_ms < base.execution_ms
+        assert cp2.execution_ms >= base.execution_ms
         # exact decomposition: single stage, 4 microbatches => execution =
-        # 4 * (profiled_compute(mbs=2) / cp + ring); cp_comm_ms is the ring's
-        # share of that total.
-        compute = profiles.get("tpu_v5e", 1, 2).total_time_ms
+        # 4 * (smoothed_compute(mbs=2) / cp + ring) + per-program overhead;
+        # cp_comm_ms is the ring's share of that total.
+        smoothed, ovh = profiles.affine_view()
+        compute = smoothed.get("tpu_v5e", 1, 2).total_time_ms
         assert cp2.execution_ms == pytest.approx(
-            4 * compute / 2 + cp2.cp_comm_ms, rel=1e-9)
+            4 * compute / 2 + cp2.cp_comm_ms + ovh[("tpu_v5e", 1)],
+            rel=1e-9)
 
     def test_cp_gradient_sync_spans_cp_axis(self, cluster, profiles, model):
         # dp=1, cp=8: weights replicated across all 8 ranks => gradient
