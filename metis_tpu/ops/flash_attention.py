@@ -522,7 +522,8 @@ def flash_attention_stats(q, k, v, *, causal=False, block_q=DEFAULT_BLOCK_Q,
     bq, bkv = blocks
     b, h = q.shape[:2]
     acc, m, l = _fa_call(_fold(q), _fold(k), _fold(v), causal, bq, bkv,
-                         interpret, normalize=False, return_stats=True)
+                         interpret, normalize=False, return_stats=True,
+                         q_heads=h, kv_heads=k.shape[1])
     acc = acc.astype(jnp.float32).reshape(b, h, *acc.shape[1:])
     m = m.reshape(b, h, -1)
     l = l.reshape(b, h, -1)

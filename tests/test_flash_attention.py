@@ -207,3 +207,20 @@ def test_llama_gqa_flash_forward_parity():
     out_d = llama_forward(params, toks, cfg_d)
     out_f = llama_forward(params, toks, cfg_f)
     np.testing.assert_allclose(out_f, out_d, atol=2e-4, rtol=2e-4)
+
+
+def test_gqa_stats_match_expanded_reference():
+    """flash_attention_stats with grouped K/V (the ring-attention building
+    block) matches the expanded-dense softmax state — review r5 finding:
+    it previously admitted GQA shapes but indexed K/V out of bounds."""
+    b, nh, kvh, s, d = 1, 4, 2, 32, 16
+    key = jax.random.PRNGKey(5)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, nh, s, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, kvh, s, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, kvh, s, d))
+    state = flash_attention_stats(q, k, v, causal=True, block_q=16,
+                                  block_kv=16, interpret=True)
+    got = finalize_stats(state).astype(jnp.float32)
+    want = dense_causal_attention(
+        q, jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1))
+    np.testing.assert_allclose(got, want, atol=2e-5)
