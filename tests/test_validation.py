@@ -304,3 +304,58 @@ def test_apply_frozen_fit_affine_and_features():
            "selected": "affine_batches", "mode": "features_loo"}
     scored = apply_frozen_fit(fit, rs, HETERO_FIT_CANDIDATES)
     assert scored[0].predicted_ms == pytest.approx(230.0)
+
+
+def test_repeat_measure_fit_selection_free_folds():
+    """bench.repeat_measure_fit cross-episode scoring: each repeat's frozen
+    fit scores the NEXT repeat's raw reports; failed folds are recorded,
+    never silently dropped."""
+    import bench
+    from metis_tpu.core.types import UniformPlan
+    from metis_tpu.validation import ValidationReport, apply_frozen_fit
+
+    plan = UniformPlan(dp=1, pp=1, tp=1, mbs=2, gbs=4)
+    episodes = iter([
+        # (fit, measured values) per repeat: fit factor alternates, so a
+        # frozen factor applied to the next episode carries real error
+        ({"factor": 2.0, "overhead_ms": 0.0}, [200.0, 100.0]),
+        ({"factor": 2.0, "overhead_ms": 0.0}, [220.0, 110.0]),
+        ({"factor": 2.0, "overhead_ms": 0.0}, [180.0, 90.0]),
+    ])
+
+    def measure_and_fit():
+        fit, meas = next(episodes)
+        reports = [ValidationReport(plan=plan, predicted_ms=p, measured_ms=m,
+                                    steps=1)
+                   for p, m in zip([100.0, 50.0], meas)]
+        held = apply_frozen_fit(fit, reports)
+        return fit, held, reports
+
+    (fit, held, reports), means, sf = bench.repeat_measure_fit(
+        measure_and_fit, repeats=3, apply_fit=apply_frozen_fit)
+    assert len(means) == 3
+    assert sf is not None and len(sf["repeat_means_pct"]) == 3
+    assert sf["mean_abs_error_pct"] is not None
+    assert "failed_folds" not in sf
+
+    # an apply_fit that always raises must be recorded, not hidden
+    def bad_apply(fit, reports):
+        raise KeyError("boom")
+
+    episodes2 = iter([
+        ({"factor": 1.0}, [100.0, 50.0]),
+        ({"factor": 1.0}, [100.0, 50.0]),
+    ])
+
+    def measure_and_fit2():
+        fit, meas = next(episodes2)
+        reports = [ValidationReport(plan=plan, predicted_ms=p, measured_ms=m,
+                                    steps=1)
+                   for p, m in zip([100.0, 50.0], meas)]
+        return fit, reports, reports
+
+    _, _, sf2 = bench.repeat_measure_fit(
+        measure_and_fit2, repeats=2, apply_fit=bad_apply)
+    assert sf2 is not None
+    assert len(sf2["failed_folds"]) == 2
+    assert sf2["mean_abs_error_pct"] is None
