@@ -979,6 +979,65 @@ def tpu_sections_subprocess(record: dict, timeout_s: float = 1500.0) -> None:
             record[key] = {"skipped": f"{type(e).__name__}: {e}"[:120]}
 
 
+def opportunistic_deep_captures(record: dict) -> None:
+    """If the chip is reachable and a deep-capture artifact is missing, run
+    its section (tools/tpu_deep_capture.py) in a bounded subprocess — a
+    tunnel that appears only during the driver's end-of-round bench still
+    yields the flagship point, flash profiles, and the validation matrix.
+    Each section writes its own calibration artifact incrementally, so a
+    mid-capture wedge keeps whatever finished; the deep-artifact fold below
+    reads the files fresh either way."""
+    if "tpu_probe" in record:  # probe already failed this run
+        return
+    cal = Path(__file__).resolve().parent / "calibration"
+    tool = Path(__file__).resolve().parent / "tools" / "tpu_deep_capture.py"
+
+    def missing(fname, key=None):
+        p = cal / fname
+        if not p.exists():
+            return True
+        if key is None:
+            return False
+        try:
+            return key not in json.loads(p.read_text())
+        except (OSError, json.JSONDecodeError):
+            return True
+
+    wanted = []
+    if missing("tpu_flagship.json", "flagship"):
+        wanted.append(("flagship", 1500.0))
+    if not (cal / "tpu_v5e_profiles_flash").is_dir():
+        wanted.append(("profiles_flash", 1500.0))
+    if missing("tpu_validation_matrix.json", "n"):
+        wanted.append(("matrix", 3000.0))
+    out: dict = {}
+    budget = 2700.0  # total cap: the driver's bench must still finish
+    t_all = time.perf_counter()
+    for section, cap in wanted:
+        remaining = budget - (time.perf_counter() - t_all)
+        if remaining < 120.0:
+            out[section] = {"skipped": "deep-capture budget exhausted"}
+            continue
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(tool), section],
+                capture_output=True, text=True,
+                timeout=min(cap, remaining))
+            out[section] = {
+                "rc": proc.returncode,
+                "wall_s": round(time.perf_counter() - t0, 1),
+                "tail": proc.stdout.strip()[-300:],
+            }
+            if proc.returncode != 0:
+                break  # likely a wedged tunnel — don't burn the budget
+        except subprocess.TimeoutExpired:
+            out[section] = {"timed_out_after_s": round(min(cap, remaining))}
+            break
+    if out:
+        record["deep_capture_runs"] = out
+
+
 def main() -> None:
     record: dict = {}
     if not probe_tpu():
@@ -1021,6 +1080,7 @@ def main() -> None:
     # is recorded and the capture-cache fold below still supplies the last
     # good hardware numbers.
     tpu_sections_subprocess(record)
+    opportunistic_deep_captures(record)
     # a wedged tunnel at bench time must not erase hardware numbers captured
     # earlier in the round (bench --tpu-capture persists them with a stamp);
     # only entries with real measurements replace a live skip
