@@ -36,5 +36,5 @@ def test_all_kernels_mosaic_compile(topo, tmp_path):
     assert record["status"] == "all kernels Mosaic-compiled"
     assert set(record["kernels"]) >= {
         "flash_fwd_causal", "flash_fwd_stats", "flash_bwd",
-        "ring_attention_sp4"}
+        "flash_fwd_gqa4", "flash_bwd_gqa4", "ring_attention_sp4"}
     assert all(v["ok"] for v in record["kernels"].values())

@@ -98,9 +98,33 @@ def _kernel_cases(dev):
         return run, qkv() + [jax.ShapeDtypeStruct(
             (BH, SEQ, HEAD_DIM), jnp.bfloat16), stats, stats]
 
+    # GQA variants: 4 query heads per KV head — certifies the grouped
+    # K/V index maps (fwd/dq) and the regrouped dK/dV grid (members
+    # innermost) against Mosaic's rules, which interpret mode cannot
+    def gqa_fwd_case():
+        fn = functools.partial(
+            fa._fa_call, causal=True, block_q=BLOCK_Q, block_kv=BLOCK_KV,
+            interpret=False, normalize=True, return_stats=False,
+            q_heads=BH, kv_heads=1)
+        kv = jax.ShapeDtypeStruct((1, SEQ, HEAD_DIM), jnp.bfloat16)
+        return fn, [qkv()[0], kv, kv]
+
+    def gqa_bwd_case():
+        def run(q, k, v, do, lse, delta):
+            return fa._fa_bwd_call(q, k, v, do, lse, delta, causal=True,
+                                   block_q=BLOCK_Q, block_kv=BLOCK_KV,
+                                   interpret=False, q_heads=BH, kv_heads=1)
+        q_steps = SEQ // BLOCK_Q
+        stats = jax.ShapeDtypeStruct((BH * q_steps, 1, BLOCK_Q), jnp.float32)
+        kv = jax.ShapeDtypeStruct((1, SEQ, HEAD_DIM), jnp.bfloat16)
+        return run, [qkv()[0], kv, kv, jax.ShapeDtypeStruct(
+            (BH, SEQ, HEAD_DIM), jnp.bfloat16), stats, stats]
+
     return [("flash_fwd_causal", fwd_case),
             ("flash_fwd_stats", fwd_stats_case),
-            ("flash_bwd", bwd_case)]
+            ("flash_bwd", bwd_case),
+            ("flash_fwd_gqa4", gqa_fwd_case),
+            ("flash_bwd_gqa4", gqa_bwd_case)]
 
 
 def _ring_case(topo):
