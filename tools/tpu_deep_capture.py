@@ -435,6 +435,67 @@ def capture_flagship() -> None:
         print("flagship: every ladder shape failed")
 
 
+def capture_gqa() -> None:
+    """GQA-native flash vs the repeat-expanded K/V path, fwd+bwd, on-device
+    loop timing — quantifies the HBM saving of serving query-head groups
+    from the unexpanded [b, kv_heads, s, d] layout."""
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    from metis_tpu.ops.flash_attention import flash_attention
+
+    dev = _device()
+    b, nh, d = 4, 8, 128
+    rec: dict = {"device": dev.device_kind, "captured_at": _now(),
+                 "shape": {"b": b, "q_heads": nh, "head_dim": d},
+                 "sweep": []}
+
+    def timed(fn, x, iters=24):
+        looped = jax.jit(lambda x: lax.fori_loop(
+            0, iters, lambda _, y: fn(y), x))
+        for _ in range(2):
+            float(jax.device_get(looped(x).sum()))
+        t0 = time.perf_counter()
+        float(jax.device_get(looped(x).sum()))
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    key = jax.random.PRNGKey(0)
+    for seq in (1024, 2048):
+        for kvh in (1, 2, 4):
+            q = jax.random.normal(jax.random.fold_in(key, 0),
+                                  (b, nh, seq, d), jnp.bfloat16)
+            k = jax.random.normal(jax.random.fold_in(key, 1),
+                                  (b, kvh, seq, d), jnp.bfloat16)
+            v = jax.random.normal(jax.random.fold_in(key, 2),
+                                  (b, kvh, seq, d), jnp.bfloat16)
+
+            def fwdbwd(expand):
+                def loss(q):
+                    kk, vv = k, v
+                    if expand:
+                        kk = jnp.repeat(k, nh // kvh, axis=1)
+                        vv = jnp.repeat(v, nh // kvh, axis=1)
+                    return flash_attention(q, kk, vv).astype(
+                        jnp.float32).sum()
+                return jax.grad(loss)
+
+            try:
+                native_ms = timed(fwdbwd(False), q)
+                expand_ms = timed(fwdbwd(True), q)
+                rec["sweep"].append(
+                    {"seq": seq, "kv_heads": kvh,
+                     "native_ms": round(native_ms, 3),
+                     "expanded_ms": round(expand_ms, 3),
+                     "speedup": round(expand_ms / native_ms, 3)})
+            except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                rec["sweep"].append(
+                    {"seq": seq, "kv_heads": kvh,
+                     "failed": f"{type(e).__name__}: {e}"[:150]})
+            (CAL / "tpu_gqa_flash.json").write_text(json.dumps(rec, indent=1))
+    print(f"gqa sweep: {len(rec['sweep'])} points -> tpu_gqa_flash.json")
+
+
 SECTIONS = {
     "profiles": capture_profiles,
     "profiles_flash": capture_profiles_flash,
@@ -443,6 +504,7 @@ SECTIONS = {
     "matrix": capture_validation_matrix,
     "flagship": capture_flagship,
     "flash": capture_flash_blocks,
+    "gqa": capture_gqa,
 }
 
 
