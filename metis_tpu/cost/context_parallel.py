@@ -20,6 +20,13 @@ because the planner must predict what the executed plan does):
   (conservative; on real slices XLA/pallas overlap most of it with the block
   matmuls — the validator's predicted-vs-measured loop is where this constant
   gets calibrated).
+- **GQA**: the RING path carries grouped K/V natively (``make_ring_attention
+  .supports_gqa``; models/llama passes unexpanded [b, kv_heads, s, d]), so
+  ring K/V rotation bytes scale by ``num_kv_heads / num_heads``.  The
+  Ulysses path still expands K/V to the query head count before its
+  all-to-alls (its head-split logic assumes matched counts), so a2a bytes
+  stay at full ``hidden_size`` — each formula prices what its executor
+  moves.
 - **Memory**: sequence sharding divides *activation* memory by cp but leaves
   weights/optimizer state whole.  Profiles report one per-layer total, so we
   recover the split from the store's batch-size sweep: per-layer memory is
@@ -48,12 +55,17 @@ def ring_comm_bytes_per_layer(
     transformer layer per microbatch."""
     if cp <= 1:
         return 0.0
+    # GQA: the ring rotates grouped K/V (kv_heads/num_heads of the hidden
+    # width) — see the module docstring and ops/ring_attention.py
+    kv_frac = (model.num_kv_heads / model.num_heads
+               if getattr(model, "num_kv_heads", 0) else 1.0)
     kv_block = (
         2  # K and V
         * mbs
         * (model.sequence_length // cp)
         * (model.hidden_size // tp)
         * model.dtype_bytes
+        * kv_frac
     )
     return (cp - 1) * RING_ROTATIONS * kv_block
 

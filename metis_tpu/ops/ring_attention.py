@@ -74,6 +74,12 @@ def _online_update(m, l, o, scores, v):
 
 def _ring_dense(q, k, v, axis_name: str):
     """Dense per-step ring attention (differentiable through the scan)."""
+    if k.shape[1] != q.shape[1]:
+        # GQA: the dense per-block einsums need matched head counts —
+        # expand here (the pallas path serves grouped K/V natively)
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     ring = jax.lax.axis_size(axis_name)
     my_pos = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
@@ -188,9 +194,12 @@ def _ring_flash_fwd(q, k, v, axis_name, bq, bkv, interpret):
 def _ring_flash_bwd(axis_name, bq, bkv, interpret, residuals, g):
     """Second ring pass: dK/dV accumulators rotate with their K/V blocks;
     each device folds in its queries' blockwise gradients (pallas backward
-    kernels) using the forward's global logsumexp."""
+    kernels) using the forward's global logsumexp.  GQA-aware: K/V (and
+    their rotating gradients) stay in the grouped [b, kv_heads, s, d]
+    layout end to end."""
     q, k, v, out, lse = residuals
     b, h, s, d = q.shape
+    kvh = k.shape[1]
     ring = jax.lax.axis_size(axis_name)
     my_pos = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % ring) for i in range(ring)]
@@ -205,12 +214,13 @@ def _ring_flash_bwd(axis_name, bq, bkv, interpret, residuals, g):
         k_cur, v_cur = args
         dq, dk, dv = _fa_bwd_call(
             q_f, _fold(k_cur), _fold(v_cur), do_f, lse_f, delta_f,
-            causal, bq, bkv, interpret)
-        reshape = lambda t: t.reshape(b, h, s, d).astype(jnp.float32)  # noqa: E731
-        return reshape(dq), reshape(dk), reshape(dv)
+            causal, bq, bkv, interpret, q_heads=h, kv_heads=kvh)
+        rq = lambda t: t.reshape(b, h, s, d).astype(jnp.float32)  # noqa: E731
+        rkv = lambda t: t.reshape(b, kvh, s, d).astype(jnp.float32)  # noqa: E731
+        return rq(dq), rkv(dk), rkv(dv)
 
-    def _varying_zeros(match):
-        z = jnp.zeros((b, h, s, d), jnp.float32)
+    def _varying_zeros(match, heads=h):
+        z = jnp.zeros((b, heads, s, d), jnp.float32)
         vma: frozenset = frozenset()
         for a in match:
             vma |= getattr(jax.typeof(a), "vma", frozenset())
@@ -223,10 +233,12 @@ def _ring_flash_bwd(axis_name, bq, bkv, interpret, residuals, g):
         return grads(args, False)
 
     def future_blk(args):
-        z = _varying_zeros((q, *args))
-        return z, z, z
+        return (_varying_zeros((q, *args)),
+                _varying_zeros((q, *args), heads=kvh),
+                _varying_zeros((q, *args), heads=kvh))
 
-    dq0 = dk0 = dv0 = _varying_zeros((q, k, v, g))
+    dq0 = _varying_zeros((q, k, v, g))
+    dk0 = dv0 = _varying_zeros((q, k, v, g), heads=kvh)
 
     def step(carry, idx):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
@@ -289,7 +301,13 @@ def make_ring_attention(mesh: Mesh, seq_axis: str, impl: str | None = None,
     # Only the sequence axis is manual; every other mesh axis (dp, tp, ...)
     # stays under GSPMD so batch/head shardings pass straight through instead
     # of being gathered at the shard_map boundary.
-    return jax.shard_map(
+    fn = jax.shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names={seq_axis},
     )
+    # GQA callers (models.llama) may pass grouped [b, kv_heads, s, d] K/V:
+    # the pallas ring path serves them natively (rotating (q_heads /
+    # kv_heads)x less K/V and dK/dV traffic); the dense fallback expands
+    # internally.
+    fn.supports_gqa = True
+    return fn
