@@ -44,30 +44,36 @@ from typing import Sequence
 from metis_tpu.core.config import ModelSpec
 from metis_tpu.profiles.store import ProfileStore
 
-# Ring rotations of the K/V block: 1 forward, ~2 backward (K/V again + dK/dV).
-RING_ROTATIONS = 3
+# Ring rotations of the K/V block: 1 forward + 1 backward at the model
+# dtype, plus the backward's dK/dV accumulator rotation at float32 (the
+# ring VJP carries fp32 accumulators — _ring_flash_bwd) — kept as explicit
+# terms in ring_comm_bytes_per_layer, not a flat rotation count.
+RING_ROTATIONS = 3  # structural count (fwd K/V, bwd K/V, bwd dK/dV)
+_GRAD_BYTES = 4     # dK/dV rotate as float32 accumulators
 
 
 def ring_comm_bytes_per_layer(
     model: ModelSpec, mbs: int, cp: int, tp: int
 ) -> float:
     """Un-overlapped ring-attention wire bytes one device moves per
-    transformer layer per microbatch."""
+    transformer layer per microbatch — priced per rotating tensor: what the
+    executor actually moves (``ops/ring_attention.py``)."""
     if cp <= 1:
         return 0.0
     # GQA: the ring rotates grouped K/V (kv_heads/num_heads of the hidden
     # width) — see the module docstring and ops/ring_attention.py
     kv_frac = (model.num_kv_heads / model.num_heads
                if getattr(model, "num_kv_heads", 0) else 1.0)
-    kv_block = (
+    kv_elems = (
         2  # K and V
         * mbs
         * (model.sequence_length // cp)
         * (model.hidden_size // tp)
-        * model.dtype_bytes
         * kv_frac
     )
-    return (cp - 1) * RING_ROTATIONS * kv_block
+    # 2 rotations at the model dtype (fwd K/V + bwd K/V) + 1 at fp32
+    # (bwd dK/dV accumulators)
+    return (cp - 1) * kv_elems * (2 * model.dtype_bytes + _GRAD_BYTES)
 
 
 def cp_ring_ms(

@@ -73,13 +73,10 @@ def _online_update(m, l, o, scores, v):
 
 
 def _ring_dense(q, k, v, axis_name: str):
-    """Dense per-step ring attention (differentiable through the scan)."""
-    if k.shape[1] != q.shape[1]:
-        # GQA: the dense per-block einsums need matched head counts —
-        # expand here (the pallas path serves grouped K/V natively)
-        rep = q.shape[1] // k.shape[1]
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
+    """Dense per-step ring attention (differentiable through the scan).
+    GQA K/V rotate GROUPED (the wire bytes the cost model prices); each
+    step expands the visiting block locally for the dense einsums."""
+    gqa_rep = q.shape[1] // k.shape[1]
     ring = jax.lax.axis_size(axis_name)
     my_pos = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
@@ -101,9 +98,13 @@ def _ring_dense(q, k, v, axis_name: str):
             src < my_pos, jnp.ones((s_local, s_local), bool),
             jnp.where(src == my_pos, diag_mask,
                       jnp.zeros((s_local, s_local), bool)))
-        scores = _block_attend(q32, k_cur.astype(jnp.float32),
-                               v_cur.astype(jnp.float32), mask)
-        m, l, o = _online_update(m, l, o, scores, v_cur)
+        k_use, v_use = k_cur, v_cur
+        if gqa_rep > 1:  # expand the visiting block LOCALLY, post-rotation
+            k_use = jnp.repeat(k_cur, gqa_rep, axis=1)
+            v_use = jnp.repeat(v_cur, gqa_rep, axis=1)
+        scores = _block_attend(q32, k_use.astype(jnp.float32),
+                               v_use.astype(jnp.float32), mask)
+        m, l, o = _online_update(m, l, o, scores, v_use)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (m, l, o, k_nxt, v_nxt), None

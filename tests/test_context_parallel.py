@@ -50,11 +50,26 @@ class TestRingCommModel:
         assert cp_ring_ms(model, 4, 1, 1, 8, 90.0) == 0.0
 
     def test_volume_formula(self, model):
-        # cp=4, tp=2: K/V block = 2 * mbs * (S/4) * (H/2) * dtype; 3 rotations
-        # per of the cp-1 steps.
+        # cp=4, tp=2: K/V elems = 2 * mbs * (S/4) * (H/2); per cp-1 step the
+        # ring moves 2 rotations at the model dtype (fwd K/V + bwd K/V) plus
+        # one at fp32 (the bwd dK/dV accumulators — _ring_flash_bwd).
         got = ring_comm_bytes_per_layer(model, mbs=2, cp=4, tp=2)
-        kv = 2 * 2 * (model.sequence_length // 4) * (model.hidden_size // 2) * 2
-        assert got == 3 * 3 * kv
+        kv_elems = 2 * 2 * (model.sequence_length // 4) * (model.hidden_size // 2)
+        assert got == 3 * kv_elems * (2 * model.dtype_bytes + 4)
+
+    def test_volume_formula_gqa(self):
+        # grouped K/V: bytes scale by num_kv_heads / num_heads (the ring
+        # rotates the unexpanded layout — ops/ring_attention.py)
+        from metis_tpu.core.config import ModelSpec
+
+        full = ModelSpec(name="m", num_layers=6, hidden_size=256,
+                         sequence_length=128, vocab_size=512, num_heads=8,
+                         family="llama")
+        gqa = ModelSpec(name="m", num_layers=6, hidden_size=256,
+                        sequence_length=128, vocab_size=512, num_heads=8,
+                        num_kv_heads=2, family="llama")
+        assert ring_comm_bytes_per_layer(gqa, 2, 4, 1) == pytest.approx(
+            ring_comm_bytes_per_layer(full, 2, 4, 1) / 4)
 
     def test_ring_time_scales_inverse_bandwidth(self, model):
         slow = cp_ring_ms(model, 2, 2, 1, 8, 45.0)
