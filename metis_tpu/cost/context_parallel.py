@@ -42,7 +42,7 @@ from __future__ import annotations
 from typing import Sequence
 
 from metis_tpu.core.config import ModelSpec
-from metis_tpu.profiles.store import ProfileStore
+from metis_tpu.profiles.store import ProfileStore, affine_fit
 
 # Ring rotations of the K/V block: 1 forward + 1 backward at the model
 # dtype, plus the backward's dK/dV accumulator rotation at float32 (the
@@ -140,32 +140,6 @@ def attention_layer_range(model: ModelSpec, start: int, end: int) -> int:
     return max(0, hi - lo)
 
 
-def linear_fit_per_layer(
-    xs: Sequence[float], rows: Sequence[Sequence[float]]
-) -> tuple[list[float], list[float]] | None:
-    """Per-layer least squares y = a + b*x over points (xs[k], rows[k][layer]).
-    Returns (intercepts, slopes) unclamped, or None when under-determined
-    (<2 points or zero variance).  Shared by the activation-split and
-    sequence-parallel fits — one copy of the numerics."""
-    n = len(xs)
-    if n < 2:
-        return None
-    mean_x = sum(xs) / n
-    var_x = sum((x - mean_x) ** 2 for x in xs)
-    if var_x == 0:
-        return None
-    num_layers = len(rows[0])
-    intercepts: list[float] = []
-    slopes: list[float] = []
-    for layer in range(num_layers):
-        ys = [row[layer] for row in rows]
-        mean_y = sum(ys) / n
-        b = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, ys)) / var_x
-        intercepts.append(mean_y - b * mean_x)
-        slopes.append(b)
-    return intercepts, slopes
-
-
 class ActivationSplitModel:
     """Per-layer (static, bs-slope) memory decomposition fit from a profile
     store's batch-size sweep, cached per (device_type, tp)."""
@@ -194,19 +168,14 @@ class ActivationSplitModel:
         if len(points) < 2:
             return None
         xs = [float(bs) for bs, _ in points]
-        n = len(xs)
-        mean_x = sum(xs) / n
-        var_x = sum((x - mean_x) ** 2 for x in xs)
-        if var_x == 0:
+        if len(set(xs)) < 2:
             return None
         num_layers = len(points[0][1])
         static: list[float] = []
         slope: list[float] = []
         for layer in range(num_layers):
             ys = [mem[layer] for _, mem in points]
-            mean_y = sum(ys) / n
-            b = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, ys)) / var_x
-            a = mean_y - b * mean_x
+            a, b = affine_fit(xs, ys)
             # Physical clamps: activations can't be negative; static memory
             # can't exceed the smallest observed total.
             b = max(b, 0.0)

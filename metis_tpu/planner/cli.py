@@ -521,6 +521,74 @@ def _cmd_validate(args: argparse.Namespace, profiles, model, config) -> int:
     return 0
 
 
+
+def _run_slice_controller(args, art, model, cluster, profiles,
+                          slice_stage: int) -> int:
+    """The per-slice-controller train route: this process runs ONE stage of
+    the chosen/pinned plan as an independent controller (its own jax
+    runtime, boundary tensors over --peers sockets) — the deployment shape
+    mixed-generation clusters need (a v4 and a v5e slice cannot join one
+    runtime).  Checkpointing is per-run for now: slice controllers train
+    from init (resume would need per-stage checkpoint exchange)."""
+    import dataclasses as _dc
+    import json as _json
+
+    from metis_tpu.execution.builder import resolve_schedule
+    from metis_tpu.execution.multihost2 import (
+        parse_link_addrs,
+        run_artifact_stage_worker,
+    )
+
+    # same resolution rule as the single-controller path: the plan's
+    # priced schedule by default, explicit --schedule/--virtual-stages
+    # override — an explicit `--schedule gpipe` on a 1f1b-priced
+    # artifact is an informed choice the worker must honor
+    sched, vs = resolve_schedule(art, args.schedule, args.virtual_stages)
+    art = _dc.replace(art, schedule=sched, virtual_stages=vs)
+
+    if art.node_sequence:
+        # mixed-device-type stages get uneven data-balancer rows /
+        # per-type sub-mesh groups in the single-runtime executor —
+        # physically impossible under one-controller-per-slice (one jax
+        # runtime cannot span device types); refuse rather than
+        # silently diverge from the plan's cost basis
+        from metis_tpu.core.types import InterStagePlan, Strategy
+        from metis_tpu.execution.hetero import plan_replica_rows
+
+        inter = InterStagePlan(
+            node_sequence=tuple(art.node_sequence),
+            device_groups=tuple(art.device_groups),
+            batches=art.microbatches, gbs=art.gbs)
+        strats = [Strategy(dp=s["dp"], tp=s["tp"])
+                  for s in art.strategies]
+        rows = plan_replica_rows(inter, strats, cluster, profiles)
+        mixed = [i for i, r in enumerate(rows) if r is not None]
+        if mixed:
+            print(f"stages {mixed} span multiple device types (uneven "
+                  "data-balancer rows) — a slice controller owns one "
+                  "jax runtime and cannot realize a mixed-type stage; "
+                  "re-plan with per-slice stage groups or run "
+                  "single-controller", file=sys.stderr)
+            return 2
+
+    links = parse_link_addrs(args.peers)
+    print(f"slice controller: stage {slice_stage} of "
+          f"{len(art.strategies)}, links {links}", file=sys.stderr)
+    report = run_artifact_stage_worker(
+        art, model, slice_stage, links, args.steps, data_path=args.data)
+    summary = {
+        "executable": "slice-controller",
+        "stage": report["stage"],
+        "stages": report["stages"],
+        "local_devices": report["local_devices"],
+        "steps": report["steps"],
+        "first_loss": report["losses"][0] if report["losses"] else None,
+        "final_loss": report["losses"][-1] if report["losses"] else None,
+        "losses": report["losses"],
+    }
+    _emit(args, _json.dumps(summary, indent=2))
+    return 0
+
 def _cmd_train(args: argparse.Namespace, profiles, model, config,
                events) -> int:
     """Plan -> executable -> data pipeline -> checkpointed train loop."""
@@ -618,70 +686,8 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     cfg = config_for_model_spec(model)
 
     if slice_stage is not None:
-        # per-slice-controller route: this process runs ONE stage of the
-        # plan as an independent controller (its own jax runtime, boundary
-        # tensors over sockets) — the deployment shape mixed-generation
-        # clusters need (a v4 and a v5e slice cannot join one runtime).
-        # Checkpointing is per-run for now: slice controllers train from
-        # init (resume would need per-stage checkpoint exchange).
-        import dataclasses as _dc
-        import json as _json
-
-        from metis_tpu.execution.builder import resolve_schedule
-        from metis_tpu.execution.multihost2 import (
-            parse_link_addrs,
-            run_artifact_stage_worker,
-        )
-
-        # same resolution rule as the single-controller path: the plan's
-        # priced schedule by default, explicit --schedule/--virtual-stages
-        # override — an explicit `--schedule gpipe` on a 1f1b-priced
-        # artifact is an informed choice the worker must honor
-        sched, vs = resolve_schedule(art, args.schedule, args.virtual_stages)
-        art = _dc.replace(art, schedule=sched, virtual_stages=vs)
-
-        if art.node_sequence:
-            # mixed-device-type stages get uneven data-balancer rows /
-            # per-type sub-mesh groups in the single-runtime executor —
-            # physically impossible under one-controller-per-slice (one jax
-            # runtime cannot span device types); refuse rather than
-            # silently diverge from the plan's cost basis
-            from metis_tpu.core.types import InterStagePlan, Strategy
-            from metis_tpu.execution.hetero import plan_replica_rows
-
-            inter = InterStagePlan(
-                node_sequence=tuple(art.node_sequence),
-                device_groups=tuple(art.device_groups),
-                batches=art.microbatches, gbs=art.gbs)
-            strats = [Strategy(dp=s["dp"], tp=s["tp"])
-                      for s in art.strategies]
-            rows = plan_replica_rows(inter, strats, cluster, profiles)
-            mixed = [i for i, r in enumerate(rows) if r is not None]
-            if mixed:
-                print(f"stages {mixed} span multiple device types (uneven "
-                      "data-balancer rows) — a slice controller owns one "
-                      "jax runtime and cannot realize a mixed-type stage; "
-                      "re-plan with per-slice stage groups or run "
-                      "single-controller", file=sys.stderr)
-                return 2
-
-        links = parse_link_addrs(args.peers)
-        print(f"slice controller: stage {slice_stage} of "
-              f"{len(art.strategies)}, links {links}", file=sys.stderr)
-        report = run_artifact_stage_worker(
-            art, model, slice_stage, links, args.steps, data_path=args.data)
-        summary = {
-            "executable": "slice-controller",
-            "stage": report["stage"],
-            "stages": report["stages"],
-            "local_devices": report["local_devices"],
-            "steps": report["steps"],
-            "first_loss": report["losses"][0] if report["losses"] else None,
-            "final_loss": report["losses"][-1] if report["losses"] else None,
-            "losses": report["losses"],
-        }
-        _emit(args, _json.dumps(summary, indent=2))
-        return 0
+        return _run_slice_controller(args, art, model, cluster, profiles,
+                                     slice_stage)
 
     # default: run the schedule the chosen/pinned plan was PRICED with
     # (a searched axis — cost/schedule.py); explicit flags override.  One

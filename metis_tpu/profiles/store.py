@@ -25,7 +25,7 @@ import json
 import re
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Iterable, Mapping
+from typing import Iterable, Mapping, Sequence
 
 from metis_tpu.core.errors import MetisError, ProfileMissError
 
@@ -81,6 +81,22 @@ class DeviceTypeMeta:
 
     optimizer_time_ms: float
     batch_generator_ms: float
+
+
+def affine_fit(xs: Sequence[float], ys: Sequence[float]) -> tuple[float, float]:
+    """Least-squares ``(intercept, slope)`` of ``ys ~ a + b * xs`` — the
+    shared 1-D fit behind the profile stores' bs-axis decompositions
+    (:meth:`ProfileStore.affine_view` for times,
+    ``cost.context_parallel.ActivationSplitModel`` for memory).  Callers
+    guard degenerate inputs (len < 2 or constant xs)."""
+    n = len(xs)
+    sx = sum(xs)
+    sy = sum(ys)
+    sxx = sum(x * x for x in xs)
+    sxy = sum(x * y for x, y in zip(xs, ys))
+    denom = n * sxx - sx * sx
+    b = (n * sxy - sx * sy) / denom
+    return (sy - b * sx) / n, b
 
 
 class ProfileStore:
@@ -165,19 +181,12 @@ class ProfileStore:
                 overhead[(t, tp)] = 0.0
                 continue
             bss = sorted(by_bs)
-            n = len(bss)
-            sx = sum(bss)
-            sxx = sum(b * b for b in bss)
-            denom = n * sxx - sx * sx
             L = next(iter(by_bs.values())).num_layers
             slopes: list[float] = []
             a_total = 0.0
             for i in range(L):
                 ys = [by_bs[b].layer_times_ms[i] for b in bss]
-                sy = sum(ys)
-                sxy = sum(b * y for b, y in zip(bss, ys))
-                b_i = (n * sxy - sx * sy) / denom
-                a_i = (sy - b_i * sx) / n
+                a_i, b_i = affine_fit(bss, ys)
                 if b_i <= 0.0:
                     b_i = sum(y / b for y, b in zip(ys, bss)) / n
                     a_i = 0.0
