@@ -236,23 +236,15 @@ def capture_profiles_flash() -> None:
 # config is attempted LAST: a device OOM poisons the backend for the rest
 # of the process (memory: tpu-tunnel hazards), and results are flushed to
 # disk after every entry so earlier measurements survive it.
+# Ordered SMALL-and-diverse first: over the tunnel each config costs
+# minutes of compiles, and the opportunistic bench-time budget may only
+# reach the first few — family/attn diversity must not be stuck behind the
+# big shapes.  Results flush after every entry either way.
 MATRIX = [
     # (name, model_kw, gbs, validate mbs list)
     ("gpt-512x8", dict(name="gpt-512x8", num_layers=8, hidden_size=512,
                        sequence_length=512, vocab_size=16384, num_heads=8),
      8, [2, 8]),
-    ("gpt-1024x10-dense", dict(name="gpt-1024x10", **{
-        k: v for k, v in MODEL_KW.items() if k != "name"}), 8, [1, 4]),
-    ("gpt-1024x10-flash", dict(name="gpt-1024x10f", attn="flash", **{
-        k: v for k, v in MODEL_KW.items() if k != "name"}), 8, [2, 8]),
-    ("gpt-512x16-deep", dict(name="gpt-512x16", num_layers=16,
-                             hidden_size=512, sequence_length=512,
-                             vocab_size=16384, num_heads=8), 8, [4]),
-    ("llama-768x8-flash", dict(name="llama-768x8", num_layers=8,
-                               hidden_size=768, sequence_length=1024,
-                               vocab_size=32768, num_heads=12,
-                               num_kv_heads=4, family="llama",
-                               attn="flash"), 8, [2]),
     ("llama-512x6-dense", dict(name="llama-512x6", num_layers=6,
                                hidden_size=512, sequence_length=512,
                                vocab_size=16384, num_heads=8,
@@ -260,6 +252,18 @@ MATRIX = [
     ("moe-512x6", dict(name="moe-512x6", num_layers=6, hidden_size=512,
                        sequence_length=512, vocab_size=16384, num_heads=8,
                        num_experts=4, expert_top_k=2), 8, [2]),
+    ("gpt-1024x10-flash", dict(name="gpt-1024x10f", attn="flash", **{
+        k: v for k, v in MODEL_KW.items() if k != "name"}), 8, [2, 8]),
+    ("gpt-1024x10-dense", dict(name="gpt-1024x10", **{
+        k: v for k, v in MODEL_KW.items() if k != "name"}), 8, [1, 4]),
+    ("llama-768x8-flash", dict(name="llama-768x8", num_layers=8,
+                               hidden_size=768, sequence_length=1024,
+                               vocab_size=32768, num_heads=12,
+                               num_kv_heads=4, family="llama",
+                               attn="flash"), 8, [2]),
+    ("gpt-512x16-deep", dict(name="gpt-512x16", num_layers=16,
+                             hidden_size=512, sequence_length=512,
+                             vocab_size=16384, num_heads=8), 8, [4]),
     ("gpt-2048x6-flash-seq2048", dict(
         name="gpt-2048x6", num_layers=6, hidden_size=2048,
         sequence_length=2048, vocab_size=32768, num_heads=16,
@@ -293,9 +297,15 @@ def capture_validation_matrix() -> None:
         try:
             model = ModelSpec(**kw)
             bss = tuple(sorted({1, 2} | set(mbss)))
+            # marginal_blocks=False: every matrix plan is pp=1, where only
+            # the layer-time SUM matters — the marginal 2-vs-1-block probe
+            # would double the per-config compile count over the tunnel for
+            # a per-layer refinement nothing here consumes
             store = profile_model(
                 model, tps=(1,), bss=bss,
-                config=ProfilerConfig(warmup=1, iters=3), devices=[dev])
+                config=ProfilerConfig(warmup=1, iters=3,
+                                      marginal_blocks=False),
+                devices=[dev])
             dtype = store.device_types[0]
             # 8 GB capacity, NOT the 16 GB nameplate: the shared chip's
             # free HBM is well under it, and a mid-matrix OOM poisons the
