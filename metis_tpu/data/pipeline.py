@@ -99,6 +99,26 @@ def _host_batches(dataset: TokenDataset, gbs: int, shuffle_seed: int | None,
         epoch += 1
 
 
+#: Synthetic-run epoch size in batches.  The shuffled schedule permutes a
+#: dataset-sized window index, so the dataset size must NOT depend on how
+#: many steps a particular run segment executes — a resumed segment would
+#: otherwise walk a different permutation than the uninterrupted run it
+#: continues.  One fixed epoch (wrapping with a per-epoch reshuffle) keeps
+#: the schedule a pure function of (seed, step).
+SYNTHETIC_SCHEDULE_BATCHES = 64
+
+
+def synthetic_run_dataset(vocab_size: int, gbs: int, seq_len: int,
+                          seed: int = 0) -> TokenDataset:
+    """The synthetic token stream train runs use when no ``--data`` is
+    given — fixed size (``SYNTHETIC_SCHEDULE_BATCHES`` batches per epoch)
+    so every controller and every resume segment derives the identical
+    batch schedule regardless of its own step count."""
+    return TokenDataset.synthetic(
+        vocab_size, gbs * seq_len * SYNTHETIC_SCHEDULE_BATCHES + 1,
+        seq_len, seed=seed)
+
+
 def make_input_pipeline(
     dataset: TokenDataset,
     gbs: int,

@@ -179,8 +179,9 @@ def run_single_controller_losses() -> list[float]:
 # ---------------------------------------------------------------------------
 
 
-def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter,
-                    microbatches) -> dict:
+def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter_factory,
+                    microbatches, restore_hook=None, save_hook=None,
+                    checkpoint_every: int = 0) -> dict:
     """The per-stage controller loop shared by the fixed-workload worker and
     the plan-artifact worker: build this stage's mesh/params/closures, then
     per step run the forward fill (storing only boundary inputs), the
@@ -190,9 +191,18 @@ def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter,
     plan (tests/test_multihost2.py, tests/test_cli.py).
 
     ``connect()`` returns the (to_prev, to_next) sockets;
-    ``batch_iter`` yields microbatch-major ``(tok_mbs, tgt_mbs)`` pairs of
-    shape ``[M, rows, seq]``, identically derived on every controller (the
-    multi-controller feeding contract, ``execution/multihost.py``)."""
+    ``batch_iter_factory(start_step)`` yields microbatch-major
+    ``(tok_mbs, tgt_mbs)`` pairs of shape ``[M, rows, seq]``, identically
+    derived on every controller from the shared data schedule, fast-
+    forwarded past ``start_step`` consumed batches on resume (the
+    multi-controller feeding contract, ``execution/multihost.py``).
+
+    ``restore_hook(params, opt_state, mesh) -> (params, opt_state,
+    start_step)`` and ``save_hook(params, opt_state, step, mesh)`` bolt
+    per-slice checkpointing on: each controller persists ONLY its stage's
+    state.  After the ring connects, neighbors exchange their
+    ``start_step`` and refuse a mismatch — slices resuming from different
+    steps would silently walk different batch schedules."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -276,11 +286,30 @@ def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter,
     add = _in_mesh(jax.jit(
         lambda a, g: jax.tree.map(jnp.add, a, g), donate_argnums=(0,)))
 
+    start_step = 0
+    if restore_hook is not None:
+        params, opt_state, start_step = restore_hook(params, opt_state, mesh)
+
     boundary_spec = NamedSharding(mesh, P(None, None, None))
     to_prev, to_next = connect()
+    # resume consistency handshake: a slice resuming from a different step
+    # than its neighbors would silently feed a different batch schedule
+    for sock in (to_prev, to_next):
+        if sock is not None:
+            send_array(sock, np.asarray([start_step], np.int64))
+    for sock in (to_prev, to_next):
+        if sock is not None:
+            peer = int(recv_array(sock)[0])
+            if peer != start_step:
+                raise RuntimeError(
+                    f"stage {stage_id} resumes at step {start_step} but a "
+                    f"neighbor resumes at {peer} — slice checkpoints are "
+                    "out of sync (same --checkpoint-dir on every "
+                    "controller?)")
+
     losses: list[float] = []
     steps = 0
-    for tok, tgt in batch_iter:
+    for tok, tgt in batch_iter_factory(start_step):
         steps += 1
         x_in: list = [None] * M
         # ---- forward fill (boundary inputs only, as the single-controller
@@ -313,15 +342,22 @@ def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter,
         params, opt_state = apply_upd(params, opt_state, acc)
         if is_last:
             losses.append(float(np.mean(step_losses)))
+        if (save_hook is not None and checkpoint_every
+                and steps % checkpoint_every == 0):
+            save_hook(params, opt_state, start_step + steps, mesh)
 
-    for s in (to_prev, to_next):
-        if s is not None:
-            s.close()
+    if save_hook is not None and not (
+            checkpoint_every and steps % checkpoint_every == 0):
+        save_hook(params, opt_state, start_step + steps, mesh)
+    for sock in (to_prev, to_next):
+        if sock is not None:
+            sock.close()
     return {
         "stage": stage_id,
         "stages": num_stages,
         "local_devices": len(jax.devices()),
         "steps": steps,
+        "start_step": start_step,
         "losses": losses,  # non-last stages report []
     }
 
@@ -338,15 +374,17 @@ def run_stage_worker(stage_id: int, num_stages: int, base_port: int) -> dict:
 
     cfg, stages = workload_plan()
 
-    def batch_iter():
-        for toks in workload_batches():
-            t = jnp.asarray(toks)
-            yield t, t
+    def batch_iter_factory(start_step):
+        def gen():
+            for toks in workload_batches():
+                t = jnp.asarray(toks)
+                yield t, t
+        return gen()
 
     return _run_stage_loop(
         cfg, stages, stage_id,
         lambda: _connect_ring(stage_id, num_stages, base_port),
-        batch_iter(), MICROBATCHES)
+        batch_iter_factory, MICROBATCHES)
 
 
 def run_artifact_stage_worker(
@@ -357,6 +395,8 @@ def run_artifact_stage_worker(
     steps: int,
     data_path: str | None = None,
     seed: int = 0,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
 ) -> dict:
     """One slice controller running stage ``stage_id`` of a REAL plan
     artifact — the CLI-drivable per-slice-controller topology (VERDICT r4
@@ -365,6 +405,10 @@ def run_artifact_stage_worker(
     input-cotangents backward.  Batches flow through the SAME deterministic
     input pipeline as the single-controller train CLI (shared ``seed`` /
     ``data_path``), so every controller derives the identical schedule.
+    With ``checkpoint_dir`` each controller checkpoints/resumes ITS stage
+    under ``<dir>/slice{stage_id}/`` (crash-safe swap, the data schedule
+    fast-forwarded on resume; the ring handshake refuses out-of-sync
+    neighbors).
 
     Refused plan shapes (explicit errors beat silent divergence):
 
@@ -379,7 +423,11 @@ def run_artifact_stage_worker(
       span device types — such stages only exist in the single-runtime
       executor (callers pass ``stage_replica_rows`` from
       ``plan_replica_rows`` to detect this; the CLI does)."""
-    from metis_tpu.data.pipeline import TokenDataset, make_input_pipeline
+    from metis_tpu.data.pipeline import (
+        TokenDataset,
+        make_input_pipeline,
+        synthetic_run_dataset,
+    )
     from metis_tpu.execution.hetero import stage_specs_from_plan
     from metis_tpu.execution.pipeline import microbatch_split
     from metis_tpu.models import config_for_model_spec
@@ -423,22 +471,60 @@ def run_artifact_stage_worker(
                     else np.memmap(data_path, dtype=np.int32, mode="r"))
         dataset = TokenDataset(toks_src, model.sequence_length)
     else:
-        dataset = TokenDataset.synthetic(
-            model.vocab_size,
-            artifact.gbs * model.sequence_length * (steps + 2) + 1,
-            model.sequence_length, seed=seed)
-    batches = make_input_pipeline(dataset, artifact.gbs, epochs=None)
+        dataset = synthetic_run_dataset(
+            model.vocab_size, artifact.gbs, model.sequence_length, seed=seed)
 
-    def batch_iter():
-        for _ in range(steps):
-            toks_g, tgts_g = next(batches)
-            yield (microbatch_split(jnp.asarray(toks_g), M),
-                   microbatch_split(jnp.asarray(tgts_g), M))
+    def batch_iter_factory(start_step):
+        # fast-forward the deterministic schedule past the batches the
+        # resumed steps already consumed (same rule as the train CLI)
+        batches = make_input_pipeline(dataset, artifact.gbs, epochs=None,
+                                      skip_batches=start_step)
+
+        def gen():
+            for _ in range(steps):
+                toks_g, tgts_g = next(batches)
+                yield (microbatch_split(jnp.asarray(toks_g), M),
+                       microbatch_split(jnp.asarray(tgts_g), M))
+        return gen()
+
+    restore_hook = save_hook = None
+    if checkpoint_dir is not None:
+        # each controller persists ONLY its stage: <dir>/slice{stage_id}/
+        # (next to the pinned plan.json — no clash); the loop's ring
+        # handshake refuses neighbors resumed from a different step
+        from pathlib import Path
+
+        from metis_tpu.execution.checkpoint import (
+            load_meta,
+            restore_checkpoint,
+            save_checkpoint,
+        )
+        from metis_tpu.execution.train import TrainState
+
+        sdir = Path(checkpoint_dir) / f"slice{stage_id}"
+
+        def restore_hook(params, opt_state, mesh):
+            try:
+                meta = load_meta(sdir)
+            except FileNotFoundError:
+                return params, opt_state, 0
+            restored = restore_checkpoint(
+                sdir, TrainState(params=params, opt_state=opt_state,
+                                 step=jnp.zeros((), jnp.int32)))
+            return restored.params, restored.opt_state, meta.step
+
+        def save_hook(params, opt_state, step, mesh):
+            save_checkpoint(
+                sdir,
+                TrainState(params=params, opt_state=opt_state,
+                           step=jnp.asarray(step, jnp.int32)),
+                mesh, plan=artifact)
 
     return _run_stage_loop(
         cfg, stages, stage_id,
         lambda: _connect_ring_addrs(stage_id, num_stages, link_addrs),
-        batch_iter(), M)
+        batch_iter_factory, M, restore_hook=restore_hook,
+        save_hook=save_hook, checkpoint_every=checkpoint_every)
 
 
 def parse_link_addrs(peers: str) -> list[tuple[str, int]]:

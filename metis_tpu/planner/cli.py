@@ -528,8 +528,9 @@ def _run_slice_controller(args, art, model, cluster, profiles,
     the chosen/pinned plan as an independent controller (its own jax
     runtime, boundary tensors over --peers sockets) — the deployment shape
     mixed-generation clusters need (a v4 and a v5e slice cannot join one
-    runtime).  Checkpointing is per-run for now: slice controllers train
-    from init (resume would need per-stage checkpoint exchange)."""
+    runtime).  With --checkpoint-dir each controller checkpoints and
+    resumes ITS OWN stage under <dir>/slice{stage}/ (the ring handshake
+    refuses neighbors resumed from a different step)."""
     import dataclasses as _dc
     import json as _json
 
@@ -575,13 +576,16 @@ def _run_slice_controller(args, art, model, cluster, profiles,
     print(f"slice controller: stage {slice_stage} of "
           f"{len(art.strategies)}, links {links}", file=sys.stderr)
     report = run_artifact_stage_worker(
-        art, model, slice_stage, links, args.steps, data_path=args.data)
+        art, model, slice_stage, links, args.steps, data_path=args.data,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every)
     summary = {
         "executable": "slice-controller",
         "stage": report["stage"],
         "stages": report["stages"],
         "local_devices": report["local_devices"],
         "steps": report["steps"],
+        "start_step": report["start_step"],
         "first_loss": report["losses"][0] if report["losses"] else None,
         "final_loss": report["losses"][-1] if report["losses"] else None,
         "losses": report["losses"],
@@ -744,10 +748,13 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                   else np.memmap(args.data, dtype=np.int32, mode="r"))
         dataset = TokenDataset(tokens, model.sequence_length)
     else:
-        dataset = TokenDataset.synthetic(
-            model.vocab_size,
-            art.gbs * model.sequence_length * (args.steps + 2) + 1,
-            model.sequence_length)
+        from metis_tpu.data.pipeline import synthetic_run_dataset
+
+        # fixed-size stream: the shuffled schedule must not depend on this
+        # segment's --steps, or a resumed run would walk a different
+        # permutation than the run it continues (data/pipeline.py)
+        dataset = synthetic_run_dataset(
+            model.vocab_size, art.gbs, model.sequence_length)
     mesh = art.build_mesh() if art.mesh_shape else None
 
     # gspmd states ARE TrainStates; the pipeline route's (params, opt_state)

@@ -188,7 +188,7 @@ class ProfileStore:
                 ys = [by_bs[b].layer_times_ms[i] for b in bss]
                 a_i, b_i = affine_fit(bss, ys)
                 if b_i <= 0.0:
-                    b_i = sum(y / b for y, b in zip(ys, bss)) / n
+                    b_i = sum(y / b for y, b in zip(ys, bss)) / len(bss)
                     a_i = 0.0
                 slopes.append(b_i)
                 a_total += a_i

@@ -271,6 +271,27 @@ class TestMbAffine:
         for t in totals[1:]:
             assert t == pytest.approx(totals[0], rel=0.02)
 
+    def test_affine_fallback_on_noise_negative_slope(self):
+        """A layer whose profiled time DECREASES with bs (pure noise) falls
+        back to the mean per-sample rate with zero intercept (regression:
+        round-5 refactor broke this branch with a NameError)."""
+        from metis_tpu.profiles.store import (
+            DeviceTypeMeta, LayerProfile, ModelProfileMeta, ProfileStore)
+
+        entries = {
+            ("X", 1, bs): LayerProfile(
+                layer_times_ms=(t,) * 3, layer_memory_mb=(1.0,) * 3,
+                fb_sync_ms=0.0)
+            for bs, t in [(1, 8.0), (2, 6.0), (4, 4.0)]  # negative slope
+        }
+        meta = ModelProfileMeta(3, 1.0, 1.0, (10,) * 3)
+        store = ProfileStore(entries, meta, {"X": DeviceTypeMeta(1.0, 1.0)})
+        smoothed, overhead = store.affine_view()
+        assert overhead[("X", 1)] == 0.0
+        rate = (8.0 / 1 + 6.0 / 2 + 4.0 / 4) / 3
+        assert smoothed.get("X", 1, 2).layer_times_ms == pytest.approx(
+            (rate * 2,) * 3)
+
     def test_strict_compat_unaffected(self, cluster, profiles, volume):
         """Strict-compat never smooths — reference per-microbatch parity."""
         est = UniformCostEstimator(

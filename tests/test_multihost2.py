@@ -129,7 +129,7 @@ print(json.dumps(rep), flush=True)
     assert outs[1]["losses"] == []
 
     # single-controller oracle: same artifact, same deterministic stream
-    from metis_tpu.data.pipeline import TokenDataset, make_input_pipeline
+    from metis_tpu.data.pipeline import make_input_pipeline, synthetic_run_dataset
     from metis_tpu.execution.hetero import make_hetero_train_step_from_artifact
     from metis_tpu.execution.pipeline import microbatch_split
     from metis_tpu.models import config_for_model_spec
@@ -140,10 +140,10 @@ print(json.dumps(rep), flush=True)
     init_fn, step_fn = make_hetero_train_step_from_artifact(
         cfg, art, devices=jax.devices()[:3])
     state = init_fn(jax.random.PRNGKey(0))
-    dataset = TokenDataset.synthetic(
-        model.vocab_size,
-        art.gbs * model.sequence_length * (steps + 2) + 1,
-        model.sequence_length, seed=0)
+    # the SAME fixed-size schedule the worker derives (data/pipeline.py:
+    # size must not depend on the segment's step count)
+    dataset = synthetic_run_dataset(
+        model.vocab_size, art.gbs, model.sequence_length, seed=0)
     batches = make_input_pipeline(dataset, art.gbs, epochs=None)
     oracle = []
     for _ in range(steps):
@@ -153,3 +153,76 @@ print(json.dumps(rep), flush=True)
         state, loss = step_fn(state, tok, tgt)
         oracle.append(float(loss))
     assert losses == pytest.approx(oracle, rel=1e-5)
+
+
+def test_artifact_worker_checkpoint_resume(tmp_path):
+    """Per-slice checkpointing: 1 step + save on each controller, then a
+    fresh pair of controllers resumes from <dir>/slice{i}/ and runs 1 more
+    step — loss stream equals an uninterrupted 2-step run (the data
+    schedule fast-forwards past the consumed batch; the ring handshake
+    passed means both slices agreed on the resume step)."""
+    import dataclasses
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from metis_tpu.core.config import ModelSpec
+    from metis_tpu.execution.mesh import PlanArtifact
+
+    model = ModelSpec(name="mck", num_layers=4, hidden_size=64,
+                      sequence_length=16, vocab_size=128, num_heads=4)
+    art = PlanArtifact(
+        mesh_axes=(), mesh_shape=(),
+        layer_partition=(0, 2, 4),
+        strategies=({"dp": 1, "tp": 1},) * 2,
+        gbs=4, microbatches=2)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    worker_src = """
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from metis_tpu.core.config import ModelSpec
+from metis_tpu.execution.mesh import PlanArtifact
+from metis_tpu.execution.multihost2 import run_artifact_stage_worker
+art = PlanArtifact.from_json(sys.argv[1])
+model = ModelSpec(**json.loads(sys.argv[2]))
+links = [("127.0.0.1", int(sys.argv[3]))]
+rep = run_artifact_stage_worker(
+    art, model, int(sys.argv[4]), links, int(sys.argv[5]),
+    checkpoint_dir=sys.argv[6] or None)
+print(json.dumps(rep), flush=True)
+"""
+
+    def run_pair(port, steps, ckpt):
+        procs = []
+        for stage in range(2):
+            env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                   "PYTHONPATH": repo}
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", worker_src, art.to_json(),
+                 json.dumps(dataclasses.asdict(model)), str(port),
+                 str(stage), str(steps), ckpt],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env, cwd=repo))
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, err[-2000:]
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        return outs
+
+    base_port = 29000 + (os.getpid() % 6000)
+    ckpt = str(tmp_path / "slices")
+    first = run_pair(base_port, 1, ckpt)
+    assert first[1]["start_step"] == 0 and len(first[1]["losses"]) == 1
+    resumed = run_pair(base_port + 1, 1, ckpt)
+    assert resumed[1]["start_step"] == 1
+
+    uninterrupted = run_pair(base_port + 2, 2, "")
+    assert uninterrupted[1]["losses"][0] == pytest.approx(
+        first[1]["losses"][0], rel=1e-6)
+    assert uninterrupted[1]["losses"][1] == pytest.approx(
+        resumed[1]["losses"][0], rel=1e-5)
