@@ -76,6 +76,7 @@ def save_checkpoint(
     mesh: Mesh,
     plan: PlanArtifact | None = None,
     block_layout: str = "canonical",
+    keep_prev: bool = False,
 ) -> Path:
     """Write state (+ optional plan artifact) under ``directory``.
 
@@ -97,7 +98,8 @@ def save_checkpoint(
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp / _STATE_DIR, tree, force=True)
     _write_meta_and_plan(tmp, _mesh_meta(state, mesh, block_layout), plan)
-    _swap_tmp_into_place(directory, tmp, prev, multi_host)
+    _swap_tmp_into_place(directory, tmp, prev, multi_host,
+                         keep_prev=keep_prev)
     return directory
 
 
@@ -145,9 +147,14 @@ def _prepare_tmp(directory: Path) -> tuple[Path, Path, bool]:
 
 
 def _swap_tmp_into_place(directory: Path, tmp: Path, prev: Path,
-                         multi_host: bool) -> None:
+                         multi_host: bool, keep_prev: bool = False) -> None:
     """The crash-safe primary swap (see ``save_checkpoint`` ordering
-    invariant); fenced so no host returns mid-swap."""
+    invariant); fenced so no host returns mid-swap.  ``keep_prev`` retains
+    the displaced checkpoint as a rollback generation — slice-controller
+    saves need it: each slice saves independently, and a crash between two
+    slices' saves leaves them at different steps; the behind slice's step
+    is then only reachable by the ahead slice through its ``.prev``
+    (``execution/multihost2.py`` rollback handshake)."""
     if multi_host:
         from jax.experimental import multihost_utils
 
@@ -158,7 +165,7 @@ def _swap_tmp_into_place(directory: Path, tmp: Path, prev: Path,
                 shutil.rmtree(prev)
             directory.rename(prev)
         tmp.rename(directory)
-        if prev.exists():
+        if prev.exists() and not keep_prev:
             shutil.rmtree(prev)
     if multi_host:
         from jax.experimental import multihost_utils

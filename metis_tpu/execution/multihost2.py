@@ -181,7 +181,7 @@ def run_single_controller_losses() -> list[float]:
 
 def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter_factory,
                     microbatches, restore_hook=None, save_hook=None,
-                    checkpoint_every: int = 0) -> dict:
+                    rollback_hook=None, checkpoint_every: int = 0) -> dict:
     """The per-stage controller loop shared by the fixed-workload worker and
     the plan-artifact worker: build this stage's mesh/params/closures, then
     per step run the forward fill (storing only boundary inputs), the
@@ -234,16 +234,20 @@ def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter_factory,
         mesh = Mesh(np.array(devs).reshape(spec.dp, spec.tp), (DP, TP))
 
     # identical init to the single-controller executor: one full init from
-    # the shared seed, slice this stage's leaves
-    init_params_fn = family_ops(cfg)[3]
-    full = init_params_fn(jax.random.PRNGKey(0), cfg)
-    specs = _stage_param_specs(spec, cfg)
-    params = jax.tree.map(
-        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
-        _slice_stage_params(full, spec), specs)
+    # the shared seed, slice this stage's leaves.  A function: the rollback
+    # path re-derives step-0 state without holding a pristine copy live.
     optimizer = build_optimizer()
-    with mesh:
-        opt_state = optimizer.init(params)
+    specs = _stage_param_specs(spec, cfg)
+
+    def init_state():
+        full = family_ops(cfg)[3](jax.random.PRNGKey(0), cfg)
+        p0 = jax.tree.map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+            _slice_stage_params(full, spec), specs)
+        with mesh:
+            return p0, optimizer.init(p0)
+
+    params, opt_state = init_state()
 
     total_blocks = max(cfg.num_blocks, 1)
     fn = _make_stage_fn(spec, cfg, resolve_attention(cfg),
@@ -292,20 +296,40 @@ def _run_stage_loop(cfg, stages, stage_id, connect, batch_iter_factory,
 
     boundary_spec = NamedSharding(mesh, P(None, None, None))
     to_prev, to_next = connect()
-    # resume consistency handshake: a slice resuming from a different step
-    # than its neighbors would silently feed a different batch schedule
-    for sock in (to_prev, to_next):
-        if sock is not None:
-            send_array(sock, np.asarray([start_step], np.int64))
-    for sock in (to_prev, to_next):
-        if sock is not None:
-            peer = int(recv_array(sock)[0])
-            if peer != start_step:
-                raise RuntimeError(
-                    f"stage {stage_id} resumes at step {start_step} but a "
-                    f"neighbor resumes at {peer} — slice checkpoints are "
-                    "out of sync (same --checkpoint-dir on every "
-                    "controller?)")
+    # Resume agreement: slices resuming from different steps would silently
+    # feed different batch schedules.  Saves on different controllers are
+    # uncoordinated, so a crash in the inter-slice save window legitimately
+    # leaves neighbors at different steps — the chain agrees on the GLOBAL
+    # minimum (num_stages-1 rounds of neighbor-min propagation) and any
+    # slice ahead of it rolls back through its retained ``.prev``
+    # generation (rollback_hook); only an unrecoverable gap raises.
+    def _exchange_min(step):
+        for sock in (to_prev, to_next):
+            if sock is not None:
+                send_array(sock, np.asarray([step], np.int64))
+        for sock in (to_prev, to_next):
+            if sock is not None:
+                step = min(step, int(recv_array(sock)[0]))
+        return step
+
+    agreed = start_step
+    for _ in range(max(num_stages - 1, 1)):
+        agreed = _exchange_min(agreed)
+    if agreed != start_step:
+        if agreed == 0:
+            # step 0 needs no checkpoint: re-derive the fresh init
+            params, opt_state = init_state()
+            rolled = (params, opt_state, 0)
+        else:
+            rolled = (rollback_hook(agreed, params, opt_state, mesh)
+                      if rollback_hook is not None else None)
+        if rolled is None:
+            raise RuntimeError(
+                f"stage {stage_id} resumes at step {start_step} but the "
+                f"slice chain agrees on {agreed}, and no rollback "
+                f"generation reaches it — slice checkpoints are out of "
+                "sync (same --checkpoint-dir on every controller?)")
+        params, opt_state, start_step = rolled
 
     losses: list[float] = []
     steps = 0
@@ -487,11 +511,12 @@ def run_artifact_stage_worker(
                        microbatch_split(jnp.asarray(tgts_g), M))
         return gen()
 
-    restore_hook = save_hook = None
+    restore_hook = save_hook = rollback_hook = None
     if checkpoint_dir is not None:
         # each controller persists ONLY its stage: <dir>/slice{stage_id}/
-        # (next to the pinned plan.json — no clash); the loop's ring
-        # handshake refuses neighbors resumed from a different step
+        # (next to the top-level plan.json the CLI pins); the loop's ring
+        # handshake agrees on the chain-min step and rolls ahead slices
+        # back through their retained .prev generation
         from pathlib import Path
 
         from metis_tpu.execution.checkpoint import (
@@ -514,17 +539,38 @@ def run_artifact_stage_worker(
             return restored.params, restored.opt_state, meta.step
 
         def save_hook(params, opt_state, step, mesh):
+            # keep_prev: saves on different controllers are uncoordinated —
+            # the retained generation is the rollback target when a crash
+            # lands between two slices' saves (rollback_hook below)
             save_checkpoint(
                 sdir,
                 TrainState(params=params, opt_state=opt_state,
                            step=jnp.asarray(step, jnp.int32)),
-                mesh, plan=artifact)
+                mesh, plan=artifact, keep_prev=True)
+
+        def rollback_hook(target_step, params, opt_state, mesh):
+            """(params, opt_state, target_step) from a checkpoint at
+            EXACTLY target_step — the primary if it matches, else the
+            retained .prev generation; None when neither reaches it."""
+            prev = sdir.with_name(sdir.name + ".prev")
+            for d in (sdir, prev):
+                try:
+                    if load_meta(d).step != target_step:
+                        continue
+                except (FileNotFoundError, OSError):
+                    continue
+                restored = restore_checkpoint(
+                    d, TrainState(params=params, opt_state=opt_state,
+                                  step=jnp.zeros((), jnp.int32)))
+                return restored.params, restored.opt_state, target_step
+            return None  # target 0 is served by the loop's fresh re-init
 
     return _run_stage_loop(
         cfg, stages, stage_id,
         lambda: _connect_ring_addrs(stage_id, num_stages, link_addrs),
         batch_iter_factory, M, restore_hook=restore_hook,
-        save_hook=save_hook, checkpoint_every=checkpoint_every)
+        save_hook=save_hook, rollback_hook=rollback_hook,
+        checkpoint_every=checkpoint_every)
 
 
 def parse_link_addrs(peers: str) -> list[tuple[str, int]]:

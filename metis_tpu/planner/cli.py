@@ -572,6 +572,19 @@ def _run_slice_controller(args, art, model, cluster, profiles,
                   "single-controller", file=sys.stderr)
             return 2
 
+    if args.checkpoint_dir is not None:
+        # pin the RESOLVED plan at the top level (the path _cmd_train's
+        # resume pinning reads — review r5: the per-slice plan.json copies
+        # under slice{N}/ are not where load_plan looks, so a resume would
+        # re-run the search and could restore old state into a different
+        # plan)
+        from pathlib import Path as _Path
+
+        pin = _Path(args.checkpoint_dir) / "plan.json"
+        if not pin.exists():
+            pin.parent.mkdir(parents=True, exist_ok=True)
+            pin.write_text(art.to_json())
+
     links = parse_link_addrs(args.peers)
     print(f"slice controller: stage {slice_stage} of "
           f"{len(art.strategies)}, links {links}", file=sys.stderr)

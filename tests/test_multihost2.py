@@ -226,3 +226,81 @@ print(json.dumps(rep), flush=True)
         first[1]["losses"][0], rel=1e-6)
     assert uninterrupted[1]["losses"][1] == pytest.approx(
         resumed[1]["losses"][0], rel=1e-5)
+
+
+def test_artifact_worker_rollback_on_torn_checkpoints(tmp_path):
+    """A crash between two slices' saves leaves them at different steps —
+    the chain-min handshake must roll the AHEAD slice back through its
+    retained .prev generation instead of wedging the run (review r5)."""
+    import dataclasses
+    import json
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    from metis_tpu.core.config import ModelSpec
+    from metis_tpu.execution.mesh import PlanArtifact
+
+    model = ModelSpec(name="mrb", num_layers=4, hidden_size=64,
+                      sequence_length=16, vocab_size=128, num_heads=4)
+    art = PlanArtifact(
+        mesh_axes=(), mesh_shape=(),
+        layer_partition=(0, 2, 4),
+        strategies=({"dp": 1, "tp": 1},) * 2,
+        gbs=4, microbatches=2)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    worker_src = """
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from metis_tpu.core.config import ModelSpec
+from metis_tpu.execution.mesh import PlanArtifact
+from metis_tpu.execution.multihost2 import run_artifact_stage_worker
+art = PlanArtifact.from_json(sys.argv[1])
+model = ModelSpec(**json.loads(sys.argv[2]))
+links = [("127.0.0.1", int(sys.argv[3]))]
+rep = run_artifact_stage_worker(
+    art, model, int(sys.argv[4]), links, int(sys.argv[5]),
+    checkpoint_dir=sys.argv[6])
+print(json.dumps(rep), flush=True)
+"""
+
+    def run_pair(port, steps, ckpt):
+        procs = []
+        for stage in range(2):
+            env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                   "PYTHONPATH": repo}
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", worker_src, art.to_json(),
+                 json.dumps(dataclasses.asdict(model)), str(port),
+                 str(stage), str(steps), ckpt],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env, cwd=repo))
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, err[-2000:]
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        return outs
+
+    base_port = 17000 + (os.getpid() % 4000)
+    ckpt = tmp_path / "slices"
+    run_pair(base_port, 1, str(ckpt))      # both slices at step 1
+    run_pair(base_port + 1, 1, str(ckpt))  # both at 2, .prev at 1
+
+    # simulate the crash window: stage 1's last save never happened —
+    # its primary reverts to the step-1 generation, stage 0 stays at 2
+    s1 = ckpt / "slice1"
+    prev1 = ckpt / "slice1.prev"
+    shutil.rmtree(s1)
+    prev1.rename(s1)
+
+    # resume: stage 0 (at 2) must roll back to the agreed min (1) via its
+    # .prev and the pair must continue — landing both at step 2
+    outs = run_pair(base_port + 2, 1, str(ckpt))
+    assert outs[0]["start_step"] == 1
+    assert outs[1]["start_step"] == 1
+    assert len(outs[1]["losses"]) == 1
