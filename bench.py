@@ -27,6 +27,13 @@ composition-level pruning + exact-prune ranking parity vs exhaustive at 64
 devices), per-executor-family contention calibration with held-out errors
 in ``validation``, measured dp-overlap feeding the cost model, and the
 probe transcript / capture cache documented at ``probe_tpu``/``tpu_capture``.
+
+Telemetry is INCREMENTAL (``SectionRecorder``): every section appends its
+own record to ``bench_sections.jsonl`` (and stderr) the moment it
+completes, a ``BENCH_DEADLINE_S`` wall-clock budget skips remaining
+sections with a recorded reason, and the final stdout line is assembled
+from whatever finished — a timeout can no longer produce an empty tail
+(BENCH_r05 was ``rc=124, tail=""``).
 """
 from __future__ import annotations
 
@@ -47,6 +54,87 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 RECORDED_REFERENCE_S = 3.3
 SCALE_REFERENCE_BUDGET_S = 300.0
+
+# Incremental telemetry (VERDICT r5: BENCH_r05 was rc=124 with an EMPTY
+# tail — the bench died at its budget having printed nothing).  Every
+# section now flushes its own JSONL record to this sidecar (and stderr)
+# the moment it completes; the final one-line JSON is assembled from
+# whatever sections finished.  BENCH_DEADLINE_S (env) is a wall-clock
+# budget: once exceeded, remaining sections are skipped with a recorded
+# reason instead of being killed mid-flight.
+SECTIONS_PATH = Path(os.environ.get(
+    "BENCH_SECTIONS_PATH",
+    Path(__file__).resolve().parent / "bench_sections.jsonl"))
+
+
+class SectionRecorder:
+    """Crash-proof per-section telemetry: a truncate-at-start, append-per-
+    section JSONL sidecar, each line flushed+fsynced the moment its section
+    completes (ok / error / skipped), mirrored to stderr.  A timeout or
+    crash at ANY point leaves every finished section's record on disk —
+    an empty-tail loss is impossible by construction."""
+
+    def __init__(self, path: Path = None, deadline_s: float | None = None):
+        self.path = Path(path) if path is not None else SECTIONS_PATH
+        self.deadline_s = deadline_s
+        self.t0 = time.monotonic()
+        self.statuses: dict[str, str] = {}
+        try:
+            self.path.write_text("")
+        except OSError:
+            pass
+
+    def elapsed_s(self) -> float:
+        return time.monotonic() - self.t0
+
+    def remaining_s(self) -> float | None:
+        if self.deadline_s is None:
+            return None
+        return self.deadline_s - self.elapsed_s()
+
+    def over_deadline(self) -> bool:
+        remaining = self.remaining_s()
+        return remaining is not None and remaining <= 0
+
+    def flush(self, section: str, status: str, payload=None,
+              wall_s: float | None = None) -> None:
+        self.statuses[section] = status
+        rec: dict = {"ts": time.time(), "section": section, "status": status,
+                     "elapsed_s": round(self.elapsed_s(), 2)}
+        if wall_s is not None:
+            rec["wall_s"] = round(wall_s, 2)
+        if payload is not None:
+            rec["data"] = payload
+        line = json.dumps(rec, default=str)
+        try:
+            with self.path.open("a") as fh:
+                fh.write(line + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+        except OSError:
+            pass
+        print(line, file=sys.stderr, flush=True)
+
+    def run(self, name: str, fn, record: dict) -> None:
+        """Run one section against the shared record dict; whatever keys it
+        adds become the flushed payload.  Exceptions are recorded, not
+        raised — one broken section must not cost the others' evidence."""
+        if self.over_deadline():
+            reason = (f"BENCH_DEADLINE_S={self.deadline_s:.0f} exhausted "
+                      f"({self.elapsed_s():.0f}s elapsed)")
+            record[name] = {"skipped": reason}
+            self.flush(name, "skipped", {"skipped": reason})
+            return
+        before = set(record)
+        t0 = time.monotonic()
+        status = "ok"
+        try:
+            fn(record)
+        except Exception as e:  # noqa: BLE001 — record, don't mask
+            record[name] = {"error": f"{type(e).__name__}: {e}"[:160]}
+            status = "error"
+        payload = {k: record[k] for k in record if k not in before}
+        self.flush(name, status, payload, wall_s=time.monotonic() - t0)
 TPU_PEAK_BF16 = {
     # device_kind substring -> peak bf16 TFLOP/s
     "v5 lite": 197e12, "v5e": 197e12, "v4": 275e12, "v5p": 459e12,
@@ -835,8 +923,9 @@ def tpu_validation(record: dict) -> None:
         record["tpu_validation"] = {"skipped": f"{type(e).__name__}: {e}"[:160]}
 
 
-PROBE_LOG = Path(__file__).resolve().parent / "calibration" / \
-    "tpu_probe_log.jsonl"
+PROBE_LOG = Path(os.environ.get(
+    "BENCH_PROBE_LOG",
+    Path(__file__).resolve().parent / "calibration" / "tpu_probe_log.jsonl"))
 TPU_CACHE = Path(__file__).resolve().parent / "calibration" / \
     "tpu_results_cache.json"
 
@@ -1038,49 +1127,38 @@ def opportunistic_deep_captures(record: dict) -> None:
         record["deep_capture_runs"] = out
 
 
-def main() -> None:
-    record: dict = {}
-    if not probe_tpu():
-        # pin THIS process to CPU so a wedged tunnel cannot hang the bench;
-        # the env var alone is NOT enough — the remote-TPU plugin overrides
-        # jax_platforms at import, so pin via jax.config before any backend
-        # initialization.  TPU sections then record the skip.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+def _probe_section(record: dict) -> None:
+    """TPU reachability probe; pins THIS process to CPU on failure so a
+    wedged tunnel cannot hang the bench (the env var alone is NOT enough —
+    the remote-TPU plugin overrides jax_platforms at import, so pin via
+    jax.config before any backend initialization)."""
+    if probe_tpu():
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
 
-        jax.config.update("jax_platforms", "cpu")
-        attempts = probe_attempts()
-        last = attempts[-1] if attempts else {}
-        if last.get("timed_out"):
-            why = "backend init/execute timed out (wedged tunnel)"
-        elif (last.get("backend") or "").startswith("cpu"):
-            why = "backend reachable but CPU-only (no TPU attached)"
-        elif last.get("rc") not in (0, None):
-            why = f"backend init failed (rc={last['rc']})"
-        else:
-            why = "probe failed"
-        record["tpu_probe"] = {
-            "status": f"no TPU: {why}; bench pinned to cpu",
-            "attempts_total": len(attempts),
-            "attempts_ok": sum(1 for a in attempts if a.get("ok")),
-            "recent_attempts": attempts[-8:],
-        }
-    parity_search(record)
-    for section in (scale_search, scale_search_256, northstar,
-                    validation_error):
-        try:
-            section(record)
-        except Exception as e:
-            record[section.__name__] = {
-                "error": f"{type(e).__name__}: {e}"[:160]}
-    # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
-    # proves the tunnel was alive at bench start — it wedged MID-RUN once
-    # (r4) and the inline tpu_step hung the whole bench past the driver's
-    # budget.  The subprocess is bounded; on timeout/crash the skip reason
-    # is recorded and the capture-cache fold below still supplies the last
-    # good hardware numbers.
-    tpu_sections_subprocess(record)
-    opportunistic_deep_captures(record)
+    jax.config.update("jax_platforms", "cpu")
+    attempts = probe_attempts()
+    last = attempts[-1] if attempts else {}
+    if last.get("timed_out"):
+        why = "backend init/execute timed out (wedged tunnel)"
+    elif (last.get("backend") or "").startswith("cpu"):
+        why = "backend reachable but CPU-only (no TPU attached)"
+    elif last.get("rc") not in (0, None):
+        why = f"backend init failed (rc={last['rc']})"
+    else:
+        why = "probe failed"
+    record["tpu_probe"] = {
+        "status": f"no TPU: {why}; bench pinned to cpu",
+        "attempts_total": len(attempts),
+        "attempts_ok": sum(1 for a in attempts if a.get("ok")),
+        "recent_attempts": attempts[-8:],
+    }
+
+
+def _artifact_folds(record: dict) -> None:
+    """Fold committed calibration artifacts into the record (capture cache,
+    mosaic AOT evidence, deep-capture files) — cheap reads, one section."""
     # a wedged tunnel at bench time must not erase hardware numbers captured
     # earlier in the round (bench --tpu-capture persists them with a stamp);
     # only entries with real measurements replace a live skip
@@ -1137,16 +1215,64 @@ def main() -> None:
                 deep[key] = {"dir": f"calibration/{sub}", "files": files}
     if deep:
         record["tpu_deep"] = deep
+
+
+def main() -> None:
+    record: dict = {}
+    deadline_env = os.environ.get("BENCH_DEADLINE_S")
+    recorder = SectionRecorder(
+        deadline_s=float(deadline_env) if deadline_env else None)
+    # flushed before any jax/metis import: even a bench truncated within
+    # seconds leaves a completed-section record on disk
+    recorder.flush("startup", "ok", {
+        "python": sys.version.split()[0],
+        "deadline_s": recorder.deadline_s,
+        "sections_file": str(recorder.path),
+    })
+    recorder.run("probe", _probe_section, record)
+    recorder.run("parity", parity_search, record)
+    recorder.run("scale_search", scale_search, record)
+    recorder.run("scale_search_256", scale_search_256, record)
+    recorder.run("northstar", northstar, record)
+    recorder.run("validation", validation_error, record)
+
+    # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
+    # proves the tunnel was alive at bench start — it wedged MID-RUN once
+    # (r4) and the inline tpu_step hung the whole bench past the driver's
+    # budget.  The subprocess is bounded (and further clamped to the
+    # remaining BENCH_DEADLINE_S); on timeout/crash the skip reason is
+    # recorded and the capture-cache fold still supplies the last good
+    # hardware numbers.
+    def _tpu_sections(rec: dict) -> None:
+        remaining = recorder.remaining_s()
+        timeout = (1500.0 if remaining is None
+                   else max(min(1500.0, remaining), 60.0))
+        tpu_sections_subprocess(rec, timeout_s=timeout)
+
+    recorder.run("tpu_sections", _tpu_sections, record)
+    recorder.run("deep_captures", opportunistic_deep_captures, record)
+    recorder.run("artifact_folds", _artifact_folds, record)
+
+    record["sections"] = dict(recorder.statuses)
+    if recorder.deadline_s is not None:
+        record["bench_deadline_s"] = recorder.deadline_s
+    record["bench_wall_s"] = round(recorder.elapsed_s(), 1)
     # The driver captures only a ~2000-char tail of stdout (round 2/3
     # artifacts came back "parsed": null) — persist the FULL record to a
     # repo file and keep the final stdout line compact enough to survive
     # the tail capture.
-    out_path = Path(__file__).resolve().parent / "bench_out.json"
+    out_path = Path(os.environ.get(
+        "BENCH_OUT_PATH",
+        Path(__file__).resolve().parent / "bench_out.json"))
     try:
         out_path.write_text(json.dumps(record, indent=1))
     except OSError as e:
         record["bench_out_write_failed"] = str(e)[:120]
-    print(json.dumps(_headline(record)))
+    headline = _headline(record)
+    # the headline is itself a section record: a driver that loses stdout
+    # entirely can still recover the one-line JSON from the sidecar
+    recorder.flush("headline", "ok", headline)
+    print(json.dumps(headline))
 
 
 def _tpu_brief(record: dict, key: str) -> dict:
@@ -1208,7 +1334,13 @@ def _headline(record: dict) -> dict:
             k: v["error"] for k, v in record.items()
             if isinstance(v, dict) and "error" in v} or None,
         "bench_out_write_failed": record.get("bench_out_write_failed"),
+        # section completion map (SectionRecorder) — which sections this
+        # line's numbers actually come from, and what was deadline-skipped
+        "sections": record.get("sections"),
+        "bench_deadline_s": record.get("bench_deadline_s"),
+        "bench_wall_s": record.get("bench_wall_s"),
         "full_record": "bench_out.json",
+        "sections_file": "bench_sections.jsonl",
     }
 
 

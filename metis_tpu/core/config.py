@@ -131,6 +131,11 @@ class SearchConfig:
     # prune_to_top_k.  Both are off by default and under strict_compat.
     prune_to_top_k: int | None = None
     beam_patience: int | None = None
+    # Emit a ``search_progress`` heartbeat event every N processed intra
+    # candidates when an EventLog is attached (core/trace.Heartbeat):
+    # candidates/sec, best-cost-so-far, elapsed — a long search is
+    # observable while running (``tail -f`` the events file)
+    progress_every: int = 1000
 
     def __post_init__(self) -> None:
         if self.gbs < 1:
@@ -139,3 +144,5 @@ class SearchConfig:
             raise ValueError("max_permute_len must be >= 1")
         if any(v < 2 for v in self.virtual_stage_candidates):
             raise ValueError("virtual_stage_candidates must all be >= 2")
+        if self.progress_every < 1:
+            raise ValueError("progress_every must be >= 1")

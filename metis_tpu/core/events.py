@@ -7,7 +7,6 @@ safe to tail.  A disabled log (no sink) is a no-op so call sites never guard.
 """
 from __future__ import annotations
 
-import io
 import json
 import time
 from pathlib import Path
@@ -16,12 +15,19 @@ from typing import IO, Any
 
 class EventLog:
     """Append-only JSONL sink.  ``EventLog(path)`` writes to a file,
-    ``EventLog(stream=...)`` to any text stream, ``EventLog()`` discards."""
+    ``EventLog(stream=...)`` to any text stream, ``EventLog()`` discards.
+
+    The file handle opens lazily on first emit and stays open (line-buffered
+    append) — span/heartbeat instrumentation emits from search inner loops,
+    where an open() per event would cost O(events) syscalls.  Line buffering
+    keeps every record tail-able the moment it is written; ``close()`` (or
+    use as a context manager) releases the handle."""
 
     def __init__(self, path: str | Path | None = None,
                  stream: IO[str] | None = None):
         self._stream: IO[str] | None = stream
         self._path = Path(path) if path is not None else None
+        self._fh: IO[str] | None = None
         if self._path is not None and stream is not None:
             raise ValueError("pass either path or stream, not both")
 
@@ -38,8 +44,28 @@ class EventLog:
             self._stream.write(line)
             self._stream.flush()
         else:
-            with open(self._path, "a") as f:
-                f.write(line)
+            if self._fh is None:
+                self._fh = open(self._path, "a", buffering=1)
+            self._fh.write(line)
+
+    def close(self) -> None:
+        """Release the held file handle (emit after close reopens it)."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc: object) -> bool:
+        self.close()
+        return False
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:  # interpreter teardown — nothing left to do
+            pass
 
 
 NULL_LOG = EventLog()

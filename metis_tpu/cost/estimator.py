@@ -116,10 +116,16 @@ class _EstimatorBase:
         profiles: ProfileStore,
         volume: TransformerVolume,
         options: EstimatorOptions,
+        counters=None,
     ):
         self.cluster = cluster
         self.volume = volume
         self.options = options
+        # optional core.trace.Counters — estimator-level accounting for the
+        # flight recorder: ``profile_miss`` (ProfileMissError raised while
+        # pricing a stage) and the bandwidth-model cache hits/misses below.
+        # None (tracing off) skips even the dict adds.
+        self.counters = counters
         self._step_overhead: dict[tuple[str, int], float] = {}
         if options.mb_affine and not options.strict_compat:
             profiles, self._step_overhead = profiles.affine_view()
@@ -187,8 +193,8 @@ class UniformCostEstimator(_EstimatorBase):
     """Cost of a uniform Megatron-grid plan on a (nominally) homogeneous
     cluster (≅ ``HomoCostEstimator.get_cost``, ``cost_estimator.py:98-138``)."""
 
-    def __init__(self, cluster, profiles, volume, options):
-        super().__init__(cluster, profiles, volume, options)
+    def __init__(self, cluster, profiles, volume, options, counters=None):
+        super().__init__(cluster, profiles, volume, options, counters)
         self.bandwidth = HomoScalarBandwidth(cluster, options.strict_compat)
 
     def get_cost(self, plan: UniformPlan, device_type: str) -> PlanCost:
@@ -252,8 +258,9 @@ class HeteroCostEstimator(_EstimatorBase):
     (≅ ``HeteroCostEstimator.get_cost``, ``cost_estimator.py:199-244``)."""
 
     def __init__(self, cluster, profiles, volume, options,
-                 bandwidth_factory: BandwidthFactory | None = None):
-        super().__init__(cluster, profiles, volume, options)
+                 bandwidth_factory: BandwidthFactory | None = None,
+                 counters=None):
+        super().__init__(cluster, profiles, volume, options, counters)
         self.data_balancer = DataBalancer(profiles)
         # CONTRACT: factories must depend on the plan's placement only
         # (node_sequence + device_groups) — the memo below reuses one model
@@ -276,6 +283,8 @@ class HeteroCostEstimator(_EstimatorBase):
         if key != self._bw_key:
             self._bw_key = key
             self._bw_model = self.bandwidth_factory(plan)
+            if self.counters is not None:
+                self.counters.inc("bw_model_built")
             if len(self._bw_cache) > 200_000:
                 self._bw_cache.clear()
         return self._bw_model
@@ -283,16 +292,31 @@ class HeteroCostEstimator(_EstimatorBase):
     def _cache_key(self, kind: str, stage_id: int, *rest):
         return (kind, self._bw_key, stage_id, *rest)
 
+    def _count_cache(self, hit: bool) -> None:
+        if self.counters is not None:
+            self.counters.inc("bw_cache_hit" if hit else "bw_cache_miss")
+
+    def _profile_miss(self, t: str, tp: int, c: int) -> ProfileMissError:
+        if self.counters is not None:
+            self.counters.inc("profile_miss")
+        return ProfileMissError(t, tp, c)
+
     def _dp_bw(self, bandwidth, stage_id: int, strat: Strategy) -> float:
         key = self._cache_key("dp", stage_id, strat.dp, strat.cp, strat.tp)
         if key not in self._bw_cache:
             self._bw_cache[key] = bandwidth.dp_bandwidth(stage_id, strat)
+            self._count_cache(hit=False)
+        else:
+            self._count_cache(hit=True)
         return self._bw_cache[key]
 
     def _pp_bw(self, bandwidth, stage_id: int) -> float:
         key = self._cache_key("pp", stage_id)
         if key not in self._bw_cache:
             self._bw_cache[key] = bandwidth.pp_bandwidth(stage_id)
+            self._count_cache(hit=False)
+        else:
+            self._count_cache(hit=True)
         return self._bw_cache[key]
 
     def _cp_bw(self, bandwidth, stage_id: int, strat: Strategy) -> float:
@@ -302,6 +326,9 @@ class HeteroCostEstimator(_EstimatorBase):
             self._bw_cache[key] = (
                 cp_bw_fn(stage_id, strat) if cp_bw_fn is not None
                 else bandwidth.dp_bandwidth(stage_id, strat))
+            self._count_cache(hit=False)
+        else:
+            self._count_cache(hit=True)
         return self._bw_cache[key]
 
     def _stage_execution_ms(
@@ -335,7 +362,7 @@ class HeteroCostEstimator(_EstimatorBase):
                 total = 0.0
                 for c in power_of_two_chunks(bs):
                     if c > self.options.max_profiled_bs:
-                        raise ProfileMissError(t, tp, c)
+                        raise self._profile_miss(t, tp, c)
                     total += self.profiles.get(t, tp, c).time_slice(start, end)
                 slowest = max(slowest, total)
             return slowest / strategy.cp
@@ -359,7 +386,7 @@ class HeteroCostEstimator(_EstimatorBase):
             total = 0.0
             for c in power_of_two_chunks(h_bs):
                 if c > self.options.max_profiled_bs:
-                    raise ProfileMissError(rep_type, tp, c)
+                    raise self._profile_miss(rep_type, tp, c)
                 total += self.profiles.get(rep_type, tp, c).time_slice(start, end)
             costs.append(total)
         return max(costs)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from metis_tpu.core.events import NULL_LOG
 from metis_tpu.execution.mesh import (
     DP,
     EP,
@@ -131,6 +132,48 @@ class TrainState:
     params: dict
     opt_state: object
     step: jnp.ndarray
+
+
+class StepTimer:
+    """Per-step train-loop telemetry -> EventLog ``train_step`` events.
+
+    ``record()`` once per completed step: wall-clock step time, cumulative
+    elapsed, and tokens/sec derived from ``tokens_per_step`` ride on every
+    emitted event alongside the caller's fields (loss etc.).  Caveat: JAX
+    dispatch is async — a step's wall time is honest only when the caller
+    synchronizes (fetching the loss does); between syncs the per-step times
+    are dispatch times and only the synced steps' values are load-bearing.
+    A disabled log records for free."""
+
+    def __init__(self, events=None, tokens_per_step: int = 0,
+                 start_step: int = 0):
+        import time as _time
+
+        self.events = events if events is not None else NULL_LOG
+        self.tokens_per_step = tokens_per_step
+        self.step_idx = start_step
+        self._clock = _time.perf_counter
+        self._t0 = self._clock()
+        self._last = self._t0
+
+    def record(self, loss: float | None = None, emit: bool = True,
+               **fields) -> dict:
+        now = self._clock()
+        step_ms = (now - self._last) * 1e3
+        self._last = now
+        self.step_idx += 1
+        rec: dict = {"step": self.step_idx,
+                     "step_ms": round(step_ms, 3),
+                     "elapsed_s": round(now - self._t0, 3)}
+        if self.tokens_per_step and step_ms > 0:
+            rec["tokens_per_s"] = round(
+                self.tokens_per_step / (step_ms / 1e3))
+        if loss is not None:
+            rec["loss"] = loss
+        rec.update(fields)
+        if emit:
+            self.events.emit("train_step", **rec)
+        return rec
 
 
 def build_optimizer(lr: float = 1e-4, weight_decay: float = 0.01):

@@ -21,6 +21,7 @@ from metis_tpu.cluster.tpu import TpuClusterSpec
 from metis_tpu.core.config import ModelSpec, SearchConfig
 from metis_tpu.core.errors import MetisError
 from metis_tpu.core.events import EventLog, NULL_LOG
+from metis_tpu.core.trace import Heartbeat, Tracer, timed_iter
 from metis_tpu.core.types import RankedPlan, UniformPlan, PlanCost
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.layers import LayerBalancer
@@ -84,6 +85,11 @@ class UniformPlannerResult:
         return self.plans[0] if self.plans else None
 
 
+def _finite(x: float) -> float | None:
+    """inf -> None for JSON-friendly best-cost-so-far heartbeat fields."""
+    return x if x != float("inf") else None
+
+
 def _check_profile_attn(profiles: ProfileStore, model: ModelSpec) -> None:
     """A profile dir stamped with an attention impl must match the model
     being planned — measured dense milliseconds must never silently price a
@@ -113,13 +119,29 @@ def plan_hetero(
 
     ``inter_filter``: optional predicate on InterStagePlan applied before
     intra-stage expansion — topology validity filters (e.g. the TPU
-    sub-torus alignment check of ``plan_tpu``) plug in here."""
+    sub-torus alignment check of ``plan_tpu``) plug in here.
+
+    Observability (core/trace.py): with an enabled ``events`` log the run
+    records a span tree (setup / enumeration / intra_stage / costing /
+    ranking under a ``plan_hetero`` root), a ``search_progress`` heartbeat
+    every ``config.progress_every`` intra candidates, and a ``counters``
+    event whose accounting reconciles with the returned result:
+    ``costed == num_costed``, ``pruned_profile_miss + pruned_inter_filter
+    == num_pruned``, and the ``prune.*`` family == ``num_bound_pruned``."""
     _check_profile_attn(profiles, model)
+    tracer = Tracer(events)
+    heartbeat = Heartbeat(events, every=config.progress_every)
+    root = tracer.span("plan_hetero", mode="hetero", model=model.name,
+                       devices=cluster.total_devices)
+    root.__enter__()
     t0 = time.perf_counter()
+    setup_span = tracer.span("setup")
+    setup_span.__enter__()
     volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
     options = EstimatorOptions.from_config(config)
     estimator = HeteroCostEstimator(
-        cluster, profiles, volume, options, bandwidth_factory)
+        cluster, profiles, volume, options, bandwidth_factory,
+        counters=tracer.counters if tracer.enabled else None)
     evaluator = StagePerformanceModel(cluster, profiles)
     balancer = LayerBalancer(cluster, profiles, config, model=model)
 
@@ -170,6 +192,7 @@ def plan_hetero(
         sched_families.append(("1f1b", 1))
         for vs in config.virtual_stage_candidates:
             sched_families.append(("interleaved", vs))
+    setup_span.__exit__(None, None, None)
     events.emit(
         "search_started", mode="hetero", devices=cluster.total_devices,
         device_types=list(cluster.device_types), gbs=config.gbs,
@@ -177,7 +200,21 @@ def plan_hetero(
 
     results: list[RankedPlan] = []
     pruned = 0
-    pruner = SearchPruner(config, cluster, profiles, model)
+    best_ms = float("inf")
+    enum_acc = tracer.accum("enumeration")
+    intra_acc = tracer.accum("intra_stage")
+    cost_acc = tracer.accum("costing")
+
+    def _tick() -> None:
+        # one intra candidate processed (costed or pruned); Heartbeat emits
+        # every config.progress_every of these with the running accounting
+        if events.enabled:
+            heartbeat.tick(best_cost_ms=_finite(best_ms),
+                           num_costed=len(results), num_pruned=pruned)
+
+    pruner = SearchPruner(config, cluster, profiles, model,
+                          counters=tracer.counters if tracer.enabled
+                          else None)
     if pruner.active:
         # composition-level pruning: doom/bound filters run once per
         # (composition, batches) class and beam-dead classes skip
@@ -191,6 +228,7 @@ def plan_hetero(
             pruner,
             variance=config.min_group_scale_variance,
             max_permute_len=config.max_permute_len,
+            counters=tracer.counters if tracer.enabled else None,
         )
     else:
         inter_iter = inter_stage_plans(
@@ -200,10 +238,14 @@ def plan_hetero(
             model.num_layers,
             variance=config.min_group_scale_variance,
             max_permute_len=config.max_permute_len,
+            counters=tracer.counters if tracer.enabled else None,
         )
+    if tracer.enabled:
+        inter_iter = timed_iter(inter_iter, enum_acc)
     for inter in inter_iter:
         if inter_filter is not None and not inter_filter(inter):
             pruned += 1
+            tracer.inc("pruned_inter_filter")
             continue
         if not pruner.admit(inter):
             continue
@@ -223,31 +265,42 @@ def plan_hetero(
             types_uniform = len(set(ranks)) == 1
         for sched, vs in sched_families:
             try:
-                for intra in schedule_intra_plans(
+                intra_gen = schedule_intra_plans(
                     inter, evaluator, balancer,
                     max_tp=config.max_profiled_tp,
                     max_bs=config.max_profiled_bs,
                     schedule=sched, virtual_stages=vs,
                     num_blocks=model.num_layers - 2,
                     types_uniform=types_uniform,
-                ):
+                )
+                if tracer.enabled:
+                    intra_gen = timed_iter(intra_gen, intra_acc)
+                for intra in intra_gen:
                     try:
-                        cost = estimator.get_cost(
-                            inter, intra.strategies, intra.layer_partition,
-                            schedule=sched, virtual_stages=vs)
+                        with cost_acc:
+                            cost = estimator.get_cost(
+                                inter, intra.strategies,
+                                intra.layer_partition,
+                                schedule=sched, virtual_stages=vs)
                     except KeyError:
                         pruned += 1
+                        tracer.inc("pruned_profile_miss")
+                        _tick()
                         continue
                     pruner.record(cost.total_ms)
+                    best_ms = min(best_ms, cost.total_ms)
                     results.append(
                         RankedPlan(inter=inter, intra=intra, cost=cost))
+                    tracer.inc("costed")
+                    _tick()
             except KeyError:
                 pruned += 1
+                tracer.inc("pruned_profile_miss")
         # one try-block per (cp, ep, zero, sp) family: a profile miss
         # mid-generation prunes only that family, not its siblings
         for (cp, cp_mode), ep, zero, sp in families:
             try:
-                for intra in intra_stage_plans(
+                intra_gen = intra_stage_plans(
                     inter, evaluator, balancer,
                     max_tp=config.max_profiled_tp,
                     max_bs=config.max_profiled_bs,
@@ -255,31 +308,48 @@ def plan_hetero(
                     ep_degrees=(ep,), zero_stages=(zero,),
                     sp_variants=(sp,), cp_modes=(cp_mode,),
                     num_heads=a2a_head_limit,
-                ):
+                )
+                if tracer.enabled:
+                    intra_gen = timed_iter(intra_gen, intra_acc)
+                for intra in intra_gen:
                     try:
-                        cost = estimator.get_cost(
-                            inter, intra.strategies, intra.layer_partition)
+                        with cost_acc:
+                            cost = estimator.get_cost(
+                                inter, intra.strategies,
+                                intra.layer_partition)
                     except KeyError:
                         pruned += 1
+                        tracer.inc("pruned_profile_miss")
+                        _tick()
                         continue
                     pruner.record(cost.total_ms)
+                    best_ms = min(best_ms, cost.total_ms)
                     results.append(
                         RankedPlan(inter=inter, intra=intra, cost=cost))
+                    tracer.inc("costed")
+                    _tick()
             except KeyError:
                 # profile miss inside stage evaluation: prune this family
                 pruned += 1
+                tracer.inc("pruned_profile_miss")
         pruner.end_candidate(inter)
 
-    results.sort(key=lambda r: r.cost.total_ms)
+    enum_acc.close()
+    intra_acc.close()
+    cost_acc.close()
+    with tracer.span("ranking", num_plans=len(results)):
+        results.sort(key=lambda r: r.cost.total_ms)
     num_costed = len(results)
     best_cost = results[0].cost.total_ms if results else None
     if top_k is not None:
         results = results[:top_k]
     elapsed = time.perf_counter() - t0
+    tracer.emit_counters(scope="plan_hetero")
     events.emit(
         "search_finished", mode="hetero", num_costed=num_costed,
         num_pruned=pruned, seconds=round(elapsed, 4),
         best_cost_ms=best_cost, num_bound_pruned=pruner.num_pruned)
+    root.__exit__(None, None, None)
     return PlannerResult(
         plans=tuple(results),
         num_costed=num_costed,
@@ -302,6 +372,11 @@ def plan_uniform(
     """Homogeneous Megatron-grid sweep at the configured gbs
     (≅ ``cost_homo_cluster``)."""
     _check_profile_attn(profiles, model)
+    tracer = Tracer(events)
+    heartbeat = Heartbeat(events, every=config.progress_every)
+    root = tracer.span("plan_uniform", mode="uniform", model=model.name,
+                       devices=cluster.total_devices)
+    root.__enter__()
     t0 = time.perf_counter()
     dtype = device_type or cluster.device_types[0]
     events.emit(
@@ -309,12 +384,15 @@ def plan_uniform(
         device_types=[dtype], gbs=config.gbs, model=model.name)
     volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
     estimator = UniformCostEstimator(
-        cluster, profiles, volume, EstimatorOptions.from_config(config))
+        cluster, profiles, volume, EstimatorOptions.from_config(config),
+        counters=tracer.counters if tracer.enabled else None)
 
     ranked: list[RankedUniformPlan] = []
     pruned = 0
     oom_excluded = 0
     num_costed = 0
+    best_ms = float("inf")
+    cost_acc = tracer.accum("costing")
     for plan in uniform_plans(
         num_devices=cluster.total_devices,
         max_tp=config.max_profiled_tp,
@@ -323,25 +401,38 @@ def plan_uniform(
         if plan.mbs > config.max_profiled_bs:
             continue
         try:
-            cost = estimator.get_cost(plan, dtype)
+            with cost_acc:
+                cost = estimator.get_cost(plan, dtype)
         except KeyError:
             pruned += 1
+            tracer.inc("pruned_profile_miss")
+            heartbeat.tick(best_cost_ms=_finite(best_ms),
+                           num_costed=num_costed, num_pruned=pruned)
             continue
         num_costed += 1
+        best_ms = min(best_ms, cost.total_ms)
+        tracer.inc("costed")
+        heartbeat.tick(best_cost_ms=_finite(best_ms),
+                       num_costed=num_costed, num_pruned=pruned)
         if cost.oom and not include_oom:
             oom_excluded += 1
+            tracer.inc("oom_excluded")
             continue
         ranked.append(RankedUniformPlan(plan=plan, cost=cost, device_type=dtype))
 
-    ranked.sort(key=lambda r: r.cost.total_ms)
+    cost_acc.close()
+    with tracer.span("ranking", num_plans=len(ranked)):
+        ranked.sort(key=lambda r: r.cost.total_ms)
     best_cost = ranked[0].cost.total_ms if ranked else None
     if top_k is not None:
         ranked = ranked[:top_k]
     elapsed = time.perf_counter() - t0
+    tracer.emit_counters(scope="plan_uniform")
     events.emit(
         "search_finished", mode="uniform", num_costed=num_costed,
         num_pruned=pruned, seconds=round(elapsed, 4),
         best_cost_ms=best_cost)
+    root.__exit__(None, None, None)
     return UniformPlannerResult(
         plans=tuple(ranked),
         num_costed=num_costed,

@@ -250,6 +250,9 @@ def main(argv: list[str] | None = None) -> int:
                         help="comma-separated batch sizes to profile")
     p_prof.add_argument("--warmup", type=int, default=2)
     p_prof.add_argument("--iters", type=int, default=5)
+    p_prof.add_argument("--events", default=None,
+                        help="append structured JSONL measurement events "
+                             "(profile_measured per (tp, bs)) to this file")
     _add_platform_arg(p_prof)
 
     p_cal = sub.add_parser(
@@ -340,6 +343,17 @@ def main(argv: list[str] | None = None) -> int:
                            "stage i and DIALED by stage i+1")
     _add_platform_arg(p_train)
 
+    p_report = sub.add_parser(
+        "report", help="render a trace/event JSONL (metis-tpu ... --events, "
+                       "core/trace spans) as a span tree with self-times, "
+                       "percentages, and counters — table or JSON")
+    p_report.add_argument("events_file",
+                          help="JSONL file written via --events")
+    p_report.add_argument("--json", action="store_true", dest="as_json",
+                          help="emit the tree as JSON instead of a table")
+    p_report.add_argument("--output", default="-",
+                          help="output path ('-' = stdout)")
+
     p_rep = sub.add_parser(
         "replan", help="elastic re-plan on topology change: diff two cluster "
                        "descriptions, search the survivor topology, report "
@@ -361,6 +375,8 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     _pin_platform(args)
+    if args.command == "report":
+        return _cmd_report(args)
     if args.command == "calibrate":
         return _cmd_calibrate(args)
     if args.command == "profile":
@@ -421,15 +437,43 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
+def _cmd_report(args: argparse.Namespace) -> int:
+    """Span-tree/counters report over an event JSONL (core/trace.py)."""
+    from metis_tpu.core.events import read_events
+    from metis_tpu.core.trace import (
+        build_span_tree,
+        render_span_table,
+        span_tree_json,
+    )
+
+    try:
+        events = read_events(args.events_file)
+    except OSError as e:
+        print(f"cannot read {args.events_file}: {e}", file=sys.stderr)
+        return 1
+    roots, counters = build_span_tree(events)
+    if not roots and not counters:
+        print(f"{args.events_file}: no span/counter events "
+              f"({len(events)} events total)", file=sys.stderr)
+    if args.as_json:
+        payload = json.dumps(span_tree_json(roots, counters), indent=2)
+    else:
+        payload = render_span_table(roots, counters)
+    _emit(args, payload)
+    return 0
+
+
 def _cmd_profile(args: argparse.Namespace) -> int:
     from metis_tpu.profiles.profiler import ProfilerConfig, profile_model
 
     model = _model_from_args(args)
+    events = EventLog(args.events) if args.events else NULL_LOG
     store = profile_model(
         model,
         tps=tuple(int(t) for t in args.tps.split(",")),
         bss=tuple(int(b) for b in args.bss.split(",")),
-        config=ProfilerConfig(warmup=args.warmup, iters=args.iters))
+        config=ProfilerConfig(warmup=args.warmup, iters=args.iters),
+        events=events)
     store.dump_to_dir(args.output_dir,
                       {"model_name": model.name, "attn": model.attn})
     print(f"profiled {model.name} -> {args.output_dir} "
@@ -917,6 +961,13 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
             writer.save(args.checkpoint_dir, as_train_state(state, step),
                         mesh, plan=art, block_layout=block_layout)
 
+    from metis_tpu.execution.train import StepTimer
+
+    # per-step wall timing + tokens/sec telemetry (execution/train.StepTimer);
+    # one event writer under multi-controller
+    timer = StepTimer(events if is_main else None,
+                      tokens_per_step=art.gbs * model.sequence_length,
+                      start_step=start_step)
     losses: list[float] = []
     t0 = time.perf_counter()
     try:
@@ -925,15 +976,12 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
             state, loss = exe.step(state, toks, tgts)
             # step-1 loss is always recorded so the summary's first_loss is
             # genuinely the first step, not the first --log-every boundary
-            if (i == 0 or (i + 1) % args.log_every == 0
-                    or i + 1 == args.steps):
-                loss = float(loss)
+            log_this = (i == 0 or (i + 1) % args.log_every == 0
+                        or i + 1 == args.steps)
+            if log_this:
+                loss = float(loss)  # forces the sync that makes timing real
                 losses.append(loss)
-                if is_main:  # one event writer under multi-controller
-                    events.emit("train_step", step=start_step + i + 1,
-                                loss=loss,
-                                elapsed_s=round(
-                                    time.perf_counter() - t0, 3))
+            timer.record(loss=loss if log_this else None, emit=log_this)
             if (can_ckpt and args.checkpoint_every
                     and (i + 1) % args.checkpoint_every == 0):
                 periodic_save(state, start_step + i + 1)

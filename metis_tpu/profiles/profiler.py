@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metis_tpu.core.config import ModelSpec
 from metis_tpu.core.errors import MetisError
+from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.timing import two_point_queue_ms
 from metis_tpu.execution.mesh import DP, TP, shard_params
 from metis_tpu.execution.train import (
@@ -180,12 +181,17 @@ class LayerProfiler:
         devices: Sequence | None = None,
         config: ProfilerConfig = ProfilerConfig(),
         dtype=jnp.bfloat16,
+        events: EventLog = NULL_LOG,
     ):
         self.model = model
         self.devices = list(devices if devices is not None else jax.devices())
         self.device_type = device_type or infer_device_type(self.devices[0])
         self.config = config
         self.cfg = config_for_model_spec(model, dtype=dtype)
+        # flight-recorder sink: one profile_measured event per (tp, bs)
+        # config as it lands — a wedged chip mid-sweep still leaves the
+        # finished measurements in the log (core/events.py)
+        self.events = events
 
     # -- per-layer closures -------------------------------------------------
     def _make_layer_fns(self, cfg: GPTConfig):
@@ -432,12 +438,31 @@ class LayerProfiler:
         with what was profiled (the reference's ``max_profiled_tp_degree``
         contract, ``arguments.py:44``).
         """
+        self.events.emit(
+            "profile_started", device_type=self.device_type,
+            model=self.model.name, tps=list(tps), bss=list(bss),
+            devices=len(self.devices))
         entries: dict[tuple[str, int, int], LayerProfile] = {}
+        t_run = time.perf_counter()
         for tp in tps:
             if tp > len(self.devices) or self.cfg.num_heads % tp != 0:
+                self.events.emit(
+                    "profile_skipped", device_type=self.device_type, tp=tp,
+                    reason=(f"tp={tp} exceeds {len(self.devices)} device(s)"
+                            if tp > len(self.devices)
+                            else f"tp={tp} does not divide "
+                                 f"{self.cfg.num_heads} heads"))
                 continue
             for bs in bss:
-                entries[(self.device_type, tp, bs)] = self._profile_one(tp, bs)
+                t_cfg = time.perf_counter()
+                prof = self._profile_one(tp, bs)
+                entries[(self.device_type, tp, bs)] = prof
+                self.events.emit(
+                    "profile_measured", device_type=self.device_type,
+                    tp=tp, bs=bs,
+                    full_model_ms=round(sum(prof.layer_times_ms), 4),
+                    max_layer_memory_mb=round(max(prof.layer_memory_mb), 2),
+                    wall_s=round(time.perf_counter() - t_cfg, 3))
         if not entries:
             raise MetisError(
                 f"no (tp, bs) combination profileable with {len(self.devices)}"
@@ -447,6 +472,11 @@ class LayerProfiler:
         pbytes = self._params_per_layer_bytes(params)
         opt_ms = self._profile_optimizer_ms()
         bg_ms = self._profile_batch_gen_ms(max(bss))
+        self.events.emit(
+            "profile_finished", device_type=self.device_type,
+            num_configs=len(entries), optimizer_ms=round(opt_ms, 4),
+            batch_gen_ms=round(bg_ms, 4),
+            wall_s=round(time.perf_counter() - t_run, 3))
         meta = ModelProfileMeta(
             num_layers=self.cfg.num_profile_layers,
             optimizer_time_ms=opt_ms,
@@ -464,9 +494,11 @@ def profile_model(
     device_type: str | None = None,
     devices: Sequence | None = None,
     config: ProfilerConfig = ProfilerConfig(),
+    events: EventLog = NULL_LOG,
 ) -> ProfileStore:
     """One-call measured profiling (see :class:`LayerProfiler`)."""
-    return LayerProfiler(model, device_type, devices, config).run(tps, bss)
+    return LayerProfiler(model, device_type, devices, config,
+                         events=events).run(tps, bss)
 
 
 def measure_remat_fraction(

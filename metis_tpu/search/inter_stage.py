@@ -23,12 +23,16 @@ def inter_stage_plans(
     variance: float = 1.0,
     max_permute_len: int = 6,
     max_stages: int | None = None,
+    counters=None,
 ) -> Iterator[InterStagePlan]:
     """Yield every inter-stage candidate.
 
     Stage count is capped at ``min(num_devices, num_layers)`` (a stage needs
     at least one layer and one device, ``plan.py:139,165``); microbatch counts
     sweep the divisors of gbs descending (``plan.py:120-124``).
+
+    ``counters``: optional ``core.trace.Counters`` — every yielded candidate
+    bumps ``inter_enumerated`` for the flight recorder's search accounting.
     """
     cap = min(num_devices, num_layers)
     if max_stages is not None:
@@ -45,6 +49,8 @@ def inter_stage_plans(
         for num_stage in range(1, cap + 1):
             for groups in groups_by_stage[num_stage]:
                 for batches in batch_options:
+                    if counters is not None:
+                        counters.inc("inter_enumerated")
                     yield InterStagePlan(
                         node_sequence=tuple(node_sequence),
                         device_groups=groups,

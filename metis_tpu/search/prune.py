@@ -99,7 +99,13 @@ class SearchPruner:
     unconditionally; the bound and beam filters only when configured."""
 
     def __init__(self, config: SearchConfig, cluster: ClusterSpec,
-                 profiles: ProfileStore, model: ModelSpec):
+                 profiles: ProfileStore, model: ModelSpec,
+                 counters=None):
+        # optional core.trace.Counters: prune-family accounting for the
+        # flight recorder (``prune.doom``/``prune.bound``/``prune.beam``
+        # mirror num_doomed/num_bounded/num_beamed); None = tracing off,
+        # not even a dict add in the hot filters
+        self._counters = counters
         self.max_bs = config.max_profiled_bs
         self.gbs = config.gbs
         self.top_k = (config.prune_to_top_k
@@ -200,11 +206,15 @@ class SearchPruner:
             if (self.gbs // g_min) // batches > self.max_bs:
                 # doom: smallest-group stage over max_bs forever
                 self.num_doomed += 1  # counts (composition, B) classes
+                if self._counters is not None:
+                    self._counters.inc("prune.doom")
                 continue
             if (self.top_k is not None and kth != float("inf")
                     and self._exec_lower_bound(
                         g_max, num_stages, batches) > kth):
                 self.num_bounded += 1  # counts (composition, B) classes
+                if self._counters is not None:
+                    self._counters.inc("prune.bound")
                 continue
             out.append(batches)
         return out
@@ -238,6 +248,8 @@ class SearchPruner:
         #    stage's mbs only grows)
         if (inter.gbs // g_min) // inter.batches > self.max_bs:
             self.num_doomed += 1
+            if self._counters is not None:
+                self._counters.inc("prune.doom")
             return False
         if self.top_k is None or self.w_min <= 0:
             return True
@@ -247,6 +259,8 @@ class SearchPruner:
                 and self._exec_lower_bound(
                     g_max, inter.num_stages, inter.batches) > kth):
             self.num_bounded += 1
+            if self._counters is not None:
+                self._counters.inc("prune.bound")
             return False
         # 3. anytime beam: stop a (placement, stage-count) class after
         #    beam_patience consecutive non-improving candidates
@@ -254,6 +268,8 @@ class SearchPruner:
             key = (inter.node_sequence, inter.num_stages)
             if self._patience.get(key, 0) > self.beam_patience:
                 self.num_beamed += 1
+                if self._counters is not None:
+                    self._counters.inc("prune.beam")
                 return False
         return True
 
@@ -292,6 +308,7 @@ def pruned_inter_stage_plans(
     pruner: SearchPruner,
     variance: float = 1.0,
     max_permute_len: int = 6,
+    counters=None,
 ) -> Iterator:
     """Inter-stage enumeration with COMPOSITION-level pruning — the flat
     walk (``inter_stage_plans``) materializes placement x arrangement x
@@ -334,6 +351,8 @@ def pruned_inter_stage_plans(
                         arrangements_of_composition(comp, max_permute_len))
                 for groups in arrangements:
                     for batches in feasible:
+                        if counters is not None:
+                            counters.inc("inter_enumerated")
                         yield InterStagePlan(
                             node_sequence=node_sequence,
                             device_groups=groups,

@@ -1,0 +1,111 @@
+"""Event-JSONL schema validation (tools/check_events_schema.py) wired into
+tier-1: a freshly generated planner run must validate clean, so schema
+drift between emitters and the documented contract breaks the build."""
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_events_schema  # noqa: E402
+
+from metis_tpu.core.events import EventLog, read_events  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def planner_events(tmp_path_factory):
+    """A fresh planner run's event file — the real emitters, not fixtures."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+    model = tiny_test_model()
+    store = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2, 4],
+                                bss=[1, 2, 4, 8, 16])
+    cluster = ClusterSpec.of(("A100", 2, 4), ("T4", 1, 4))
+    path = tmp_path_factory.mktemp("schema") / "events.jsonl"
+    with EventLog(path) as log:
+        plan_hetero(cluster, store, model,
+                    SearchConfig(gbs=64, progress_every=200), events=log)
+    return path
+
+
+def test_fresh_planner_run_validates_clean(planner_events):
+    n, problems = check_events_schema.validate_file(planner_events)
+    assert problems == []
+    assert n >= 6  # spans + started/finished + counters at minimum
+
+
+def test_every_emitted_event_name_is_documented(planner_events):
+    names = {e["event"] for e in read_events(planner_events)}
+    assert names <= set(check_events_schema.EVENT_SCHEMA)
+
+
+def test_unknown_event_name_is_flagged():
+    problems = check_events_schema.validate_events(
+        [{"ts": 1.0, "event": "not_a_real_event"}])
+    assert len(problems) == 1 and "unknown event name" in problems[0]
+
+
+def test_missing_ts_and_event_flagged():
+    problems = check_events_schema.validate_events(
+        [{"event": "search_started"}, {"ts": 1.0}])
+    assert any("'ts'" in p for p in problems)
+    assert any("'event'" in p for p in problems)
+
+
+def test_missing_required_fields_flagged():
+    problems = check_events_schema.validate_events(
+        [{"ts": 1.0, "event": "span_end", "name": "x"}])
+    assert len(problems) == 1
+    assert "missing fields" in problems[0]
+    assert "span_id" in problems[0]
+
+
+def test_invalid_json_line_is_a_problem_not_a_crash(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"ts": 1.0, "event": "search_progress", "n": 1, '
+                 '"elapsed_s": 0.1}\n{not json\n')
+    n, problems = check_events_schema.validate_file(p)
+    assert n == 1
+    assert any("invalid JSON" in x for x in problems)
+
+
+def test_cli_main_exit_codes(planner_events, tmp_path, capsys):
+    assert check_events_schema.main([str(planner_events)]) == 0
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"ts": 1.0, "event": "mystery"}) + "\n")
+    assert check_events_schema.main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "schema OK" in out and "unknown event name" in out
+
+
+def test_profiler_and_train_events_validate(tmp_path):
+    """The non-planner emitters (profiler measurement events, train-step
+    telemetry) also conform to the documented schema."""
+    from metis_tpu.core.config import ModelSpec
+    from metis_tpu.execution.train import StepTimer
+    from metis_tpu.profiles.profiler import ProfilerConfig, profile_model
+
+    path = tmp_path / "mixed.jsonl"
+    with EventLog(path) as log:
+        model = ModelSpec(name="t", num_layers=4, hidden_size=64,
+                          sequence_length=32, vocab_size=128, num_heads=4)
+        profile_model(model, tps=(1, 16), bss=(1,),
+                      config=ProfilerConfig(warmup=1, iters=1),
+                      events=log)
+        timer = StepTimer(log, tokens_per_step=64 * 32)
+        for i in range(3):
+            timer.record(loss=3.0 - i)
+    events = read_events(path)
+    names = [e["event"] for e in events]
+    assert "profile_started" in names and "profile_measured" in names
+    assert "profile_skipped" in names  # tp=16 > local devices
+    assert "profile_finished" in names
+    steps = [e for e in events if e["event"] == "train_step"]
+    assert [s["step"] for s in steps] == [1, 2, 3]
+    assert all("tokens_per_s" in s and "step_ms" in s for s in steps)
+    assert check_events_schema.validate_events(events) == []

@@ -8,6 +8,10 @@ topology from libtpu (no chip needed); skips when libtpu can't provide one.
 """
 import pytest
 
+# minutes-scale Mosaic compiles — excluded from the tier-1 "-m 'not slow'"
+# run (pyproject.toml markers) so the suite fits its wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def topo():

@@ -72,10 +72,18 @@ class TestEventLog:
                     events=EventLog(log_path))
         events = read_events(log_path)
         kinds = [e["event"] for e in events]
-        assert kinds == ["search_started", "search_finished"]
-        assert events[0]["devices"] == 8
-        assert events[1]["num_costed"] > 0
-        assert events[1]["best_cost_ms"] > 0
+        # flight-recorder spans/counters interleave; the start/finish pair
+        # stays present and ordered
+        assert kinds.index("search_started") < kinds.index("search_finished")
+        started = next(e for e in events if e["event"] == "search_started")
+        finished = next(e for e in events if e["event"] == "search_finished")
+        assert started["devices"] == 8
+        assert finished["num_costed"] > 0
+        assert finished["best_cost_ms"] > 0
+        # the span tree covers the search phases (core/trace.py)
+        span_names = {e["name"] for e in events if e["event"] == "span_end"}
+        assert {"plan_hetero", "enumeration", "costing",
+                "ranking"} <= span_names
 
     def test_uniform_planner_emits_events(self, setup, tmp_path):
         from metis_tpu.planner import plan_uniform
@@ -86,7 +94,8 @@ class TestEventLog:
         plan_uniform(cluster, store, model, SearchConfig(gbs=64),
                      events=EventLog(log_path))
         kinds = [e["event"] for e in read_events(log_path)]
-        assert kinds == ["search_started", "search_finished"]
+        assert kinds.index("search_started") < kinds.index("search_finished")
+        assert "counters" in kinds
 
     def test_disabled_log_is_noop(self, setup):
         log = EventLog()
@@ -118,5 +127,5 @@ class TestEventLog:
             "--top-k", "1", "--output", str(out), "--events", str(ev),
         ])
         assert rc == 0
-        assert [e["event"] for e in read_events(ev)] == [
-            "search_started", "search_finished"]
+        kinds = [e["event"] for e in read_events(ev)]
+        assert kinds.index("search_started") < kinds.index("search_finished")

@@ -1,0 +1,413 @@
+"""Flight-recorder layer (core/trace.py): spans, counters, heartbeats,
+the report renderer, and the bench SectionRecorder's crash-proofness."""
+import io
+import json
+import time
+
+import pytest
+
+from metis_tpu.core.events import EventLog, read_events
+from metis_tpu.core.trace import (
+    Counters,
+    Heartbeat,
+    NULL_SPAN,
+    Tracer,
+    build_span_tree,
+    render_span_table,
+    span_tree_json,
+    timed_iter,
+)
+
+
+def _stream_tracer():
+    buf = io.StringIO()
+    return Tracer(EventLog(stream=buf)), buf
+
+
+def _events(buf: io.StringIO) -> list[dict]:
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+class TestSpans:
+    def test_nesting_paths_and_parents(self):
+        tracer, buf = _stream_tracer()
+        with tracer.span("root"):
+            with tracer.span("child"):
+                with tracer.span("grand"):
+                    pass
+            with tracer.span("sibling"):
+                pass
+        ends = [e for e in _events(buf) if e["event"] == "span_end"]
+        by_name = {e["name"]: e for e in ends}
+        assert by_name["grand"]["path"] == "root/child/grand"
+        assert by_name["grand"]["parent_id"] == by_name["child"]["span_id"]
+        assert by_name["child"]["parent_id"] == by_name["root"]["span_id"]
+        assert by_name["sibling"]["parent_id"] == by_name["root"]["span_id"]
+        assert by_name["root"]["parent_id"] is None
+        # children close before parents
+        names_in_order = [e["name"] for e in ends]
+        assert names_in_order.index("grand") < names_in_order.index("child")
+        assert names_in_order.index("child") < names_in_order.index("root")
+
+    def test_durations_monotonic_and_nested(self):
+        tracer, buf = _stream_tracer()
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                time.sleep(0.02)
+        ends = {e["name"]: e for e in _events(buf)
+                if e["event"] == "span_end"}
+        assert ends["inner"]["dur_ms"] >= 15.0
+        assert ends["outer"]["dur_ms"] >= ends["inner"]["dur_ms"]
+
+    def test_span_attrs_ride_on_end(self):
+        tracer, buf = _stream_tracer()
+        with tracer.span("s", model="gpt") as sp:
+            sp.set(extra=7)
+        end = [e for e in _events(buf) if e["event"] == "span_end"][0]
+        assert end["model"] == "gpt" and end["extra"] == 7
+
+    def test_begin_emitted_for_crash_evidence(self):
+        """A span entered but never exited (crash) still leaves its
+        span_begin in the log and shows up unclosed in the tree."""
+        tracer, buf = _stream_tracer()
+        span = tracer.span("doomed")
+        span.__enter__()  # never exited
+        roots, _ = build_span_tree(_events(buf))
+        assert roots[0].name == "doomed" and not roots[0].closed
+        assert "open" in render_span_table(roots, {})
+
+    def test_disabled_tracer_is_noop(self):
+        tracer = Tracer()
+        assert tracer.span("x") is NULL_SPAN
+        assert tracer.accum("y") is NULL_SPAN
+        with tracer.span("x"):
+            tracer.inc("n")
+        tracer.emit_counters(scope="nowhere")  # must not raise
+        assert not tracer.counters.as_dict()
+
+
+class TestAccumSpans:
+    def test_accumulates_across_entries(self):
+        tracer, buf = _stream_tracer()
+        with tracer.span("root"):
+            acc = tracer.accum("phase")
+            for _ in range(5):
+                with acc:
+                    pass
+            acc.close()
+        end = [e for e in _events(buf)
+               if e["event"] == "span_end" and e["name"] == "phase"][0]
+        assert end["entries"] == 5
+        assert end["dur_ms"] >= 0
+
+    def test_parent_exit_closes_forgotten_accum(self):
+        tracer, buf = _stream_tracer()
+        with tracer.span("root"):
+            with tracer.accum("leaky"):
+                pass
+            # no close()
+        names = [e["name"] for e in _events(buf)
+                 if e["event"] == "span_end"]
+        assert "leaky" in names
+
+    def test_close_idempotent(self):
+        tracer, buf = _stream_tracer()
+        acc = tracer.accum("a")
+        acc.close()
+        acc.close()
+        assert sum(1 for e in _events(buf)
+                   if e["event"] == "span_end") == 1
+
+    def test_timed_iter_charges_generator_pulls(self):
+        tracer, buf = _stream_tracer()
+        acc = tracer.accum("gen")
+        out = list(timed_iter(iter(range(4)), acc))
+        acc.close()
+        assert out == [0, 1, 2, 3]
+        end = [e for e in _events(buf) if e["event"] == "span_end"][0]
+        assert end["entries"] == 5  # 4 items + the exhaustion pull
+
+
+class TestCounters:
+    def test_aggregation(self):
+        c = Counters()
+        c.inc("a")
+        c.inc("a", 4)
+        c.inc("b", 2)
+        assert c.as_dict() == {"a": 5, "b": 2}
+        assert c.get("a") == 5 and c.get("missing") == 0
+
+    def test_emit_counters_event(self):
+        tracer, buf = _stream_tracer()
+        tracer.inc("costed", 3)
+        tracer.emit_counters(scope="test", extra_field=1)
+        ev = _events(buf)[0]
+        assert ev["event"] == "counters" and ev["scope"] == "test"
+        assert ev["counters"] == {"costed": 3}
+        assert ev["extra_field"] == 1
+
+
+class TestHeartbeat:
+    def test_cadence_every_n_ticks(self):
+        buf = io.StringIO()
+        hb = Heartbeat(EventLog(stream=buf), every=10)
+        for _ in range(35):
+            hb.tick(best=1.0)
+        beats = _events(buf)
+        assert [b["n"] for b in beats] == [10, 20, 30]
+        assert all(b["event"] == "search_progress" for b in beats)
+        assert all("elapsed_s" in b and "per_s" in b for b in beats)
+        assert all(b["best"] == 1.0 for b in beats)
+
+    def test_bulk_ticks_and_disabled(self):
+        buf = io.StringIO()
+        hb = Heartbeat(EventLog(stream=buf), every=100)
+        hb.tick(250)
+        assert [b["n"] for b in _events(buf)] == [250]
+        null_hb = Heartbeat(EventLog(), every=1)
+        null_hb.tick()  # must not raise, must not count
+        assert null_hb.n == 0
+
+
+class TestEventLogHandle:
+    def test_handle_stays_open_and_close_releases(self, tmp_path):
+        p = tmp_path / "ev.jsonl"
+        log = EventLog(p)
+        log.emit("a", n=1)
+        fh = log._fh
+        assert fh is not None and not fh.closed
+        log.emit("b", n=2)
+        assert log._fh is fh  # no reopen per emit
+        # line-buffered: both records already on disk, tail-able live
+        assert [e["event"] for e in read_events(p)] == ["a", "b"]
+        log.close()
+        assert log._fh is None
+        log.emit("c", n=3)  # emit after close reopens
+        log.close()
+        assert [e["event"] for e in read_events(p)] == ["a", "b", "c"]
+
+    def test_context_manager(self, tmp_path):
+        p = tmp_path / "ev.jsonl"
+        with EventLog(p) as log:
+            log.emit("x")
+        assert log._fh is None
+        assert read_events(p)[0]["event"] == "x"
+
+
+class TestReport:
+    def _sample_events(self):
+        tracer, buf = _stream_tracer()
+        with tracer.span("root", mode="test"):
+            with tracer.span("setup"):
+                pass
+            acc = tracer.accum("work")
+            for _ in range(3):
+                with acc:
+                    time.sleep(0.001)
+            acc.close()
+        tracer.inc("costed", 7)
+        tracer.emit_counters(scope="root")
+        return _events(buf)
+
+    def test_tree_self_time_and_json(self):
+        roots, counters = build_span_tree(self._sample_events())
+        assert len(roots) == 1
+        root = roots[0]
+        assert [c.name for c in root.children] == ["setup", "work"]
+        child_sum = sum(c.dur_ms for c in root.children)
+        assert root.self_ms == pytest.approx(root.dur_ms - child_sum)
+        assert counters == {"root": {"costed": 7}}
+        js = span_tree_json(roots, counters)
+        assert js["spans"][0]["name"] == "root"
+        assert js["spans"][0]["attrs"]["mode"] == "test"
+        assert {c["name"] for c in js["spans"][0]["children"]} == \
+            {"setup", "work"}
+        assert js["counters"]["root"]["costed"] == 7
+
+    def test_render_table(self):
+        roots, counters = build_span_tree(self._sample_events())
+        table = render_span_table(roots, counters)
+        assert "root" in table and "  work" in table
+        assert "costed = 7" in table
+        assert "100.0" in table  # root percent
+
+    def test_cli_report_round_trip(self, tmp_path):
+        from metis_tpu.planner.cli import main as cli_main
+
+        ev_path = tmp_path / "ev.jsonl"
+        ev_path.write_text("".join(
+            json.dumps(e) + "\n" for e in self._sample_events()))
+        out = tmp_path / "report.txt"
+        rc = cli_main(["report", str(ev_path), "--output", str(out)])
+        assert rc == 0
+        assert "root" in out.read_text()
+        out_json = tmp_path / "report.json"
+        rc = cli_main(["report", str(ev_path), "--json",
+                       "--output", str(out_json)])
+        assert rc == 0
+        parsed = json.loads(out_json.read_text())
+        assert parsed["spans"][0]["name"] == "root"
+        assert parsed["counters"]["root"]["costed"] == 7
+
+    def test_cli_report_missing_file(self, tmp_path):
+        from metis_tpu.planner.cli import main as cli_main
+
+        assert cli_main(["report", str(tmp_path / "nope.jsonl")]) == 1
+
+
+class TestPlannerIntegration:
+    @pytest.fixture(scope="class")
+    def run(self, tmp_path_factory):
+        from metis_tpu.cluster import ClusterSpec
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_hetero
+        from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+        model = tiny_test_model()
+        store = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2, 4],
+                                    bss=[1, 2, 4, 8, 16])
+        cluster = ClusterSpec.of(("A100", 2, 4), ("T4", 1, 4))
+        path = tmp_path_factory.mktemp("trace") / "events.jsonl"
+        with EventLog(path) as log:
+            result = plan_hetero(cluster, store, model,
+                                 SearchConfig(gbs=64, progress_every=100),
+                                 events=log)
+        return result, read_events(path)
+
+    def test_span_tree_covers_phases(self, run):
+        _, events = run
+        roots, _ = build_span_tree(events)
+        root = next(r for r in roots if r.name == "plan_hetero")
+        names = {c.name for c in root.children}
+        assert {"setup", "enumeration", "intra_stage", "costing",
+                "ranking"} <= names
+        assert all(c.closed for c in root.children)
+
+    def test_counters_reconcile_with_result(self, run):
+        """The acceptance criterion: flight-recorder counters sum
+        consistently with PlannerResult accounting."""
+        result, events = run
+        cnt = next(e for e in events if e["event"] == "counters")["counters"]
+        assert cnt["costed"] == result.num_costed
+        assert (cnt.get("pruned_profile_miss", 0)
+                + cnt.get("pruned_inter_filter", 0)) == result.num_pruned
+        assert (cnt.get("prune.doom", 0) + cnt.get("prune.bound", 0)
+                + cnt.get("prune.beam", 0)) == result.num_bound_pruned
+        assert cnt["inter_enumerated"] > 0
+
+    def test_heartbeat_progression(self, run):
+        result, events = run
+        beats = [e for e in events if e["event"] == "search_progress"]
+        assert beats, "a >100-candidate search must emit heartbeats"
+        ns = [b["n"] for b in beats]
+        assert ns == sorted(ns)
+        costed = [b["num_costed"] for b in beats]
+        assert costed == sorted(costed)
+        # best-cost-so-far only improves
+        bests = [b["best_cost_ms"] for b in beats
+                 if b["best_cost_ms"] is not None]
+        assert bests == sorted(bests, reverse=True)
+        assert result.num_costed >= costed[-1]
+
+    def test_uniform_planner_spans(self, tmp_path):
+        from metis_tpu.cluster import ClusterSpec
+        from metis_tpu.core.config import SearchConfig
+        from metis_tpu.planner import plan_uniform
+        from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+        model = tiny_test_model()
+        store = synthesize_profiles(model, ["A100"], tps=[1, 2],
+                                    bss=[1, 2, 4, 8, 16])
+        cluster = ClusterSpec.of(("A100", 2, 4))
+        path = tmp_path / "uniform.jsonl"
+        with EventLog(path) as log:
+            result = plan_uniform(cluster, store, model,
+                                  SearchConfig(gbs=64), events=log)
+        events = read_events(path)
+        roots, counters = build_span_tree(events)
+        root = next(r for r in roots if r.name == "plan_uniform")
+        assert {"costing", "ranking"} <= {c.name for c in root.children}
+        cnt = counters["plan_uniform"]
+        assert cnt["costed"] == result.num_costed
+
+
+class TestBenchSections:
+    """bench.SectionRecorder: a section that raises (or a truncated run)
+    still leaves every prior section's JSONL record on disk."""
+
+    @pytest.fixture()
+    def recorder(self, tmp_path):
+        import bench
+
+        return bench.SectionRecorder(path=tmp_path / "sections.jsonl")
+
+    def test_section_flushed_the_moment_it_completes(self, recorder):
+        record = {}
+        recorder.run("one", lambda r: r.__setitem__("k", 1), record)
+        lines = [json.loads(l) for l in
+                 recorder.path.read_text().splitlines()]
+        assert lines[-1]["section"] == "one"
+        assert lines[-1]["status"] == "ok"
+        assert lines[-1]["data"] == {"k": 1}
+
+    def test_raising_section_keeps_prior_records(self, recorder):
+        record = {}
+        recorder.run("good", lambda r: r.__setitem__("x", 42), record)
+
+        def boom(r):
+            raise RuntimeError("section died")
+
+        recorder.run("bad", boom, record)
+        lines = [json.loads(l) for l in
+                 recorder.path.read_text().splitlines()]
+        assert [(l["section"], l["status"]) for l in lines] == [
+            ("good", "ok"), ("bad", "error")]
+        assert lines[0]["data"] == {"x": 42}  # prior record intact on disk
+        assert "RuntimeError" in record["bad"]["error"]
+
+    def test_deadline_skips_with_recorded_reason(self, tmp_path):
+        import bench
+
+        rec = bench.SectionRecorder(path=tmp_path / "s.jsonl",
+                                    deadline_s=0.0)
+        time.sleep(0.01)
+        record = {}
+        ran = []
+        rec.run("late", lambda r: ran.append(1), record)
+        assert not ran
+        assert "skipped" in record["late"]
+        line = json.loads(rec.path.read_text().splitlines()[0])
+        assert line["status"] == "skipped"
+        assert "BENCH_DEADLINE_S" in line["data"]["skipped"]
+
+    def test_truncated_bench_leaves_startup_record(self, tmp_path):
+        """The acceptance criterion: an artificially truncated bench run
+        (tiny deadline standing in for `timeout 5`) leaves >= 1
+        completed-section record on disk — an empty-tail BENCH_r05-style
+        loss is impossible by construction."""
+        import os
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        sections = tmp_path / "sections.jsonl"
+        env = {**os.environ, "BENCH_DEADLINE_S": "0.01",
+               "BENCH_SECTIONS_PATH": str(sections),
+               "BENCH_OUT_PATH": str(tmp_path / "bench_out.json"),
+               "BENCH_PROBE_LOG": str(tmp_path / "probe.jsonl"),
+               "JAX_PLATFORMS": "cpu"}
+        repo = Path(__file__).resolve().parent.parent
+        proc = subprocess.run(
+            [sys.executable, str(repo / "bench.py")], env=env,
+            capture_output=True, text=True, timeout=300, cwd=str(repo))
+        lines = [json.loads(l) for l in
+                 sections.read_text().splitlines()]
+        assert lines, "sidecar must exist even for a truncated run"
+        assert lines[0]["section"] == "startup"
+        assert lines[0]["status"] == "ok"
+        # skipped sections carry their reason; the final stdout line is
+        # assembled from whatever finished
+        statuses = {l["section"]: l["status"] for l in lines}
+        assert statuses.get("parity") == "skipped"
+        headline = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert headline["sections"]["parity"] == "skipped"

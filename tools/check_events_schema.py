@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Validate an event/trace JSONL file against the documented schema.
+
+The schema is the README "Observability" section's contract: every line is
+a JSON object with a numeric ``ts`` and a string ``event``; every event
+name is one the codebase emits; each event carries its required fields.
+Run over any ``--events`` output (planner, profiler, train) — unknown
+event names and missing fields are reported as problems, exit 1.
+
+Usage:  python tools/check_events_schema.py events.jsonl [more.jsonl ...]
+
+Also importable: ``validate_events(list_of_dicts) -> list[str]`` — the
+tier-1 test (tests/test_events_schema.py) runs it over a freshly generated
+planner run so schema drift breaks the build, not the dashboards.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# event name -> fields required beyond the universal ts/event.
+# Emitters: planner/api.py (search_*, counters, spans via core/trace.py),
+# planner/cli.py + execution/train.py (train_step), profiles/profiler.py
+# (profile_*).
+EVENT_SCHEMA: dict[str, set[str]] = {
+    "search_started": {"mode", "devices", "gbs"},
+    "search_finished": {"mode", "num_costed", "num_pruned", "seconds"},
+    "search_progress": {"n", "elapsed_s"},
+    "counters": {"scope", "counters"},
+    "span_begin": {"name", "span_id", "path"},
+    "span_end": {"name", "span_id", "path", "dur_ms"},
+    "train_step": {"step"},
+    "profile_started": {"device_type"},
+    "profile_measured": {"device_type", "tp", "bs"},
+    "profile_skipped": {"tp", "reason"},
+    "profile_finished": {"device_type"},
+}
+
+
+def validate_events(events: list[dict]) -> list[str]:
+    """Problems (empty = valid) for already-parsed event dicts."""
+    problems: list[str] = []
+    for i, ev in enumerate(events, 1):
+        where = f"event {i}"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not a JSON object")
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
+            problems.append(f"{where}: missing/non-numeric 'ts'")
+        name = ev.get("event")
+        if not isinstance(name, str):
+            problems.append(f"{where}: missing/non-string 'event'")
+            continue
+        required = EVENT_SCHEMA.get(name)
+        if required is None:
+            problems.append(f"{where}: unknown event name {name!r}")
+            continue
+        missing = sorted(required - set(ev))
+        if missing:
+            problems.append(f"{where} ({name}): missing fields {missing}")
+    return problems
+
+
+def validate_file(path: str | Path) -> tuple[int, list[str]]:
+    """(num_events, problems) for one JSONL file; unparseable lines are
+    problems, not crashes."""
+    events: list[dict] = []
+    problems: list[str] = []
+    try:
+        lines = Path(path).read_text().splitlines()
+    except OSError as e:
+        return 0, [f"cannot read {path}: {e}"]
+    for lineno, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            problems.append(f"line {lineno}: invalid JSON ({e.msg})")
+    problems.extend(validate_events(events))
+    return len(events), problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("files", nargs="+", help="event JSONL file(s)")
+    parser.add_argument("--max-problems", type=int, default=20,
+                        help="report at most N problems per file")
+    args = parser.parse_args(argv)
+    rc = 0
+    for path in args.files:
+        n, problems = validate_file(path)
+        if problems:
+            rc = 1
+            print(f"{path}: {n} events, {len(problems)} problem(s)")
+            for p in problems[:args.max_problems]:
+                print(f"  {p}")
+            if len(problems) > args.max_problems:
+                print(f"  ... {len(problems) - args.max_problems} more")
+        else:
+            print(f"{path}: {n} events, schema OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
