@@ -33,7 +33,10 @@ own record to ``bench_sections.jsonl`` (and stderr) the moment it
 completes, a ``BENCH_DEADLINE_S`` wall-clock budget skips remaining
 sections with a recorded reason, and the final stdout line is assembled
 from whatever finished — a timeout can no longer produce an empty tail
-(BENCH_r05 was ``rc=124, tail=""``).
+(BENCH_r05 was ``rc=124, tail=""``).  The budget DEFAULTS ON
+(``DEFAULT_BENCH_DEADLINE_S`` = 600 s) when the env var is unset, so an
+unattended driver run can never repeat the rc=124 failure; set
+``BENCH_DEADLINE_S=0`` to run unbudgeted.
 """
 from __future__ import annotations
 
@@ -61,7 +64,11 @@ SCALE_REFERENCE_BUDGET_S = 300.0
 # the moment it completes; the final one-line JSON is assembled from
 # whatever sections finished.  BENCH_DEADLINE_S (env) is a wall-clock
 # budget: once exceeded, remaining sections are skipped with a recorded
-# reason instead of being killed mid-flight.
+# reason instead of being killed mid-flight.  Unset, the budget defaults
+# to DEFAULT_BENCH_DEADLINE_S — the driver's external timeout must never
+# be the first line of defense again (BENCH_r05 rc=124); an explicit
+# BENCH_DEADLINE_S=0 (or negative) opts out entirely.
+DEFAULT_BENCH_DEADLINE_S = 600.0
 SECTIONS_PATH = Path(os.environ.get(
     "BENCH_SECTIONS_PATH",
     Path(__file__).resolve().parent / "bench_sections.jsonl"))
@@ -1219,9 +1226,14 @@ def _artifact_folds(record: dict) -> None:
 
 def main() -> None:
     record: dict = {}
-    deadline_env = os.environ.get("BENCH_DEADLINE_S")
-    recorder = SectionRecorder(
-        deadline_s=float(deadline_env) if deadline_env else None)
+    deadline_env = os.environ.get("BENCH_DEADLINE_S", "").strip()
+    if not deadline_env:
+        deadline_s: float | None = DEFAULT_BENCH_DEADLINE_S  # safe default
+    else:
+        deadline_s = float(deadline_env)
+        if deadline_s <= 0:  # explicit opt-out: run unbudgeted
+            deadline_s = None
+    recorder = SectionRecorder(deadline_s=deadline_s)
     # flushed before any jax/metis import: even a bench truncated within
     # seconds leaves a completed-section record on disk
     recorder.flush("startup", "ok", {

@@ -341,6 +341,42 @@ def build_span_tree(
     return roots, counters
 
 
+def filter_top_spans(roots: list[SpanNode], n: int) -> list[SpanNode]:
+    """Prune a span tree to its ``n`` most expensive spans by self-time
+    (``metis-tpu report --top N``).
+
+    Ancestors of a kept span are kept for context, and open spans (no
+    ``span_end`` — the crash signal) are always kept regardless of rank.
+    The input nodes are not mutated; pruned copies are returned.
+    """
+    flat: list[tuple[SpanNode, tuple[SpanNode, ...]]] = []
+
+    def walk(node: SpanNode, ancestors: tuple[SpanNode, ...]) -> None:
+        flat.append((node, ancestors))
+        for c in node.children:
+            walk(c, ancestors + (node,))
+
+    for r in roots:
+        walk(r, ())
+    closed = sorted((nd for nd, _ in flat if nd.dur_ms is not None),
+                    key=lambda nd: -(nd.self_ms or 0.0))
+    keep = {id(nd) for nd in closed[:max(n, 0)]}
+    keep |= {id(nd) for nd, _ in flat if nd.dur_ms is None}  # crashed-open
+    for nd, ancestors in flat:
+        if id(nd) in keep:
+            keep |= {id(a) for a in ancestors}
+
+    def prune(node: SpanNode) -> SpanNode:
+        copy = SpanNode(name=node.name, span_id=node.span_id,
+                        parent_id=node.parent_id, path=node.path,
+                        dur_ms=node.dur_ms, entries=node.entries,
+                        attrs=dict(node.attrs))
+        copy.children = [prune(c) for c in node.children if id(c) in keep]
+        return copy
+
+    return [prune(r) for r in roots if id(r) in keep]
+
+
 def span_tree_json(roots: list[SpanNode],
                    counters: dict[str, dict[str, int]]) -> dict:
     def node_dict(n: SpanNode) -> dict:

@@ -156,16 +156,100 @@ class PlanCost:
     oom: bool = False
 
 
+# Canonical additive component order for a CostBreakdown: every key the
+# estimators emit, rendered in this order by ``metis-tpu explain``.
+COST_COMPONENTS = (
+    "compute", "imbalance", "cp_comm", "ep_comm", "step_overhead",
+    "pp_comm", "dp_comm", "fb_sync", "optimizer", "batch_gen",
+)
+
+
+@dataclass(frozen=True)
+class CostBreakdown:
+    """Per-component decomposition of one plan's ranked scalar (all ms).
+
+    The explainability contract (PAPER.md §0 — Metis *is* its cost model):
+    ``components`` is an ADDITIVE decomposition, ``sum(components.values())
+    == total_ms`` up to float association, so a ranking can always be traced
+    to the term that decided it.  ``compute`` is the schedule's execution
+    time with every stage leveled at the mean (perfectly balanced, comm
+    free); ``imbalance`` is what the actual stage skew adds on top;
+    ``cp_comm``/``ep_comm`` are the in-schedule collective shares;
+    ``step_overhead`` the fitted per-program fixed cost — together these
+    four plus ``compute`` reconstitute ``PlanCost.execution_ms`` exactly.
+    The remaining keys mirror their PlanCost fields.
+
+    Per-stage vectors carry the priced per-microbatch stage times (as the
+    schedule charged them — leveled for uneven 1f1b), the cp+ep comm share,
+    the gradient-sync and optimizer candidates (the cost model takes the max
+    over stages for those two).
+    """
+
+    total_ms: float
+    components: dict[str, float]
+    stage_execution_ms: tuple[float, ...] = ()
+    stage_comm_ms: tuple[float, ...] = ()
+    stage_dp_comm_ms: tuple[float, ...] = ()
+    stage_optimizer_ms: tuple[float, ...] = ()
+    schedule: str = "gpipe"
+
+    @property
+    def component_sum_ms(self) -> float:
+        return sum(self.components.values())
+
+    def delta(self, other: "CostBreakdown") -> dict[str, float]:
+        """Per-component ``other - self`` (positive = other costs more)."""
+        keys = [k for k in COST_COMPONENTS
+                if k in self.components or k in other.components]
+        keys += [k for k in self.components if k not in keys]
+        keys += [k for k in other.components if k not in keys]
+        return {k: other.components.get(k, 0.0) - self.components.get(k, 0.0)
+                for k in keys}
+
+    def decisive_component(self, other: "CostBreakdown") -> tuple[str, float]:
+        """The term that moved the ranking most: (name, other-minus-self ms)."""
+        d = self.delta(other)
+        name = max(d, key=lambda k: abs(d[k]))
+        return name, d[name]
+
+    def to_json_dict(self) -> dict:
+        return {
+            "total_ms": self.total_ms,
+            "components": dict(self.components),
+            "stage_execution_ms": list(self.stage_execution_ms),
+            "stage_comm_ms": list(self.stage_comm_ms),
+            "stage_dp_comm_ms": list(self.stage_dp_comm_ms),
+            "stage_optimizer_ms": list(self.stage_optimizer_ms),
+            "schedule": self.schedule,
+        }
+
+    @staticmethod
+    def from_json_dict(d: dict) -> "CostBreakdown":
+        return CostBreakdown(
+            total_ms=d["total_ms"],
+            components=dict(d["components"]),
+            stage_execution_ms=tuple(d.get("stage_execution_ms", ())),
+            stage_comm_ms=tuple(d.get("stage_comm_ms", ())),
+            stage_dp_comm_ms=tuple(d.get("stage_dp_comm_ms", ())),
+            stage_optimizer_ms=tuple(d.get("stage_optimizer_ms", ())),
+            schedule=d.get("schedule", "gpipe"),
+        )
+
+
 @dataclass(frozen=True)
 class RankedPlan:
-    """One fully-specified, costed candidate — the planner's output unit."""
+    """One fully-specified, costed candidate — the planner's output unit.
+
+    ``breakdown`` is attached post-ranking to the top-k plans only (the
+    search hot path never pays for it); None elsewhere."""
 
     inter: InterStagePlan
     intra: IntraStagePlan
     cost: PlanCost
+    breakdown: CostBreakdown | None = None
 
     def to_json_dict(self) -> dict:
-        return {
+        d = {
             "cost_ms": self.cost.total_ms,
             "cost_breakdown": asdict(self.cost),
             "node_sequence": list(self.inter.node_sequence),
@@ -179,6 +263,9 @@ class RankedPlan:
             "schedule": self.intra.schedule,
             "virtual_stages": self.intra.virtual_stages,
         }
+        if self.breakdown is not None:
+            d["breakdown"] = self.breakdown.to_json_dict()
+        return d
 
 
 def dump_ranked_plans(plans: Sequence[RankedPlan], limit: int | None = None) -> str:

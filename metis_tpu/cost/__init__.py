@@ -16,6 +16,7 @@ from metis_tpu.cost.ici import (
 from metis_tpu.cost.calibration import (
     CollectiveCalibration,
     LinearFit,
+    fit_ledger_correction,
     fit_samples,
     measure_dp_overlap,
     microbenchmark_collectives,
@@ -42,6 +43,7 @@ __all__ = [
     "sub_torus_eff_bw_gbps",
     "CollectiveCalibration",
     "LinearFit",
+    "fit_ledger_correction",
     "fit_samples",
     "measure_dp_overlap",
     "microbenchmark_collectives",

@@ -128,6 +128,23 @@ class CollectiveCalibration:
         fit = self.fits.get(collective)
         return 0.0 if fit is None else max(fit.latency_ms, 0.0)
 
+    def with_correction(self, scale: float) -> "CollectiveCalibration":
+        """A new calibration with every fit's ``predict_ms`` scaled by a
+        ledger-derived correction factor (``fit_ledger_correction``):
+        latency and per-byte slope scale together, so the alpha/beta shape
+        is preserved while the absolute prediction tracks what the
+        accuracy ledger measured."""
+        if scale <= 0:
+            raise ValueError(f"correction scale must be > 0, got {scale}")
+        fits = {
+            name: LinearFit(f.latency_ms * scale, f.ms_per_byte * scale,
+                            f.r2, f.n_samples)
+            for name, f in self.fits.items()
+        }
+        return CollectiveCalibration(
+            platform=self.platform, device_kind=self.device_kind,
+            group_size=self.group_size, fits=fits, samples=self.samples)
+
 
 def fit_samples(samples: Sequence[CollectiveSample]) -> dict[str, LinearFit]:
     """Least-squares alpha-beta fit per collective (clamped to latency >= 0:
@@ -267,6 +284,57 @@ def microbenchmark_collectives(
         fits=fit_samples(samples),
         samples=tuple(samples),
     )
+
+
+# ---------------------------------------------------------------------------
+# accuracy-ledger residual refit
+# ---------------------------------------------------------------------------
+
+
+def fit_ledger_correction(samples) -> dict:
+    """Fit a multiplicative ``predict_ms`` correction from accuracy-ledger
+    residuals (``obs/ledger.py``): the closing of the drift loop — once the
+    ledger shows the estimator systematically off, its residuals refit the
+    prediction instead of being merely alarmed about.
+
+    ``samples``: an iterable of ``(predicted_ms, measured_ms)`` pairs OR
+    ledger ``AccuracySample`` objects (matched ones; unpredicted samples
+    are skipped).  The scale is the least-squares through-origin fit
+    ``measured ≈ scale * predicted`` — a single factor, because a ranking
+    model only needs its *level* corrected (a uniform scale preserves every
+    plan ordering while fixing the absolute step-time estimate the drift
+    band is judged against).
+
+    Returns ``{"scale", "n", "mape_before_pct", "mape_after_pct"}``; apply
+    with ``CollectiveCalibration.with_correction(scale)`` or by scaling any
+    ``predict_ms`` output directly.
+    """
+    pairs: list[tuple[float, float]] = []
+    for s in samples:
+        if hasattr(s, "predicted_ms"):
+            if s.predicted_ms is None or s.measured_ms <= 0:
+                continue
+            pairs.append((float(s.predicted_ms), float(s.measured_ms)))
+        else:
+            p, m = s
+            if p is None or m is None or m <= 0:
+                continue
+            pairs.append((float(p), float(m)))
+    if not pairs:
+        raise ValueError("no matched (predicted, measured) samples to fit")
+    sxx = sum(p * p for p, _ in pairs)
+    sxy = sum(p * m for p, m in pairs)
+    scale = sxy / sxx if sxx > 0 else 1.0
+
+    def mape(factor: float) -> float:
+        return sum(abs(p * factor - m) / m for p, m in pairs) / len(pairs) * 100
+
+    return {
+        "scale": round(scale, 6),
+        "n": len(pairs),
+        "mape_before_pct": round(mape(1.0), 3),
+        "mape_after_pct": round(mape(scale), 3),
+    }
 
 
 # ---------------------------------------------------------------------------

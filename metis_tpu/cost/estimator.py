@@ -24,7 +24,13 @@ from typing import Callable, Sequence
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.core.config import SearchConfig
 from metis_tpu.core.errors import ProfileMissError
-from metis_tpu.core.types import InterStagePlan, PlanCost, Strategy, UniformPlan
+from metis_tpu.core.types import (
+    CostBreakdown,
+    InterStagePlan,
+    PlanCost,
+    Strategy,
+    UniformPlan,
+)
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
 from metis_tpu.balance.stage_perf import rank_device_types
@@ -189,6 +195,54 @@ class _EstimatorBase:
         return self.profiles.type_meta[device_type].batch_generator_ms
 
 
+def _assemble_breakdown(
+    cost: PlanCost,
+    detail: dict,
+    schedule: str,
+    batches: int,
+    virtual_stages: int,
+    remat_fraction: float | None,
+) -> CostBreakdown:
+    """CostBreakdown from a PlanCost plus the estimator's ``_detail`` dump.
+
+    Parity-preserving by construction: ``compute`` is the schedule priced
+    with every stage leveled at the comm-free mean, ``imbalance`` the delta
+    to the comm-free actual lens, and cp/ep/overhead are the exact terms
+    ``get_cost`` added — so compute + imbalance + cp + ep + overhead ==
+    ``PlanCost.execution_ms`` and the component sum == ``total_ms`` up to
+    float association.
+    """
+    lens_nocomm = detail["lens_nocomm"]
+    mean_l = sum(lens_nocomm) / len(lens_nocomm)
+    balanced = schedule_execution_ms(
+        schedule, [mean_l] * len(lens_nocomm), batches, virtual_stages,
+        remat_fraction=remat_fraction)
+    actual = schedule_execution_ms(
+        schedule, lens_nocomm, batches, virtual_stages,
+        remat_fraction=remat_fraction)
+    components = {
+        "compute": balanced,
+        "imbalance": actual - balanced,
+        "cp_comm": cost.cp_comm_ms,
+        "ep_comm": cost.ep_comm_ms,
+        "step_overhead": detail["overhead_ms"],
+        "pp_comm": cost.pp_comm_ms,
+        "dp_comm": cost.dp_comm_ms,
+        "fb_sync": cost.fb_sync_ms,
+        "optimizer": cost.optimizer_ms,
+        "batch_gen": cost.batch_gen_ms,
+    }
+    return CostBreakdown(
+        total_ms=cost.total_ms,
+        components=components,
+        stage_execution_ms=detail["sched_lens"],
+        stage_comm_ms=detail.get("comm_by_stage", ()),
+        stage_dp_comm_ms=detail.get("dp_costs", ()),
+        stage_optimizer_ms=detail.get("opt_costs", ()),
+        schedule=schedule,
+    )
+
+
 class UniformCostEstimator(_EstimatorBase):
     """Cost of a uniform Megatron-grid plan on a (nominally) homogeneous
     cluster (≅ ``HomoCostEstimator.get_cost``, ``cost_estimator.py:98-138``)."""
@@ -197,7 +251,19 @@ class UniformCostEstimator(_EstimatorBase):
         super().__init__(cluster, profiles, volume, options, counters)
         self.bandwidth = HomoScalarBandwidth(cluster, options.strict_compat)
 
-    def get_cost(self, plan: UniformPlan, device_type: str) -> PlanCost:
+    def get_breakdown(
+        self, plan: UniformPlan, device_type: str,
+    ) -> tuple[PlanCost, CostBreakdown]:
+        """(cost, per-component breakdown) — same math path as ``get_cost``,
+        so the scalar is bit-identical; run post-ranking on top-k plans."""
+        detail: dict = {}
+        cost = self.get_cost(plan, device_type, _detail=detail)
+        num_mbs = plan.gbs // plan.mbs // plan.dp
+        return cost, _assemble_breakdown(
+            cost, detail, "gpipe", num_mbs, 1, None)
+
+    def get_cost(self, plan: UniformPlan, device_type: str,
+                 _detail: dict | None = None) -> PlanCost:
         L = self.volume.num_layers
         counts = uniform_layer_split(L, plan.pp)
         prof = self.profiles.get(device_type, plan.tp, plan.mbs)
@@ -228,8 +294,8 @@ class UniformCostEstimator(_EstimatorBase):
             self.cluster.nodes[0].device_type if self.options.strict_compat
             else device_type)
         oom = self.cluster.memory_mb(cap_type) < max(stage_memory)
-        execution = ((num_mbs - 1) * max(lens) + sum(lens)
-                     + self._step_overhead_ms([(device_type, plan.tp)]))
+        overhead = self._step_overhead_ms([(device_type, plan.tp)])
+        execution = (num_mbs - 1) * max(lens) + sum(lens) + overhead
         optimizer = self._optimizer_ms(device_type) / plan.pp / plan.tp
         # only the measured exposed share of the gradient sync rides the
         # critical path (overlap calibration; serial under strict_compat)
@@ -238,6 +304,10 @@ class UniformCostEstimator(_EstimatorBase):
             plan.dp) * self.options.dp_exposed_share
         batch_gen = self._batch_gen_ms(num_mbs, device_type)
 
+        if _detail is not None:
+            _detail.update(
+                sched_lens=tuple(lens), lens_nocomm=tuple(lens),
+                comm_by_stage=(0.0,) * plan.pp, overhead_ms=overhead)
         return PlanCost(
             total_ms=execution + fb_sync + optimizer + dp_cost + pp_cost + batch_gen,
             execution_ms=execution,
@@ -391,6 +461,25 @@ class HeteroCostEstimator(_EstimatorBase):
             costs.append(total)
         return max(costs)
 
+    def get_breakdown(
+        self,
+        plan: InterStagePlan,
+        strategies: Sequence[Strategy],
+        layer_partition: Sequence[int],
+        rank_types: Sequence[str] | None = None,
+        schedule: str = "gpipe",
+        virtual_stages: int = 1,
+    ) -> tuple[PlanCost, CostBreakdown]:
+        """(cost, per-component breakdown) — same math path as ``get_cost``,
+        so the ranked scalar is bit-identical and the components sum to it;
+        run post-ranking on top-k plans, never in the search hot loop."""
+        detail: dict = {}
+        cost = self.get_cost(plan, strategies, layer_partition, rank_types,
+                             schedule, virtual_stages, _detail=detail)
+        return cost, _assemble_breakdown(
+            cost, detail, schedule, plan.batches, virtual_stages,
+            self.options.remat_fwd_fraction)
+
     def get_cost(
         self,
         plan: InterStagePlan,
@@ -399,6 +488,7 @@ class HeteroCostEstimator(_EstimatorBase):
         rank_types: Sequence[str] | None = None,
         schedule: str = "gpipe",
         virtual_stages: int = 1,
+        _detail: dict | None = None,
     ) -> PlanCost:
         ranks = (
             list(rank_types) if rank_types is not None
@@ -559,13 +649,26 @@ class HeteroCostEstimator(_EstimatorBase):
             and len(set(ranks)) <= 1)
         overhead = self._step_overhead_ms(overhead_pairs)
         if rectangular:
-            execution += overhead  # signed: the affine extrapolation
+            overhead_term = overhead  # signed: the affine extrapolation
         else:
             # a real dispatch cannot cost negative time — a noise-negative
             # intercept must not get amplified by the microbatch count
-            execution += max(overhead, 0.0) * plan.batches
+            overhead_term = max(overhead, 0.0) * plan.batches
+        execution += overhead_term
         first_stage_type = ranks[0] if ranks else None
         batch_gen = self._batch_gen_ms(plan.batches, first_stage_type)
+
+        if _detail is not None:
+            # explainability dump (get_breakdown): the exact intermediates
+            # the total was assembled from, so the component decomposition
+            # reconciles with the ranked scalar by construction
+            _detail.update(
+                sched_lens=tuple(sched_lens),
+                lens_nocomm=tuple(lens_nocomm),
+                comm_by_stage=tuple(comm_by_stage),
+                dp_costs=tuple(dp_costs),
+                opt_costs=tuple(opt_costs),
+                overhead_ms=overhead_term)
 
         return PlanCost(
             total_ms=(execution + fb_sync + max(opt_costs) + max(dp_costs)

@@ -133,6 +133,7 @@ def build_executable(
     profiles=None,
     schedule: str | None = None,
     virtual_stages: int | None = None,
+    events=None,
 ) -> Executable:
     """Route ``artifact`` to the execution path that realizes it.
 
@@ -148,7 +149,11 @@ def build_executable(
     ``None`` (default) runs the schedule the ARTIFACT was priced with —
     the planner searches the schedule as a plan axis (cost/schedule.py,
     including 1f1b's remat overhead and true activation peak) and the
-    executable must realize what was costed; pass explicitly to override."""
+    executable must realize what was costed; pass explicitly to override.
+
+    ``events`` (optional ``core.events.EventLog``): forwarded to the
+    pipeline route for build/first-step-compile phase spans via the flight
+    recorder (``execution/pipeline.py``)."""
     schedule, virtual_stages = resolve_schedule(
         artifact, schedule, virtual_stages)
     if schedule not in ("gpipe", "1f1b", "interleaved"):
@@ -182,12 +187,13 @@ def build_executable(
         if _uniform_block_split(artifact, cfg, pp):
             return _pipeline_executable(
                 cfg, artifact, s0, pp, devices, optimizer,
-                schedule, virtual_stages)
+                schedule, virtual_stages, events=events)
         counts = _uneven_1f1b_split(artifact, cfg, pp, schedule)
         if counts is not None:
             return _pipeline_executable(
                 cfg, artifact, s0, pp, devices, optimizer,
-                schedule, virtual_stages, block_counts=counts)
+                schedule, virtual_stages, block_counts=counts,
+                events=events)
 
     return _hetero_executable(
         cfg, artifact, strategies, devices, optimizer, cluster, profiles)
@@ -215,7 +221,8 @@ def _gspmd_executable(cfg, artifact, s0, devices, optimizer) -> Executable:
 
 def _pipeline_executable(cfg, artifact, s0, pp, devices,
                          optimizer, schedule="gpipe",
-                         virtual_stages=2, block_counts=None) -> Executable:
+                         virtual_stages=2, block_counts=None,
+                         events=None) -> Executable:
     import numpy as np
     from jax.sharding import Mesh
 
@@ -225,10 +232,13 @@ def _pipeline_executable(cfg, artifact, s0, pp, devices,
         raise ValueError(f"plan needs {need} devices, have {len(devs)}")
     mesh = Mesh(
         np.array(devs[:need]).reshape(pp, s0["dp"], s0["tp"]), (PP, DP, TP))
+    from metis_tpu.core.events import NULL_LOG
+
     init_fn, raw_step = make_pipeline_train_step(
         cfg, mesh, artifact.microbatches, optimizer=optimizer,
         schedule=schedule, virtual_stages=virtual_stages,
-        block_counts=block_counts)
+        block_counts=block_counts,
+        events=events if events is not None else NULL_LOG)
 
     def init(key):
         return init_fn(key)

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from metis_tpu.core.events import EventLog, NULL_LOG
+from metis_tpu.core.trace import Tracer
 from metis_tpu.execution.mesh import DP, PP, TP, gpt_param_specs, shard_params
 from metis_tpu.models.gpt import (
     GPTConfig, _layer_norm, default_attention, init_params)
@@ -616,6 +618,7 @@ def make_pipeline_train_step(
     schedule: str = "gpipe",
     virtual_stages: int = 2,
     block_counts=None,
+    events: EventLog = NULL_LOG,
 ):
     """Jitted pipeline train step over a (pp, dp, tp) mesh.
 
@@ -632,6 +635,13 @@ def make_pipeline_train_step(
     tests).  NOTE the interleaved layout also changes the physical block
     order of params/checkpoints (``interleave_block_order``) — resume
     compares ``CheckpointMeta.block_layout``.
+
+    ``events`` (optional ``core.events.EventLog``): phase observability via
+    the flight recorder — ``pipeline_init`` and ``pipeline_first_step``
+    spans time the on-mesh parameter initialization and the first (XLA
+    compile-dominated) step invocation, so a trace distinguishes compile
+    time from the steady-state step times the cost-model accuracy ledger
+    scores (``obs/ledger.AccuracyMonitor`` skips those compile steps).
 
     ``block_counts`` (optional, len == pp, sum == ``cfg.num_blocks``): an
     UNEVEN per-stage block partition for the gpipe/1f1b schedules.  Every
@@ -728,7 +738,14 @@ def make_pipeline_train_step(
     with mesh:
         jitted = jax.jit(step_fn, donate_argnums=(0, 1))
 
+    tracer = Tracer(events)
+
     def init_fn(key):
+        with tracer.span("pipeline_init", schedule=schedule, pp=pp,
+                         microbatches=num_microbatches):
+            return _init(key)
+
+    def _init(key):
         full = init_params(key, cfg)
         if schedule == "interleaved":
             # reorder the stacked block axis device-major so the contiguous
@@ -748,7 +765,21 @@ def make_pipeline_train_step(
         opt_state = optimizer.init(params)
         return params, opt_state
 
+    first_step = [True]
+
     def run(params, opt_state, tokens_mbs, targets_mbs):
+        if first_step[0]:
+            # the compile-dominated first invocation gets its own span so a
+            # trace (and the accuracy ledger's skip_steps) can separate XLA
+            # compile time from the steady-state steps the planner priced
+            first_step[0] = False
+            with tracer.span("pipeline_first_step", schedule=schedule,
+                             pp=pp, microbatches=num_microbatches):
+                with mesh:
+                    out = jitted(params, opt_state, tokens_mbs, targets_mbs)
+                if tracer.enabled:
+                    jax.block_until_ready(out[2])  # loss — bound the span
+                return out
         with mesh:
             return jitted(params, opt_state, tokens_mbs, targets_mbs)
 

@@ -143,15 +143,24 @@ class StepTimer:
     dispatch is async — a step's wall time is honest only when the caller
     synchronizes (fetching the loss does); between syncs the per-step times
     are dispatch times and only the synced steps' values are load-bearing.
-    A disabled log records for free."""
+    A disabled log records for free.
+
+    ``monitor`` (optional ``obs.ledger.AccuracyMonitor``): every SYNCED
+    step (``loss`` passed — the fetch forced the sync that makes the wall
+    time honest) is also fed to the cost-model accuracy ledger, which
+    emits ``accuracy_sample`` events and raises the drift alarm when the
+    estimator's prediction stops matching the hardware.  Use
+    ``--log-every 1`` for per-step accuracy; sparser syncs fold the
+    un-synced steps' dispatch lag into the synced step's time."""
 
     def __init__(self, events=None, tokens_per_step: int = 0,
-                 start_step: int = 0):
+                 start_step: int = 0, monitor=None):
         import time as _time
 
         self.events = events if events is not None else NULL_LOG
         self.tokens_per_step = tokens_per_step
         self.step_idx = start_step
+        self.monitor = monitor
         self._clock = _time.perf_counter
         self._t0 = self._clock()
         self._last = self._t0
@@ -173,6 +182,8 @@ class StepTimer:
         rec.update(fields)
         if emit:
             self.events.emit("train_step", **rec)
+        if self.monitor is not None and loss is not None:
+            self.monitor.observe(step_ms, step=self.step_idx)
         return rec
 
 

@@ -1,0 +1,25 @@
+from metis_tpu.obs.ledger import (
+    AccuracyLedger,
+    AccuracyMonitor,
+    AccuracySample,
+    DriftDetector,
+    DriftStatus,
+    LedgerSummary,
+    fingerprint_artifact,
+    fingerprint_ranked_plan,
+    fingerprint_uniform_plan,
+    plan_fingerprint,
+)
+
+__all__ = [
+    "AccuracyLedger",
+    "AccuracyMonitor",
+    "AccuracySample",
+    "DriftDetector",
+    "DriftStatus",
+    "LedgerSummary",
+    "fingerprint_artifact",
+    "fingerprint_ranked_plan",
+    "fingerprint_uniform_plan",
+    "plan_fingerprint",
+]

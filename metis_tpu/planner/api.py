@@ -12,6 +12,7 @@ instead of crashing the whole search.
 """
 from __future__ import annotations
 
+import dataclasses
 import time
 from dataclasses import dataclass, field
 from itertools import product
@@ -22,7 +23,16 @@ from metis_tpu.core.config import ModelSpec, SearchConfig
 from metis_tpu.core.errors import MetisError
 from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.trace import Heartbeat, Tracer, timed_iter
-from metis_tpu.core.types import RankedPlan, UniformPlan, PlanCost
+from metis_tpu.core.types import (
+    CostBreakdown,
+    PlanCost,
+    RankedPlan,
+    UniformPlan,
+)
+from metis_tpu.obs.ledger import (
+    fingerprint_ranked_plan,
+    fingerprint_uniform_plan,
+)
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.layers import LayerBalancer
 from metis_tpu.balance.stage_perf import StagePerformanceModel, rank_device_types
@@ -70,6 +80,14 @@ class RankedUniformPlan:
     plan: UniformPlan
     cost: PlanCost
     device_type: str
+    # attached post-ranking to the top-k plans only (plan explainability)
+    breakdown: CostBreakdown | None = None
+
+
+# How many top plans get a CostBreakdown attached (and a ``plan_explain``
+# event emitted) when the caller passes no explicit top_k — breakdown
+# recomputation is per-plan work the search hot path must never pay for.
+DEFAULT_EXPLAIN_K = 5
 
 
 @dataclass(frozen=True)
@@ -344,6 +362,32 @@ def plan_hetero(
     if top_k is not None:
         results = results[:top_k]
     elapsed = time.perf_counter() - t0
+    # plan explainability: re-price the top-k through the SAME estimator to
+    # attach per-component breakdowns (components sum to the ranked scalar)
+    # and emit one plan_explain event per plan.  After the elapsed stamp so
+    # search_seconds stays the pure search-time north-star metric.
+    explain_k = min(len(results),
+                    top_k if top_k is not None else DEFAULT_EXPLAIN_K)
+    if explain_k:
+        with tracer.span("explain", num_plans=explain_k):
+            for i in range(explain_k):
+                rp = results[i]
+                try:
+                    _, bd = estimator.get_breakdown(
+                        rp.inter, rp.intra.strategies,
+                        rp.intra.layer_partition,
+                        schedule=rp.intra.schedule,
+                        virtual_stages=rp.intra.virtual_stages)
+                except KeyError:  # pragma: no cover - costed once already
+                    continue
+                results[i] = dataclasses.replace(rp, breakdown=bd)
+                events.emit(
+                    "plan_explain", rank=i + 1,
+                    fingerprint=fingerprint_ranked_plan(rp),
+                    total_ms=round(bd.total_ms, 4),
+                    components={k: round(v, 4)
+                                for k, v in bd.components.items()},
+                    schedule=rp.intra.schedule)
     tracer.emit_counters(scope="plan_hetero")
     events.emit(
         "search_finished", mode="hetero", num_costed=num_costed,
@@ -427,6 +471,24 @@ def plan_uniform(
     if top_k is not None:
         ranked = ranked[:top_k]
     elapsed = time.perf_counter() - t0
+    explain_k = min(len(ranked),
+                    top_k if top_k is not None else DEFAULT_EXPLAIN_K)
+    if explain_k:
+        with tracer.span("explain", num_plans=explain_k):
+            for i in range(explain_k):
+                r = ranked[i]
+                try:
+                    _, bd = estimator.get_breakdown(r.plan, r.device_type)
+                except KeyError:  # pragma: no cover - costed once already
+                    continue
+                ranked[i] = dataclasses.replace(r, breakdown=bd)
+                events.emit(
+                    "plan_explain", rank=i + 1,
+                    fingerprint=fingerprint_uniform_plan(r.plan),
+                    total_ms=round(bd.total_ms, 4),
+                    components={k: round(v, 4)
+                                for k, v in bd.components.items()},
+                    schedule="gpipe")
     tracer.emit_counters(scope="plan_uniform")
     events.emit(
         "search_finished", mode="uniform", num_costed=num_costed,

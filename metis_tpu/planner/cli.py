@@ -278,6 +278,10 @@ def main(argv: list[str] | None = None) -> int:
     p_val.add_argument("--validate-top-k", type=int, default=3)
     p_val.add_argument("--steps", type=int, default=5)
     p_val.add_argument("--warmup", type=int, default=2)
+    p_val.add_argument("--ledger", default=None,
+                       help="also record every (predicted, measured) pair "
+                            "to this accuracy ledger JSONL (obs/ledger.py; "
+                            "read back with `metis-tpu accuracy`)")
     _add_platform_arg(p_val)
 
     p_train = sub.add_parser(
@@ -318,6 +322,15 @@ def main(argv: list[str] | None = None) -> int:
                               "overlapped with training); 0 = final only")
     p_train.add_argument("--log-every", type=int, default=1,
                          help="emit a train_step event every N steps")
+    p_train.add_argument("--ledger", default=None,
+                         help="cost-model accuracy ledger JSONL: record the "
+                              "chosen plan's predicted breakdown and every "
+                              "measured step; emits accuracy_sample events "
+                              "and a drift_alarm when the rolling error "
+                              "leaves --drift-band (obs/ledger.py)")
+    p_train.add_argument("--drift-band", type=float, default=20.0,
+                         help="rolling MAPE %% that fires the drift alarm "
+                              "(hysteresis: re-arms below half the band)")
     g_mh = p_train.add_argument_group(
         "multi-host (run the SAME command on every host, varying only "
         "--process-id; execution.multihost wires jax.distributed)")
@@ -351,8 +364,44 @@ def main(argv: list[str] | None = None) -> int:
                           help="JSONL file written via --events")
     p_report.add_argument("--json", action="store_true", dest="as_json",
                           help="emit the tree as JSON instead of a table")
+    p_report.add_argument("--top", type=int, default=None, metavar="N",
+                          help="keep only the N most expensive spans by "
+                               "self-time (ancestors kept for context, "
+                               "crashed-open spans always shown)")
     p_report.add_argument("--output", default="-",
                           help="output path ('-' = stdout)")
+
+    p_exp = sub.add_parser(
+        "explain", help="why plan #1 beat plan #2: run a hetero search and "
+                        "render the top plans' per-component cost delta "
+                        "table (CostBreakdown — components sum to the "
+                        "ranked scalar)")
+    _add_cluster_args(p_exp)
+    p_exp.add_argument("--profile-dir", required=True)
+    _add_model_args(p_exp)
+    _add_search_args(p_exp)
+    p_exp.add_argument("--ranks", default="1,2",
+                       help="1-based ranks to compare, e.g. 1,3 "
+                            "(default: the top two)")
+    p_exp.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit breakdowns + delta as JSON")
+
+    p_acc = sub.add_parser(
+        "accuracy", help="cost-model accuracy from a ledger JSONL "
+                         "(metis-tpu train/validate --ledger): error "
+                         "distribution, per-plan MAPE, worst samples/"
+                         "stages, drift status")
+    p_acc.add_argument("ledger", help="accuracy ledger JSONL")
+    p_acc.add_argument("--band", type=float, default=20.0,
+                       help="drift band (MAPE %%) the status is judged "
+                            "against")
+    p_acc.add_argument("--fingerprint", default=None,
+                       help="restrict to one plan fingerprint")
+    p_acc.add_argument("--top", type=int, default=5,
+                       help="worst samples to list")
+    p_acc.add_argument("--json", action="store_true", dest="as_json")
+    p_acc.add_argument("--output", default="-",
+                       help="output path ('-' = stdout)")
 
     p_rep = sub.add_parser(
         "replan", help="elastic re-plan on topology change: diff two cluster "
@@ -377,6 +426,8 @@ def main(argv: list[str] | None = None) -> int:
     _pin_platform(args)
     if args.command == "report":
         return _cmd_report(args)
+    if args.command == "accuracy":
+        return _cmd_accuracy(args)
     if args.command == "calibrate":
         return _cmd_calibrate(args)
     if args.command == "profile":
@@ -394,6 +445,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_replan(args, profiles, model, config, events)
     if args.command == "train":
         return _cmd_train(args, profiles, model, config, events)
+    if args.command == "explain":
+        return _cmd_explain(args, profiles, model, config, events)
 
     if args.command == "hetero":
         cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
@@ -455,11 +508,195 @@ def _cmd_report(args: argparse.Namespace) -> int:
     if not roots and not counters:
         print(f"{args.events_file}: no span/counter events "
               f"({len(events)} events total)", file=sys.stderr)
+    if args.top is not None:
+        from metis_tpu.core.trace import filter_top_spans
+
+        roots = filter_top_spans(roots, args.top)
     if args.as_json:
         payload = json.dumps(span_tree_json(roots, counters), indent=2)
     else:
         payload = render_span_table(roots, counters)
     _emit(args, payload)
+    return 0
+
+
+def _cmd_explain(args: argparse.Namespace, profiles, model, config,
+                 events) -> int:
+    """Per-component plan delta table: the cost term that decided a hetero
+    ranking (cost/estimator.get_breakdown via planner-attached breakdowns)."""
+    from metis_tpu.core.types import COST_COMPONENTS
+    from metis_tpu.obs.ledger import fingerprint_ranked_plan
+
+    try:
+        ranks = sorted({int(r) for r in args.ranks.split(",")})
+    except ValueError:
+        print(f"--ranks must be comma-separated 1-based integers, got "
+              f"{args.ranks!r}", file=sys.stderr)
+        return 2
+    if not ranks or ranks[0] < 1 or len(ranks) > 2:
+        print("--ranks takes one or two 1-based ranks (e.g. 1,2)",
+              file=sys.stderr)
+        return 2
+    cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    result = plan_hetero(cluster, profiles, model, config,
+                         top_k=max(args.top_k, ranks[-1]), events=events)
+    if len(result.plans) < ranks[-1]:
+        print(f"search found only {len(result.plans)} plans "
+              f"({result.num_pruned} pruned); cannot explain rank "
+              f"{ranks[-1]}", file=sys.stderr)
+        return 1
+    chosen = [result.plans[r - 1] for r in ranks]
+    if any(p.breakdown is None for p in chosen):
+        print("breakdown unavailable for a requested rank (profile miss "
+              "during re-pricing)", file=sys.stderr)
+        return 1
+    fps = [fingerprint_ranked_plan(p) for p in chosen]
+
+    if args.as_json:
+        payload: dict = {"plans": [
+            {"rank": r, "fingerprint": fp, **p.to_json_dict()}
+            for r, fp, p in zip(ranks, fps, chosen)]}
+        if len(chosen) == 2:
+            payload["delta"] = {
+                k: round(v, 4)
+                for k, v in chosen[0].breakdown.delta(
+                    chosen[1].breakdown).items()}
+            name, d = chosen[0].breakdown.decisive_component(
+                chosen[1].breakdown)
+            payload["decisive"] = {"component": name, "delta_ms": round(d, 4)}
+        _emit(args, json.dumps(payload, indent=2))
+        return 0
+
+    bds = [p.breakdown for p in chosen]
+    keys = [k for k in COST_COMPONENTS
+            if any(abs(b.components.get(k, 0.0)) > 1e-12 for b in bds)]
+    header = ["component"] + [f"#{r} ({fp})" for r, fp in zip(ranks, fps)]
+    rows: list[list[str]] = []
+    if len(bds) == 2:
+        header.append(f"delta (#{ranks[1]}-#{ranks[0]})")
+        delta = bds[0].delta(bds[1])
+    for k in keys:
+        row = [k] + [f"{b.components.get(k, 0.0):.3f}" for b in bds]
+        if len(bds) == 2:
+            row.append(f"{delta[k]:+.3f}")
+        rows.append(row)
+    total_row = ["total"] + [f"{b.total_ms:.3f}" for b in bds]
+    if len(bds) == 2:
+        total_row.append(f"{bds[1].total_ms - bds[0].total_ms:+.3f}")
+    rows.append(total_row)
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    lines = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(header)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(widths[i]) for i, c in enumerate(row)).rstrip()
+              for row in rows]
+    for r, p, b in zip(ranks, chosen, bds):
+        lines.append("")
+        lines.append(
+            f"#{r}: stages {list(p.inter.device_groups)} x "
+            f"{[(s.dp, s.tp) for s in p.intra.strategies]}, "
+            f"batches {p.inter.batches}, schedule {b.schedule}; "
+            f"per-stage ms {[round(x, 2) for x in b.stage_execution_ms]}")
+    if len(bds) == 2:
+        name, d = bds[0].decisive_component(bds[1])
+        gap = bds[1].total_ms - bds[0].total_ms
+        lines.append("")
+        if abs(gap) < 1e-3 and abs(d) < 1e-3:
+            lines.append(
+                f"decisive: none — the plans tie at {bds[0].total_ms:.3f} ms "
+                "on every component (ranking broke the tie by order)")
+        elif d > 0:
+            lines.append(
+                f"decisive: {name} ({d:+.3f} ms of the {gap:+.3f} ms gap) — "
+                f"#{ranks[1]} loses mostly on {name}")
+        else:
+            lines.append(
+                f"decisive: {name} ({d:+.3f} ms against a {gap:+.3f} ms gap) "
+                f"— #{ranks[1]} wins {name} but loses elsewhere")
+    _emit(args, "\n".join(lines))
+    print(f"costed {result.num_costed} plans ({result.num_pruned} pruned) "
+          f"in {result.search_seconds:.2f}s", file=sys.stderr)
+    return 0
+
+
+def _cmd_accuracy(args: argparse.Namespace) -> int:
+    """Ledger summary: cost-model error distribution + drift status."""
+    from pathlib import Path
+
+    from metis_tpu.obs.ledger import AccuracyLedger, DriftDetector
+
+    if not Path(args.ledger).exists():
+        print(f"no such ledger: {args.ledger}", file=sys.stderr)
+        return 1
+    ledger = AccuracyLedger(args.ledger)
+    summary = ledger.summary(fingerprint=args.fingerprint, worst_k=args.top)
+    # drift status: replay the matched samples (in recorded order) through
+    # a detector at the requested band — same hysteresis as the live train
+    # loop, so `accuracy` and the drift_alarm agree
+    detector = DriftDetector(band_pct=args.band)
+    for s in ledger.samples:
+        if args.fingerprint and s.fingerprint != args.fingerprint:
+            continue
+        if s.error_pct is not None:
+            detector.observe(s.error_pct)
+    status = detector.status()
+
+    if args.as_json:
+        payload = summary.to_json_dict()
+        payload["drift"] = {
+            "in_drift": status.in_drift,
+            "rolling_mape_pct": (round(status.rolling_mape_pct, 3)
+                                 if status.rolling_mape_pct is not None
+                                 else None),
+            "band_pct": status.band_pct,
+            "alarms": status.alarms,
+        }
+        _emit(args, json.dumps(payload, indent=2))
+        return 0
+
+    lines = [f"accuracy ledger {args.ledger}: {summary.n_samples} samples "
+             f"({summary.n_matched} matched) over {summary.n_plans} plan(s)"]
+    if summary.mape_pct is not None:
+        lines.append(
+            f"error: MAPE {summary.mape_pct:.1f}%  signed bias "
+            f"{summary.signed_error_pct:+.1f}%  p50 {summary.p50_abs_pct:.1f}%"
+            f"  p90 {summary.p90_abs_pct:.1f}%  max {summary.max_abs_pct:.1f}%")
+        mape_txt = (f"{status.rolling_mape_pct:.1f}%"
+                    if status.rolling_mape_pct is not None else "n/a")
+        lines.append(
+            f"drift: {'ALARM' if status.in_drift else 'ok'} "
+            f"(rolling MAPE {mape_txt} vs band {status.band_pct:.1f}%, "
+            f"{status.alarms} alarm(s) over the replay)")
+    else:
+        lines.append("no samples carry a matching prediction — record one "
+                     "with `metis-tpu train --ledger` or `validate --ledger`")
+    if summary.by_plan:
+        lines.append("")
+        lines.append("per plan:")
+        for fp, d in summary.by_plan.items():
+            mape = (f"{d['mape_pct']:.1f}%" if d["mape_pct"] is not None
+                    else "n/a")
+            pred = (f"{d['predicted_ms']:.2f} ms"
+                    if d.get("predicted_ms") is not None else "unpredicted")
+            lines.append(f"  {fp}: n={d['n']} mape={mape} predicted={pred}")
+    if summary.worst:
+        lines.append("")
+        lines.append("worst samples:")
+        for w in summary.worst:
+            lines.append(
+                f"  {w['fingerprint']} step={w['step']} src={w['source']}: "
+                f"predicted {w['predicted_ms']:.2f} vs measured "
+                f"{w['measured_ms']:.2f} ms ({w['error_pct']:+.1f}%)")
+    if summary.stage_residuals:
+        lines.append("")
+        lines.append("per-stage residuals (worst first):")
+        for sr in sorted(summary.stage_residuals,
+                         key=lambda d: -d["mape_pct"]):
+            lines.append(
+                f"  stage {sr['stage']}: signed "
+                f"{sr['signed_error_pct']:+.1f}% mape {sr['mape_pct']:.1f}% "
+                f"(n={sr['n']})")
+    _emit(args, "\n".join(lines))
     return 0
 
 
@@ -524,6 +761,23 @@ def _cmd_validate(args: argparse.Namespace, profiles, model, config) -> int:
     reports = validate_planner_choice(
         result.plans, model, top_k=args.validate_top_k,
         steps=args.steps, warmup=args.warmup)
+    if args.ledger and reports:
+        # every validated plan is one (predicted, measured) accuracy pair —
+        # feed the cost-model ledger so `metis-tpu accuracy` (and the
+        # calibration refit) see on-device ground truth, not just train runs
+        from metis_tpu.obs.ledger import (
+            AccuracyLedger,
+            fingerprint_uniform_plan,
+        )
+
+        with AccuracyLedger(args.ledger) as ledger:
+            for r in reports:
+                fp = fingerprint_uniform_plan(r.plan)
+                if fp not in ledger.predictions:
+                    ledger.record_prediction(fp, r.predicted_ms,
+                                             model=model.name)
+                ledger.record_measurement(fp, r.measured_ms,
+                                          source="validate")
     out = {"plans": [r.to_json_dict() for r in reports]}
     # leave-one-out affine calibration (validation.affine_loo_calibrated):
     # separates systematic environment factors (contention, dispatch
@@ -762,7 +1016,8 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
     def _build(sched):
         return build_executable(cfg, art, cluster=cluster, profiles=profiles,
                                 schedule=sched,
-                                virtual_stages=virtual_stages)
+                                virtual_stages=virtual_stages,
+                                events=events if is_main else None)
 
     try:
         try:
@@ -963,11 +1218,39 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
 
     from metis_tpu.execution.train import StepTimer
 
+    # cost-model accuracy ledger (obs/ledger.py): record the chosen plan's
+    # prediction once, then score every synced step against it —
+    # accuracy_sample events per step, one drift_alarm per excursion past
+    # --drift-band.  One writer under multi-controller.
+    monitor = ledger = None
+    if args.ledger and is_main:
+        from metis_tpu.obs.ledger import (
+            AccuracyLedger,
+            AccuracyMonitor,
+            fingerprint_artifact,
+        )
+
+        ledger = AccuracyLedger(args.ledger)
+        fp = fingerprint_artifact(art)
+        if plan_cost_ms is not None and fp not in ledger.predictions:
+            bd = result.best.breakdown  # top_k=1 search attaches it
+            ledger.record_prediction(
+                fp, plan_cost_ms,
+                components=bd.components if bd is not None else None,
+                stage_ms=bd.stage_execution_ms if bd is not None else (),
+                model=model.name, schedule=art.schedule)
+        elif fp not in ledger.predictions:
+            print(f"--ledger: pinned plan {fp} has no recorded prediction; "
+                  "measurements will be unmatched (no accuracy samples) "
+                  "until one is recorded", file=sys.stderr)
+        monitor = AccuracyMonitor(ledger, fp, events=events,
+                                  band_pct=args.drift_band)
+
     # per-step wall timing + tokens/sec telemetry (execution/train.StepTimer);
     # one event writer under multi-controller
     timer = StepTimer(events if is_main else None,
                       tokens_per_step=art.gbs * model.sequence_length,
-                      start_step=start_step)
+                      start_step=start_step, monitor=monitor)
     losses: list[float] = []
     t0 = time.perf_counter()
     try:
@@ -1015,6 +1298,26 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                          if args.steps and elapsed > 0 else None),
         "checkpoint": args.checkpoint_dir if can_ckpt else None,
     }
+    if monitor is not None:
+        status = monitor.status()
+        summary["accuracy"] = {
+            "fingerprint": monitor.fingerprint,
+            "ledger": args.ledger,
+            "n": status.n,
+            "rolling_mape_pct": (round(status.rolling_mape_pct, 2)
+                                 if status.rolling_mape_pct is not None
+                                 else None),
+            "drift": status.in_drift,
+            "drift_alarms": status.alarms,
+        }
+        if status.in_drift:
+            print(f"cost-model drift: rolling MAPE "
+                  f"{status.rolling_mape_pct:.1f}% exceeds the "
+                  f"{args.drift_band:.1f}% band — the plan was ranked on "
+                  "predictions the hardware no longer honors; re-search "
+                  "with `metis-tpu replan` (library: "
+                  "planner.replan.replan_on_drift)", file=sys.stderr)
+        ledger.close()
     if is_main:  # one summary writer under multi-controller
         _emit(args, json.dumps(summary, indent=2))
     return 0

@@ -7,6 +7,12 @@ a first-class API: diff two cluster descriptions, re-plan on the survivor
 topology, and report what changed, so an orchestrator can drop a failed slice,
 re-plan in seconds, and resume from the last checkpoint
 (execution.checkpoint restores onto the new mesh).
+
+Second trigger (cost-model drift, ``obs/ledger.py``): when the accuracy
+ledger's rolling predicted-vs-measured error leaves the configured band, the
+plan was chosen on predictions the hardware no longer honors — the same
+re-plan machinery runs against the *current* topology via
+:func:`replan_on_drift`, fed by a ``DriftDetector`` status.
 """
 from __future__ import annotations
 
@@ -102,3 +108,29 @@ def replan(
         new_best_cost_ms=new_best.cost.total_ms if new_best else None,
         plan_changed=changed,
     )
+
+
+def replan_on_drift(
+    status,
+    cluster: ClusterSpec,
+    profiles: ProfileStore,
+    model: ModelSpec,
+    config: SearchConfig,
+    old_result: PlannerResult | None = None,
+    **plan_kwargs,
+) -> ReplanReport | None:
+    """Cost-model-drift replan trigger.
+
+    ``status`` is an ``obs.ledger.DriftStatus`` (or anything with an
+    ``in_drift`` bool) from the accuracy ledger's drift detector: None is
+    returned while the predicted-vs-measured error sits inside the band —
+    no search is paid for.  Once in drift, the CURRENT topology is
+    re-searched (fresh profiles / calibration may rank a different plan) and
+    the standard :class:`ReplanReport` comes back; ``old_result`` (the run's
+    original search, if still at hand) supplies the cost comparison without
+    a second search, mirroring ``replan``'s time-critical path.
+    """
+    if not getattr(status, "in_drift", False):
+        return None
+    return replan(cluster, cluster, profiles, model, config,
+                  old_result=old_result, search_old=False, **plan_kwargs)

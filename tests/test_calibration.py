@@ -223,3 +223,54 @@ class TestTorusAlignment:
 
             assert stage_groups_torus_aligned(
                 tc, r.inter.node_sequence, r.inter.device_groups)
+
+
+class TestLedgerCorrection:
+    """Accuracy-ledger residuals refit the prediction level
+    (cost/calibration.fit_ledger_correction + with_correction)."""
+
+    def test_synthetic_drift_refit(self):
+        from metis_tpu.cost import fit_ledger_correction
+
+        # the estimator under-predicts by 30% everywhere (synthetic drift):
+        # measured = 1.3 * predicted (+ small asymmetric noise)
+        preds = [100.0, 200.0, 50.0, 400.0, 120.0]
+        pairs = [(p, 1.3 * p * (1 + 0.01 * ((i % 3) - 1)))
+                 for i, p in enumerate(preds)]
+        fit = fit_ledger_correction(pairs)
+        assert fit["n"] == 5
+        assert fit["scale"] == pytest.approx(1.3, rel=0.02)
+        assert fit["mape_before_pct"] == pytest.approx(23.0, abs=1.5)
+        assert fit["mape_after_pct"] < 1.5  # drift refit closes the error
+
+    def test_accepts_ledger_samples_and_skips_unmatched(self):
+        from metis_tpu.cost import fit_ledger_correction
+        from metis_tpu.obs.ledger import AccuracyLedger
+
+        led = AccuracyLedger(None)
+        led.record_prediction("fp", 100.0)
+        led.record_measurement("fp", 120.0)
+        led.record_measurement("other", 50.0)  # unpredicted — skipped
+        fit = fit_ledger_correction(led.samples)
+        assert fit["n"] == 1
+        assert fit["scale"] == pytest.approx(1.2, rel=1e-6)
+
+    def test_empty_raises(self):
+        from metis_tpu.cost import fit_ledger_correction
+
+        with pytest.raises(ValueError):
+            fit_ledger_correction([])
+
+    def test_with_correction_scales_predict_ms(self):
+        fits = fit_samples([
+            CollectiveSample("all_reduce", 4, 1000, 1.0),
+            CollectiveSample("all_reduce", 4, 2000, 1.5),
+        ])
+        cal = CollectiveCalibration(
+            platform="cpu", device_kind="cpu", group_size=4, fits=fits)
+        corrected = cal.with_correction(1.3)
+        for nbytes in (500, 1000, 4000):
+            assert corrected.fits["all_reduce"].predict_ms(nbytes) == \
+                pytest.approx(1.3 * cal.fits["all_reduce"].predict_ms(nbytes))
+        with pytest.raises(ValueError):
+            cal.with_correction(0.0)

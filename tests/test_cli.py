@@ -359,6 +359,140 @@ def test_train_replan_on_resume_elastic(tmp_path):
     assert summary["steps"] == 1 and summary["final_loss"] is not None
 
 
+def test_explain_subcommand_table(fixture_dir, tmp_path):
+    """`metis-tpu explain` renders the per-component delta table; the
+    components sum (within tolerance) to the ranking scalar."""
+    out = tmp_path / "explain.txt"
+    rc = main(["explain", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--top-k", "3",
+               "--output", str(out)])
+    assert rc == 0
+    text = out.read_text()
+    assert "component" in text and "total" in text and "decisive:" in text
+    assert "compute" in text and "dp_comm" in text
+
+
+def test_explain_subcommand_json_sums_to_scalar(fixture_dir, tmp_path):
+    out = tmp_path / "explain.json"
+    rc = main(["explain", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--top-k", "3",
+               "--ranks", "1,2", "--json", "--output", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    assert len(payload["plans"]) == 2
+    for p in payload["plans"]:
+        comp = p["breakdown"]["components"]
+        assert sum(comp.values()) == pytest.approx(p["cost_ms"], rel=1e-9)
+    assert payload["decisive"]["component"] in payload["delta"]
+    assert sum(payload["delta"].values()) == pytest.approx(
+        payload["plans"][1]["cost_ms"] - payload["plans"][0]["cost_ms"],
+        abs=0.01)
+
+
+def test_explain_bad_ranks(fixture_dir, tmp_path):
+    rc = main(["explain", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+               "--ranks", "one,two", "--output", str(tmp_path / "x")])
+    assert rc == 2
+
+
+def test_train_ledger_and_accuracy_subcommand(fixture_dir, tmp_path):
+    """train --ledger records prediction + per-step measurements; `metis-tpu
+    accuracy` summarizes them (text and JSON)."""
+    ledger = tmp_path / "ledger.jsonl"
+    ev = tmp_path / "events.jsonl"
+    rc = main(["train", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--steps", "4",
+               "--ledger", str(ledger), "--events", str(ev),
+               "--output", str(tmp_path / "summary.json")])
+    assert rc == 0
+    summary = json.loads((tmp_path / "summary.json").read_text())
+    acc = summary["accuracy"]
+    assert acc["ledger"] == str(ledger)
+    assert acc["n"] == 3  # 4 steps minus the skipped compile step
+    assert acc["rolling_mape_pct"] is not None
+    records = [json.loads(l) for l in ledger.read_text().splitlines()]
+    kinds = [r["kind"] for r in records]
+    assert kinds.count("prediction") == 1
+    assert kinds.count("measurement") == 3
+    assert {r["fingerprint"] for r in records} == {acc["fingerprint"]}
+    # events validate against the documented schema (accuracy_sample rides
+    # alongside train_step / span events)
+    import sys as _sys
+    from pathlib import Path as _Path
+    _sys.path.insert(0, str(_Path(__file__).resolve().parent.parent / "tools"))
+    import check_events_schema
+    n, problems = check_events_schema.validate_file(ev)
+    assert problems == []
+    names = {json.loads(l)["event"] for l in ev.read_text().splitlines()}
+    assert "accuracy_sample" in names and "plan_explain" in names
+
+    # accuracy subcommand over the ledger: text + json
+    out = tmp_path / "acc.txt"
+    assert main(["accuracy", str(ledger), "--output", str(out)]) == 0
+    text = out.read_text()
+    assert "samples" in text and "MAPE" in text and "drift:" in text
+    outj = tmp_path / "acc.json"
+    assert main(["accuracy", str(ledger), "--json",
+                 "--output", str(outj)]) == 0
+    payload = json.loads(outj.read_text())
+    assert payload["n_samples"] == 3 and payload["n_matched"] == 3
+    assert payload["drift"]["band_pct"] == 20.0
+
+
+def test_accuracy_subcommand_missing_file(tmp_path):
+    assert main(["accuracy", str(tmp_path / "nope.jsonl")]) == 1
+
+
+def test_validate_ledger_records_pairs(fixture_dir, tmp_path):
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax lacks jax.shard_map — validate's pipeline "
+                    "measurement path (pre-existing env limitation; see "
+                    "test_validate_subcommand_end_to_end)")
+    ledger = tmp_path / "vledger.jsonl"
+    rc = main(["validate", "--hostfile", str(fixture_dir / "hostfile_small"),
+               "--clusterfile", str(fixture_dir / "cluster.json"),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4",
+               "--validate-top-k", "2", "--steps", "1", "--warmup", "1",
+               "--ledger", str(ledger),
+               "--output", str(tmp_path / "val.json"), "--platform", "cpu"])
+    assert rc == 0
+    records = [json.loads(l) for l in ledger.read_text().splitlines()]
+    meas = [r for r in records if r["kind"] == "measurement"]
+    assert meas and all(r["source"] == "validate" for r in meas)
+    preds = {r["fingerprint"] for r in records if r["kind"] == "prediction"}
+    assert {m["fingerprint"] for m in meas} <= preds
+
+
+def test_report_top_filter(fixture_dir, tmp_path):
+    """report --top N keeps only the most expensive spans (plus ancestors)."""
+    ev = tmp_path / "ev.jsonl"
+    rc = main(["hetero", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--top-k", "2",
+               "--events", str(ev), "--output", str(tmp_path / "p.json")])
+    assert rc == 0
+    full = tmp_path / "full.json"
+    topped = tmp_path / "top.json"
+    assert main(["report", str(ev), "--json", "--output", str(full)]) == 0
+    assert main(["report", str(ev), "--json", "--top", "1",
+                 "--output", str(topped)]) == 0
+
+    def count(node):
+        return 1 + sum(count(c) for c in node.get("children", ()))
+
+    n_full = sum(count(s) for s in json.loads(full.read_text())["spans"])
+    n_top = sum(count(s) for s in json.loads(topped.read_text())["spans"])
+    assert n_top < n_full
+
+
 def test_model_size_preset(tmp_path):
     """--model-size expands the reference launcher's shape preset
     (scripts/cost_het_cluster.sh:22-29); explicit shape flags override."""

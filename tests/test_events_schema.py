@@ -39,6 +39,36 @@ def test_fresh_planner_run_validates_clean(planner_events):
     assert n >= 6  # spans + started/finished + counters at minimum
 
 
+def test_fresh_planner_run_emits_plan_explain(planner_events):
+    """The planner attaches top-k breakdowns and their plan_explain events
+    ride in the same (schema-clean) file."""
+    explains = [e for e in read_events(planner_events)
+                if e["event"] == "plan_explain"]
+    assert explains
+    for e in explains:
+        assert sum(e["components"].values()) == pytest.approx(
+            e["total_ms"], abs=0.01)
+
+
+def test_accuracy_and_drift_events_validate(tmp_path):
+    """The obs/ledger emitters (accuracy_sample, drift_alarm) conform to
+    the documented schema."""
+    from metis_tpu.obs.ledger import AccuracyLedger, AccuracyMonitor
+
+    path = tmp_path / "acc.jsonl"
+    with EventLog(path) as log:
+        led = AccuracyLedger(None)
+        led.record_prediction("fp01", 100.0)
+        mon = AccuracyMonitor(led, "fp01", events=log, band_pct=10.0,
+                              min_samples=2, skip_steps=0)
+        for i in range(4):
+            mon.observe(150.0, step=i)
+    events = read_events(path)
+    names = [e["event"] for e in events]
+    assert "accuracy_sample" in names and names.count("drift_alarm") == 1
+    assert check_events_schema.validate_events(events) == []
+
+
 def test_every_emitted_event_name_is_documented(planner_events):
     names = {e["event"] for e in read_events(planner_events)}
     assert names <= set(check_events_schema.EVENT_SCHEMA)

@@ -1,0 +1,367 @@
+"""Cost-model accuracy subsystem (obs/ledger.py + estimator breakdowns):
+per-component plan explainability, predicted-vs-measured ledger, drift
+alarm with hysteresis, and the drift-triggered replan."""
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_events_schema  # noqa: E402
+
+from metis_tpu.cluster import ClusterSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.events import EventLog, read_events
+from metis_tpu.core.types import Strategy, UniformPlan
+from metis_tpu.obs.ledger import (
+    AccuracyLedger,
+    AccuracyMonitor,
+    DriftDetector,
+    fingerprint_artifact,
+    fingerprint_ranked_plan,
+    fingerprint_uniform_plan,
+    plan_fingerprint,
+)
+from metis_tpu.planner import plan_hetero
+from metis_tpu.planner.api import plan_uniform
+from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+
+@pytest.fixture(scope="module")
+def workload():
+    model = tiny_test_model()
+    store = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2, 4],
+                                bss=[1, 2, 4, 8, 16])
+    cluster = ClusterSpec.of(("A100", 2, 4), ("T4", 1, 4))
+    return model, store, cluster
+
+
+# ---------------------------------------------------------------------------
+# CostBreakdown: components sum to the ranked scalar (parity-preserving)
+# ---------------------------------------------------------------------------
+
+
+def test_hetero_breakdown_components_sum_to_scalar(workload):
+    model, store, cluster = workload
+    res = plan_hetero(cluster, store, model, SearchConfig(gbs=64), top_k=5)
+    assert res.plans
+    for rp in res.plans:
+        bd = rp.breakdown
+        assert bd is not None
+        tol = 1e-6 * max(1.0, rp.cost.total_ms)
+        assert abs(bd.component_sum_ms - rp.cost.total_ms) < tol
+        assert bd.total_ms == rp.cost.total_ms
+        # per-stage vectors cover every stage
+        assert len(bd.stage_execution_ms) == rp.inter.num_stages
+
+
+def test_breakdown_scalar_is_bit_identical_to_get_cost(workload):
+    """get_breakdown re-prices through the same math path — the scalar the
+    explain surface shows is exactly the scalar the ranking used."""
+    model, store, cluster = workload
+    res = plan_hetero(cluster, store, model, SearchConfig(gbs=64), top_k=3)
+    from metis_tpu.cost.estimator import EstimatorOptions, HeteroCostEstimator
+    from metis_tpu.cost.volume import TransformerVolume
+
+    volume = TransformerVolume(model, store.model.params_per_layer_bytes)
+    est = HeteroCostEstimator(cluster, store, volume,
+                              EstimatorOptions.from_config(
+                                  SearchConfig(gbs=64)))
+    for rp in res.plans:
+        cost, bd = est.get_breakdown(
+            rp.inter, rp.intra.strategies, rp.intra.layer_partition,
+            schedule=rp.intra.schedule,
+            virtual_stages=rp.intra.virtual_stages)
+        assert cost.total_ms == rp.cost.total_ms
+        assert bd.total_ms == cost.total_ms
+
+
+def test_schedule_family_breakdown_sums():
+    """1f1b/interleaved plans (remat factor, leveled lens, send factor) also
+    decompose additively.  Homogeneous cluster: the shard_map-pipeline
+    schedule families require one device type everywhere."""
+    model = tiny_test_model()
+    store = synthesize_profiles(model, ["A100"], tps=[1, 2, 4],
+                                bss=[1, 2, 4, 8, 16])
+    cluster = ClusterSpec.of(("A100", 2, 4))
+    res = plan_hetero(cluster, store, model,
+                      SearchConfig(gbs=64, enable_schedule_search=True),
+                      top_k=20)
+    scheds = {p.intra.schedule for p in res.plans if p.breakdown}
+    assert len(scheds) > 1  # at least gpipe + one schedule family explained
+    for rp in res.plans:
+        if rp.breakdown is None:
+            continue
+        tol = 1e-6 * max(1.0, rp.cost.total_ms)
+        assert abs(rp.breakdown.component_sum_ms - rp.cost.total_ms) < tol
+        assert rp.breakdown.schedule == rp.intra.schedule
+
+
+def test_uniform_breakdown_components_sum(workload):
+    model, store, cluster = workload
+    res = plan_uniform(cluster, store, model, SearchConfig(gbs=64), top_k=4)
+    assert res.plans
+    for r in res.plans:
+        assert r.breakdown is not None
+        tol = 1e-6 * max(1.0, r.cost.total_ms)
+        assert abs(r.breakdown.component_sum_ms - r.cost.total_ms) < tol
+
+
+def test_breakdown_delta_and_decisive(workload):
+    model, store, cluster = workload
+    res = plan_hetero(cluster, store, model, SearchConfig(gbs=64), top_k=2)
+    assert len(res.plans) >= 2
+    b1, b2 = res.plans[0].breakdown, res.plans[1].breakdown
+    delta = b1.delta(b2)
+    # component deltas sum to the total gap
+    gap = b2.total_ms - b1.total_ms
+    assert sum(delta.values()) == pytest.approx(gap, abs=1e-6)
+    name, d = b1.decisive_component(b2)
+    assert name in delta and abs(d) == max(abs(v) for v in delta.values())
+
+
+def test_plan_explain_events_emitted_and_valid(workload, tmp_path):
+    model, store, cluster = workload
+    path = tmp_path / "ev.jsonl"
+    with EventLog(path) as log:
+        res = plan_hetero(cluster, store, model, SearchConfig(gbs=64),
+                          top_k=3, events=log)
+    evs = read_events(path)
+    explains = [e for e in evs if e["event"] == "plan_explain"]
+    assert len(explains) == len(res.plans)
+    assert [e["rank"] for e in explains] == [1, 2, 3]
+    for e, rp in zip(explains, res.plans):
+        assert e["fingerprint"] == fingerprint_ranked_plan(rp)
+        assert sum(e["components"].values()) == pytest.approx(
+            e["total_ms"], abs=0.01)
+    assert check_events_schema.validate_events(evs) == []
+
+
+# ---------------------------------------------------------------------------
+# plan fingerprints: one identity across planner and execution
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_ranked_plan_matches_artifact(workload):
+    from metis_tpu.execution.mesh import PlanArtifact
+
+    model, store, cluster = workload
+    res = plan_hetero(cluster, store, model, SearchConfig(gbs=64), top_k=3)
+    for rp in res.plans:
+        art = PlanArtifact.from_ranked_plan(rp)
+        assert fingerprint_ranked_plan(rp) == fingerprint_artifact(art)
+
+
+def test_fingerprint_uniform_plan_matches_artifact():
+    from metis_tpu.execution.mesh import PlanArtifact
+
+    plan = UniformPlan(dp=2, pp=2, tp=2, mbs=2, gbs=8)
+    assert fingerprint_uniform_plan(plan) == fingerprint_artifact(
+        PlanArtifact.from_uniform_plan(plan))
+    # pp is part of the identity even though dp/tp stay fixed
+    other = UniformPlan(dp=2, pp=1, tp=2, mbs=2, gbs=8)
+    assert fingerprint_uniform_plan(plan) != fingerprint_uniform_plan(other)
+
+
+def test_fingerprint_strategy_default_insensitivity():
+    """A bare {dp, tp} dict (old artifacts) and a full Strategy fingerprint
+    identically — defaults are canonicalized before hashing."""
+    a = plan_fingerprint(layer_partition=(0, 2, 4),
+                        strategies=[{"dp": 2, "tp": 1}, {"dp": 1, "tp": 2}],
+                        gbs=8, microbatches=2)
+    b = plan_fingerprint(layer_partition=(0, 2, 4),
+                        strategies=[Strategy(dp=2, tp=1),
+                                    Strategy(dp=1, tp=2)],
+                        gbs=8, microbatches=2)
+    assert a == b
+    c = plan_fingerprint(layer_partition=(0, 2, 4),
+                        strategies=[Strategy(dp=2, tp=1, zero=1),
+                                    Strategy(dp=1, tp=2)],
+                        gbs=8, microbatches=2)
+    assert a != c
+
+
+# ---------------------------------------------------------------------------
+# ledger: JSONL round-trip + MAPE math
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_roundtrip_and_mape_math(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    led = AccuracyLedger(path)
+    led.record_prediction("abc", 100.0, components={"compute": 100.0},
+                          stage_ms=[60.0, 40.0])
+    led.record_measurement("abc", 125.0, step=1)   # -20% signed
+    led.record_measurement("abc", 80.0, step=2)    # +25% signed
+    led.record_measurement("zzz", 50.0, step=3)    # unpredicted
+    led.close()
+
+    led2 = AccuracyLedger(path)  # round-trip through the file
+    s = led2.summary()
+    assert s.n_samples == 3 and s.n_matched == 2 and s.n_plans == 2
+    assert s.mape_pct == pytest.approx((20.0 + 25.0) / 2, abs=1e-6)
+    assert s.signed_error_pct == pytest.approx((-20.0 + 25.0) / 2, abs=1e-6)
+    assert s.max_abs_pct == pytest.approx(25.0, abs=1e-6)
+    assert s.worst[0]["error_pct"] == pytest.approx(25.0, abs=1e-6)
+    assert s.by_plan["abc"]["n_matched"] == 2
+    assert s.by_plan["zzz"]["mape_pct"] is None
+
+    # the raw file is two kinds of JSONL records, nothing else
+    kinds = [json.loads(l)["kind"] for l in path.read_text().splitlines()]
+    assert kinds == ["prediction", "measurement", "measurement",
+                     "measurement"]
+
+
+def test_ledger_stage_residuals(tmp_path):
+    led = AccuracyLedger(tmp_path / "l.jsonl")
+    led.record_prediction("fp", 100.0, stage_ms=[60.0, 40.0])
+    led.record_measurement("fp", 110.0, stage_ms=[60.0, 50.0])
+    s = led.summary()
+    assert len(s.stage_residuals) == 2
+    assert s.stage_residuals[0]["signed_error_pct"] == pytest.approx(0.0)
+    assert s.stage_residuals[1]["signed_error_pct"] == pytest.approx(-20.0)
+
+
+# ---------------------------------------------------------------------------
+# drift detector: hysteresis, exactly one alarm per excursion
+# ---------------------------------------------------------------------------
+
+
+def test_drift_detector_fires_exactly_once_per_excursion(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    with EventLog(path) as log:
+        det = DriftDetector(band_pct=10.0, min_samples=3, window=4,
+                            events=log, fingerprint="fp")
+        fired = [det.observe(e) for e in (2.0, 3.0, 50.0, 60.0, 55.0, 58.0)]
+        # fires once on crossing; stays silent while still in drift
+        assert fired.count(True) == 1
+        assert det.in_drift and det.alarms == 1
+        # error returns inside the clear band (5%) -> re-armed
+        for e in (1.0, 1.0, 2.0, 1.0):
+            det.observe(e)
+        assert not det.in_drift
+        # second excursion -> exactly one more alarm
+        fired2 = [det.observe(e) for e in (40.0, 45.0, 50.0, 42.0)]
+        assert fired2.count(True) == 1 and det.alarms == 2
+    evs = read_events(path)
+    alarms = [e for e in evs if e["event"] == "drift_alarm"]
+    assert len(alarms) == 2
+    assert all(a["band_pct"] == 10.0 and a["fingerprint"] == "fp"
+               for a in alarms)
+    assert check_events_schema.validate_events(evs) == []
+
+
+def test_drift_detector_respects_min_samples():
+    det = DriftDetector(band_pct=10.0, min_samples=5)
+    assert not any(det.observe(99.0) for _ in range(4))
+    assert det.observe(99.0)  # fifth sample crosses min_samples
+
+
+def test_drift_detector_hovering_at_band_does_not_spam():
+    """Between clear (band/2) and band, nothing fires and nothing re-arms."""
+    det = DriftDetector(band_pct=20.0, min_samples=2, window=4)
+    for e in (50.0, 50.0):
+        det.observe(e)
+    assert det.alarms == 1
+    for _ in range(20):  # hover around 15% — above clear, below band
+        det.observe(15.0)
+    assert det.alarms == 1 and det.in_drift
+
+
+# ---------------------------------------------------------------------------
+# monitor: the synthetic mispredicted run (acceptance scenario)
+# ---------------------------------------------------------------------------
+
+
+def test_mispredicted_run_fires_exactly_one_valid_drift_alarm(tmp_path):
+    """A plan predicted at 100 ms measuring ~150 ms drives the rolling MAPE
+    over the band and fires exactly one drift_alarm that the schema tool
+    validates — the ISSUE acceptance scenario."""
+    ev_path = tmp_path / "ev.jsonl"
+    with EventLog(ev_path) as log, \
+            AccuracyLedger(tmp_path / "ledger.jsonl") as led:
+        led.record_prediction("plan01", 100.0)
+        mon = AccuracyMonitor(led, "plan01", events=log, band_pct=20.0,
+                              min_samples=3, skip_steps=1)
+        mon.observe(900.0, step=0)  # compile step — skipped, not scored
+        for i in range(10):
+            mon.observe(150.0, step=i + 1)  # ~33% error every step
+        status = mon.status()
+        assert status.in_drift and status.alarms == 1
+    evs = read_events(ev_path)
+    assert [e["event"] for e in evs].count("drift_alarm") == 1
+    samples = [e for e in evs if e["event"] == "accuracy_sample"]
+    assert len(samples) == 10  # the skipped compile step emitted nothing
+    assert all(s["error_pct"] == pytest.approx(-33.333, abs=0.01)
+               for s in samples)
+    assert check_events_schema.validate_events(evs) == []
+    # and the ledger agrees: MAPE far above the band
+    led2 = AccuracyLedger(tmp_path / "ledger.jsonl")
+    assert led2.summary().mape_pct > 20.0
+
+
+def test_monitor_unpredicted_plan_emits_no_samples(tmp_path):
+    with EventLog(tmp_path / "ev.jsonl") as log:
+        led = AccuracyLedger(None)  # in-memory
+        mon = AccuracyMonitor(led, "nope", events=log, skip_steps=0)
+        out = mon.observe(123.0, step=1)
+        assert out is not None and out.error_pct is None
+    # no prediction -> no accuracy_sample, no alarm (the lazy EventLog
+    # never even created the file)
+    assert not (tmp_path / "ev.jsonl").exists()
+    assert led.samples[0].predicted_ms is None
+
+
+def test_step_timer_feeds_monitor(tmp_path):
+    """execution/train.StepTimer routes synced steps into the monitor."""
+    from metis_tpu.execution.train import StepTimer
+
+    led = AccuracyLedger(None)
+    led.record_prediction("fp", 1000.0)
+    mon = AccuracyMonitor(led, "fp", band_pct=20.0, min_samples=2,
+                          skip_steps=0)
+    timer = StepTimer(None, tokens_per_step=0, monitor=mon)
+    timer.record(loss=1.0)          # synced -> observed
+    timer.record(loss=None)         # unsynced -> not observed
+    timer.record(loss=0.5, emit=False)  # synced, unemitted -> observed
+    assert len(led.samples) == 2
+    assert led.samples[0].step == 1 and led.samples[1].step == 3
+
+
+# ---------------------------------------------------------------------------
+# drift-triggered replan
+# ---------------------------------------------------------------------------
+
+
+def test_replan_on_drift(workload):
+    from metis_tpu.obs.ledger import DriftStatus
+    from metis_tpu.planner.replan import replan_on_drift
+
+    model, store, cluster = workload
+    ok = DriftStatus(in_drift=False, rolling_mape_pct=3.0, n=10, alarms=0,
+                     band_pct=20.0)
+    assert replan_on_drift(ok, cluster, store, model,
+                           SearchConfig(gbs=64)) is None
+    bad = DriftStatus(in_drift=True, rolling_mape_pct=35.0, n=10, alarms=1,
+                      band_pct=20.0)
+    report = replan_on_drift(bad, cluster, store, model,
+                             SearchConfig(gbs=64))
+    assert report is not None
+    assert report.delta.is_empty  # same topology — drift, not node loss
+    assert report.result.best is not None
+    assert report.old_best_cost_ms is None  # time-critical: no old search
+
+
+def test_replan_on_drift_reuses_old_result(workload):
+    from metis_tpu.obs.ledger import DriftStatus
+    from metis_tpu.planner.replan import replan_on_drift
+
+    model, store, cluster = workload
+    old = plan_hetero(cluster, store, model, SearchConfig(gbs=64), top_k=1)
+    bad = DriftStatus(in_drift=True, rolling_mape_pct=35.0, n=10, alarms=1,
+                      band_pct=20.0)
+    report = replan_on_drift(bad, cluster, store, model,
+                             SearchConfig(gbs=64), old_result=old)
+    assert report.old_best_cost_ms == old.best.cost.total_ms

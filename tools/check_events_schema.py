@@ -21,9 +21,10 @@ import sys
 from pathlib import Path
 
 # event name -> fields required beyond the universal ts/event.
-# Emitters: planner/api.py (search_*, counters, spans via core/trace.py),
-# planner/cli.py + execution/train.py (train_step), profiles/profiler.py
-# (profile_*).
+# Emitters: planner/api.py (search_*, plan_explain, counters, spans via
+# core/trace.py), planner/cli.py + execution/train.py (train_step),
+# profiles/profiler.py (profile_*), obs/ledger.py (accuracy_sample via
+# AccuracyMonitor, drift_alarm via DriftDetector).
 EVENT_SCHEMA: dict[str, set[str]] = {
     "search_started": {"mode", "devices", "gbs"},
     "search_finished": {"mode", "num_costed", "num_pruned", "seconds"},
@@ -36,6 +37,11 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "profile_measured": {"device_type", "tp", "bs"},
     "profile_skipped": {"tp", "reason"},
     "profile_finished": {"device_type"},
+    # cost-model explainability + accuracy (obs/ledger.py, planner/api.py)
+    "plan_explain": {"rank", "fingerprint", "total_ms", "components"},
+    "accuracy_sample": {"fingerprint", "predicted_ms", "measured_ms",
+                        "error_pct"},
+    "drift_alarm": {"mape_pct", "band_pct", "n"},
 }
 
 
