@@ -322,6 +322,84 @@ def scale_search(record: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# parallel sharded search (search/parallel.py — SearchConfig.workers)
+# ---------------------------------------------------------------------------
+
+
+def parallel_search(record: dict) -> None:
+    """Serial vs sharded search on the 64-device scale workload, plus the
+    determinism guarantee asserted in-bench: the parallel FULL ranking on
+    the parity workload must be byte-identical to serial
+    (``dump_ranked_plans`` equality).  The speedup is honest measured
+    wall-clock — on a single-core host the sharded run pays fork+merge
+    overhead for no gain and the ratio reports that; ``cpus`` records what
+    the box offered."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import PARITY_GBS, write_parity_fixture
+
+    cpus = os.cpu_count() or 1
+    workers = max(4, min(cpus, 8))
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        serial = plan_hetero(
+            cluster, store, tiny_test_model(),
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True))
+        par = plan_hetero(
+            cluster, store, tiny_test_model(),
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                         workers=workers))
+        assert dump_ranked_plans(par.plans) == dump_ranked_plans(
+            serial.plans), "parallel parity ranking diverged from serial"
+        assert (par.num_costed, par.num_pruned, par.num_bound_pruned) == (
+            serial.num_costed, serial.num_pruned, serial.num_bound_pruned)
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_scale_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        # top_k bounds what each worker ships back across the queue; the
+        # top-32 ranking is still exact (worker-local truncation keeps a
+        # superset of the merged top-k)
+        t0 = time.perf_counter()
+        s_res = plan_hetero(
+            cluster, store, scale_model(),
+            SearchConfig(gbs=SCALE_GBS, strict_compat=True,
+                         max_profiled_tp=SCALE_MAX_TP,
+                         max_profiled_bs=SCALE_MAX_BS), top_k=32)
+        serial_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        p_res = plan_hetero(
+            cluster, store, scale_model(),
+            SearchConfig(gbs=SCALE_GBS, strict_compat=True,
+                         max_profiled_tp=SCALE_MAX_TP,
+                         max_profiled_bs=SCALE_MAX_BS,
+                         workers=workers), top_k=32)
+        parallel_s = time.perf_counter() - t0
+        assert dump_ranked_plans(p_res.plans) == dump_ranked_plans(
+            s_res.plans), "parallel top-32 diverged from serial at scale"
+        record["parallel_search"] = {
+            "workers": workers, "cpus": cpus,
+            "devices": 64, "gbs": SCALE_GBS,
+            "plans_costed": p_res.num_costed,
+            "serial_s": round(serial_s, 2),
+            "parallel_s": round(parallel_s, 2),
+            "speedup": round(serial_s / parallel_s, 2),
+            "parity_byte_identical": True,
+        }
+
+
+# ---------------------------------------------------------------------------
 # scale point: 256 devices, 4 types (search/prune.py — VERDICT r2 step 7)
 # ---------------------------------------------------------------------------
 
@@ -1244,6 +1322,7 @@ def main() -> None:
     recorder.run("probe", _probe_section, record)
     recorder.run("parity", parity_search, record)
     recorder.run("scale_search", scale_search, record)
+    recorder.run("parallel_search", parallel_search, record)
     recorder.run("scale_search_256", scale_search_256, record)
     recorder.run("northstar", northstar, record)
     recorder.run("validation", validation_error, record)
@@ -1322,6 +1401,8 @@ def _headline(record: dict) -> dict:
         "validation_skipped": val.get("skipped"),
         "northstar_gap_pct": ns.get("gap_vs_exhaustive_pct"),
         "northstar_beam_s": ns.get("beam_s"),
+        "parallel_speedup": (record.get("parallel_search") or {})
+        .get("speedup"),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),
         "tpu_step": _tpu_brief(record, "tpu_step"),

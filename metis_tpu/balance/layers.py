@@ -44,6 +44,17 @@ from metis_tpu.native import minmax_partition_native, native_available
 from metis_tpu.search.intra_stage import PartitionResult
 
 
+# Cross-candidate memo bound (entries) — see LayerBalancer.__init__.
+_MEMO_MAX = 200_000
+
+
+def _strategy_key(strategies: Sequence[Strategy]) -> tuple:
+    """Hashable memo key over every strategy axis the memory/partition
+    models read (dp, tp, cp, ep, zero, sp; cp_mode rides along for safety)."""
+    return tuple((s.dp, s.tp, s.cp, s.ep, s.zero, s.sp, s.cp_mode)
+                 for s in strategies)
+
+
 def minmax_partition(
     weights: Sequence[float],
     performance: Sequence[float],
@@ -129,6 +140,14 @@ class LayerBalancer:
         self.act_split = ActivationSplitModel(profiles)
         self.sp_model = SequenceParallelModel(self.act_split)
         self._prefix_cache: dict[tuple, list[float]] = {}
+        # Cross-candidate partition memos: the DP answer depends only on
+        # (placement, groups, microbatch total, strategy axes, performance,
+        # capacity) — and the enumeration revisits those combinations once
+        # per batch count and type permutation.  PartitionResult is frozen,
+        # so cached values are shared safely.  Bounded like the estimator's
+        # bandwidth cache (cost/estimator.py) against pathological searches.
+        self._part_cache: dict[tuple, PartitionResult] = {}
+        self._sched_cache: dict[tuple, PartitionResult] = {}
         # Normalized per-layer durations from the tp1_bs1 profile of the first
         # device type (≅ load_balancer.py:22-27, made deterministic).  When
         # the sweep starts above bs=1, the smallest profiled bs at tp=1
@@ -267,7 +286,31 @@ class LayerBalancer:
         activation term is charged at its actual in-flight count instead.
         Falls back to the legacy schedule-blind demand when the store has
         too few batch points to identify the split (conservative for the
-        remat schedules — never optimistic about relief)."""
+        remat schedules — never optimistic about relief).
+
+        Memoized across candidates (profile misses propagate uncached, so
+        the caller's prune accounting replays identically)."""
+        key = (plan.node_sequence, plan.device_groups, plan.batches,
+               plan.gbs // plan.batches, _strategy_key(strategies),
+               schedule, virtual_stages, tuple(memory_capacity))
+        cached = self._sched_cache.get(key)
+        if cached is not None:
+            return cached
+        out = self._schedule_partition_uncached(
+            plan, strategies, memory_capacity, schedule, virtual_stages)
+        if len(self._sched_cache) > _MEMO_MAX:
+            self._sched_cache.clear()
+        self._sched_cache[key] = out
+        return out
+
+    def _schedule_partition_uncached(
+        self,
+        plan: InterStagePlan,
+        strategies: Sequence[Strategy],
+        memory_capacity: Sequence[float],
+        schedule: str,
+        virtual_stages: int,
+    ) -> PartitionResult:
         from metis_tpu.cost.estimator import uniform_layer_split
         from metis_tpu.cost.schedule import (
             boundary_buffer_mb,
@@ -317,6 +360,28 @@ class LayerBalancer:
 
     # -- partitioning ------------------------------------------------------
     def partition(
+        self,
+        plan: InterStagePlan,
+        strategies: Sequence[Strategy],
+        compute_performance: Sequence[float],
+        memory_capacity: Sequence[float],
+    ) -> PartitionResult:
+        # the internal ProfileMissError path returns a normal infeasible
+        # result, so it caches like any other answer
+        key = (plan.node_sequence, plan.device_groups,
+               plan.gbs // plan.batches, _strategy_key(strategies),
+               tuple(compute_performance), tuple(memory_capacity))
+        cached = self._part_cache.get(key)
+        if cached is not None:
+            return cached
+        out = self._partition_uncached(
+            plan, strategies, compute_performance, memory_capacity)
+        if len(self._part_cache) > _MEMO_MAX:
+            self._part_cache.clear()
+        self._part_cache[key] = out
+        return out
+
+    def _partition_uncached(
         self,
         plan: InterStagePlan,
         strategies: Sequence[Strategy],

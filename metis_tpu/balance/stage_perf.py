@@ -46,39 +46,71 @@ def node_device_types(cluster: ClusterSpec, node_sequence: Sequence[str]) -> lis
     return out
 
 
+# Cross-candidate memo bound (entries, not bytes): thousands of inter-stage
+# candidates share the same (placement, groups) sub-problems, so these caches
+# hit constantly — but a pathological search must not grow them unboundedly.
+_MEMO_MAX = 200_000
+
+
 class StagePerformanceModel:
-    """Implements the search layer's StageEvaluator protocol."""
+    """Implements the search layer's StageEvaluator protocol.
+
+    Both evaluations are memoized across candidates: the result depends only
+    on (node_sequence, device_groups) — plus the per-stage microbatch and
+    strategy axes for ``compute_performance`` — and the enumeration revisits
+    the same compositions once per batch count and once per type permutation.
+    Cached values are immutable tuples shared between callers.
+    """
 
     def __init__(self, cluster: ClusterSpec, profiles: ProfileStore):
         self.cluster = cluster
         self.profiles = profiles
         self.data_balancer = DataBalancer(profiles)
+        self._cap_cache: dict[tuple, tuple[float, ...]] = {}
+        self._perf_cache: dict[tuple, tuple[float, ...]] = {}
 
     def stage_types(self, plan: InterStagePlan, stage_id: int) -> list[str]:
         ranks = rank_device_types(self.cluster, plan.node_sequence)
         start, end = plan.stage_rank_range(stage_id)
         return ranks[start:end]
 
-    def memory_capacity(self, plan: InterStagePlan) -> list[float]:
+    def memory_capacity(self, plan: InterStagePlan) -> Sequence[float]:
         """Aggregate HBM per stage, MB (≅ ``device_group.py:87-101``)."""
-        ranks = rank_device_types(self.cluster, plan.node_sequence)
-        out = []
-        for stage_id in range(plan.num_stages):
-            start, end = plan.stage_rank_range(stage_id)
-            out.append(sum(self.cluster.memory_mb(t) for t in ranks[start:end]))
+        key = (plan.node_sequence, plan.device_groups)
+        out = self._cap_cache.get(key)
+        if out is None:
+            ranks = rank_device_types(self.cluster, plan.node_sequence)
+            vals = []
+            for stage_id in range(plan.num_stages):
+                start, end = plan.stage_rank_range(stage_id)
+                vals.append(
+                    sum(self.cluster.memory_mb(t) for t in ranks[start:end]))
+            out = tuple(vals)
+            if len(self._cap_cache) > _MEMO_MAX:
+                self._cap_cache.clear()
+            self._cap_cache[key] = out
         return out
 
     def compute_performance(
         self, plan: InterStagePlan, strategies: Sequence[Strategy]
-    ) -> list[float]:
+    ) -> Sequence[float]:
         """Normalized per-stage throughput (sums to 1;
         ≅ ``device_group.py:54-85``)."""
+        # per-stage bs is gbs // batches // dp, so the per-candidate batch
+        # count enters only through the microbatch total (two-step floor
+        # division is exact for positive ints) — plans sharing it hit
+        mb_total = plan.gbs // plan.batches
+        key = (plan.node_sequence, plan.device_groups, mb_total,
+               tuple((s.dp, s.tp, s.cp) for s in strategies))
+        cached = self._perf_cache.get(key)
+        if cached is not None:
+            return cached
         ranks = rank_device_types(self.cluster, plan.node_sequence)
         raw: list[float] = []
         for stage_id, strat in enumerate(strategies):
             start, end = plan.stage_rank_range(stage_id)
             types = ranks[start:end]
-            bs = plan.gbs // plan.batches // strat.dp
+            bs = mb_total // strat.dp
             if len(set(types)) == 1:
                 # Context parallelism shards the sequence: per-device compute
                 # scales ~1/cp (metis_tpu.cost.context_parallel docstring).
@@ -86,7 +118,7 @@ class StagePerformanceModel:
                 raw.append(1.0 / t)
             else:
                 split = self.data_balancer.partition(
-                    types, strat.dp, strat.tp, plan.gbs // plan.batches)
+                    types, strat.dp, strat.tp, mb_total)
                 chunks = replica_chunks(types, strat.dp)
                 times = []
                 for replica_id, h_bs in enumerate(split):
@@ -97,4 +129,8 @@ class StagePerformanceModel:
                 worst = max(times) if times else 0.0
                 raw.append(1.0 / worst if worst else 0.0)
         total = sum(raw)
-        return [r / total for r in raw] if total else raw
+        out = tuple(r / total for r in raw) if total else tuple(raw)
+        if len(self._perf_cache) > _MEMO_MAX:
+            self._perf_cache.clear()
+        self._perf_cache[key] = out
+        return out

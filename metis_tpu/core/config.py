@@ -136,6 +136,13 @@ class SearchConfig:
     # candidates/sec, best-cost-so-far, elapsed — a long search is
     # observable while running (``tail -f`` the events file)
     progress_every: int = 1000
+    # Shard the inter-stage candidate stream across N multiprocessing
+    # workers (search/parallel.py).  1 = the serial loop; >1 is transparent:
+    # the merged ranking is byte-identical to serial (index-stride sharding
+    # + stable tie-break) and the planner falls back to serial — emitting a
+    # ``parallel_fallback`` event — when no start method is available or the
+    # search inputs cannot be pickled.
+    workers: int = 1
 
     def __post_init__(self) -> None:
         if self.gbs < 1:
@@ -146,3 +153,5 @@ class SearchConfig:
             raise ValueError("virtual_stage_candidates must all be >= 2")
         if self.progress_every < 1:
             raise ValueError("progress_every must be >= 1")
+        if self.workers < 1:
+            raise ValueError("workers must be >= 1")

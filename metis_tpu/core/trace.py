@@ -48,6 +48,13 @@ class Counters:
     def get(self, name: str) -> int:
         return self._c.get(name, 0)
 
+    def merge(self, other: dict[str, int]) -> None:
+        """Fold another run's counter dict into this registry — how the
+        parallel search parent (search/parallel.py) reconciles per-worker
+        accounting into the one ``counters`` event the run emits."""
+        for name, n in other.items():
+            self._c[name] = self._c.get(name, 0) + n
+
     def as_dict(self) -> dict[str, int]:
         return dict(self._c)
 

@@ -277,7 +277,24 @@ def dump_ranked_plans(plans: Sequence[RankedPlan], limit: int | None = None) -> 
     return json.dumps(out, indent=2)
 
 
+@lru_cache(maxsize=8192)
+def _divisors_ascending(n: int) -> tuple[int, ...]:
+    # search-hot: the enumeration loop asks for the same gbs's divisors once
+    # per stage count per search; trial division to n is O(n) per call —
+    # factor-pair walk to sqrt(n) plus the cache makes repeats free
+    small: list[int] = []
+    large: list[int] = []
+    i = 1
+    while i * i <= n:
+        if n % i == 0:
+            small.append(i)
+            if i * i != n:
+                large.append(n // i)
+        i += 1
+    return tuple(small + large[::-1])
+
+
 def divisors(n: int, descending: bool = False) -> Iterator[int]:
     """All divisors of n (ascending by default)."""
-    ds = [i for i in range(1, n + 1) if n % i == 0]
+    ds = _divisors_ascending(n)
     return iter(reversed(ds)) if descending else iter(ds)

@@ -21,6 +21,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Sequence
 
+import numpy as np
+
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.core.config import SearchConfig
 from metis_tpu.core.errors import ProfileMissError
@@ -347,6 +349,14 @@ class HeteroCostEstimator(_EstimatorBase):
         self._bw_key = None
         self._bw_model = None
         self._bw_cache: dict = {}
+        # Cross-candidate stage-time memo: many (inter, intra) candidates
+        # share (stage composition, layer range, strategy) sub-problems.
+        # Values are the SCALAR path's floats verbatim, so cached pricing is
+        # bit-identical to uncached (tests/test_ledger.py pins exact
+        # re-price equality).  Bounded like _bw_cache.
+        self._stage_ms_cache: dict = {}
+        # stage_time_grid prefix matrices per (device_type, tp)
+        self._time_grid_cache: dict = {}
 
     def _bandwidth_for(self, plan: InterStagePlan):
         key = (plan.node_sequence, plan.device_groups)
@@ -401,7 +411,69 @@ class HeteroCostEstimator(_EstimatorBase):
             self._count_cache(hit=True)
         return self._bw_cache[key]
 
+    def stage_time_grid(
+        self, device_type: str, tp: int, start: int, end: int,
+    ) -> tuple[tuple[int, ...], np.ndarray]:
+        """Vectorized batch costing of one stage's intra-strategy grid:
+        ``(batch_sizes, times_ms)`` pricing layers ``[start, end)`` at EVERY
+        profiled batch size of the ``(device_type, tp)`` configuration in one
+        numpy subtraction of cached per-layer prefix sums.
+
+        The scalar ``get_cost`` path and its ``CostBreakdown`` decomposition
+        stay the oracle — prefix-sum association differs from the sequential
+        ``time_slice`` sum at the last ulp, so this grid is for batch
+        consumers (sweeps, regression tooling) and is oracle-tested against
+        the scalar path at rtol 1e-9 (tools/check_search_regression.py)."""
+        key = (device_type, tp)
+        entry = self._time_grid_cache.get(key)
+        if entry is None:
+            bss = sorted(b for (_, t, b) in self.profiles.configs(device_type)
+                         if t == tp)
+            if not bss:
+                raise ProfileMissError(device_type, tp, 1)
+            mat = np.stack([
+                np.asarray(self.profiles.get(device_type, tp, b).layer_times_ms,
+                           dtype=np.float64)
+                for b in bss])
+            prefix = np.concatenate(
+                [np.zeros((len(bss), 1)), np.cumsum(mat, axis=1)], axis=1)
+            entry = (tuple(bss), prefix)
+            self._time_grid_cache[key] = entry
+        bss, prefix = entry
+        return bss, prefix[:, end] - prefix[:, start]
+
     def _stage_execution_ms(
+        self,
+        plan: InterStagePlan,
+        strategy: Strategy,
+        stage_types: Sequence[str],
+        start: int,
+        end: int,
+    ) -> float:
+        # homo stages collapse dp/batches into the microbatch size, so plans
+        # differing only in that split hit one entry; mixed stages key on the
+        # microbatch total (two-step floor division is exact).  Successes
+        # only: a profile miss re-runs so the raise and its ``profile_miss``
+        # accounting replay identically on every repeat.
+        if len(set(stage_types)) == 1:
+            key = ("h", stage_types[0], strategy.tp,
+                   plan.gbs // strategy.dp // plan.batches, strategy.cp,
+                   start, end)
+        else:
+            key = ("m", tuple(stage_types), strategy.dp, strategy.tp,
+                   strategy.cp, strategy.ep, strategy.zero,
+                   plan.gbs // plan.batches, start, end)
+        cached = self._stage_ms_cache.get(key)
+        if cached is not None:
+            return cached
+        out = self._stage_execution_ms_uncached(
+            plan, strategy, stage_types, start, end)
+        if len(self._stage_ms_cache) > 200_000:
+            self._stage_ms_cache.clear()
+        self._stage_ms_cache[key] = out
+        return out
+
+    def _stage_execution_ms_uncached(
         self,
         plan: InterStagePlan,
         strategy: Strategy,

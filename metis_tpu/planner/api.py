@@ -14,8 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from dataclasses import dataclass, field
-from itertools import product
+from dataclasses import dataclass
 
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.cluster.tpu import TpuClusterSpec
@@ -34,20 +33,11 @@ from metis_tpu.obs.ledger import (
     fingerprint_uniform_plan,
 )
 from metis_tpu.profiles.store import ProfileStore
-from metis_tpu.balance.layers import LayerBalancer
-from metis_tpu.balance.stage_perf import StagePerformanceModel, rank_device_types
-from metis_tpu.cost.estimator import (
-    EstimatorOptions,
-    HeteroCostEstimator,
-    UniformCostEstimator,
-)
-from metis_tpu.cost.context_parallel import cp_candidates
-from metis_tpu.cost.expert_parallel import ep_candidates
-from metis_tpu.cost.zero import zero_candidates
+from metis_tpu.cost.estimator import EstimatorOptions, UniformCostEstimator
 from metis_tpu.cost.ici import IciDcnBandwidth
 from metis_tpu.cost.volume import TransformerVolume
 from metis_tpu.search.inter_stage import inter_stage_plans
-from metis_tpu.search.intra_stage import intra_stage_plans, schedule_intra_plans
+from metis_tpu.search.parallel import CandidateEvaluator
 from metis_tpu.search.prune import SearchPruner, pruned_inter_stage_plans
 from metis_tpu.search.uniform import uniform_plans
 
@@ -145,8 +135,22 @@ def plan_hetero(
     every ``config.progress_every`` intra candidates, and a ``counters``
     event whose accounting reconciles with the returned result:
     ``costed == num_costed``, ``pruned_profile_miss + pruned_inter_filter
-    == num_pruned``, and the ``prune.*`` family == ``num_bound_pruned``."""
+    == num_pruned``, and the ``prune.*`` family == ``num_bound_pruned``.
+
+    With ``config.workers > 1`` the search runs sharded across worker
+    processes (search/parallel.py) — same ranking, byte-for-byte — falling
+    back to this serial loop (and emitting a ``parallel_fallback`` event)
+    when multiprocessing is unavailable or the inputs don't pickle."""
     _check_profile_attn(profiles, model)
+    if config.workers > 1:
+        from metis_tpu.search.parallel import try_parallel_plan_hetero
+
+        parallel_result = try_parallel_plan_hetero(
+            cluster, profiles, model, config,
+            bandwidth_factory=bandwidth_factory, top_k=top_k,
+            events=events, inter_filter=inter_filter)
+        if parallel_result is not None:
+            return parallel_result
     tracer = Tracer(events)
     heartbeat = Heartbeat(events, every=config.progress_every)
     root = tracer.span("plan_hetero", mode="hetero", model=model.name,
@@ -155,66 +159,19 @@ def plan_hetero(
     t0 = time.perf_counter()
     setup_span = tracer.span("setup")
     setup_span.__enter__()
-    volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
-    options = EstimatorOptions.from_config(config)
-    estimator = HeteroCostEstimator(
-        cluster, profiles, volume, options, bandwidth_factory,
+    # The per-candidate cost loop (estimator, stage evaluator, balancer,
+    # cp/ep/zero/sp + schedule family grids, and the evaluate() generator)
+    # lives in search/parallel.CandidateEvaluator so this serial driver and
+    # the sharded workers run literally the same code.
+    ctx = CandidateEvaluator(
+        cluster, profiles, model, config,
+        bandwidth_factory=bandwidth_factory,
         counters=tracer.counters if tracer.enabled else None)
-    evaluator = StagePerformanceModel(cluster, profiles)
-    balancer = LayerBalancer(cluster, profiles, config, model=model)
-
-    # Context-/expert-parallel families (net-new vs the reference,
-    # SURVEY.md §5): degree 1 is always searched; higher powers of two join
-    # when enabled and the sequence/expert count divides evenly.
-    # cp families carry (degree, mode): every degree > 1 searches the ring
-    # K/V-rotation mode, plus the Ulysses all-to-all mode when the head
-    # count splits evenly over the cp axis (ops/ulysses.py; with uneven
-    # heads GSPMD pads, so a2a is searched only where it is efficient).
-    # GQA: K/V carry num_kv_heads heads, so the a2a head split must divide
-    # BOTH counts — equivalently their gcd (d | nh and d | kv <=> d | gcd).
-    import math
-
-    a2a_head_limit = math.gcd(
-        model.num_heads, model.num_kv_heads or model.num_heads)
-    cp_families: list[tuple[int, str]] = [(1, "ring")]
-    if (config.enable_cp and not config.strict_compat
-            and model.num_experts == 0):
-        # cp composes with the DENSE families only: the execution layer has
-        # no cp+MoE path (execution/hetero.py raises NotImplementedError),
-        # so the search must not emit what cannot run — MoE models prune
-        # the cp>1 families here rather than at execution time (VERDICT r2
-        # weak #5; the no-unrunnable-plans property test pins this).
-        for d in cp_candidates(config.max_cp_degree, model.sequence_length):
-            cp_families.append((d, "ring"))
-            if a2a_head_limit % d == 0:
-                cp_families.append((d, "a2a"))
-    ep_degrees: list[int] = [1]
-    if config.enable_ep and not config.strict_compat:
-        ep_degrees += ep_candidates(config.max_ep_degree, model.num_experts)
-    zero_stages = zero_candidates(
-        config.enable_zero and not config.strict_compat)
-    sp_variants = ((False, True)
-                   if config.enable_sp and not config.strict_compat
-                   else (False,))
-    families = list(product(cp_families, ep_degrees, zero_stages, sp_variants))
-    # Pipeline-SCHEDULE families (cost/schedule.py): 1f1b and interleaved
-    # variants of the base (dp, tp) family only — they run on the shard_map
-    # pipeline executor, whose contract excludes cp/ep/zero/sp axes
-    # (execution/builder.py routing).  gpipe is always searched above.
-    # MoE models are excluded for the same reason as cp above: the
-    # shard_map pipeline is a dense-GPT program — routing an MoE plan there
-    # would silently train without the experts.
-    sched_families: list[tuple[str, int]] = []
-    if (config.enable_schedule_search and not config.strict_compat
-            and model.num_experts == 0):
-        sched_families.append(("1f1b", 1))
-        for vs in config.virtual_stage_candidates:
-            sched_families.append(("interleaved", vs))
     setup_span.__exit__(None, None, None)
     events.emit(
         "search_started", mode="hetero", devices=cluster.total_devices,
         device_types=list(cluster.device_types), gbs=config.gbs,
-        num_families=len(families), model=model.name)
+        num_families=len(ctx.families), model=model.name)
 
     results: list[RankedPlan] = []
     pruned = 0
@@ -260,6 +217,8 @@ def plan_hetero(
         )
     if tracer.enabled:
         inter_iter = timed_iter(inter_iter, enum_acc)
+        ctx.intra_acc = intra_acc
+    ctx.cost_acc = cost_acc
     for inter in inter_iter:
         if inter_filter is not None and not inter_filter(inter):
             pruned += 1
@@ -268,88 +227,19 @@ def plan_hetero(
         if not pruner.admit(inter):
             continue
         pruner.begin_candidate()
-        cp_eligible = None
-        types_uniform = True
-        if len(cp_families) > 1 or sched_families:
-            # Ring attention needs uniform block timing: only homogeneous
-            # stages take the cp axis; the shard_map pipeline (schedule
-            # families) needs ONE device type everywhere.  One placement
-            # resolve per inter plan, shared by both uses.
-            ranks = rank_device_types(cluster, inter.node_sequence)
-            cp_eligible = [
-                len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
-                for s in range(inter.num_stages)
-            ]
-            types_uniform = len(set(ranks)) == 1
-        for sched, vs in sched_families:
-            try:
-                intra_gen = schedule_intra_plans(
-                    inter, evaluator, balancer,
-                    max_tp=config.max_profiled_tp,
-                    max_bs=config.max_profiled_bs,
-                    schedule=sched, virtual_stages=vs,
-                    num_blocks=model.num_layers - 2,
-                    types_uniform=types_uniform,
-                )
-                if tracer.enabled:
-                    intra_gen = timed_iter(intra_gen, intra_acc)
-                for intra in intra_gen:
-                    try:
-                        with cost_acc:
-                            cost = estimator.get_cost(
-                                inter, intra.strategies,
-                                intra.layer_partition,
-                                schedule=sched, virtual_stages=vs)
-                    except KeyError:
-                        pruned += 1
-                        tracer.inc("pruned_profile_miss")
-                        _tick()
-                        continue
-                    pruner.record(cost.total_ms)
-                    best_ms = min(best_ms, cost.total_ms)
-                    results.append(
-                        RankedPlan(inter=inter, intra=intra, cost=cost))
-                    tracer.inc("costed")
-                    _tick()
-            except KeyError:
+        # evaluate() applies pruner.record and the costed/profile-miss
+        # counters itself; this driver keeps the pruned tally, the results
+        # list, and the heartbeat (a family-level miss does not tick,
+        # matching the historical accounting)
+        for kind, item in ctx.evaluate(inter, pruner):
+            if kind == "plan":
+                best_ms = min(best_ms, item.cost.total_ms)
+                results.append(item)
+                _tick()
+            else:
                 pruned += 1
-                tracer.inc("pruned_profile_miss")
-        # one try-block per (cp, ep, zero, sp) family: a profile miss
-        # mid-generation prunes only that family, not its siblings
-        for (cp, cp_mode), ep, zero, sp in families:
-            try:
-                intra_gen = intra_stage_plans(
-                    inter, evaluator, balancer,
-                    max_tp=config.max_profiled_tp,
-                    max_bs=config.max_profiled_bs,
-                    cp_degrees=(cp,), cp_eligible=cp_eligible,
-                    ep_degrees=(ep,), zero_stages=(zero,),
-                    sp_variants=(sp,), cp_modes=(cp_mode,),
-                    num_heads=a2a_head_limit,
-                )
-                if tracer.enabled:
-                    intra_gen = timed_iter(intra_gen, intra_acc)
-                for intra in intra_gen:
-                    try:
-                        with cost_acc:
-                            cost = estimator.get_cost(
-                                inter, intra.strategies,
-                                intra.layer_partition)
-                    except KeyError:
-                        pruned += 1
-                        tracer.inc("pruned_profile_miss")
-                        _tick()
-                        continue
-                    pruner.record(cost.total_ms)
-                    best_ms = min(best_ms, cost.total_ms)
-                    results.append(
-                        RankedPlan(inter=inter, intra=intra, cost=cost))
-                    tracer.inc("costed")
+                if item:
                     _tick()
-            except KeyError:
-                # profile miss inside stage evaluation: prune this family
-                pruned += 1
-                tracer.inc("pruned_profile_miss")
         pruner.end_candidate(inter)
 
     enum_acc.close()
@@ -373,7 +263,7 @@ def plan_hetero(
             for i in range(explain_k):
                 rp = results[i]
                 try:
-                    _, bd = estimator.get_breakdown(
+                    _, bd = ctx.estimator.get_breakdown(
                         rp.inter, rp.intra.strategies,
                         rp.intra.layer_partition,
                         schedule=rp.intra.schedule,

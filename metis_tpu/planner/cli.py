@@ -141,6 +141,12 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
                    help="measured fraction of the dp gradient all-reduce "
                         "hidden under backward compute "
                         "(cost.measure_dp_overlap); 0 = serial model")
+    g.add_argument("--workers", type=int, default=1,
+                   help="shard the search across N worker processes "
+                        "(search/parallel.py); the merged ranking is "
+                        "byte-identical to serial, and the planner falls "
+                        "back to the serial loop when multiprocessing is "
+                        "unavailable")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
     g.add_argument("--events", default=None,
@@ -196,6 +202,7 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         enable_sp=args.enable_sp,
         enable_schedule_search=getattr(args, "enable_schedule_search", False),
         dp_overlap_fraction=getattr(args, "dp_overlap", 0.0),
+        workers=getattr(args, "workers", 1),
     )
 
 

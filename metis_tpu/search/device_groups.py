@@ -18,6 +18,7 @@ tests/test_search_parity.py); the implementation is our own.
 """
 from __future__ import annotations
 
+from functools import lru_cache
 from itertools import chain
 from typing import Iterator, Sequence
 
@@ -129,13 +130,31 @@ def enumerate_device_groups(
     variance: float = 1.0,
     max_permute_len: int = 6,
     shapes: Sequence[int] | None = None,
-) -> list[tuple[int, ...]]:
+) -> Sequence[tuple[int, ...]]:
     """Every candidate per-stage device-count arrangement for a stage count.
 
     ``variance`` filters shapes below ``max(num_devices // num_stages,
     num_stages // num_devices) * variance`` — the reference's "key idea 1"
     (small-group pruning).
+
+    Memoized across calls: the arrangement space depends only on the
+    arguments, and both replanning (``planner/replan.replan_on_drift``) and
+    the sharded parallel workers re-enumerate the identical space.  Callers
+    receive a shared immutable tuple — iterate, don't mutate.
     """
+    return _enumerate_device_groups(
+        num_stages, num_devices, variance, max_permute_len,
+        None if shapes is None else tuple(shapes))
+
+
+@lru_cache(maxsize=4096)
+def _enumerate_device_groups(
+    num_stages: int,
+    num_devices: int,
+    variance: float,
+    max_permute_len: int,
+    shapes: tuple[int, ...] | None,
+) -> tuple[tuple[int, ...], ...]:
     all_shapes = list(shapes) if shapes is not None else power_of_two_shapes(num_devices)
     min_group = max(num_devices // num_stages, num_stages // num_devices) * variance
     eligible = [s for s in all_shapes if s >= min_group]
@@ -143,4 +162,4 @@ def enumerate_device_groups(
     out: list[tuple[int, ...]] = []
     for comp in nondecreasing_compositions(num_stages, num_devices, eligible):
         out.extend(arrangements_of_composition(comp, max_permute_len))
-    return out
+    return tuple(out)

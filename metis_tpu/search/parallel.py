@@ -1,0 +1,496 @@
+"""Sharded parallel plan search — the multiprocess executor behind
+``SearchConfig.workers``.
+
+The search hot loop (``planner/api.plan_hetero``) is a single-process pure
+Python walk, exactly like the reference it reproduces — and "planner search
+time" is a north-star metric (BASELINE.md).  This module makes it scale with
+cores without changing a single answer:
+
+- **Index-stride sharding.**  Every worker enumerates the SAME flat
+  inter-stage candidate stream (``search/inter_stage.inter_stage_plans``)
+  and processes only candidates whose global index ``idx`` satisfies
+  ``idx % num_workers == worker_id``.  The shard assignment depends only on
+  the enumeration order — which is deterministic — so the union of shards
+  is exactly the serial candidate set for ANY worker count, including 1.
+- **Stable tie-break merge.**  The serial path appends costed plans in
+  (global candidate index, per-candidate yield sequence) order and then
+  STABLE-sorts by ``cost.total_ms`` — so its final order is exactly the
+  order of the key ``(total_ms, idx, seq)``.  Workers tag each plan with
+  that key; the parent sorts the concatenation by it, reproducing the
+  serial ranking byte-for-byte (``dump_ranked_plans`` equality is asserted
+  in-bench and in tests/test_parallel_search.py).
+- **Counter reconciliation.**  Each worker runs its own ``Counters`` and
+  ``SearchPruner``; the parent folds the dicts together
+  (``Counters.merge``) and sums ``num_costed``/``num_pruned``/
+  ``num_bound_pruned``.  The doom fast-path is stateless per candidate, so
+  with the bound/beam prunes off (``prune_to_top_k`` unset — always the
+  case under ``strict_compat``) every merged count equals the serial run's.
+  With ``prune_to_top_k`` set the workers keep their exactness guarantee
+  (a worker-local kth-best is never better than the global one, so a
+  bound-pruned candidate is provably outside the global top-K) but prune
+  *later* than the serial composition-level walk — the top-K set matches
+  serial, while prune counters and the tail beyond K may not.  Per-worker
+  cache-utilization counters (``bw_cache_*``) naturally differ from a
+  one-process run.
+- **Graceful fallback.**  ``try_parallel_plan_hetero`` returns None — and
+  emits a ``parallel_fallback`` event with the reason — when no
+  multiprocessing start method is available or the search inputs don't
+  pickle (e.g. ``plan_tpu``'s closure-based bandwidth factory under
+  spawn-only platforms); ``plan_hetero`` then runs its serial loop.
+
+``CandidateEvaluator`` is the factored-out per-candidate cost loop itself,
+shared verbatim by the serial path and the workers — one implementation,
+two drivers.
+"""
+from __future__ import annotations
+
+import math
+import multiprocessing as mp
+import pickle
+import queue as _queue
+import time
+from itertools import product
+
+from metis_tpu.core.events import EventLog, NULL_LOG
+from metis_tpu.core.trace import NULL_SPAN, Counters, Tracer, timed_iter
+from metis_tpu.core.types import RankedPlan
+from metis_tpu.balance.layers import LayerBalancer
+from metis_tpu.balance.stage_perf import StagePerformanceModel, rank_device_types
+from metis_tpu.cost.context_parallel import cp_candidates
+from metis_tpu.cost.estimator import EstimatorOptions, HeteroCostEstimator
+from metis_tpu.cost.expert_parallel import ep_candidates
+from metis_tpu.cost.volume import TransformerVolume
+from metis_tpu.cost.zero import zero_candidates
+from metis_tpu.search.inter_stage import inter_stage_plans
+from metis_tpu.search.intra_stage import intra_stage_plans, schedule_intra_plans
+from metis_tpu.search.prune import SearchPruner
+
+
+class CandidateEvaluator:
+    """The per-candidate cost loop of ``plan_hetero``, factored out so the
+    serial path and the sharded workers run literally the same code.
+
+    Construction mirrors ``plan_hetero``'s setup span: estimator, stage
+    evaluator, layer balancer, and the cp/ep/zero/sp and pipeline-schedule
+    family grids.  ``evaluate(inter, pruner)`` is a generator yielding, in
+    the exact serial insertion order::
+
+        ("plan", RankedPlan)   # costed candidate; ``pruner.record`` and the
+                               # ``costed`` counter already applied
+        ("miss", True)         # per-intra profile miss (counts as a
+                               # heartbeat tick, like the serial loop)
+        ("miss", False)        # family-level profile miss (no tick)
+
+    so drivers only do bookkeeping: pruned tallies, heartbeats, and result
+    collection.  ``inter_filter``/``pruner.admit``/``begin_candidate``/
+    ``end_candidate`` remain the driver's job.
+    """
+
+    def __init__(self, cluster, profiles, model, config,
+                 bandwidth_factory=None, counters=None):
+        self.cluster = cluster
+        self.model = model
+        self.config = config
+        self.counters = counters
+        volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
+        options = EstimatorOptions.from_config(config)
+        self.estimator = HeteroCostEstimator(
+            cluster, profiles, volume, options, bandwidth_factory,
+            counters=counters)
+        self.evaluator = StagePerformanceModel(cluster, profiles)
+        self.balancer = LayerBalancer(cluster, profiles, config, model=model)
+        # GQA: the a2a head split must divide BOTH head counts — their gcd
+        self.a2a_head_limit = math.gcd(
+            model.num_heads, model.num_kv_heads or model.num_heads)
+        # cp composes with the DENSE families only (execution/hetero.py has
+        # no cp+MoE path); every degree > 1 searches ring K/V rotation plus
+        # the Ulysses a2a mode where the head count splits evenly.
+        cp_families: list[tuple[int, str]] = [(1, "ring")]
+        if (config.enable_cp and not config.strict_compat
+                and model.num_experts == 0):
+            for d in cp_candidates(config.max_cp_degree,
+                                   model.sequence_length):
+                cp_families.append((d, "ring"))
+                if self.a2a_head_limit % d == 0:
+                    cp_families.append((d, "a2a"))
+        self.cp_families = cp_families
+        ep_degrees: list[int] = [1]
+        if config.enable_ep and not config.strict_compat:
+            ep_degrees += ep_candidates(config.max_ep_degree,
+                                        model.num_experts)
+        zero_stages = zero_candidates(
+            config.enable_zero and not config.strict_compat)
+        sp_variants = ((False, True)
+                       if config.enable_sp and not config.strict_compat
+                       else (False,))
+        self.families = list(
+            product(cp_families, ep_degrees, zero_stages, sp_variants))
+        # 1f1b/interleaved run on the shard_map pipeline executor — dense
+        # GPT only (execution/builder.py routing), so MoE models skip them.
+        sched_families: list[tuple[str, int]] = []
+        if (config.enable_schedule_search and not config.strict_compat
+                and model.num_experts == 0):
+            sched_families.append(("1f1b", 1))
+            for vs in config.virtual_stage_candidates:
+                sched_families.append(("interleaved", vs))
+        self.sched_families = sched_families
+        # serial-path tracing hooks: plan_hetero routes the intra generators
+        # through its intra_stage accum span and costing through cost_acc;
+        # workers leave them dark (no EventLog crosses the process boundary)
+        self.intra_acc = None
+        self.cost_acc = NULL_SPAN
+
+    def _inc(self, name: str) -> None:
+        if self.counters is not None:
+            self.counters.inc(name)
+
+    def evaluate(self, inter, pruner):
+        config = self.config
+        cp_eligible = None
+        types_uniform = True
+        if len(self.cp_families) > 1 or self.sched_families:
+            # Ring attention needs uniform block timing: only homogeneous
+            # stages take the cp axis; the shard_map pipeline (schedule
+            # families) needs ONE device type everywhere.  One placement
+            # resolve per inter plan, shared by both uses.
+            ranks = rank_device_types(self.cluster, inter.node_sequence)
+            cp_eligible = [
+                len(set(ranks[slice(*inter.stage_rank_range(s))])) == 1
+                for s in range(inter.num_stages)
+            ]
+            types_uniform = len(set(ranks)) == 1
+        for sched, vs in self.sched_families:
+            try:
+                intra_gen = schedule_intra_plans(
+                    inter, self.evaluator, self.balancer,
+                    max_tp=config.max_profiled_tp,
+                    max_bs=config.max_profiled_bs,
+                    schedule=sched, virtual_stages=vs,
+                    num_blocks=self.model.num_layers - 2,
+                    types_uniform=types_uniform,
+                )
+                if self.intra_acc is not None:
+                    intra_gen = timed_iter(intra_gen, self.intra_acc)
+                for intra in intra_gen:
+                    try:
+                        with self.cost_acc:
+                            cost = self.estimator.get_cost(
+                                inter, intra.strategies,
+                                intra.layer_partition,
+                                schedule=sched, virtual_stages=vs)
+                    except KeyError:
+                        self._inc("pruned_profile_miss")
+                        yield "miss", True
+                        continue
+                    pruner.record(cost.total_ms)
+                    self._inc("costed")
+                    yield "plan", RankedPlan(inter=inter, intra=intra,
+                                             cost=cost)
+            except KeyError:
+                self._inc("pruned_profile_miss")
+                yield "miss", False
+        # one try-block per (cp, ep, zero, sp) family: a profile miss
+        # mid-generation prunes only that family, not its siblings
+        for (cp, cp_mode), ep, zero, sp in self.families:
+            try:
+                intra_gen = intra_stage_plans(
+                    inter, self.evaluator, self.balancer,
+                    max_tp=config.max_profiled_tp,
+                    max_bs=config.max_profiled_bs,
+                    cp_degrees=(cp,), cp_eligible=cp_eligible,
+                    ep_degrees=(ep,), zero_stages=(zero,),
+                    sp_variants=(sp,), cp_modes=(cp_mode,),
+                    num_heads=self.a2a_head_limit,
+                )
+                if self.intra_acc is not None:
+                    intra_gen = timed_iter(intra_gen, self.intra_acc)
+                for intra in intra_gen:
+                    try:
+                        with self.cost_acc:
+                            cost = self.estimator.get_cost(
+                                inter, intra.strategies,
+                                intra.layer_partition)
+                    except KeyError:
+                        self._inc("pruned_profile_miss")
+                        yield "miss", True
+                        continue
+                    pruner.record(cost.total_ms)
+                    self._inc("costed")
+                    yield "plan", RankedPlan(inter=inter, intra=intra,
+                                             cost=cost)
+            except KeyError:
+                self._inc("pruned_profile_miss")
+                yield "miss", False
+
+
+def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
+                 model, config, bandwidth_factory, inter_filter, top_k,
+                 want_counters):
+    """One shard of the search, in a child process.
+
+    Enumerates the FULL flat candidate stream (bumping ``inter_enumerated``
+    only for owned candidates, so worker sums equal the serial total) and
+    runs the shared cost loop on every ``idx % num_workers == worker_id``
+    candidate with its own pruner.  Reports ``("progress", ...)`` every
+    ``config.progress_every`` heartbeat ticks and one final
+    ``("result", ...)`` carrying the (locally sorted, optionally top-k
+    truncated) tagged plans plus the accounting.
+    """
+    try:
+        counters = Counters() if want_counters else None
+        ctx = CandidateEvaluator(
+            cluster, profiles, model, config,
+            bandwidth_factory=bandwidth_factory, counters=counters)
+        pruner = SearchPruner(config, cluster, profiles, model,
+                              counters=counters)
+        plans: list[tuple] = []  # (total_ms, global_idx, seq, RankedPlan)
+        pruned = 0
+        ticks = 0
+        best_ms = float("inf")
+        t0 = time.perf_counter()
+        every = max(int(config.progress_every), 1)
+        next_emit = every
+        stream = inter_stage_plans(
+            cluster.device_types, cluster.total_devices, config.gbs,
+            model.num_layers, variance=config.min_group_scale_variance,
+            max_permute_len=config.max_permute_len)
+        for idx, inter in enumerate(stream):
+            if idx % num_workers != worker_id:
+                continue
+            if counters is not None:
+                counters.inc("inter_enumerated")
+            if inter_filter is not None and not inter_filter(inter):
+                pruned += 1
+                if counters is not None:
+                    counters.inc("pruned_inter_filter")
+                continue
+            if not pruner.admit(inter):
+                continue
+            pruner.begin_candidate()
+            seq = 0
+            for kind, item in ctx.evaluate(inter, pruner):
+                if kind == "plan":
+                    if item.cost.total_ms < best_ms:
+                        best_ms = item.cost.total_ms
+                    plans.append((item.cost.total_ms, idx, seq, item))
+                    seq += 1
+                    ticks += 1
+                else:
+                    pruned += 1
+                    if item:
+                        ticks += 1
+                if ticks >= next_emit:
+                    next_emit = ticks + every
+                    elapsed = time.perf_counter() - t0
+                    out_queue.put((
+                        "progress", worker_id, ticks, elapsed,
+                        best_ms if best_ms != float("inf") else None,
+                        len(plans), pruned))
+            pruner.end_candidate(inter)
+        num_costed = len(plans)
+        # local sort by the global stable-tie-break key; with a top_k the
+        # merged top-k is a subset of the union of local top-ks, so the
+        # tail never needs to cross the process boundary
+        plans.sort(key=lambda rec: rec[:3])
+        if top_k is not None:
+            plans = plans[:top_k]
+        out_queue.put((
+            "result", worker_id, plans,
+            counters.as_dict() if counters is not None else None,
+            num_costed, pruned, pruner.num_pruned))
+    except BaseException as e:  # noqa: BLE001 — report; parent falls back
+        out_queue.put(("error", worker_id, f"{type(e).__name__}: {e}"))
+
+
+def _mp_context():
+    """A usable multiprocessing context, fork preferred (cheap, inherits
+    the parent's loaded modules); None when no start method works."""
+    for method in ("fork", "spawn"):
+        try:
+            return mp.get_context(method)
+        except (ValueError, RuntimeError):
+            continue
+    return None
+
+
+def try_parallel_plan_hetero(
+    cluster, profiles, model, config,
+    bandwidth_factory=None,
+    top_k: int | None = None,
+    events: EventLog = NULL_LOG,
+    inter_filter=None,
+):
+    """Run ``plan_hetero``'s search sharded over ``config.workers``
+    processes.  Returns the merged PlannerResult — byte-identical ranking
+    to the serial loop — or None when parallel execution is unavailable
+    (the caller then runs the serial path); every None is preceded by a
+    ``parallel_fallback`` event naming the reason."""
+    from metis_tpu.planner.api import DEFAULT_EXPLAIN_K, PlannerResult
+
+    workers = int(config.workers)
+    if workers <= 1:
+        return None
+    try:
+        pickle.dumps((cluster, profiles, model, config, bandwidth_factory,
+                      inter_filter, top_k))
+    except Exception as e:
+        events.emit("parallel_fallback",
+                    reason=f"unpicklable search inputs ({type(e).__name__})")
+        return None
+    mp_ctx = _mp_context()
+    if mp_ctx is None:
+        events.emit("parallel_fallback",
+                    reason="no multiprocessing start method available")
+        return None
+
+    tracer = Tracer(events)
+    root = tracer.span("plan_hetero", mode="hetero", model=model.name,
+                       devices=cluster.total_devices, workers=workers)
+    root.__enter__()
+    t0 = time.perf_counter()
+    setup_span = tracer.span("setup")
+    setup_span.__enter__()
+    # parent-side evaluator: family count for search_started + the
+    # estimator for the post-ranking explain breakdowns
+    ctx = CandidateEvaluator(
+        cluster, profiles, model, config,
+        bandwidth_factory=bandwidth_factory,
+        counters=tracer.counters if tracer.enabled else None)
+    setup_span.__exit__(None, None, None)
+    events.emit(
+        "search_started", mode="hetero", devices=cluster.total_devices,
+        device_types=list(cluster.device_types), gbs=config.gbs,
+        num_families=len(ctx.families), model=model.name, workers=workers)
+
+    out_queue = mp_ctx.Queue()
+    procs = []
+    try:
+        for wid in range(workers):
+            p = mp_ctx.Process(
+                target=_worker_main,
+                args=(wid, workers, out_queue, cluster, profiles, model,
+                      config, bandwidth_factory, inter_filter, top_k,
+                      events.enabled),
+                daemon=True)
+            p.start()
+            procs.append(p)
+    except OSError as e:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        root.__exit__(None, None, None)
+        events.emit("parallel_fallback",
+                    reason=f"worker start failed ({type(e).__name__})")
+        return None
+
+    results_by_wid: dict[int, tuple] = {}
+    failed: str | None = None
+    strikes = 0
+    workers_span = tracer.span("workers", workers=workers)
+    workers_span.__enter__()
+    # drain while the workers run — the result payloads exceed the pipe
+    # buffer, so a put-then-join worker would deadlock against a
+    # join-then-get parent
+    while len(results_by_wid) < workers and failed is None:
+        try:
+            msg = out_queue.get(timeout=1.0)
+        except _queue.Empty:
+            for wid, p in enumerate(procs):
+                if (wid not in results_by_wid and not p.is_alive()
+                        and p.exitcode not in (0, None)):
+                    failed = f"worker {wid} exited with code {p.exitcode}"
+                    break
+            if failed is None and all(not p.is_alive() for p in procs):
+                strikes += 1  # all dead, queue quiet: give the feeder
+                if strikes >= 5:  # threads a few grace periods to flush
+                    failed = "workers exited without reporting results"
+            continue
+        kind = msg[0]
+        if kind == "progress":
+            _, wid, n, elapsed, best, n_costed, n_pruned = msg
+            events.emit(
+                "search_progress", n=n, elapsed_s=round(elapsed, 3),
+                per_s=round(n / elapsed, 1) if elapsed > 0 else None,
+                worker=wid, best_cost_ms=best, num_costed=n_costed,
+                num_pruned=n_pruned)
+        elif kind == "error":
+            failed = f"worker {msg[1]} raised: {msg[2]}"
+        else:
+            results_by_wid[msg[1]] = msg[2:]
+    if failed is not None:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(timeout=5.0)
+        workers_span.__exit__(None, None, None)
+        root.__exit__(None, None, None)
+        events.emit("parallel_fallback", reason=failed)
+        return None
+    for p in procs:
+        p.join()
+    workers_span.__exit__(None, None, None)
+
+    merged: list[tuple] = []
+    num_costed = 0
+    pruned = 0
+    bound_pruned = 0
+    for wid in range(workers):
+        w_plans, w_counters, w_costed, w_pruned, w_bound = results_by_wid[wid]
+        merged.extend(w_plans)
+        num_costed += w_costed
+        pruned += w_pruned
+        bound_pruned += w_bound
+        if w_counters:
+            tracer.counters.merge(w_counters)
+    with tracer.span("ranking", num_plans=len(merged)):
+        # (total_ms, global candidate idx, per-candidate yield seq): the
+        # serial path's stable sort over its insertion order is exactly a
+        # sort by this key, so the merge reproduces it byte-for-byte
+        merged.sort(key=lambda rec: rec[:3])
+    results = [rec[3] for rec in merged]
+    best_cost = results[0].cost.total_ms if results else None
+    if top_k is not None:
+        results = results[:top_k]
+    elapsed = time.perf_counter() - t0
+
+    import dataclasses
+
+    from metis_tpu.obs.ledger import fingerprint_ranked_plan
+
+    explain_k = min(len(results),
+                    top_k if top_k is not None else DEFAULT_EXPLAIN_K)
+    if explain_k:
+        with tracer.span("explain", num_plans=explain_k):
+            for i in range(explain_k):
+                rp = results[i]
+                try:
+                    _, bd = ctx.estimator.get_breakdown(
+                        rp.inter, rp.intra.strategies,
+                        rp.intra.layer_partition,
+                        schedule=rp.intra.schedule,
+                        virtual_stages=rp.intra.virtual_stages)
+                except KeyError:  # pragma: no cover - costed once already
+                    continue
+                results[i] = dataclasses.replace(rp, breakdown=bd)
+                events.emit(
+                    "plan_explain", rank=i + 1,
+                    fingerprint=fingerprint_ranked_plan(rp),
+                    total_ms=round(bd.total_ms, 4),
+                    components={k: round(v, 4)
+                                for k, v in bd.components.items()},
+                    schedule=rp.intra.schedule)
+    tracer.emit_counters(scope="plan_hetero")
+    events.emit(
+        "search_finished", mode="hetero", num_costed=num_costed,
+        num_pruned=pruned, seconds=round(elapsed, 4),
+        best_cost_ms=best_cost, num_bound_pruned=bound_pruned,
+        workers=workers)
+    root.__exit__(None, None, None)
+    return PlannerResult(
+        plans=tuple(results),
+        num_costed=num_costed,
+        num_pruned=pruned,
+        search_seconds=elapsed,
+        num_bound_pruned=bound_pruned,
+    )

@@ -107,7 +107,7 @@ class TestStagePerformance:
         sp = StagePerformanceModel(cluster, profiles)
         plan = InterStagePlan(("T4", "A100"), (8, 8), 8, 128)
         cap = sp.memory_capacity(plan)
-        assert cap == [8 * 15 * 1024, 8 * 80 * 1024]
+        assert tuple(cap) == (8 * 15 * 1024, 8 * 80 * 1024)
 
     def test_compute_performance_normalized_and_ordered(self, cluster, profiles):
         sp = StagePerformanceModel(cluster, profiles)
@@ -120,7 +120,7 @@ class TestStagePerformance:
         sp = StagePerformanceModel(cluster, profiles)
         plan = InterStagePlan(("A100", "T4"), (16,), 8, 128)
         perf = sp.compute_performance(plan, (Strategy(4, 4),))
-        assert perf == [1.0]
+        assert tuple(perf) == (1.0,)
 
 
 class TestLayerBalancer:

@@ -1,0 +1,145 @@
+"""Parallel sharded plan search (search/parallel.py).
+
+The contract under test: ``SearchConfig.workers`` is TRANSPARENT — the
+merged ranking is byte-identical to the serial loop for any worker count,
+every semantic counter reconciles, and when multiprocessing is unavailable
+the planner silently serves the serial result (plus a ``parallel_fallback``
+event naming the reason).
+"""
+import json
+
+import pytest
+
+from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.events import EventLog
+from metis_tpu.core.types import dump_ranked_plans
+from metis_tpu.planner import plan_hetero
+from metis_tpu.profiles import ProfileStore, tiny_test_model
+from metis_tpu.testing import PARITY_GBS
+
+
+@pytest.fixture(scope="module")
+def workload(parity_fixture_dir):
+    cluster = ClusterSpec.from_files(
+        parity_fixture_dir / "hostfile",
+        parity_fixture_dir / "clusterfile.json")
+    store = ProfileStore.from_dir(parity_fixture_dir / "profiles")
+    return cluster, store, tiny_test_model()
+
+
+@pytest.fixture(scope="module")
+def serial_result(workload):
+    cluster, store, model = workload
+    return plan_hetero(cluster, store, model,
+                       SearchConfig(gbs=PARITY_GBS, strict_compat=True))
+
+
+@pytest.mark.parametrize("workers", [1, 2, 4])
+def test_ranking_byte_identical_to_serial(workload, serial_result, workers):
+    cluster, store, model = workload
+    res = plan_hetero(
+        cluster, store, model,
+        SearchConfig(gbs=PARITY_GBS, strict_compat=True, workers=workers))
+    assert dump_ranked_plans(res.plans) == dump_ranked_plans(
+        serial_result.plans)
+    assert res.num_costed == serial_result.num_costed
+    assert res.num_pruned == serial_result.num_pruned
+    assert res.num_bound_pruned == serial_result.num_bound_pruned
+
+
+def test_top_k_byte_identical_to_serial(workload):
+    """Worker-local top-k truncation must still merge to the serial top-k."""
+    cluster, store, model = workload
+    cfg = SearchConfig(gbs=PARITY_GBS, strict_compat=True)
+    serial = plan_hetero(cluster, store, model, cfg, top_k=7)
+    par = plan_hetero(
+        cluster, store, model,
+        SearchConfig(gbs=PARITY_GBS, strict_compat=True, workers=3),
+        top_k=7)
+    assert dump_ranked_plans(par.plans) == dump_ranked_plans(serial.plans)
+    assert par.num_costed == serial.num_costed
+
+
+def _events(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def test_counter_reconciliation(workload, serial_result, tmp_path):
+    """The merged ``counters`` event reports the SAME semantic accounting
+    as a serial run: per-worker counters sum to the one-process values."""
+    cluster, store, model = workload
+
+    def counters_with(workers):
+        path = tmp_path / f"events_w{workers}.jsonl"
+        with EventLog(path) as log:
+            plan_hetero(
+                cluster, store, model,
+                SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                             workers=workers, progress_every=200),
+                events=log)
+        ctr = [e for e in _events(path) if e["event"] == "counters"]
+        assert len(ctr) == 1
+        return ctr[0]["counters"], _events(path)
+
+    serial_counters, _ = counters_with(1)
+    merged, events = counters_with(2)
+    for name in ("costed", "inter_enumerated", "pruned_profile_miss",
+                 "pruned_inter_filter", "prune.doom", "prune.bound",
+                 "prune.beam"):
+        assert merged.get(name) == serial_counters.get(name), name
+    assert merged["costed"] == serial_result.num_costed
+
+    heartbeats = [e for e in events if e["event"] == "search_progress"]
+    assert heartbeats, "parallel run emitted no heartbeats"
+    assert sorted({e["worker"] for e in heartbeats}) == [0, 1]
+    finished = [e for e in events if e["event"] == "search_finished"]
+    assert finished[-1]["workers"] == 2
+    assert finished[-1]["num_costed"] == serial_result.num_costed
+
+
+def test_fallback_when_no_start_method(workload, serial_result, tmp_path,
+                                       monkeypatch):
+    """No usable multiprocessing context -> the serial loop serves the
+    request and a parallel_fallback event records why."""
+    import metis_tpu.search.parallel as parallel
+
+    monkeypatch.setattr(parallel, "_mp_context", lambda: None)
+    cluster, store, model = workload
+    path = tmp_path / "events.jsonl"
+    with EventLog(path) as log:
+        res = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True, workers=4),
+            events=log)
+    assert dump_ranked_plans(res.plans) == dump_ranked_plans(
+        serial_result.plans)
+    fallbacks = [e for e in _events(path) if e["event"] == "parallel_fallback"]
+    assert len(fallbacks) == 1
+    assert "start method" in fallbacks[0]["reason"]
+
+
+def test_fallback_on_unpicklable_inputs(workload, serial_result, tmp_path):
+    """plan_tpu passes closures as inter_filter/bandwidth_factory — the
+    pickle probe must route those to the serial loop, not crash a worker."""
+    cluster, store, model = workload
+    path = tmp_path / "events.jsonl"
+    with EventLog(path) as log:
+        res = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True, workers=2),
+            events=log,
+            inter_filter=lambda inter: True)
+    assert dump_ranked_plans(res.plans) == dump_ranked_plans(
+        serial_result.plans)
+    fallbacks = [e for e in _events(path) if e["event"] == "parallel_fallback"]
+    assert len(fallbacks) == 1
+    assert "unpicklable" in fallbacks[0]["reason"]
+
+
+def test_regression_gate_passes():
+    """The CI gate (tools/check_search_regression.py) must hold: frozen
+    golden costed count, parallel byte-identity, grid-vs-oracle agreement."""
+    from tools.check_search_regression import main
+
+    assert main([]) == 0

@@ -28,7 +28,12 @@ from pathlib import Path
 EVENT_SCHEMA: dict[str, set[str]] = {
     "search_started": {"mode", "devices", "gbs"},
     "search_finished": {"mode", "num_costed", "num_pruned", "seconds"},
+    # a parallel run (SearchConfig.workers > 1) tags each heartbeat with
+    # the integer ``worker`` id that emitted it; serial heartbeats omit it
     "search_progress": {"n", "elapsed_s"},
+    # parallel search fell back to the serial loop (search/parallel.py):
+    # unpicklable inputs, no start method, or a worker failure
+    "parallel_fallback": {"reason"},
     "counters": {"scope", "counters"},
     "span_begin": {"name", "span_id", "path"},
     "span_end": {"name", "span_id", "path", "dur_ms"},

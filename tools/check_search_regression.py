@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Search-regression gate: the parity workload, serial vs parallel.
+
+Three frozen invariants, any drift exits 1:
+
+1. **Golden costed count.**  The serial search on the shared parity workload
+   (metis_tpu.testing.write_parity_fixture: 8xA100 + 8xT4, 4/node, GPT-10L,
+   gbs=128, strict_compat) costs exactly ``GOLDEN_NUM_COSTED`` plans.  This
+   is the same invariant the upstream reference freezes as its 1,124-plan
+   golden run (``results/hetero_cost_model``, BASELINE.md) — our count
+   differs because the synthetic parity profiles cover bs up to 16 where the
+   reference fixture files stop at 4, widening the intra grid.
+2. **Parallel byte-identity.**  ``SearchConfig.workers=2`` must reproduce
+   the serial ranking byte-for-byte (``dump_ranked_plans`` equality) and
+   the same ``num_costed`` / ``num_pruned`` / ``num_bound_pruned``.
+3. **Vectorized-grid oracle.**  ``HeteroCostEstimator.stage_time_grid``
+   must agree with the scalar ``LayerProfile.time_slice`` path within
+   rtol 1e-9 for every (device_type, tp, layer-range) of the fixture.
+
+Usage:  python tools/check_search_regression.py
+Also importable: ``main(argv) -> int`` — the tier-1 test
+(tests/test_parallel_search.py) runs it in-process so regressions break
+the build, not the dashboards.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# Frozen by this gate: serial num_costed on the parity workload.  Update
+# ONLY when a deliberate search-space change lands, with the rationale in
+# the commit that changes it.
+GOLDEN_NUM_COSTED = 1764
+
+
+def _check_grid_oracle(cluster, store) -> list[str]:
+    import numpy as np
+
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.cost.estimator import EstimatorOptions, HeteroCostEstimator
+    from metis_tpu.cost.volume import TransformerVolume
+    from metis_tpu.profiles import tiny_test_model
+    from metis_tpu.testing import PARITY_GBS
+
+    problems: list[str] = []
+    model = tiny_test_model()
+    config = SearchConfig(gbs=PARITY_GBS, strict_compat=True)
+    estimator = HeteroCostEstimator(
+        cluster, store,
+        TransformerVolume(model, store.model.params_per_layer_bytes),
+        EstimatorOptions.from_config(config))
+    for device_type in cluster.device_types:
+        tps = sorted({tp for (_, tp, _) in store.configs(device_type)})
+        for tp in tps:
+            for start in range(model.num_layers):
+                for end in range(start, model.num_layers + 1):
+                    bss, grid = estimator.stage_time_grid(
+                        device_type, tp, start, end)
+                    oracle = [store.get(device_type, tp, b)
+                              .time_slice(start, end) for b in bss]
+                    try:
+                        np.testing.assert_allclose(
+                            grid, oracle, rtol=1e-9, atol=0.0)
+                    except AssertionError:
+                        problems.append(
+                            f"stage_time_grid({device_type!r}, tp={tp}, "
+                            f"[{start}:{end}]) diverges from the scalar "
+                            f"time_slice oracle beyond rtol 1e-9")
+    return problems
+
+
+def run_checks(workers: int = 2) -> list[str]:
+    """All problems found (empty = regression-free)."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import PARITY_GBS, write_parity_fixture
+
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        model = tiny_test_model()
+
+        serial = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True))
+        if serial.num_costed != GOLDEN_NUM_COSTED:
+            problems.append(
+                f"serial num_costed = {serial.num_costed}, frozen golden is "
+                f"{GOLDEN_NUM_COSTED} — the search space drifted")
+
+        parallel = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                         workers=workers))
+        if dump_ranked_plans(serial.plans) != dump_ranked_plans(
+                parallel.plans):
+            problems.append(
+                f"workers={workers} ranking is not byte-identical to serial")
+        for field in ("num_costed", "num_pruned", "num_bound_pruned"):
+            s, p = getattr(serial, field), getattr(parallel, field)
+            if s != p:
+                problems.append(
+                    f"workers={workers} {field} = {p}, serial = {s}")
+
+        problems.extend(_check_grid_oracle(cluster, store))
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workers", type=int, default=2,
+                        help="worker count for the parallel leg")
+    args = parser.parse_args(argv)
+    problems = run_checks(workers=args.workers)
+    if problems:
+        print(f"{len(problems)} problem(s):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(f"search regression gate OK (golden num_costed = "
+          f"{GOLDEN_NUM_COSTED}, workers={args.workers} byte-identical, "
+          f"time grid matches the scalar oracle)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
