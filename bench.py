@@ -28,6 +28,10 @@ devices), per-executor-family contention calibration with held-out errors
 in ``validation``, measured dp-overlap feeding the cost model, and the
 probe transcript / capture cache documented at ``probe_tpu``/``tpu_capture``.
 
+``resilience`` carries the fault-tolerance numbers: digest-verified
+checkpoint save/restore latency and the supervisor's measured
+time-to-recover from an injected device loss (``tools/chaos_drill.py``).
+
 Telemetry is INCREMENTAL (``SectionRecorder``): every section appends its
 own record to ``bench_sections.jsonl`` (and stderr) the moment it
 completes, a ``BENCH_DEADLINE_S`` wall-clock budget skips remaining
@@ -962,6 +966,87 @@ def validation_error(record: dict) -> None:
             f"{type(e).__name__}: {e}"[:160]
 
 
+# ---------------------------------------------------------------------------
+# fault tolerance: checkpoint latency + supervisor time-to-recover
+# ---------------------------------------------------------------------------
+
+
+def resilience_bench(record: dict) -> None:
+    """Fault-tolerance numbers: digest-verified checkpoint save/restore
+    latency on a small sharded TrainState, and the supervisor's end-to-end
+    time-to-recover from an injected device loss + retried checkpoint-IO
+    failures (tools/chaos_drill.py in a CPU-pinned subprocess — the drill
+    forces the 8-virtual-device mesh and must not inherit a TPU backend)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from metis_tpu.execution import (
+        DP,
+        TP,
+        build_train_state,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from metis_tpu.models import GPTConfig
+
+    entry: dict = {}
+    cfg = GPTConfig(vocab_size=1024, seq_len=64, hidden=128, num_heads=4,
+                    num_blocks=4, dtype=jnp.float32)
+    cpus = jax.devices("cpu")
+    mesh = Mesh(np.array(cpus[:4]).reshape(2, 2), (DP, TP))
+    state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = Path(td) / "ckpt"
+        saves, restores = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            save_checkpoint(ckpt, state, mesh, keep_prev=True)
+            saves.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            restore_checkpoint(ckpt, state)
+            restores.append(time.perf_counter() - t0)
+    entry["checkpoint"] = {
+        "params": n_params,
+        "save_ms": round(sorted(saves)[1] * 1e3, 1),  # median of 3
+        "restore_ms": round(sorted(restores)[1] * 1e3, 1),
+        "digest_verified": True,
+        "keep_prev": True,
+    }
+
+    with tempfile.TemporaryDirectory() as td:
+        rep_path = Path(td) / "report.json"
+        proc = subprocess.run(
+            [sys.executable,
+             str(Path(__file__).resolve().parent / "tools" / "chaos_drill.py"),
+             "--steps", "6", "--skip-corruption",
+             "--report", str(rep_path)],
+            capture_output=True, text=True, timeout=600.0,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if proc.returncode != 0 or not rep_path.exists():
+            entry["drill"] = {
+                "error": f"rc={proc.returncode}: "
+                         + proc.stderr.strip().splitlines()[-1][:160]
+                         if proc.stderr.strip() else f"rc={proc.returncode}"}
+        else:
+            rep = json.loads(rep_path.read_text())["drill"]
+            recov = rep["recoveries"]
+            entry["drill"] = {
+                "fault_script": "checkpoint_write@2x2,device_loss@5",
+                "outcome": rep["outcome"],
+                "steps": rep["steps_done"],
+                "retries": rep["retries"],
+                "checkpoints": rep["checkpoints"],
+                # replan-on-survivors + digest-verified restore, end to end
+                "time_to_recover_s": (round(recov[0]["recover_s"], 2)
+                                      if recov else None),
+                "recoveries": recov,
+            }
+    record["resilience"] = entry
+
+
 def tpu_validation(record: dict) -> None:
     """North-star error on REAL hardware: profile per-layer times on the TPU
     chip, plan a single-chip uniform schedule from those profiles, execute
@@ -1326,6 +1411,7 @@ def main() -> None:
     recorder.run("scale_search_256", scale_search_256, record)
     recorder.run("northstar", northstar, record)
     recorder.run("validation", validation_error, record)
+    recorder.run("resilience", resilience_bench, record)
 
     # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
     # proves the tunnel was alive at bench start — it wedged MID-RUN once
@@ -1403,6 +1489,12 @@ def _headline(record: dict) -> dict:
         "northstar_beam_s": ns.get("beam_s"),
         "parallel_speedup": (record.get("parallel_search") or {})
         .get("speedup"),
+        "resilience_recover_s": (((record.get("resilience") or {})
+                                  .get("drill") or {})
+                                 .get("time_to_recover_s")),
+        "resilience_ckpt_save_ms": (((record.get("resilience") or {})
+                                     .get("checkpoint") or {})
+                                    .get("save_ms")),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),
         "tpu_step": _tpu_brief(record, "tpu_step"),

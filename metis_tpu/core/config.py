@@ -155,3 +155,43 @@ class SearchConfig:
             raise ValueError("progress_every must be >= 1")
         if self.workers < 1:
             raise ValueError("workers must be >= 1")
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs for the training supervisor
+    (``resilience/supervisor.py``) — how often to checkpoint, how hard to
+    retry transient IO, and how to judge/answer loss anomalies."""
+
+    # checkpoint cadence in steps (0 = final checkpoint only — a device
+    # loss then has nothing to restore, so drills want >= 1)
+    checkpoint_every: int = 1
+    # retained previous checkpoint: the corruption-fallback generation
+    keep_prev: bool = True
+    # transient-IO retry shape (resilience/retry.RetryPolicy)
+    retry_attempts: int = 3
+    retry_base_delay_s: float = 0.05
+    retry_max_delay_s: float = 2.0
+    # loss anomaly guard (execution/train.LossAnomalyDetector): a step
+    # loss > spike_factor x the rolling mean of the last spike_window
+    # healthy losses is a spike; NaN/inf is always an anomaly
+    spike_factor: float = 10.0
+    spike_window: int = 8
+    # roll back to the latest valid checkpoint on NaN/inf loss (spikes are
+    # reported but never rolled back — they are usually survivable)
+    restore_on_anomaly: bool = True
+    # give up after this many recoveries (device loss + anomaly rollbacks
+    # combined) — a persistently failing run must fail, not loop
+    max_recoveries: int = 8
+
+    def __post_init__(self) -> None:
+        if self.checkpoint_every < 0:
+            raise ValueError("checkpoint_every must be >= 0")
+        if self.retry_attempts < 1:
+            raise ValueError("retry_attempts must be >= 1")
+        if self.spike_factor <= 1.0:
+            raise ValueError("spike_factor must exceed 1.0")
+        if self.spike_window < 1:
+            raise ValueError("spike_window must be >= 1")
+        if self.max_recoveries < 0:
+            raise ValueError("max_recoveries must be >= 0")

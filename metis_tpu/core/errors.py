@@ -31,3 +31,47 @@ class InfeasiblePlanError(MetisError):
 
 class ClusterSpecError(MetisError):
     """Malformed cluster description."""
+
+
+class CheckpointCorruptError(MetisError):
+    """A checkpoint on disk failed integrity verification — a truncated or
+    garbage array file, a digest mismatch against ``CheckpointMeta.digests``,
+    or an unreadable orbax store.  Restore paths raise this (never a raw
+    deserialization traceback) so callers can fall back to the retained
+    ``.prev`` checkpoint (``execution/checkpoint.py``)."""
+
+
+class CheckpointWriteError(MetisError, OSError):
+    """An (async) checkpoint write failed.  Subclasses OSError so the
+    default ``RetryPolicy`` transient classification retries it; the message
+    always carries the checkpoint path."""
+
+
+class RetryExhaustedError(MetisError):
+    """A retried operation failed on every allowed attempt
+    (``resilience/retry.py``); ``__cause__`` is the final attempt's error."""
+
+    def __init__(self, op: str, attempts: int, last_error: BaseException):
+        super().__init__(
+            f"{op} failed after {attempts} attempt(s): "
+            f"{type(last_error).__name__}: {last_error}")
+        self.op = op
+        self.attempts = attempts
+
+
+class DeviceLossError(MetisError):
+    """A device/slice dropped out of the topology mid-run.  ``lost`` maps
+    device type -> device count; the training supervisor answers it with
+    checkpoint -> replan-on-survivors -> restore
+    (``resilience/supervisor.py``)."""
+
+    def __init__(self, lost: dict[str, int], step: int | None = None):
+        desc = ", ".join(f"{n}x{t}" for t, n in lost.items()) or "unknown"
+        super().__init__(f"device loss at step {step}: {desc}")
+        self.lost = dict(lost)
+        self.step = step
+
+
+class TrainingAnomalyError(MetisError):
+    """A loss anomaly (NaN/inf or spike) with no checkpoint to roll back
+    to, or with rollback disabled — training cannot safely continue."""

@@ -43,7 +43,11 @@ from metis_tpu.execution.pipeline import (
     make_pipeline_train_step,
     microbatch_split,
 )
-from metis_tpu.execution.train import build_train_state, make_train_step
+from metis_tpu.execution.train import (
+    TrainState,
+    build_train_state,
+    make_train_step,
+)
 from metis_tpu.models.gpt import GPTConfig
 from metis_tpu.models.moe import MoEConfig
 
@@ -122,6 +126,75 @@ def resolve_schedule(
         virtual_stages = (artifact.virtual_stages
                           if artifact.virtual_stages > 1 else 2)
     return schedule, virtual_stages
+
+
+def exec_state_to_train_state(kind: str, state, step: int,
+                              mesh=None, replicate_step: bool = False
+                              ) -> TrainState:
+    """Adapt an executable's state to the checkpointable ``TrainState``.
+
+    The gspmd route's state IS a TrainState; the pipeline route's is a
+    ``(params, opt_state)`` tuple whose step lives outside the state — wrap
+    it with ``step`` as an int32 scalar.  ``replicate_step`` (multi-host):
+    orbax refuses host-local arrays in a multi-controller run, so the step
+    scalar is replicated over ``mesh``.  Hetero per-stage state lists have
+    their own save/restore pair (``save_hetero_checkpoint``) and do not
+    adapt."""
+    if kind == "gspmd":
+        return state
+    if kind == "hetero":
+        raise ValueError(
+            "hetero state lists checkpoint via save_hetero_checkpoint, "
+            "not TrainState")
+    import jax.numpy as jnp
+
+    params, opt_state = state
+    step_arr = jnp.asarray(step, jnp.int32)
+    if replicate_step and mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        step_arr = jax.device_put(
+            step_arr, NamedSharding(mesh, PartitionSpec()))
+    return TrainState(params=params, opt_state=opt_state, step=step_arr)
+
+
+def train_state_to_exec_state(kind: str, ts: TrainState):
+    """Inverse of ``exec_state_to_train_state`` — unwrap a (restored)
+    TrainState back into the shape ``Executable.step`` consumes."""
+    if kind == "gspmd":
+        return ts
+    if kind == "hetero":
+        raise ValueError("hetero state lists do not adapt to TrainState")
+    return (ts.params, ts.opt_state)
+
+
+def checkpoint_block_layout(
+    artifact: PlanArtifact,
+    cfg: GPTConfig,
+    exe_kind: str,
+    schedule: str,
+    virtual_stages: int,
+) -> str:
+    """The ``CheckpointMeta.block_layout`` string describing how this
+    (plan, executable, schedule) physically orders the stacked block axis.
+
+    The interleaved schedule permutes the block order
+    (``execution.pipeline.interleave_block_order``) as a function of BOTH
+    pp and virtual_stages; an uneven 1f1b split pads/reorders it too
+    (``pad_blocks_for_partition``).  Restore compares this string and
+    refuses a mismatch — a silent mismatch would scramble the layers."""
+    if exe_kind != "pipeline":
+        return "canonical"
+    if artifact.mesh_shape and PP in artifact.mesh_axes:
+        pp = artifact.mesh_shape[artifact.mesh_axes.index(PP)]
+    else:
+        pp = 1
+    if schedule == "interleaved":
+        return f"interleaved:{pp}x{virtual_stages}"
+    counts = _uneven_1f1b_split(artifact, cfg, pp, schedule)
+    if counts is not None:
+        return f"uneven:{pp}x" + "-".join(str(c) for c in counts)
+    return "canonical"
 
 
 def build_executable(

@@ -17,9 +17,10 @@ artifacts live here:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import shutil
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 
 import jax
@@ -27,6 +28,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding
 
+from metis_tpu.core.errors import CheckpointCorruptError, CheckpointWriteError
 from metis_tpu.execution.mesh import PlanArtifact
 from metis_tpu.execution.train import TrainState
 
@@ -44,12 +46,19 @@ class CheckpointMeta:
     schedule's device-major chunk permutation
     (``execution.pipeline.interleave_block_order``) — restoring a permuted
     checkpoint under a different schedule would silently scramble the
-    layers, so resume must compare this field."""
+    layers, so resume must compare this field.
+
+    ``digests`` maps each state-tree leaf path to a sha256 of its logical
+    content (shape + dtype + bytes, mesh-independent — a cross-mesh restore
+    of the same values verifies clean).  Restore recomputes and compares;
+    a mismatch raises :class:`CheckpointCorruptError` and triggers the
+    ``.prev`` fallback instead of silently training on garbage."""
 
     step: int
     mesh_axes: tuple[str, ...]
     mesh_shape: tuple[int, ...]
     block_layout: str = "canonical"
+    digests: dict[str, str] = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps({
@@ -57,6 +66,7 @@ class CheckpointMeta:
             "mesh_axes": list(self.mesh_axes),
             "mesh_shape": list(self.mesh_shape),
             "block_layout": self.block_layout,
+            "digests": self.digests,
         }, indent=2)
 
     @staticmethod
@@ -67,7 +77,46 @@ class CheckpointMeta:
             mesh_axes=tuple(d["mesh_axes"]),
             mesh_shape=tuple(d["mesh_shape"]),
             block_layout=d.get("block_layout", "canonical"),
+            digests=dict(d.get("digests", {})),
         )
+
+
+def _tree_digests(tree) -> dict[str, str]:
+    """Leaf path -> sha256 of (shape, dtype, content bytes) for every array
+    leaf.  Gathers each array to host (``device_get``), so the digest is a
+    property of the logical value, not its sharding.  Multi-host runs skip
+    digests (non-addressable shards cannot be gathered here) — single-host
+    is where CI drills and the corruption-fallback story live."""
+    if jax.process_count() > 1:
+        return {}
+    out: dict[str, str] = {}
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in leaves:
+        arr = np.asarray(jax.device_get(leaf))
+        h = hashlib.sha256()
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+        out[jax.tree_util.keystr(path)] = h.hexdigest()
+    return out
+
+
+def _verify_digests(directory: Path, tree, meta: CheckpointMeta) -> None:
+    """Raise :class:`CheckpointCorruptError` when a restored leaf's content
+    digest disagrees with the one recorded at save.  Checkpoints without
+    recorded digests (older, or multi-host saves) verify vacuously."""
+    if not meta.digests:
+        return
+    actual = _tree_digests(tree)
+    if not actual:  # multi-host restore: digests not computable here
+        return
+    bad = sorted(k for k, v in meta.digests.items() if actual.get(k) != v)
+    if bad:
+        shown = ", ".join(bad[:3]) + ("..." if len(bad) > 3 else "")
+        raise CheckpointCorruptError(
+            f"checkpoint {directory}: content digest mismatch for "
+            f"{len(bad)} leaf/leaves ({shown}) — the checkpoint on disk "
+            "is corrupt")
 
 
 def save_checkpoint(
@@ -97,7 +146,8 @@ def save_checkpoint(
     tree = _state_tree(state)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp / _STATE_DIR, tree, force=True)
-    _write_meta_and_plan(tmp, _mesh_meta(state, mesh, block_layout), plan)
+    _write_meta_and_plan(
+        tmp, _mesh_meta(state, mesh, block_layout, _tree_digests(tree)), plan)
     _swap_tmp_into_place(directory, tmp, prev, multi_host,
                          keep_prev=keep_prev)
     return directory
@@ -120,12 +170,14 @@ def _write_meta_and_plan(tmp: Path, meta: CheckpointMeta,
 
 
 def _mesh_meta(state: TrainState, mesh: Mesh,
-               block_layout: str = "canonical") -> CheckpointMeta:
+               block_layout: str = "canonical",
+               digests: dict[str, str] | None = None) -> CheckpointMeta:
     return CheckpointMeta(
         step=int(state.step),
         mesh_axes=tuple(mesh.axis_names),
         mesh_shape=tuple(mesh.devices.shape),
         block_layout=block_layout,
+        digests=digests or {},
     )
 
 
@@ -192,11 +244,17 @@ class AsyncCheckpointWriter:
             if step % interval == 0:
                 writer.save(ckpt_dir, state, mesh, plan)  # non-blocking
         writer.close()                                    # flush + swap
+
+    ``keep_prev`` retains the displaced checkpoint as a ``.prev`` rollback
+    generation on every swap (``_swap_tmp_into_place``) — the corruption
+    fallback ``restore_checkpoint`` restores from when the latest fails
+    digest verification.
     """
 
-    def __init__(self):
+    def __init__(self, keep_prev: bool = False):
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         self._pending: tuple[Path, Path, Path, bool] | None = None
+        self._keep_prev = keep_prev
 
     def save(
         self,
@@ -209,29 +267,73 @@ class AsyncCheckpointWriter:
         self.wait()  # finish + swap any previous write first
         directory = Path(directory).absolute()
         tmp, prev, multi_host = _prepare_tmp(directory)
-        self._ckptr.save(tmp / _STATE_DIR, _state_tree(state), force=True)
-        _write_meta_and_plan(tmp, _mesh_meta(state, mesh, block_layout), plan)
+        tree = _state_tree(state)
+        # digests are computed from the live state at enqueue time (the
+        # same snapshot the async serializer copies out), so the meta
+        # describes exactly the bytes the background write will land
+        digests = _tree_digests(tree)
+        self._ckptr.save(tmp / _STATE_DIR, tree, force=True)
+        _write_meta_and_plan(
+            tmp, _mesh_meta(state, mesh, block_layout, digests), plan)
         self._pending = (directory, tmp, prev, multi_host)
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) is durable and swapped
-        into place as the primary checkpoint."""
+        into place as the primary checkpoint.
+
+        A failure of the background save surfaces HERE, re-raised as
+        :class:`CheckpointWriteError` naming the checkpoint path — the
+        write was dispatched steps ago, so without the path the traceback
+        points at an unrelated train-loop line.  The failed write's
+        ``.tmp`` is left unswapped: the previous complete checkpoint
+        remains the primary."""
         if self._pending is None:
             return
-        self._ckptr.wait_until_finished()
         directory, tmp, prev, multi_host = self._pending
         self._pending = None
-        _swap_tmp_into_place(directory, tmp, prev, multi_host)
+        try:
+            self._ckptr.wait_until_finished()
+        except Exception as e:
+            raise CheckpointWriteError(
+                f"async checkpoint write to {directory} failed: "
+                f"{type(e).__name__}: {e}") from e
+        _swap_tmp_into_place(directory, tmp, prev, multi_host,
+                             keep_prev=self._keep_prev)
 
     def close(self) -> None:
-        self.wait()
-        self._ckptr.close()
+        """Flush + swap the in-flight write, then release the checkpointer.
+
+        An in-flight write failure is surfaced (as
+        :class:`CheckpointWriteError`), never swallowed — but the
+        underlying orbax checkpointer is always closed, so a failed final
+        checkpoint does not also leak its background threads.  Orbax's own
+        ``close()`` re-joins the background commit and re-raises its error
+        raw — when ``wait()`` already surfaced the failure, that second
+        raise is suppressed so the typed, path-carrying error propagates."""
+        try:
+            self.wait()
+        except BaseException:
+            try:
+                self._ckptr.close()
+            except Exception:  # noqa: BLE001 — wait()'s error is primary
+                pass
+            raise
+        else:
+            self._ckptr.close()
 
     def __enter__(self) -> "AsyncCheckpointWriter":
         return self
 
-    def __exit__(self, *exc) -> None:
-        self.close()
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is not None:
+            # the body is already unwinding — don't let a secondary flush
+            # failure mask the original error
+            try:
+                self.close()
+            except Exception:  # noqa: BLE001 — reported path is the body's
+                pass
+        else:
+            self.close()
 
 
 def _resolve_dir(directory: str | Path) -> Path:
@@ -277,12 +379,84 @@ def _as_restore(leaf):
 
 def _restore_tree(directory: Path, ref: dict) -> dict:
     """Restore the state tree shaped/sharded like ``ref`` (orbax reshards
-    onto the reference leaves' NamedShardings on read)."""
+    onto the reference leaves' NamedShardings on read).
+
+    Raises ``FileNotFoundError`` when ``directory`` holds no checkpoint at
+    all (the "fresh start" signal callers branch on) but a typed
+    :class:`CheckpointCorruptError` for everything else — a truncated array
+    file, a missing array inside an otherwise-present store, a garbage
+    metadata blob — so callers can fall back to ``.prev`` instead of dying
+    on a raw deserialization traceback."""
+    state_dir = directory / _STATE_DIR
+    if not state_dir.exists():
+        raise FileNotFoundError(f"no checkpoint state at {state_dir}")
     restore_args = jax.tree.map(_as_restore, ref)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        return ckptr.restore(
-            directory / _STATE_DIR,
-            args=ocp.args.PyTreeRestore(item=ref, restore_args=restore_args))
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(
+                state_dir,
+                args=ocp.args.PyTreeRestore(
+                    item=ref, restore_args=restore_args))
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {directory} is unreadable: "
+            f"{type(e).__name__}: {e}") from e
+
+
+def _load_meta_if_present(directory: Path) -> CheckpointMeta | None:
+    p = directory / _META_FILE
+    if not p.exists():
+        return None
+    try:
+        return CheckpointMeta.from_json(p.read_text())
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {directory} has an unreadable {_META_FILE}: "
+            f"{type(e).__name__}: {e}") from e
+
+
+def _restore_verified(directory: Path, ref: dict) -> dict:
+    """Restore ``ref``-shaped state from ``directory`` and verify it against
+    the per-leaf content digests its own ``meta.json`` recorded at save
+    (checkpoints without digests verify vacuously)."""
+    tree = _restore_tree(directory, ref)
+    meta = _load_meta_if_present(directory)
+    if meta is not None:
+        _verify_digests(directory, tree, meta)
+    return tree
+
+
+def _restore_candidates(directory: str | Path) -> list[Path]:
+    """Checkpoint generations to try, newest first: the resolved primary,
+    then the retained ``.prev`` rollback generation (when it exists and is
+    not already what the primary resolved to)."""
+    directory = Path(directory).absolute()
+    primary = _resolve_dir(directory)
+    prev = directory.with_name(directory.name + ".prev")
+    out = [primary]
+    if prev.exists() and prev != primary:
+        out.append(prev)
+    return out
+
+
+def _restore_with_fallback(directory: str | Path, ref: dict) -> dict:
+    """Digest-verified restore with automatic fallback: if the latest
+    checkpoint is corrupt (unreadable store OR digest mismatch) and a
+    ``.prev`` generation is retained, restore that instead.  Only when
+    every generation fails does an error propagate; a missing checkpoint
+    altogether stays ``FileNotFoundError``, but corruption anywhere wins
+    over a missing fallback — callers must not mistake "the checkpoint is
+    garbage" for "fresh start"."""
+    errors: list[Exception] = []
+    for cand in _restore_candidates(directory):
+        try:
+            return _restore_verified(cand, ref)
+        except (CheckpointCorruptError, FileNotFoundError) as e:
+            errors.append(e)
+    for e in errors:
+        if isinstance(e, CheckpointCorruptError):
+            raise e
+    raise errors[0]
 
 
 def block_layouts_compatible(meta: CheckpointMeta, expected: str) -> bool:
@@ -321,7 +495,11 @@ def restore_checkpoint(
     ``expected_block_layout``: when given, refuse a checkpoint whose
     recorded ``CheckpointMeta.block_layout`` differs — restoring a permuted
     (interleaved-schedule) checkpoint under a different layout silently
-    scrambles the layers."""
+    scrambles the layers.
+
+    The restore is digest-verified against the checkpoint's recorded
+    content digests, with automatic fallback to the retained ``.prev``
+    generation when the latest is corrupt (``_restore_with_fallback``)."""
     if expected_block_layout is not None:
         meta = load_meta(directory)
         if not block_layouts_compatible(meta, expected_block_layout):
@@ -330,7 +508,7 @@ def restore_checkpoint(
                 f"'{meta.block_layout}', expected '{expected_block_layout}' "
                 "— refusing to restore (a layout mismatch silently "
                 "scrambles the stacked block axis)")
-    tree = _restore_tree(_resolve_dir(directory), _state_tree(reference_state))
+    tree = _restore_with_fallback(directory, _state_tree(reference_state))
     step = tree["step"]
     if not isinstance(step, jax.Array):
         step = jax.numpy.asarray(np.asarray(step))
@@ -368,12 +546,14 @@ def save_hetero_checkpoint(
     state: list,
     step: int,
     plan: PlanArtifact | None = None,
+    keep_prev: bool = False,
 ) -> Path:
     """Checkpoint the multi-mesh hetero executor's state — a list of
     per-stage ``[params, opt_state]`` pairs, each living on its own stage
     mesh (``execution.hetero.make_hetero_train_step``).  Same crash-safe
     swap as ``save_checkpoint``; the meta records the stage count in place
-    of a mesh shape."""
+    of a mesh shape.  Digests cover the PADDED tree (the bytes actually on
+    disk) — the restore side verifies before grafting empties back."""
     import jax.numpy as jnp
 
     directory = Path(directory).absolute()
@@ -383,8 +563,10 @@ def save_hetero_checkpoint(
         ckptr.save(tmp / _STATE_DIR, tree, force=True)
     _write_meta_and_plan(
         tmp, CheckpointMeta(step=int(step), mesh_axes=("stage",),
-                            mesh_shape=(len(state),)), plan)
-    _swap_tmp_into_place(directory, tmp, prev, multi_host)
+                            mesh_shape=(len(state),),
+                            digests=_tree_digests(tree)), plan)
+    _swap_tmp_into_place(directory, tmp, prev, multi_host,
+                         keep_prev=keep_prev)
     return directory
 
 
@@ -394,11 +576,12 @@ def restore_hetero_checkpoint(
 ) -> list:
     """Restore a per-stage state list shaped/sharded like
     ``reference_state`` (a fresh ``init_fn(key)`` of the SAME plan — stage
-    structure must match; shardings are taken from the reference leaves)."""
+    structure must match; shardings are taken from the reference leaves).
+    Digest-verified, with ``.prev`` fallback like ``restore_checkpoint``."""
     import jax.numpy as jnp
 
     ref = _hetero_tree(reference_state, jnp.zeros((), jnp.int32))
-    tree = _restore_tree(_resolve_dir(directory), _pad_empty(ref))
+    tree = _restore_with_fallback(directory, _pad_empty(ref))
     # graft the reference's empty leaves back over their saved placeholders
     tree = jax.tree.map(
         lambda r, g: r if getattr(r, "size", 1) == 0 else g, ref, tree)

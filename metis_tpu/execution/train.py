@@ -187,6 +187,54 @@ class StepTimer:
         return rec
 
 
+class LossAnomalyDetector:
+    """Step-loss sanity guard for the training supervisor
+    (``resilience/supervisor.py``).
+
+    ``observe(loss, step)`` classifies each synced step loss:
+
+    - ``"nan"`` — non-finite (NaN/inf).  The state is already poisoned;
+      the only safe answer is a rollback to the last checkpoint.
+    - ``"spike"`` — finite but > ``spike_factor`` x the rolling mean of the
+      last ``window`` healthy losses (once ``min_history`` of them exist —
+      the first steps of a fresh run are legitimately wild).  Reported but
+      survivable: spikes usually anneal away.
+    - ``None`` — healthy; the loss joins the rolling window.
+
+    Anomalous losses never enter the window, so one spike does not raise
+    the baseline that judges the next."""
+
+    def __init__(self, spike_factor: float = 10.0, window: int = 8,
+                 min_history: int = 3):
+        if spike_factor <= 1.0:
+            raise ValueError("spike_factor must exceed 1.0")
+        if window < 1 or min_history < 1:
+            raise ValueError("window and min_history must be >= 1")
+        from collections import deque
+
+        self.spike_factor = spike_factor
+        self.min_history = min_history
+        self._healthy: deque = deque(maxlen=window)
+
+    def observe(self, loss: float, step: int | None = None) -> str | None:
+        import math
+
+        loss = float(loss)
+        if not math.isfinite(loss):
+            return "nan"
+        if len(self._healthy) >= self.min_history:
+            mean = sum(self._healthy) / len(self._healthy)
+            if mean > 0 and loss > self.spike_factor * mean:
+                return "spike"
+        self._healthy.append(loss)
+        return None
+
+    def reset(self) -> None:
+        """Forget history — call after a rollback: the restored state's
+        losses should be judged fresh, not against the poisoned run-up."""
+        self._healthy.clear()
+
+
 def build_optimizer(lr: float = 1e-4, weight_decay: float = 0.01):
     return optax.adamw(lr, weight_decay=weight_decay)
 

@@ -15,6 +15,11 @@ __all__ = [
     "plan_tpu",
     "plan_uniform",
 ]
-from metis_tpu.planner.replan import ClusterDelta, ReplanReport, replan
+from metis_tpu.planner.replan import (
+    ClusterDelta,
+    ReplanReport,
+    replan,
+    shrink_cluster,
+)
 
-__all__ += ["ClusterDelta", "ReplanReport", "replan"]
+__all__ += ["ClusterDelta", "ReplanReport", "replan", "shrink_cluster"]

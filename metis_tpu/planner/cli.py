@@ -338,6 +338,23 @@ def main(argv: list[str] | None = None) -> int:
     p_train.add_argument("--drift-band", type=float, default=20.0,
                          help="rolling MAPE %% that fires the drift alarm "
                               "(hysteresis: re-arms below half the band)")
+    g_res = p_train.add_argument_group(
+        "resilience (resilience/supervisor.py — single-controller only)")
+    g_res.add_argument("--resilient", action="store_true",
+                       help="run under the fault-tolerant training "
+                            "supervisor: loss anomaly guards, retrying "
+                            "checkpoints with .prev retention, SIGTERM "
+                            "drain, replan-on-device-loss.  Requires "
+                            "--checkpoint-dir")
+    g_res.add_argument("--fault-script", default=None,
+                       help="deterministic fault injection script, e.g. "
+                            "'checkpoint_write@2x2,device_loss@5' "
+                            "(resilience/faults.py syntax)")
+    g_res.add_argument("--retry-attempts", type=int, default=3,
+                       help="transient-IO retry budget per checkpoint write")
+    g_res.add_argument("--spike-factor", type=float, default=10.0,
+                       help="loss > this x the rolling mean is flagged as "
+                            "a spike anomaly")
     g_mh = p_train.add_argument_group(
         "multi-host (run the SAME command on every host, varying only "
         "--process-id; execution.multihost wires jax.distributed)")
@@ -362,6 +379,30 @@ def main(argv: list[str] | None = None) -> int:
                            "per stage boundary: link i is LISTENED on by "
                            "stage i and DIALED by stage i+1")
     _add_platform_arg(p_train)
+
+    p_chaos = sub.add_parser(
+        "chaos", help="fault-injection drill: run the training supervisor "
+                      "with a scripted fault sequence (checkpoint IO "
+                      "failures, device loss, NaN loss, preemption) and "
+                      "report what it survived — the CI-runnable proof the "
+                      "recovery paths work (tools/chaos_drill.py wraps "
+                      "this for the canned scenario)")
+    _add_cluster_args(p_chaos)
+    p_chaos.add_argument("--profile-dir", required=True)
+    _add_model_args(p_chaos)
+    _add_search_args(p_chaos)
+    p_chaos.add_argument("--steps", type=int, default=8,
+                         help="training steps the drill must complete")
+    p_chaos.add_argument("--fault-script", required=True,
+                         help="e.g. 'checkpoint_write@2x2,device_loss@5' "
+                              "(resilience/faults.py syntax)")
+    p_chaos.add_argument("--checkpoint-dir", required=True)
+    p_chaos.add_argument("--checkpoint-every", type=int, default=2)
+    p_chaos.add_argument("--retry-attempts", type=int, default=3)
+    p_chaos.add_argument("--spike-factor", type=float, default=10.0)
+    p_chaos.add_argument("--seed", type=int, default=0,
+                         help="seed for probabilistic fault entries")
+    _add_platform_arg(p_chaos)
 
     p_report = sub.add_parser(
         "report", help="render a trace/event JSONL (metis-tpu ... --events, "
@@ -452,6 +493,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_replan(args, profiles, model, config, events)
     if args.command == "train":
         return _cmd_train(args, profiles, model, config, events)
+    if args.command == "chaos":
+        return _cmd_chaos(args, profiles, model, config, events)
     if args.command == "explain":
         return _cmd_explain(args, profiles, model, config, events)
 
@@ -911,6 +954,61 @@ def _run_slice_controller(args, art, model, cluster, profiles,
     _emit(args, _json.dumps(summary, indent=2))
     return 0
 
+def _run_supervisor(args: argparse.Namespace, cluster, profiles, model,
+                    config, events) -> int:
+    """Shared driver for ``train --resilient`` and the ``chaos`` drill:
+    build the fault script + resilience knobs from flags, run the
+    supervisor, emit its report JSON.  Exit 0 for the two healthy outcomes
+    (completed / cleanly preempted), 1 for a failed run."""
+    import json as _json
+
+    from metis_tpu.core.config import ResilienceConfig
+    from metis_tpu.resilience import FaultInjector, TrainingSupervisor
+
+    res = ResilienceConfig(
+        checkpoint_every=getattr(args, "checkpoint_every", 0) or 1,
+        retry_attempts=args.retry_attempts,
+        spike_factor=args.spike_factor,
+    )
+    faults = FaultInjector(args.fault_script or "",
+                           seed=getattr(args, "seed", 0), events=events)
+
+    data_factory = None
+    if getattr(args, "data", None):
+        import numpy as np
+
+        from metis_tpu.data.pipeline import TokenDataset
+
+        def data_factory(art):
+            tokens = (np.load(args.data, mmap_mode="r")
+                      if args.data.endswith(".npy")
+                      else np.memmap(args.data, dtype=np.int32, mode="r"))
+            return TokenDataset(tokens, model.sequence_length)
+
+    supervisor = TrainingSupervisor(
+        cluster, profiles, model, config,
+        checkpoint_dir=args.checkpoint_dir, steps=args.steps,
+        resilience=res, faults=faults, events=events,
+        data_factory=data_factory, install_signal_handler=True)
+    report = supervisor.run()
+    _emit(args, _json.dumps(report.to_json_dict(), indent=2))
+    if report.outcome == "failed":
+        print(f"supervised run FAILED: {report.detail}", file=sys.stderr)
+        return 1
+    print(f"supervised run {report.outcome}: {report.steps_done}/"
+          f"{report.target_steps} steps, {len(report.recoveries)} "
+          f"recoveries, {report.retries} retries, {report.checkpoints} "
+          "checkpoints", file=sys.stderr)
+    return 0
+
+
+def _cmd_chaos(args: argparse.Namespace, profiles, model, config,
+               events) -> int:
+    """Scripted fault drill through the training supervisor."""
+    cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    return _run_supervisor(args, cluster, profiles, model, config, events)
+
+
 def _cmd_train(args: argparse.Namespace, profiles, model, config,
                events) -> int:
     """Plan -> executable -> data pipeline -> checkpointed train loop."""
@@ -970,6 +1068,20 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
               f"{info.local_device_count} local devices", file=sys.stderr)
 
     cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+
+    if getattr(args, "resilient", False):
+        if multihost or slice_stage is not None:
+            print("--resilient is single-controller only (the supervisor "
+                  "rebuilds the executable on recovery, which a "
+                  "multi-controller run cannot do mid-flight)",
+                  file=sys.stderr)
+            return 2
+        if args.checkpoint_dir is None:
+            print("--resilient requires --checkpoint-dir (recovery restores "
+                  "from the latest checkpoint)", file=sys.stderr)
+            return 2
+        return _run_supervisor(args, cluster, profiles, model, config,
+                               events)
 
     # Resume pins the checkpoint's saved plan: re-running the search could
     # pick a DIFFERENT best plan (new profiles, cost-model changes, broken
@@ -1085,47 +1197,23 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
         restore_hetero_checkpoint,
         save_hetero_checkpoint,
     )
-    from metis_tpu.execution.train import TrainState
+    from metis_tpu.execution.builder import (
+        checkpoint_block_layout,
+        exec_state_to_train_state,
+        train_state_to_exec_state,
+    )
 
     def as_train_state(state, step):
-        if exe.kind == "gspmd":
-            return state
-        params, opt_state = state
-        import jax.numpy as jnp
+        # multi-host: orbax refuses host-local arrays in a multi-controller
+        # run — replicate the step scalar over the global mesh
+        return exec_state_to_train_state(
+            exe.kind, state, step, mesh=mesh, replicate_step=multihost)
 
-        step_arr = jnp.asarray(step, jnp.int32)
-        if multihost and mesh is not None:
-            # orbax refuses host-local arrays in a multi-controller run —
-            # replicate the step scalar over the global mesh
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            step_arr = jax.device_put(
-                step_arr, NamedSharding(mesh, PartitionSpec()))
-        return TrainState(params=params, opt_state=opt_state,
-                          step=step_arr)
-
-    # the interleaved schedule permutes the physical block order of
-    # params/checkpoints; record it and refuse a resume under a different
-    # layout (a silent mismatch would scramble the layers).  The permutation
-    # (interleave_block_order) depends on BOTH pp and virtual_stages, and
-    # restore supports a different target mesh — so pp must be part of the
-    # layout string or a same-vs/different-pp resume would pass the guard
-    # and scramble the stacked block axis.
-    pp_extent = (art.mesh_shape[art.mesh_axes.index("pp")]
-                 if "pp" in art.mesh_axes else 1)
-    block_layout = "canonical"
-    if exe.kind == "pipeline":
-        if schedule == "interleaved":
-            block_layout = f"interleaved:{pp_extent}x{virtual_stages}"
-        else:
-            # an uneven 1f1b split pads/reorders the stacked block axis
-            # (execution.pipeline.pad_blocks_for_partition) — a layout too
-            from metis_tpu.execution.builder import _uneven_1f1b_split
-
-            counts = _uneven_1f1b_split(art, cfg, pp_extent, schedule)
-            if counts is not None:
-                block_layout = ("uneven:" + str(pp_extent) + "x"
-                                + "-".join(str(c) for c in counts))
+    # record how this (plan, schedule) physically orders the stacked block
+    # axis and refuse a resume under a different layout (a silent mismatch
+    # would scramble the layers)
+    block_layout = checkpoint_block_layout(
+        art, cfg, exe.kind, schedule, virtual_stages)
 
     state = exe.init(jax.random.PRNGKey(0))
     start_step = 0
@@ -1152,8 +1240,7 @@ def _cmd_train(args: argparse.Namespace, profiles, model, config,
                     restored = restore_checkpoint(
                         args.checkpoint_dir,
                         as_train_state(state, start_step))
-                    state = (restored if exe.kind == "gspmd"
-                             else (restored.params, restored.opt_state))
+                    state = train_state_to_exec_state(exe.kind, restored)
             except Exception as e:  # noqa: BLE001 — see replan note
                 if replanned:
                     # cross-mesh restore reshards arrays, but it cannot

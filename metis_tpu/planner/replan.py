@@ -19,8 +19,9 @@ from __future__ import annotations
 from collections import Counter
 from dataclasses import dataclass
 
-from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.cluster.spec import ClusterSpec, NodeSpec
 from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.errors import ClusterSpecError
 from metis_tpu.planner.api import PlannerResult, plan_hetero
 from metis_tpu.profiles.store import ProfileStore
 
@@ -49,6 +50,42 @@ class ClusterDelta:
         removed = {t: old_counts[t] - new_counts[t]
                    for t in old_counts if old_counts[t] > new_counts.get(t, 0)}
         return ClusterDelta(added=added, removed=removed)
+
+
+def shrink_cluster(cluster: ClusterSpec,
+                   removed: dict[str, int]) -> ClusterSpec:
+    """The survivor topology after losing ``removed`` (type -> device count).
+
+    Devices are peeled from the END of the node list (highest ranks first —
+    the linear placement puts later pipeline stages there, so survivors keep
+    the front ranks a restored plan maps onto).  A partial loss narrows the
+    last matching node rather than dropping it.  Raises
+    :class:`ClusterSpecError` when a type loses more devices than it has, or
+    when nothing survives — an empty topology cannot be re-planned."""
+    remaining = dict(removed)
+    for t, n in remaining.items():
+        if n < 1:
+            raise ClusterSpecError(f"removed[{t!r}] must be >= 1, got {n}")
+        have = cluster.num_devices_by_type(t)
+        if n > have:
+            raise ClusterSpecError(
+                f"cannot remove {n}x{t}: cluster only has {have}")
+    survivors: list[NodeSpec] = []
+    for node in reversed(cluster.nodes):
+        need = remaining.get(node.device_type, 0)
+        if need <= 0:
+            survivors.append(node)
+            continue
+        take = min(need, node.num_devices)
+        remaining[node.device_type] = need - take
+        if node.num_devices > take:
+            survivors.append(NodeSpec(node.device_type,
+                                      node.num_devices - take))
+    if not survivors:
+        raise ClusterSpecError(
+            "device loss removed every device — nothing to re-plan on")
+    return ClusterSpec(nodes=tuple(reversed(survivors)),
+                       devices=dict(cluster.devices))
 
 
 @dataclass(frozen=True)

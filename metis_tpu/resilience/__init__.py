@@ -1,0 +1,29 @@
+"""Fault tolerance: deterministic fault injection, bounded retry, and the
+training supervisor that drives checkpoint/replan/restore recovery."""
+from metis_tpu.resilience.faults import (
+    INJECTION_POINTS,
+    NULL_INJECTOR,
+    FaultInjector,
+    FaultSpec,
+    parse_fault_script,
+)
+from metis_tpu.resilience.retry import RetryPolicy
+from metis_tpu.resilience.supervisor import (
+    RecoveryRecord,
+    RetryingCheckpointWriter,
+    SupervisorReport,
+    TrainingSupervisor,
+)
+
+__all__ = [
+    "INJECTION_POINTS",
+    "NULL_INJECTOR",
+    "FaultInjector",
+    "FaultSpec",
+    "parse_fault_script",
+    "RetryPolicy",
+    "RecoveryRecord",
+    "RetryingCheckpointWriter",
+    "SupervisorReport",
+    "TrainingSupervisor",
+]

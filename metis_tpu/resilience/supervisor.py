@@ -1,0 +1,482 @@
+"""Fault-tolerant training supervisor: the run loop that survives.
+
+The planner's elastic story (``planner/replan.py``) and the checkpointer's
+crash-safe story (``execution/checkpoint.py``) only pay off if something
+DRIVES them when a run goes wrong.  :class:`TrainingSupervisor` is that
+driver — it wraps the executable step loop with:
+
+- **loss anomaly guards** (``execution.train.LossAnomalyDetector``): a
+  NaN/inf loss rolls the run back to the latest digest-verified checkpoint;
+  a spike is reported (``anomaly_detected``) and survived;
+- **retrying checkpoints** (:class:`RetryingCheckpointWriter`): periodic
+  saves through a bounded-backoff :class:`~metis_tpu.resilience.retry.RetryPolicy`
+  with ``.prev`` retention, so transient IO never kills a run and a corrupt
+  latest generation never loses it;
+- **graceful preemption drain**: on SIGTERM (or an injected ``preempt``
+  fault) the in-flight step finishes, a final checkpoint lands, and the run
+  exits cleanly (``preempt_drain``) — the resumable outcome a scheduler
+  wants from an evicted job;
+- **replan-on-device-loss**: an (injected) ``device_loss`` fault shrinks
+  the cluster to the survivor topology (``shrink_cluster``), re-plans on it
+  (``replan(..., search_old=False)`` — the time-critical path), rebuilds
+  the executable, and restores the latest checkpoint onto the NEW mesh
+  (orbax reshards on read), then resumes mid-stream (``recovery_complete``).
+
+Every decision is visible in the event stream; the whole loop is drillable
+on CPU in CI via ``resilience/faults.py`` (``tools/chaos_drill.py``).
+"""
+from __future__ import annotations
+
+import signal
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable
+
+import jax
+
+from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.core.config import ModelSpec, ResilienceConfig, SearchConfig
+from metis_tpu.core.errors import InfeasiblePlanError, MetisError, \
+    TrainingAnomalyError
+from metis_tpu.core.events import EventLog, NULL_LOG
+from metis_tpu.core.trace import Tracer
+from metis_tpu.execution.builder import (
+    build_executable,
+    checkpoint_block_layout,
+    exec_state_to_train_state,
+    resolve_schedule,
+    train_state_to_exec_state,
+)
+from metis_tpu.execution.checkpoint import (
+    AsyncCheckpointWriter,
+    load_meta,
+    load_plan,
+    restore_checkpoint,
+    restore_hetero_checkpoint,
+    save_hetero_checkpoint,
+)
+from metis_tpu.execution.mesh import DP, EP, SP, PlanArtifact
+from metis_tpu.execution.train import LossAnomalyDetector, StepTimer
+from metis_tpu.planner.api import plan_hetero
+from metis_tpu.planner.replan import replan, shrink_cluster
+from metis_tpu.profiles.store import ProfileStore
+from metis_tpu.resilience.faults import FaultInjector, NULL_INJECTOR
+from metis_tpu.resilience.retry import RetryPolicy
+
+
+class RetryingCheckpointWriter:
+    """An :class:`AsyncCheckpointWriter` whose saves go through a
+    :class:`RetryPolicy` — each attempt enqueues the async write and waits
+    it durable, so transient IO failures (including injected
+    ``checkpoint_write`` faults) surface inside the retry wrapper instead
+    of steps later.  ``keep_prev=True`` retains the displaced generation
+    as the corruption-fallback rollback."""
+
+    def __init__(self, policy: RetryPolicy, events: EventLog = NULL_LOG,
+                 faults: FaultInjector = NULL_INJECTOR,
+                 keep_prev: bool = True,
+                 sleep: Callable[[float], None] = time.sleep,
+                 on_retry: Callable[[int, BaseException], None] | None = None):
+        self.policy = policy
+        self.events = events
+        self.faults = faults
+        self.sleep = sleep
+        self.on_retry = on_retry
+        self.saves = 0
+        self._writer = AsyncCheckpointWriter(keep_prev=keep_prev)
+
+    def save(self, directory, state, mesh, plan=None,
+             block_layout: str = "canonical", step: int | None = None):
+        def attempt():
+            if self.faults.check("checkpoint_write", step) is not None:
+                raise OSError(
+                    f"injected checkpoint IO failure at step {step}")
+            self._writer.save(directory, state, mesh, plan=plan,
+                              block_layout=block_layout)
+            self._writer.wait()
+
+        self.policy.call(attempt, op="checkpoint_write", events=self.events,
+                         sleep=self.sleep, on_retry=self.on_retry)
+        self.saves += 1
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+@dataclass(frozen=True)
+class RecoveryRecord:
+    """One survived incident: what happened, where the run stood, where it
+    resumed, and what the recovery cost."""
+
+    kind: str  # "device_loss" | "anomaly_rollback"
+    step: int  # step count when the incident hit
+    resumed_step: int  # checkpointed step the run resumed from
+    recover_s: float
+    plan_changed: bool = False
+    detail: str = ""
+
+
+@dataclass
+class SupervisorReport:
+    """What a supervised run did — the chaos drill's assertion surface."""
+
+    outcome: str  # "completed" | "preempted" | "failed"
+    steps_done: int
+    target_steps: int
+    recoveries: list[RecoveryRecord] = field(default_factory=list)
+    retries: int = 0
+    checkpoints: int = 0
+    final_loss: float | None = None
+    losses: list[float] = field(default_factory=list)
+    detail: str = ""
+
+    def to_json_dict(self) -> dict:
+        return {
+            "outcome": self.outcome,
+            "steps_done": self.steps_done,
+            "target_steps": self.target_steps,
+            "recoveries": [
+                {"kind": r.kind, "step": r.step,
+                 "resumed_step": r.resumed_step,
+                 "recover_s": round(r.recover_s, 4),
+                 "plan_changed": r.plan_changed, "detail": r.detail}
+                for r in self.recoveries],
+            "retries": self.retries,
+            "checkpoints": self.checkpoints,
+            "final_loss": self.final_loss,
+            "detail": self.detail,
+        }
+
+
+class TrainingSupervisor:
+    """Run ``steps`` training steps under full fault supervision.
+
+    ``plan -> build -> (restore) -> step loop`` with the guards described in
+    the module docstring.  The plan is pinned from ``checkpoint_dir`` when
+    one was saved there (resume never silently retrains under a different
+    layout); otherwise ``plan_hetero(top_k=1)`` picks it.
+
+    ``faults`` injects scripted failures (``resilience/faults.py``);
+    ``sleep`` is injectable so drills retry at full speed;
+    ``install_signal_handler=True`` arms a real SIGTERM drain (CLI runs —
+    tests use the ``preempt`` fault instead).  ``data_factory(artifact)``
+    overrides the synthetic token stream."""
+
+    def __init__(
+        self,
+        cluster: ClusterSpec,
+        profiles: ProfileStore,
+        model: ModelSpec,
+        search_config: SearchConfig,
+        *,
+        checkpoint_dir: str | Path,
+        steps: int,
+        resilience: ResilienceConfig | None = None,
+        faults: FaultInjector = NULL_INJECTOR,
+        events: EventLog = NULL_LOG,
+        data_factory: Callable[[PlanArtifact], object] | None = None,
+        optimizer=None,
+        install_signal_handler: bool = False,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if steps < 1:
+            raise ValueError("steps must be >= 1")
+        self.cluster = cluster
+        self.profiles = profiles
+        self.model = model
+        self.search_config = search_config
+        self.checkpoint_dir = Path(checkpoint_dir)
+        self.steps = steps
+        self.res = resilience or ResilienceConfig()
+        self.faults = faults
+        self.events = events
+        self.data_factory = data_factory
+        self.optimizer = optimizer
+        self.install_signal_handler = install_signal_handler
+        self._sleep = sleep
+        self._drain = False
+        self._drain_reason = ""
+
+    # -- build helpers ----------------------------------------------------
+
+    def _initial_artifact(self) -> PlanArtifact:
+        pinned = None
+        try:
+            pinned = load_plan(self.checkpoint_dir)
+        except FileNotFoundError:
+            pinned = None
+        if pinned is not None:
+            return pinned
+        return self._search_artifact(self.cluster)
+
+    def _search_artifact(self, cluster: ClusterSpec) -> PlanArtifact:
+        result = plan_hetero(cluster, self.profiles, self.model,
+                             self.search_config, top_k=1, events=self.events)
+        if result.best is None:
+            raise InfeasiblePlanError(
+                f"no feasible plan for {cluster.total_devices} devices")
+        return PlanArtifact.from_ranked_plan(result.best)
+
+    def _build(self, art: PlanArtifact):
+        from metis_tpu.models import config_for_model_spec
+
+        cfg = config_for_model_spec(self.model)
+        schedule, vs = resolve_schedule(art)
+        exe = build_executable(
+            cfg, art, optimizer=self.optimizer, cluster=self.cluster,
+            profiles=self.profiles, schedule=schedule, virtual_stages=vs,
+            events=self.events)
+        mesh = art.build_mesh() if art.mesh_shape else None
+        layout = checkpoint_block_layout(art, cfg, exe.kind, schedule, vs)
+        return exe, mesh, layout
+
+    def _batches(self, art: PlanArtifact, exe, mesh, skip: int):
+        from metis_tpu.data.pipeline import (
+            make_input_pipeline,
+            synthetic_run_dataset,
+        )
+
+        if self.data_factory is not None:
+            dataset = self.data_factory(art)
+        else:
+            dataset = synthetic_run_dataset(
+                self.model.vocab_size, art.gbs, self.model.sequence_length)
+        if exe.kind == "gspmd":
+            s0 = dict(art.strategies[0])
+            dp_ax = (DP, EP) if s0.get("ep", 1) > 1 else DP
+            seq_ax = SP if s0.get("cp", 1) > 1 else None
+            return make_input_pipeline(
+                dataset, art.gbs, mesh=mesh, dp_axis=dp_ax, seq_axis=seq_ax,
+                epochs=None, skip_batches=skip)
+        return make_input_pipeline(dataset, art.gbs, epochs=None,
+                                   skip_batches=skip)
+
+    # -- checkpoint adapters ----------------------------------------------
+
+    def _save(self, writer: RetryingCheckpointWriter, exe, art, mesh,
+              layout: str, state, step: int) -> None:
+        if exe.kind == "hetero":
+            def attempt():
+                if self.faults.check("checkpoint_write", step) is not None:
+                    raise OSError(
+                        f"injected checkpoint IO failure at step {step}")
+                save_hetero_checkpoint(self.checkpoint_dir, state, step,
+                                       plan=art, keep_prev=self.res.keep_prev)
+
+            writer.policy.call(attempt, op="checkpoint_write",
+                               events=self.events, sleep=self._sleep,
+                               on_retry=writer.on_retry)
+            writer.saves += 1
+        else:
+            writer.save(self.checkpoint_dir,
+                        exec_state_to_train_state(exe.kind, state, step),
+                        mesh, plan=art, block_layout=layout, step=step)
+
+    def _restore(self, exe, layout: str, reference_state):
+        """(state, step) from the latest valid checkpoint generation; the
+        reference supplies shapes/shardings for the TARGET mesh.  Raises
+        ``FileNotFoundError`` when no checkpoint exists yet."""
+        meta = load_meta(self.checkpoint_dir)
+        if exe.kind == "hetero":
+            state = restore_hetero_checkpoint(self.checkpoint_dir,
+                                              reference_state)
+        else:
+            ts = restore_checkpoint(
+                self.checkpoint_dir,
+                exec_state_to_train_state(exe.kind, reference_state,
+                                          meta.step),
+                expected_block_layout=layout)
+            state = train_state_to_exec_state(exe.kind, ts)
+        return state, meta.step
+
+    # -- the supervised loop ----------------------------------------------
+
+    def _handle_sigterm(self, signum, frame) -> None:  # pragma: no cover
+        self._drain = True
+        self._drain_reason = "sigterm"
+
+    def run(self) -> SupervisorReport:
+        res = self.res
+        report = SupervisorReport(outcome="failed", steps_done=0,
+                                  target_steps=self.steps)
+        tracer = Tracer(self.events)
+        detector = LossAnomalyDetector(spike_factor=res.spike_factor,
+                                       window=res.spike_window)
+        policy = RetryPolicy(max_attempts=res.retry_attempts,
+                             base_delay_s=res.retry_base_delay_s,
+                             max_delay_s=res.retry_max_delay_s)
+
+        def count_retry(attempt, err):
+            report.retries += 1
+
+        writer = RetryingCheckpointWriter(
+            policy, events=self.events, faults=self.faults,
+            keep_prev=res.keep_prev, sleep=self._sleep,
+            on_retry=count_retry)
+        prev_handler = None
+        if self.install_signal_handler:
+            prev_handler = signal.signal(signal.SIGTERM, self._handle_sigterm)
+        try:
+            self._run_loop(report, tracer, detector, writer)
+        except MetisError as e:
+            report.outcome = "failed"
+            report.detail = f"{type(e).__name__}: {e}"
+        finally:
+            if prev_handler is not None:
+                signal.signal(signal.SIGTERM, prev_handler)
+            try:
+                writer.close()
+            except Exception as e:  # noqa: BLE001 — keep the report
+                if not report.detail:
+                    report.detail = f"close: {type(e).__name__}: {e}"
+        report.checkpoints = writer.saves
+        if report.losses:
+            report.final_loss = report.losses[-1]
+        return report
+
+    def _run_loop(self, report: SupervisorReport, tracer: Tracer,
+                  detector: LossAnomalyDetector,
+                  writer: RetryingCheckpointWriter) -> None:
+        res = self.res
+        with tracer.span("supervised_run", steps=self.steps):
+            with tracer.span("plan"):
+                art = self._initial_artifact()
+            with tracer.span("build"):
+                exe, mesh, layout = self._build(art)
+                state = exe.init(jax.random.PRNGKey(0))
+            step = 0
+            try:
+                state, step = self._restore(exe, layout, state)
+            except FileNotFoundError:
+                step = 0
+            report.steps_done = step
+            batches = self._batches(art, exe, mesh, skip=step)
+            tokens_per_step = art.gbs * self.model.sequence_length
+            timer = StepTimer(events=self.events,
+                              tokens_per_step=tokens_per_step,
+                              start_step=step)
+
+            while step < self.steps:
+                # -- device loss: checkpointed state + survivors -> replan
+                spec = self.faults.check("device_loss", step)
+                if spec is not None:
+                    if len(report.recoveries) >= res.max_recoveries:
+                        raise TrainingAnomalyError(
+                            f"{len(report.recoveries)} recoveries exhausted "
+                            f"max_recoveries={res.max_recoveries}")
+                    t0 = time.perf_counter()
+                    with tracer.span("recovery", kind="device_loss"):
+                        lost = spec.lost_devices()
+                        if not lost:
+                            last = self.cluster.nodes[-1]
+                            lost = {last.device_type: last.num_devices}
+                        survivor = shrink_cluster(self.cluster, lost)
+                        rep = replan(self.cluster, survivor, self.profiles,
+                                     self.model, self.search_config,
+                                     search_old=False)
+                        if rep.result.best is None:
+                            raise InfeasiblePlanError(
+                                "no feasible plan on survivor topology")
+                        art = PlanArtifact.from_ranked_plan(rep.result.best)
+                        self.cluster = survivor
+                        exe, mesh, layout = self._build(art)
+                        fresh = exe.init(jax.random.PRNGKey(0))
+                        try:
+                            state, step = self._restore(exe, layout, fresh)
+                        except FileNotFoundError:
+                            state, step = fresh, 0
+                        batches = self._batches(art, exe, mesh, skip=step)
+                        detector.reset()
+                        timer = StepTimer(events=self.events,
+                                          tokens_per_step=tokens_per_step,
+                                          start_step=step)
+                    recover_s = time.perf_counter() - t0
+                    self.events.emit(
+                        "recovery_complete", step=step, kind="device_loss",
+                        recover_s=round(recover_s, 4),
+                        plan_changed=rep.plan_changed,
+                        survivor_devices=survivor.total_devices)
+                    report.recoveries.append(RecoveryRecord(
+                        kind="device_loss", step=report.steps_done,
+                        resumed_step=step, recover_s=recover_s,
+                        plan_changed=rep.plan_changed,
+                        detail=",".join(f"{t}={n}" for t, n in lost.items())))
+                    report.steps_done = step
+                    continue
+
+                # -- preemption: finish in-flight work, checkpoint, exit
+                if self.faults.check("preempt", step) is not None:
+                    self._drain = True
+                    self._drain_reason = self._drain_reason or "preempt_fault"
+                if self._drain:
+                    self.events.emit("preempt_drain", step=step,
+                                     reason=self._drain_reason or "sigterm")
+                    self._save(writer, exe, art, mesh, layout, state, step)
+                    report.outcome = "preempted"
+                    report.detail = self._drain_reason
+                    return
+
+                # -- one training step
+                tokens, targets = next(batches)
+                state, loss = exe.step(state, tokens, targets)
+                loss = float(loss)
+                if self.faults.check("loss_nan", step) is not None:
+                    loss = float("nan")
+                if self.faults.check("loss_spike", step) is not None:
+                    loss = abs(loss) * res.spike_factor * 10 + 1e3
+
+                kind = detector.observe(loss, step)
+                if kind == "nan":
+                    self.events.emit("anomaly_detected", kind="nan",
+                                     step=step, loss=str(loss))
+                    if not res.restore_on_anomaly:
+                        raise TrainingAnomalyError(
+                            f"non-finite loss at step {step} and "
+                            "restore_on_anomaly is off")
+                    if len(report.recoveries) >= res.max_recoveries:
+                        raise TrainingAnomalyError(
+                            f"non-finite loss at step {step}: "
+                            f"max_recoveries={res.max_recoveries} exhausted")
+                    t0 = time.perf_counter()
+                    with tracer.span("recovery", kind="anomaly_rollback"):
+                        try:
+                            # the pre-step state was donated to the step —
+                            # only the CURRENT state is a valid reference
+                            state, resumed = self._restore(exe, layout, state)
+                        except FileNotFoundError:
+                            raise TrainingAnomalyError(
+                                f"non-finite loss at step {step} with no "
+                                "checkpoint to roll back to") from None
+                        batches = self._batches(art, exe, mesh, skip=resumed)
+                        detector.reset()
+                        timer = StepTimer(events=self.events,
+                                          tokens_per_step=tokens_per_step,
+                                          start_step=resumed)
+                    recover_s = time.perf_counter() - t0
+                    self.events.emit(
+                        "recovery_complete", step=resumed,
+                        kind="anomaly_rollback",
+                        recover_s=round(recover_s, 4), plan_changed=False)
+                    report.recoveries.append(RecoveryRecord(
+                        kind="anomaly_rollback", step=step,
+                        resumed_step=resumed, recover_s=recover_s))
+                    step = resumed
+                    report.steps_done = step
+                    continue
+                if kind == "spike":
+                    self.events.emit("anomaly_detected", kind="spike",
+                                     step=step, loss=loss)
+
+                step += 1
+                report.steps_done = step
+                report.losses.append(loss)
+                timer.record(loss)
+                if (res.checkpoint_every
+                        and step % res.checkpoint_every == 0
+                        and step < self.steps):
+                    self._save(writer, exe, art, mesh, layout, state, step)
+
+            # -- completed: land the final checkpoint
+            self._save(writer, exe, art, mesh, layout, state, step)
+            report.outcome = "completed"

@@ -120,6 +120,37 @@ class TestTrainStateCheckpoint:
         assert not (tmp_path / "ckpt.tmp").exists()
         assert not (tmp_path / "ckpt.prev").exists()
 
+    def test_async_writer_close_surfaces_write_failure(self, tmp_path):
+        """Regression: close() must SURFACE a failed in-flight background
+        save (as CheckpointWriteError naming the checkpoint path), not
+        swallow it — and still release the underlying checkpointer."""
+        from metis_tpu.core.errors import CheckpointWriteError
+        from metis_tpu.execution.checkpoint import AsyncCheckpointWriter
+
+        cfg = tiny_cfg()
+        mesh = dp_tp_mesh(4, 2)
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        writer = AsyncCheckpointWriter()
+        writer.save(tmp_path / "ckpt", state, mesh)
+
+        closed = []
+        real_close = writer._ckptr.close
+
+        def tracked_close():
+            closed.append(True)
+            real_close()
+
+        writer._ckptr.close = tracked_close
+        writer._ckptr.wait_until_finished = lambda: (_ for _ in ()).throw(
+            RuntimeError("disk on fire"))
+        with pytest.raises(CheckpointWriteError) as exc:
+            writer.close()
+        assert "ckpt" in str(exc.value)
+        assert "disk on fire" in str(exc.value)
+        assert closed, "underlying checkpointer was not closed"
+        # the failed write never swapped: no primary checkpoint appeared
+        assert not (tmp_path / "ckpt").exists()
+
     def test_hetero_state_roundtrip(self, tmp_path):
         """The multi-mesh executor's per-stage state list checkpoints and
         restores bit-identically (2-stage non-uniform plan)."""

@@ -31,6 +31,87 @@ class TestClusterDelta:
         c = ClusterSpec.of(("A100", 2, 4))
         assert ClusterDelta.between(c, c).is_empty
 
+    def test_device_added_back_after_loss(self):
+        """A lost-then-replaced node is a no-op delta, not an add+remove."""
+        old = ClusterSpec.of(("A100", 2, 4))
+        lost = ClusterSpec.of(("A100", 1, 4))
+        healed = ClusterSpec.of(("A100", 2, 4))
+        assert ClusterDelta.between(old, lost).removed == {"A100": 4}
+        assert ClusterDelta.between(lost, healed).added == {"A100": 4}
+        assert ClusterDelta.between(old, healed).is_empty
+
+    def test_type_count_changes_both_ways(self):
+        """One type shrinking while another grows lands in both maps."""
+        old = ClusterSpec.of(("A100", 2, 4), ("T4", 1, 4))
+        new = ClusterSpec.of(("A100", 1, 4), ("T4", 2, 4))
+        d = ClusterDelta.between(old, new)
+        assert d.removed == {"A100": 4}
+        assert d.added == {"T4": 4}
+        assert not d.is_empty
+
+    def test_type_swap(self):
+        """A type disappearing entirely while a new one appears."""
+        old = ClusterSpec.of(("A100", 1, 4))
+        new = ClusterSpec.of(("T4", 1, 8))
+        d = ClusterDelta.between(old, new)
+        assert d.removed == {"A100": 4}
+        assert d.added == {"T4": 8}
+
+    def test_empty_delta_short_circuit(self):
+        """Same topology spelled with different node granularity is still
+        an empty delta (counts per type, not node lists)."""
+        a = ClusterSpec.of(("A100", 2, 4))
+        b = ClusterSpec.of(("A100", 4, 2))
+        assert ClusterDelta.between(a, b).is_empty
+
+
+class TestShrinkCluster:
+    def test_whole_node_removed_from_end(self):
+        from metis_tpu.planner import shrink_cluster
+
+        c = ClusterSpec.of(("A100", 3, 4))
+        s = shrink_cluster(c, {"A100": 4})
+        assert s.num_nodes == 2
+        assert s.total_devices == 8
+        assert ClusterDelta.between(c, s).removed == {"A100": 4}
+
+    def test_partial_node_narrows(self):
+        from metis_tpu.cluster.spec import NodeSpec
+        from metis_tpu.planner import shrink_cluster
+
+        c = ClusterSpec.of(("A100", 2, 4))
+        s = shrink_cluster(c, {"A100": 2})
+        assert s.nodes == (NodeSpec("A100", 4), NodeSpec("A100", 2))
+
+    def test_mixed_types_only_named_type_shrinks(self):
+        from metis_tpu.planner import shrink_cluster
+
+        c = ClusterSpec.of(("A100", 2, 4), ("T4", 2, 4))
+        s = shrink_cluster(c, {"T4": 8})
+        assert s.num_devices_by_type("T4") == 0
+        assert s.num_devices_by_type("A100") == 8
+        # the surviving spec still knows the T4 DeviceSpec (profiles may
+        # reference it)
+        assert "T4" in s.devices
+
+    def test_removing_too_many_raises(self):
+        from metis_tpu.core.errors import ClusterSpecError
+        from metis_tpu.planner import shrink_cluster
+
+        c = ClusterSpec.of(("A100", 1, 4))
+        with pytest.raises(ClusterSpecError):
+            shrink_cluster(c, {"A100": 5})
+        with pytest.raises(ClusterSpecError):
+            shrink_cluster(c, {"T4": 1})
+
+    def test_nothing_survives_raises(self):
+        from metis_tpu.core.errors import ClusterSpecError
+        from metis_tpu.planner import shrink_cluster
+
+        c = ClusterSpec.of(("A100", 1, 4))
+        with pytest.raises(ClusterSpecError):
+            shrink_cluster(c, {"A100": 4})
+
 
 class TestReplan:
     def test_lost_node_replans_slower(self, setup):

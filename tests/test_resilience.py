@@ -1,0 +1,324 @@
+"""Fault tolerance: injection scripting, retry policy, anomaly detection,
+checkpoint corruption fallback, and the supervisor's recovery drills
+(resilience/ + tools/chaos_drill.py wired into tier-1)."""
+import jax
+import numpy as np
+import pytest
+
+from metis_tpu.core.errors import (
+    CheckpointCorruptError,
+    RetryExhaustedError,
+)
+from metis_tpu.core.events import EventLog
+from metis_tpu.resilience import (
+    FaultInjector,
+    FaultSpec,
+    RetryPolicy,
+    parse_fault_script,
+)
+
+
+class TestFaultScript:
+    def test_parse_full_syntax(self):
+        specs = parse_fault_script(
+            "checkpoint_write@2x2,device_loss@5:A100=4,loss_nan@3,"
+            "preempt@7,checkpoint_write~0.5")
+        assert [s.point for s in specs] == [
+            "checkpoint_write", "device_loss", "loss_nan", "preempt",
+            "checkpoint_write"]
+        assert specs[0].step == 2 and specs[0].times == 2
+        assert specs[1].lost_devices() == {"A100": 4}
+        assert specs[4].prob == 0.5 and specs[4].step is None
+
+    def test_device_loss_arg_with_commas(self):
+        """TYPE=COUNT fragments after a device_loss entry glue onto it."""
+        specs = parse_fault_script("device_loss@5:A100=4,T4=2,preempt@9")
+        assert len(specs) == 2
+        assert specs[0].lost_devices() == {"A100": 4, "T4": 2}
+        assert specs[1].point == "preempt"
+
+    def test_bad_entries_raise(self):
+        with pytest.raises(ValueError):
+            parse_fault_script("not_a_point@1")
+        with pytest.raises(ValueError):
+            parse_fault_script("checkpoint_write@@2")
+        with pytest.raises(ValueError):
+            FaultSpec("checkpoint_write", times=0)
+        with pytest.raises(ValueError):
+            FaultSpec("checkpoint_write", prob=0.0)
+        with pytest.raises(ValueError):
+            FaultSpec("device_loss", arg="A100=zero").lost_devices()
+
+    def test_check_decrements_budget_and_emits(self, tmp_path):
+        path = tmp_path / "ev.jsonl"
+        with EventLog(path) as events:
+            inj = FaultInjector("checkpoint_write@2x2", events=events)
+            assert inj.armed
+            assert inj.check("checkpoint_write", 1) is None  # before step 2
+            assert inj.check("checkpoint_write", 2) is not None
+            assert inj.check("checkpoint_write", 3) is not None
+            assert inj.check("checkpoint_write", 4) is None  # budget spent
+            assert not inj.armed
+        from metis_tpu.core.events import read_events
+
+        evs = [e for e in read_events(path) if e["event"] == "fault_injected"]
+        assert len(evs) == 2
+        assert evs[0]["point"] == "checkpoint_write"
+        assert evs[0]["times_left"] == 1 and evs[1]["times_left"] == 0
+
+    def test_probabilistic_firing_is_seeded(self):
+        def fired_steps(seed):
+            inj = FaultInjector("loss_spike x9 ~0.5".replace(" ", ""),
+                                seed=seed)
+            return [s for s in range(40) if inj.check("loss_spike", s)]
+
+        a, b = fired_steps(7), fired_steps(7)
+        assert a == b, "same seed must replay identically"
+        assert fired_steps(8) != a, "different seed should differ"
+        assert 0 < len(a) < 40
+
+    def test_unknown_point_raises(self):
+        with pytest.raises(ValueError):
+            FaultInjector().check("bogus_point", 1)
+
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self, tmp_path):
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.01)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        slept = []
+        path = tmp_path / "ev.jsonl"
+        with EventLog(path) as events:
+            out = policy.call(flaky, op="write", events=events,
+                              sleep=slept.append)
+        assert out == "ok" and calls["n"] == 3
+        assert len(slept) == 2 and slept[1] > slept[0] * 1.2  # backoff grew
+        from metis_tpu.core.events import read_events
+
+        evs = read_events(path)
+        retries = [e for e in evs if e["event"] == "retry_attempt"]
+        assert [e["attempt"] for e in retries] == [1, 2]
+        assert all(e["op"] == "write" for e in retries)
+        assert not [e for e in evs if e["event"] == "retry_exhausted"]
+
+    def test_exhaustion_raises_typed_and_emits(self, tmp_path):
+        policy = RetryPolicy(max_attempts=2, base_delay_s=0.0)
+        path = tmp_path / "ev.jsonl"
+        with EventLog(path) as events:
+            with pytest.raises(RetryExhaustedError) as exc:
+                policy.call(lambda: (_ for _ in ()).throw(OSError("nope")),
+                            op="write", events=events, sleep=lambda _s: None)
+        assert exc.value.attempts == 2
+        assert isinstance(exc.value.__cause__, OSError)
+        from metis_tpu.core.events import read_events
+
+        exhausted = [e for e in read_events(path)
+                     if e["event"] == "retry_exhausted"]
+        assert len(exhausted) == 1 and exhausted[0]["attempts"] == 2
+
+    def test_fatal_errors_never_retry(self):
+        policy = RetryPolicy(max_attempts=5)
+        calls = {"n": 0}
+
+        def bug():
+            calls["n"] += 1
+            raise KeyError("a bug, not an outage")
+
+        with pytest.raises(KeyError):
+            policy.call(bug, sleep=lambda _s: None)
+        assert calls["n"] == 1
+
+    def test_classification_fatal_wins_on_overlap(self):
+        class CarvedOut(OSError):
+            pass
+
+        policy = RetryPolicy(fatal=(CarvedOut,))
+        assert policy.classify(OSError()) == "transient"
+        assert policy.classify(CarvedOut()) == "fatal"
+        assert policy.classify(RuntimeError()) == "fatal"
+
+    def test_deterministic_jitter(self):
+        import random
+
+        policy = RetryPolicy(base_delay_s=0.1, max_delay_s=10.0, jitter=0.25)
+        a = [policy.delay_s(i, random.Random(0)) for i in (1, 2, 3)]
+        b = [policy.delay_s(i, random.Random(0)) for i in (1, 2, 3)]
+        assert a == b
+        # stays within the +/-25% band of the undithered curve
+        for attempt, d in zip((1, 2, 3), a):
+            base = 0.1 * 2.0 ** (attempt - 1)
+            assert base * 0.75 <= d <= base * 1.25
+
+
+class TestLossAnomalyDetector:
+    def test_nan_and_inf_always_flag(self):
+        from metis_tpu.execution.train import LossAnomalyDetector
+
+        d = LossAnomalyDetector()
+        assert d.observe(float("nan")) == "nan"
+        assert d.observe(float("inf")) == "nan"
+        assert d.observe(1.0) is None
+
+    def test_spike_needs_history_and_factor(self):
+        from metis_tpu.execution.train import LossAnomalyDetector
+
+        d = LossAnomalyDetector(spike_factor=10.0, window=8, min_history=3)
+        assert d.observe(100.0) is None  # wild early losses tolerated
+        assert d.observe(5.0) is None
+        assert d.observe(5.0) is None
+        # mean ~36.7; 9x is not a spike at factor 10
+        assert d.observe(330.0) is None
+        assert d.observe(5000.0) == "spike"
+        # the spike never entered the window: baseline unchanged
+        assert d.observe(5000.0) == "spike"
+        d.reset()
+        assert d.observe(5000.0) is None  # fresh history after rollback
+
+
+class TestCheckpointIntegrity:
+    def _small_state(self):
+        import jax.numpy as jnp
+        import numpy as onp
+        from jax.sharding import Mesh
+
+        from metis_tpu.execution import DP, TP, build_train_state
+        from metis_tpu.models import GPTConfig
+
+        cfg = GPTConfig(vocab_size=128, seq_len=16, hidden=32, num_heads=2,
+                        num_blocks=2, dtype=jnp.float32)
+        mesh = Mesh(onp.array(jax.devices()[:4]).reshape(2, 2), (DP, TP))
+        state, _ = build_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        return state, mesh
+
+    def test_digests_recorded_and_verified(self, tmp_path):
+        from metis_tpu.execution import (
+            load_meta,
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        state, mesh = self._small_state()
+        save_checkpoint(tmp_path / "ckpt", state, mesh)
+        meta = load_meta(tmp_path / "ckpt")
+        assert meta.digests, "save recorded no content digests"
+        restored = restore_checkpoint(tmp_path / "ckpt", state)
+        assert int(restored.step) == 0
+
+    def test_garbage_array_raises_typed_error(self, tmp_path):
+        """Truncated/garbage array file -> CheckpointCorruptError, not a
+        raw deserialization traceback."""
+        from metis_tpu.execution import restore_checkpoint, save_checkpoint
+
+        state, mesh = self._small_state()
+        save_checkpoint(tmp_path / "ckpt", state, mesh)
+        victim = max(
+            (p for p in (tmp_path / "ckpt" / "state").rglob("*")
+             if p.is_file()),
+            key=lambda p: p.stat().st_size)
+        victim.write_bytes(b"garbage")
+        with pytest.raises(CheckpointCorruptError):
+            restore_checkpoint(tmp_path / "ckpt", state)
+
+    def test_corrupt_latest_falls_back_to_prev(self, tmp_path):
+        from metis_tpu.execution import restore_checkpoint, save_checkpoint
+        from metis_tpu.execution.train import TrainState
+
+        state, mesh = self._small_state()
+        import jax.numpy as jnp
+
+        s1 = TrainState(params=state.params, opt_state=state.opt_state,
+                        step=jnp.asarray(1, jnp.int32))
+        s2 = TrainState(params=state.params, opt_state=state.opt_state,
+                        step=jnp.asarray(2, jnp.int32))
+        save_checkpoint(tmp_path / "ckpt", s1, mesh, keep_prev=True)
+        save_checkpoint(tmp_path / "ckpt", s2, mesh, keep_prev=True)
+        assert (tmp_path / "ckpt.prev").exists()
+        victim = max(
+            (p for p in (tmp_path / "ckpt" / "state").rglob("*")
+             if p.is_file()),
+            key=lambda p: p.stat().st_size)
+        victim.write_bytes(b"\xde\xad" * 32)
+        restored = restore_checkpoint(tmp_path / "ckpt", state)
+        assert int(np.asarray(jax.device_get(restored.step))) == 1
+
+    def test_missing_checkpoint_stays_file_not_found(self, tmp_path):
+        from metis_tpu.execution import restore_checkpoint
+
+        state, _mesh = self._small_state()
+        with pytest.raises(FileNotFoundError):
+            restore_checkpoint(tmp_path / "nope", state)
+
+
+@pytest.mark.slow
+class TestSupervisorDrills:
+    """Full supervisor drills: each compiles 1-2 executables (plan search +
+    jit) — minutes of wall-clock on a 1-CPU box, so they carry the ``slow``
+    marker like the pallas-numerics suites.  ``python tools/chaos_drill.py``
+    and bench.py's ``resilience`` section run the same drills end-to-end;
+    tier-1 still covers every resilience unit (faults, retry, anomaly
+    detector, digest corruption + ``.prev`` fallback) above."""
+
+    def test_preempt_drains_cleanly(self, tmp_path):
+        """An injected preemption finishes the in-flight step, lands a
+        final checkpoint, and exits with the resumable 'preempted'
+        outcome."""
+        from metis_tpu.core.config import ResilienceConfig
+        from metis_tpu.core.events import read_events
+        from metis_tpu.execution.checkpoint import load_meta
+        from metis_tpu.resilience import TrainingSupervisor
+        from tools.chaos_drill import drill_setup
+
+        cluster, profiles, model, config = drill_setup()
+        path = tmp_path / "ev.jsonl"
+        with EventLog(path) as events:
+            sup = TrainingSupervisor(
+                cluster, profiles, model, config,
+                checkpoint_dir=tmp_path / "ckpt", steps=10,
+                resilience=ResilienceConfig(checkpoint_every=2),
+                faults=FaultInjector("preempt@3", events=events),
+                events=events, sleep=lambda _s: None)
+            report = sup.run()
+        assert report.outcome == "preempted"
+        assert report.steps_done == 3
+        assert load_meta(tmp_path / "ckpt").step == 3
+        drains = [e for e in read_events(path)
+                  if e["event"] == "preempt_drain"]
+        assert len(drains) == 1 and drains[0]["step"] == 3
+
+    def test_chaos_drill_end_to_end(self, tmp_path):
+        """The canned CI drill: 2 transient ckpt-IO failures + a device
+        loss mid-run; the supervisor retries, replans on the survivors,
+        restores the digest-verified checkpoint, and completes all steps
+        with a schema-valid event stream (asserts live in run_drill)."""
+        from tools.chaos_drill import run_drill
+
+        rep = run_drill(tmp_path, steps=8)
+        assert rep["outcome"] == "completed"
+        assert rep["steps_done"] == 8
+        assert [r["kind"] for r in rep["recoveries"]] == ["device_loss"]
+
+    def test_corruption_drill_falls_back_to_prev(self, tmp_path):
+        from tools.chaos_drill import run_corruption_drill
+
+        out = run_corruption_drill(tmp_path)
+        assert out["fallback_step"] == 3
+
+
+def test_resilience_events_registered_in_schema():
+    """Every event the resilience stack emits is in the enforced schema."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    from check_events_schema import EVENT_SCHEMA
+
+    for name in ("fault_injected", "retry_attempt", "retry_exhausted",
+                 "anomaly_detected", "preempt_drain", "recovery_complete"):
+        assert name in EVENT_SCHEMA

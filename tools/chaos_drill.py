@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Scripted end-to-end chaos drill for the training supervisor.
+
+The CI-runnable proof that the whole recovery story works on CPU
+(``resilience/`` + ``execution/checkpoint.py`` + ``planner/replan.py``):
+
+1. **The canned drill** (``run_drill``): train a tiny GPT on 8 virtual CPU
+   devices under the supervisor with the script
+   ``checkpoint_write@2x2,device_loss@5`` — the step-2 checkpoint write
+   fails twice (transient IO, retried), then at step 5 a whole node drops.
+   The supervisor must replan on the 4 survivor devices, restore the
+   digest-verified checkpoint onto the new plan, finish all requested
+   steps, and leave a schema-valid event stream in the right causal order
+   (``fault_injected`` before ``retry_attempt`` before
+   ``recovery_complete``).
+2. **The corruption drill** (``run_corruption_drill``): scribble garbage
+   over the latest checkpoint's biggest array file and restore — the
+   digest verification must reject it and fall back to the retained
+   ``.prev`` generation.
+
+Run directly (``python tools/chaos_drill.py``) or via the tier-1 wrapper
+``tests/test_resilience.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# the drill needs multiple devices; force 8 virtual CPU devices BEFORE the
+# first jax import (mirrors tests/conftest.py)
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from metis_tpu.cluster.spec import ClusterSpec  # noqa: E402
+from metis_tpu.core.config import ModelSpec, ResilienceConfig, \
+    SearchConfig  # noqa: E402
+from metis_tpu.core.events import EventLog, read_events  # noqa: E402
+from metis_tpu.profiles.synthetic import synthesize_profiles  # noqa: E402
+from metis_tpu.resilience import FaultInjector, TrainingSupervisor  # noqa: E402
+from tools.check_events_schema import validate_events  # noqa: E402
+
+DEFAULT_FAULT_SCRIPT = "checkpoint_write@2x2,device_loss@5"
+
+
+def drill_model() -> ModelSpec:
+    """A model tiny enough to actually TRAIN on CPU in seconds (the shared
+    ``tiny_test_model`` fixture is hidden-4096 — planner-scale, not
+    CPU-train-scale)."""
+    return ModelSpec(name="gpt-drill", num_layers=4, hidden_size=32,
+                     sequence_length=16, vocab_size=128, num_heads=2)
+
+
+def drill_setup(gbs: int = 8):
+    """(cluster, profiles, model, search_config) for the canned drill:
+    2 nodes x 4 A100s on 8 virtual CPU devices — losing a node leaves a
+    plannable 4-device survivor topology."""
+    model = drill_model()
+    cluster = ClusterSpec.of(("A100", 2, 4))
+    profiles = synthesize_profiles(model, ["A100"], tps=[1, 2, 4],
+                                   bss=[1, 2, 4, 8])
+    config = SearchConfig(gbs=gbs, max_profiled_tp=4, max_profiled_bs=8)
+    return cluster, profiles, model, config
+
+
+def _no_sleep(_s: float) -> None:
+    pass
+
+
+def run_drill(tmp_dir: str | Path, steps: int = 8,
+              fault_script: str = DEFAULT_FAULT_SCRIPT,
+              checkpoint_every: int = 2, verbose: bool = False) -> dict:
+    """The canned fault drill.  Returns the supervisor report dict;
+    raises AssertionError when any recovery guarantee is violated."""
+    tmp_dir = Path(tmp_dir)
+    events_path = tmp_dir / "events.jsonl"
+    cluster, profiles, model, config = drill_setup()
+    with EventLog(events_path) as events:
+        faults = FaultInjector(fault_script, seed=0, events=events)
+        supervisor = TrainingSupervisor(
+            cluster, profiles, model, config,
+            checkpoint_dir=tmp_dir / "ckpt", steps=steps,
+            resilience=ResilienceConfig(checkpoint_every=checkpoint_every,
+                                        retry_attempts=3),
+            faults=faults, events=events, sleep=_no_sleep)
+        report = supervisor.run()
+
+    rep = report.to_json_dict()
+    if verbose:
+        print(json.dumps(rep, indent=2))
+
+    # -- the drill's guarantees -------------------------------------------
+    assert report.outcome == "completed", \
+        f"drill did not complete: {rep['outcome']} ({rep['detail']})"
+    assert report.steps_done == steps, \
+        f"finished {report.steps_done}/{steps} steps"
+    fired_points = [f["point"] for f in faults.fired]
+    assert "checkpoint_write" in fired_points, "ckpt-IO fault never fired"
+    assert "device_loss" in fired_points, "device-loss fault never fired"
+    assert report.retries >= 2, \
+        f"expected >=2 ckpt retries, saw {report.retries}"
+    assert any(r.kind == "device_loss" for r in report.recoveries), \
+        "no device-loss recovery recorded"
+
+    # -- the event stream is schema-valid and causally ordered ------------
+    evs = read_events(events_path)
+    problems = validate_events(evs)
+    assert not problems, "event schema problems:\n  " + "\n  ".join(problems)
+    names = [e["event"] for e in evs]
+    for required in ("fault_injected", "retry_attempt", "recovery_complete",
+                     "train_step"):
+        assert required in names, f"no {required} event emitted"
+    assert names.index("fault_injected") < names.index("retry_attempt") \
+        < names.index("recovery_complete"), \
+        "fault -> retry -> recovery events out of order"
+    # the device-loss recovery resumed from a checkpointed step, replanned
+    # on the survivors, and kept training to the requested step count
+    rec = next(e for e in evs if e["event"] == "recovery_complete")
+    assert rec["step"] < steps, "recovery resumed past the target"
+    last_step = max(e["step"] for e in evs if e["event"] == "train_step")
+    assert last_step == steps, \
+        f"last train_step event at {last_step}, wanted {steps}"
+    return rep
+
+
+def run_corruption_drill(tmp_dir: str | Path, steps: int = 4) -> dict:
+    """Corrupt the LATEST checkpoint generation and prove restore falls
+    back to the retained ``.prev`` one (digest verification catching the
+    garbage is the load-bearing part)."""
+    import numpy as np
+
+    from metis_tpu.core.errors import CheckpointCorruptError
+    from metis_tpu.execution.builder import (
+        build_executable,
+        exec_state_to_train_state,
+    )
+    from metis_tpu.execution.checkpoint import (
+        load_meta,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from metis_tpu.execution.mesh import DP, PP, TP, PlanArtifact
+    from metis_tpu.models import config_for_model_spec
+
+    import jax
+
+    tmp_dir = Path(tmp_dir)
+    ckpt = tmp_dir / "ckpt-corrupt"
+    cluster, profiles, model, config = drill_setup()
+    # a pinned pp=1 dp=4 plan — the gspmd route checkpoints a TrainState,
+    # which is what the digest-verified restore_checkpoint path covers
+    art = PlanArtifact(mesh_axes=(PP, DP, TP), mesh_shape=(1, 4, 1),
+                       layer_partition=(),
+                       strategies=({"dp": 4, "tp": 1},),
+                       gbs=config.gbs, microbatches=1)
+    cfg = config_for_model_spec(model)
+    exe = build_executable(cfg, art, cluster=cluster, profiles=profiles)
+    assert exe.kind == "gspmd", f"expected gspmd route, got {exe.kind}"
+    mesh = art.build_mesh()
+
+    from metis_tpu.data.pipeline import make_input_pipeline, \
+        synthetic_run_dataset
+
+    dataset = synthetic_run_dataset(model.vocab_size, art.gbs,
+                                    model.sequence_length)
+    batches = make_input_pipeline(dataset, art.gbs, epochs=None)
+    state = exe.init(jax.random.PRNGKey(0))
+    for step in range(1, steps + 1):
+        tokens, targets = next(batches)
+        state, _ = exe.step(state, tokens, targets)
+        # keep_prev retains generation N-1 when N lands
+        save_checkpoint(ckpt, exec_state_to_train_state(exe.kind, state, step),
+                        mesh, plan=art, keep_prev=True)
+    assert load_meta(ckpt).step == steps
+    prev_meta = (ckpt.parent / (ckpt.name + ".prev")) / "meta.json"
+    assert prev_meta.exists(), "no .prev generation was retained"
+
+    # scribble garbage over the latest generation's biggest array file
+    victim = max((p for p in (ckpt / "state").rglob("*") if p.is_file()),
+                 key=lambda p: p.stat().st_size)
+    victim.write_bytes(b"\xde\xad\xbe\xef" * 64)
+
+    ref = exec_state_to_train_state(exe.kind, state, steps)
+    restored = restore_checkpoint(ckpt, ref)
+    got = int(np.asarray(jax.device_get(restored.step)))
+    assert got == steps - 1, \
+        f"fallback restored step {got}, wanted .prev's {steps - 1}"
+
+    # and with no .prev, the corruption is a typed, catchable error
+    import shutil
+
+    shutil.rmtree(ckpt.parent / (ckpt.name + ".prev"))
+    try:
+        restore_checkpoint(ckpt, ref)
+    except CheckpointCorruptError:
+        pass
+    else:
+        raise AssertionError(
+            "corrupt checkpoint with no .prev restored silently")
+    return {"fallback_step": got, "corrupted_file": victim.name}
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--fault-script", default=DEFAULT_FAULT_SCRIPT)
+    p.add_argument("--checkpoint-every", type=int, default=2)
+    p.add_argument("--keep", default=None, metavar="DIR",
+                   help="run in DIR and keep the artifacts (default: a "
+                        "temp dir, removed afterwards)")
+    p.add_argument("--skip-corruption", action="store_true")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="also write the drill reports as JSON to PATH "
+                        "(bench.py's resilience section consumes this)")
+    args = p.parse_args(argv)
+
+    def _run(d: str) -> None:
+        rep = run_drill(d, steps=args.steps, fault_script=args.fault_script,
+                        checkpoint_every=args.checkpoint_every, verbose=True)
+        print(f"fault drill OK: {rep['steps_done']} steps, "
+              f"{len(rep['recoveries'])} recoveries, {rep['retries']} "
+              "retries")
+        out = None
+        if not args.skip_corruption:
+            out = run_corruption_drill(d)
+            print(f"corruption drill OK: fell back to .prev at step "
+                  f"{out['fallback_step']}")
+        if args.report:
+            Path(args.report).write_text(
+                json.dumps({"drill": rep, "corruption": out}))
+
+    if args.keep:
+        Path(args.keep).mkdir(parents=True, exist_ok=True)
+        _run(args.keep)
+    else:
+        with tempfile.TemporaryDirectory(prefix="chaos-drill-") as d:
+            _run(d)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

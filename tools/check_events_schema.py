@@ -47,6 +47,13 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "accuracy_sample": {"fingerprint", "predicted_ms", "measured_ms",
                         "error_pct"},
     "drift_alarm": {"mape_pct", "band_pct", "n"},
+    # fault tolerance (resilience/ — faults.py, retry.py, supervisor.py)
+    "fault_injected": {"point"},
+    "retry_attempt": {"op", "attempt"},
+    "retry_exhausted": {"op", "attempts"},
+    "anomaly_detected": {"kind", "step"},
+    "preempt_drain": {"step"},
+    "recovery_complete": {"step", "recover_s"},
 }
 
 
