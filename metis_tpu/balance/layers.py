@@ -47,6 +47,10 @@ from metis_tpu.search.intra_stage import PartitionResult
 # Cross-candidate memo bound (entries) — see LayerBalancer.__init__.
 _MEMO_MAX = 200_000
 
+# Negative-cache sentinel for the stage-prefix memo: a ProfileMissError on
+# the rows walk is cached and replayed as the same infeasible result.
+_MISS = object()
+
 
 def _strategy_key(strategies: Sequence[Strategy]) -> tuple:
     """Hashable memo key over every strategy axis the memory/partition
@@ -129,6 +133,7 @@ class LayerBalancer:
         profiles: ProfileStore,
         config: SearchConfig,
         model: ModelSpec | None = None,
+        counters=None,
     ):
         self.cluster = cluster
         self.profiles = profiles
@@ -136,10 +141,17 @@ class LayerBalancer:
         # ModelSpec is only needed for expert-parallel memory relief
         # (expert fraction is analytic); without it ep plans get no relief.
         self.model = model
+        # optional core.trace.Counters for memo hit/miss/evict accounting
+        self._counters = counters
         self.data_balancer = DataBalancer(profiles)
         self.act_split = ActivationSplitModel(profiles)
         self.sp_model = SequenceParallelModel(self.act_split)
-        self._prefix_cache: dict[tuple, list[float]] = {}
+        # Stage-prefix memo: keyed on the cheap strategy/type/batch facts the
+        # rows depend on (not the rows themselves — hashing O(L) float tuples
+        # per stage per candidate used to dominate the partition hot path).
+        self._prefix_cache: dict[tuple, object] = {}
+        # (node_sequence, device_groups) -> (ranks, per-stage type tuples)
+        self._types_cache: dict[tuple, tuple] = {}
         # Cross-candidate partition memos: the DP answer depends only on
         # (placement, groups, microbatch total, strategy axes, performance,
         # capacity) — and the enumeration revisits those combinations once
@@ -230,16 +242,57 @@ class LayerBalancer:
             static_scale=static_scale, static_reduction_mb=reduction,
             act_scale=act_scale)
 
-    def _memory_prefix(self, rows: Sequence[tuple[float, ...]]) -> np.ndarray:
-        """Combined prefix over a stage's memory rows: element j is the total
-        MB of layers [0, j) summed across all replica-chunk rows (their sum is
-        all the demand model needs, so one array replaces len(rows) prefixes)."""
-        key = tuple(rows)
-        cached = self._prefix_cache.get(key)
-        if cached is None:
+    def _count(self, name: str) -> None:
+        if self._counters is not None:
+            self._counters.inc(name)
+
+    def _stage_structure(self, plan: InterStagePlan) -> tuple:
+        """(rank types, per-stage type tuples, per-stage homo flags) of a
+        placement — sliced once per (node_sequence, device_groups) instead
+        of per partition call."""
+        key = (plan.node_sequence, plan.device_groups)
+        ent = self._types_cache.get(key)
+        if ent is None:
+            ranks = rank_device_types(self.cluster, plan.node_sequence)
+            stage_types = tuple(
+                ranks[slice(*plan.stage_rank_range(s))]
+                for s in range(plan.num_stages))
+            homos = tuple(len(set(t)) == 1 for t in stage_types)
+            ent = (ranks, stage_types, homos)
+            if len(self._types_cache) > _MEMO_MAX:
+                self._types_cache.clear()
+                self._count("memo.layer_types.evict")
+            self._types_cache[key] = ent
+        return ent
+
+    def _build_prefix(
+        self,
+        key: tuple,
+        plan: InterStagePlan,
+        strategy: Strategy,
+        stage_types: Sequence[str],
+        all_types: Sequence[str],
+    ):
+        """Miss path of the stage-prefix memo (the hit path is inlined in
+        ``_partition_uncached`` — the hottest loop in the search): resolve
+        the stage's memory rows and collapse them to one combined prefix
+        array whose element j is the total MB of layers [0, j) summed across
+        all replica-chunk rows.  Caches ``_MISS`` when the rows walk raised
+        ProfileMissError (the uncached walk would raise the identical error
+        every time, so the replay is exact)."""
+        self._count("memo.layer_prefix.miss")
+        try:
+            rows = self._stage_memory_rows(
+                plan, strategy, stage_types, all_types)
+        except ProfileMissError:
+            cached = _MISS
+        else:
             combined = np.sum(np.asarray(rows, dtype=np.float64), axis=0)
             cached = np.concatenate(([0.0], np.cumsum(combined)))
-            self._prefix_cache[key] = cached
+        if len(self._prefix_cache) > _MEMO_MAX:
+            self._prefix_cache.clear()
+            self._count("memo.layer_prefix.evict")
+        self._prefix_cache[key] = cached
         return cached
 
     def stage_memory_demand(
@@ -300,6 +353,7 @@ class LayerBalancer:
             plan, strategies, memory_capacity, schedule, virtual_stages)
         if len(self._sched_cache) > _MEMO_MAX:
             self._sched_cache.clear()
+            self._count("memo.layer_sched.evict")
         self._sched_cache[key] = out
         return out
 
@@ -367,17 +421,23 @@ class LayerBalancer:
         memory_capacity: Sequence[float],
     ) -> PartitionResult:
         # the internal ProfileMissError path returns a normal infeasible
-        # result, so it caches like any other answer
+        # result, so it caches like any other answer.  Strategy is frozen
+        # (hashable, all-field equality), so the tuple itself keys the memo
+        # with the same semantics as an explicit per-axis key at a fraction
+        # of the construction cost.
         key = (plan.node_sequence, plan.device_groups,
-               plan.gbs // plan.batches, _strategy_key(strategies),
+               plan.gbs // plan.batches, tuple(strategies),
                tuple(compute_performance), tuple(memory_capacity))
         cached = self._part_cache.get(key)
         if cached is not None:
+            self._count("memo.layer_part.hit")
             return cached
+        self._count("memo.layer_part.miss")
         out = self._partition_uncached(
             plan, strategies, compute_performance, memory_capacity)
         if len(self._part_cache) > _MEMO_MAX:
             self._part_cache.clear()
+            self._count("memo.layer_part.evict")
         self._part_cache[key] = out
         return out
 
@@ -388,27 +448,55 @@ class LayerBalancer:
         compute_performance: Sequence[float],
         memory_capacity: Sequence[float],
     ) -> PartitionResult:
-        ranks = rank_device_types(self.cluster, plan.node_sequence)
-        stage_types = [
-            ranks[slice(*plan.stage_rank_range(s))] for s in range(plan.num_stages)
-        ]
+        ranks, stage_types, homos = self._stage_structure(plan)
 
         # Resolve each stage's memory-profile set once, collapsed to a single
         # combined prefix array: demand(s, i, j) is one subtraction, and the
-        # whole feasibility mask for the DP is a numpy broadcast.
-        try:
-            stage_prefix = np.stack([
-                self._memory_prefix(self._stage_memory_rows(
-                    plan, strategies[s], stage_types[s], ranks))
-                for s in range(plan.num_stages)
-            ])  # [S, L+1]
-        except ProfileMissError:
-            return PartitionResult(None, -1, None)
+        # whole feasibility mask for the DP is a numpy broadcast.  A miss on
+        # any stage makes the whole candidate infeasible (the uncached walk
+        # raised out of the stack build at the same stage).
+        S = plan.num_stages
+        g2 = plan.gbs // plan.batches
+        stage_prefix = np.empty((S, self._wprefix.shape[0]))  # [S, L+1]
+        compat = self.config.strict_compat
+        pc = self._prefix_cache
+        counters = self._counters
+        for s in range(S):
+            strat = strategies[s]
+            st = stage_types[s]
+            # Memo keys name what _stage_memory_rows actually reads — device
+            # types, the strategy's memory axes, and the per-replica batch —
+            # so distinct placements sharing a stage shape share the array.
+            # "m"/compat keys carry all ranks: strict mode splits over the
+            # full cluster device list, not just this stage's slice.
+            if homos[s]:
+                mem_type = ranks[0] if compat else st[0]
+                if not compat and (strat.cp > 1 or strat.ep > 1
+                                   or strat.zero > 0
+                                   or (strat.sp and strat.tp > 1)):
+                    key = ("s", mem_type, g2 // strat.dp, strat.dp, strat.tp,
+                           strat.cp, strat.ep, strat.zero, strat.sp)
+                else:
+                    key = ("h", mem_type, strat.tp, g2 // strat.dp)
+            elif compat:
+                key = ("m", ranks, st, strat.dp, strat.tp, g2)
+            else:
+                key = ("m", None, st, strat.dp, strat.tp, g2)
+            pref = pc.get(key)
+            if pref is None:
+                pref = self._build_prefix(key, plan, strat, st, ranks)
+            elif counters is not None:
+                counters.inc("memo.layer_prefix.hit")
+            if pref is _MISS:
+                return PartitionResult(None, -1, None)
+            stage_prefix[s] = pref
+
         coef = self.config.mem_coef
+        sgrid = np.arange(plan.num_stages)
 
         def stage_demands(bounds: Sequence[int]) -> np.ndarray:
-            lo = stage_prefix[np.arange(plan.num_stages), bounds[:-1]]
-            hi = stage_prefix[np.arange(plan.num_stages), bounds[1:]]
+            lo = stage_prefix[sgrid, bounds[:-1]]
+            hi = stage_prefix[sgrid, bounds[1:]]
             return 0.001 + coef * (hi - lo)
 
         cap = np.asarray(memory_capacity, dtype=np.float64)

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Sequence
 
 from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.core.errors import ProfileMissError
 from metis_tpu.core.types import InterStagePlan, Strategy
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
@@ -52,22 +53,48 @@ def node_device_types(cluster: ClusterSpec, node_sequence: Sequence[str]) -> lis
 _MEMO_MAX = 200_000
 
 
+class _Miss:
+    """Negative-cache sentinel: replays the exact ProfileMissError the
+    uncached evaluation raised, so miss-driven pruning repeats identically."""
+
+    __slots__ = ("args",)
+
+    def __init__(self, args):
+        self.args = args
+
+
 class StagePerformanceModel:
     """Implements the search layer's StageEvaluator protocol.
 
-    Both evaluations are memoized across candidates: the result depends only
-    on (node_sequence, device_groups) — plus the per-stage microbatch and
-    strategy axes for ``compute_performance`` — and the enumeration revisits
-    the same compositions once per batch count and once per type permutation.
-    Cached values are immutable tuples shared between callers.
+    Memoization is by SUB-PROBLEM, not whole result: a whole-result cache
+    keyed on (placement, groups, strategies) almost never hits at scale —
+    escalation makes strategy tuples nearly unique per candidate — so
+    ``compute_performance`` instead composes three caches that do hit:
+    the per-placement stage structure, the per-(type, tp, bs) profile total
+    time, and the per-(types, dp, tp, mb_total) hetero-split evaluation.
+    Every cached float is the scalar evaluation's value verbatim, so the
+    normalized tuples are bit-identical to the uncached walk.
     """
 
-    def __init__(self, cluster: ClusterSpec, profiles: ProfileStore):
+    def __init__(self, cluster: ClusterSpec, profiles: ProfileStore,
+                 counters=None):
         self.cluster = cluster
         self.profiles = profiles
         self.data_balancer = DataBalancer(profiles)
+        # optional core.trace.Counters for memo hit/miss/evict accounting;
+        # None (tracing off) costs one attribute test per lookup
+        self._counters = counters
         self._cap_cache: dict[tuple, tuple[float, ...]] = {}
-        self._perf_cache: dict[tuple, tuple[float, ...]] = {}
+        # (node_sequence, device_groups) -> per-stage (is_homo, types)
+        self._struct_cache: dict[tuple, tuple] = {}
+        # (type, tp, bs) -> LayerProfile.total_time_ms | _Miss
+        self._tt_cache: dict[tuple, float | _Miss] = {}
+        # (types, dp, tp, mb_total) -> raw hetero stage value | _Miss
+        self._mixed_cache: dict[tuple, float | _Miss] = {}
+
+    def _count(self, name: str) -> None:
+        if self._counters is not None:
+            self._counters.inc(name)
 
     def stage_types(self, plan: InterStagePlan, stage_id: int) -> list[str]:
         ranks = rank_device_types(self.cluster, plan.node_sequence)
@@ -79,6 +106,7 @@ class StagePerformanceModel:
         key = (plan.node_sequence, plan.device_groups)
         out = self._cap_cache.get(key)
         if out is None:
+            self._count("memo.stage_cap.miss")
             ranks = rank_device_types(self.cluster, plan.node_sequence)
             vals = []
             for stage_id in range(plan.num_stages):
@@ -88,8 +116,68 @@ class StagePerformanceModel:
             out = tuple(vals)
             if len(self._cap_cache) > _MEMO_MAX:
                 self._cap_cache.clear()
+                self._count("memo.stage_cap.evict")
             self._cap_cache[key] = out
+        else:
+            self._count("memo.stage_cap.hit")
         return out
+
+    def _stage_structure(self, plan: InterStagePlan) -> tuple:
+        """Per-stage (is_homo, device types) of a placement — resolved once
+        per (node_sequence, device_groups), shared by every strategy set."""
+        key = (plan.node_sequence, plan.device_groups)
+        struct = self._struct_cache.get(key)
+        if struct is None:
+            self._count("memo.stage_struct.miss")
+            ranks = rank_device_types(self.cluster, plan.node_sequence)
+            entries = []
+            for stage_id in range(plan.num_stages):
+                start, end = plan.stage_rank_range(stage_id)
+                types = ranks[start:end]
+                entries.append((len(set(types)) == 1, types))
+            struct = tuple(entries)
+            if len(self._struct_cache) > _MEMO_MAX:
+                self._struct_cache.clear()
+                self._count("memo.stage_struct.evict")
+            self._struct_cache[key] = struct
+        else:
+            self._count("memo.stage_struct.hit")
+        return struct
+
+    def _total_time(self, key: tuple) -> float | _Miss:
+        try:
+            v: float | _Miss = self.profiles.get(*key).total_time_ms
+        except ProfileMissError as e:
+            v = _Miss((e.device_type, e.tp, e.bs))
+        if len(self._tt_cache) > _MEMO_MAX:
+            self._tt_cache.clear()
+            self._count("memo.stage_tt.evict")
+        self._tt_cache[key] = v
+        return v
+
+    def _mixed_raw(self, key: tuple) -> float | _Miss:
+        """Raw (pre-normalization) throughput of one heterogeneous stage —
+        the data-balancer split + power-of-two chunk walk of the uncached
+        path, verbatim.  Depends only on (types, dp, tp, mb_total)."""
+        types, dp, tp, mb_total = key
+        try:
+            split = self.data_balancer.partition(types, dp, tp, mb_total)
+            chunks = replica_chunks(types, dp)
+            times = []
+            for replica_id, h_bs in enumerate(split):
+                rep_type = chunks[replica_id][0]
+                times.append(sum(
+                    self.profiles.get(rep_type, tp, c).total_time_ms
+                    for c in power_of_two_chunks(h_bs)))
+            worst = max(times) if times else 0.0
+            v: float | _Miss = 1.0 / worst if worst else 0.0
+        except ProfileMissError as e:
+            v = _Miss((e.device_type, e.tp, e.bs))
+        if len(self._mixed_cache) > _MEMO_MAX:
+            self._mixed_cache.clear()
+            self._count("memo.stage_mixed.evict")
+        self._mixed_cache[key] = v
+        return v
 
     def compute_performance(
         self, plan: InterStagePlan, strategies: Sequence[Strategy]
@@ -100,37 +188,29 @@ class StagePerformanceModel:
         # count enters only through the microbatch total (two-step floor
         # division is exact for positive ints) — plans sharing it hit
         mb_total = plan.gbs // plan.batches
-        key = (plan.node_sequence, plan.device_groups, mb_total,
-               tuple((s.dp, s.tp, s.cp) for s in strategies))
-        cached = self._perf_cache.get(key)
-        if cached is not None:
-            return cached
-        ranks = rank_device_types(self.cluster, plan.node_sequence)
+        struct = self._stage_structure(plan)
+        tt = self._tt_cache
+        mixed = self._mixed_cache
         raw: list[float] = []
         for stage_id, strat in enumerate(strategies):
-            start, end = plan.stage_rank_range(stage_id)
-            types = ranks[start:end]
-            bs = mb_total // strat.dp
-            if len(set(types)) == 1:
+            homo, types = struct[stage_id]
+            if homo:
+                key = (types[0], strat.tp, mb_total // strat.dp)
+                v = tt.get(key)
+                if v is None:
+                    v = self._total_time(key)
+                if v.__class__ is _Miss:
+                    raise ProfileMissError(*v.args)
                 # Context parallelism shards the sequence: per-device compute
                 # scales ~1/cp (metis_tpu.cost.context_parallel docstring).
-                t = self.profiles.get(types[0], strat.tp, bs).total_time_ms / strat.cp
-                raw.append(1.0 / t)
+                raw.append(1.0 / (v / strat.cp))
             else:
-                split = self.data_balancer.partition(
-                    types, strat.dp, strat.tp, mb_total)
-                chunks = replica_chunks(types, strat.dp)
-                times = []
-                for replica_id, h_bs in enumerate(split):
-                    rep_type = chunks[replica_id][0]
-                    times.append(sum(
-                        self.profiles.get(rep_type, strat.tp, c).total_time_ms
-                        for c in power_of_two_chunks(h_bs)))
-                worst = max(times) if times else 0.0
-                raw.append(1.0 / worst if worst else 0.0)
+                key = (types, strat.dp, strat.tp, mb_total)
+                v = mixed.get(key)
+                if v is None:
+                    v = self._mixed_raw(key)
+                if v.__class__ is _Miss:
+                    raise ProfileMissError(*v.args)
+                raw.append(v)
         total = sum(raw)
-        out = tuple(r / total for r in raw) if total else tuple(raw)
-        if len(self._perf_cache) > _MEMO_MAX:
-            self._perf_cache.clear()
-        self._perf_cache[key] = out
-        return out
+        return tuple(r / total for r in raw) if total else tuple(raw)

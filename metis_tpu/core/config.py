@@ -143,6 +143,13 @@ class SearchConfig:
     # ``parallel_fallback`` event — when no start method is available or the
     # search inputs cannot be pickled.
     workers: int = 1
+    # Batched table-driven costing (cost/batch.BatchCostEstimator): the
+    # search drivers collect each inter plan's intra candidates and price
+    # them against precomputed stage-time/placement tables instead of
+    # walking the scalar estimator per candidate.  Bit-identical results by
+    # construction (the scalar path is the parity oracle —
+    # tools/check_search_regression.py); False forces the scalar loop.
+    use_batch_eval: bool = True
 
     def __post_init__(self) -> None:
         if self.gbs < 1:

@@ -104,6 +104,13 @@ class EstimatorOptions:
         return bw_gbps * (1024 * 1024 if self.strict_compat else 1e6)
 
 
+# Memo bounds (entries) for the PR-4 costing caches: wholesale clear beyond
+# these, so a long-lived daemon sweeping many clusters cannot grow them
+# unboundedly.  Evictions are visible as ``memo.*.evict`` counters.
+_BW_CACHE_MAX = 200_000
+_STAGE_MS_CACHE_MAX = 200_000
+
+
 def uniform_layer_split(total_layers: int, num_stages: int) -> list[int]:
     """Even layer counts per stage; first/last get +1 for embed/head
     (≅ ``model/utils.py:5-31``)."""
@@ -365,8 +372,10 @@ class HeteroCostEstimator(_EstimatorBase):
             self._bw_model = self.bandwidth_factory(plan)
             if self.counters is not None:
                 self.counters.inc("bw_model_built")
-            if len(self._bw_cache) > 200_000:
+            if len(self._bw_cache) > _BW_CACHE_MAX:
                 self._bw_cache.clear()
+                if self.counters is not None:
+                    self.counters.inc("memo.bw.evict")
         return self._bw_model
 
     def _cache_key(self, kind: str, stage_id: int, *rest):
@@ -465,11 +474,17 @@ class HeteroCostEstimator(_EstimatorBase):
                    plan.gbs // plan.batches, start, end)
         cached = self._stage_ms_cache.get(key)
         if cached is not None:
+            if self.counters is not None:
+                self.counters.inc("memo.stage_ms.hit")
             return cached
+        if self.counters is not None:
+            self.counters.inc("memo.stage_ms.miss")
         out = self._stage_execution_ms_uncached(
             plan, strategy, stage_types, start, end)
-        if len(self._stage_ms_cache) > 200_000:
+        if len(self._stage_ms_cache) > _STAGE_MS_CACHE_MAX:
             self._stage_ms_cache.clear()
+            if self.counters is not None:
+                self.counters.inc("memo.stage_ms.evict")
         self._stage_ms_cache[key] = out
         return out
 

@@ -51,21 +51,25 @@ def _load() -> ctypes.CDLL | None:
         return None
     fn = lib.metis_minmax_partition
     fn.restype = ctypes.c_int
+    # void-pointer signature: callers pass raw ndarray.ctypes.data addresses,
+    # skipping a ctypes.cast per argument in the search-hot wrapper below
     fn.argtypes = [
-        ctypes.POINTER(ctypes.c_double), ctypes.c_int,   # wprefix, L
-        ctypes.POINTER(ctypes.c_double), ctypes.c_int,   # perf, S
-        ctypes.POINTER(ctypes.c_double),                 # mem_prefix | NULL
-        ctypes.POINTER(ctypes.c_double),                 # cap | NULL
+        ctypes.c_void_p, ctypes.c_int,                   # wprefix, L
+        ctypes.c_void_p, ctypes.c_int,                   # perf, S
+        ctypes.c_void_p,                                 # mem_prefix | NULL
+        ctypes.c_void_p,                                 # cap | NULL
         ctypes.c_double, ctypes.c_double,                # base, coef
-        ctypes.POINTER(ctypes.c_int),                    # out_bounds
+        ctypes.c_void_p,                                 # out_bounds
     ]
     return lib
 
 
 _LIB = _load()
-_DP = ctypes.POINTER(ctypes.c_double)
-_IP = ctypes.POINTER(ctypes.c_int)
-_NULL_D = ctypes.cast(None, _DP)
+
+# Reusable out-bounds buffers keyed by stage count (search-hot: one DP call
+# per costed candidate; the planner is single-threaded per process, so the
+# buffer is never live across two concurrent calls).
+_OUT_BUFS: dict[int, ctypes.Array] = {}
 
 
 def native_available() -> bool:
@@ -90,18 +94,22 @@ def minmax_partition_native(
     L = len(wprefix) - 1
     perf = np.ascontiguousarray(performance, dtype=np.float64)
     S = len(perf)
-    out = (ctypes.c_int * (S + 1))()
+    out = _OUT_BUFS.get(S)
+    if out is None:
+        out = _OUT_BUFS.setdefault(S, (ctypes.c_int * (S + 1))())
     if mem_prefix is not None:
-        mp = np.ascontiguousarray(mem_prefix, dtype=np.float64) \
-            .ctypes.data_as(_DP)
-        cp = np.ascontiguousarray(capacity, dtype=np.float64) \
-            .ctypes.data_as(_DP)
+        # locals keep the (possibly copied) contiguous arrays alive
+        # until the call returns — .ctypes.data alone would not
+        mp_arr = np.ascontiguousarray(mem_prefix, dtype=np.float64)
+        cp_arr = np.ascontiguousarray(capacity, dtype=np.float64)
+        mp = mp_arr.ctypes.data
+        cp = cp_arr.ctypes.data
     else:
-        mp = cp = _NULL_D
+        mp = cp = None
     rc = _LIB.metis_minmax_partition(
-        wprefix.ctypes.data_as(_DP), L,
-        perf.ctypes.data_as(_DP), S,
-        mp, cp, base, coef, out)
+        wprefix.ctypes.data, L,
+        perf.ctypes.data, S,
+        mp, cp, base, coef, ctypes.addressof(out))
     if rc != 0:
         return None
     return tuple(out)

@@ -219,6 +219,33 @@ def plan_hetero(
         inter_iter = timed_iter(inter_iter, enum_acc)
         ctx.intra_acc = intra_acc
     ctx.cost_acc = cost_acc
+    # Admitted inters are buffered and priced through evaluate_batch —
+    # the batched table-driven costing path (cost/batch.py) when the
+    # config's family grid allows it, the per-candidate scalar loop
+    # otherwise.  With the bound/beam prunes active, admit() must see each
+    # candidate's recorded costs before judging the next, so the buffer
+    # degenerates to one inter — every mode stays byte-identical to the
+    # historical one-at-a-time loop (evaluate_batch handles
+    # begin_candidate/end_candidate; this driver keeps the pruned tally,
+    # the results list, and the heartbeat — a family-level miss does not
+    # tick, matching the historical accounting).
+    batch: list = []
+    bsize = 1 if pruner.active else 64
+
+    def _drain() -> None:
+        nonlocal best_ms, pruned
+        for _inter, batch_events in ctx.evaluate_batch(batch, pruner):
+            for kind, item in batch_events:
+                if kind == "plan":
+                    best_ms = min(best_ms, item.cost.total_ms)
+                    results.append(item)
+                    _tick()
+                else:
+                    pruned += 1
+                    if item:
+                        _tick()
+        batch.clear()
+
     for inter in inter_iter:
         if inter_filter is not None and not inter_filter(inter):
             pruned += 1
@@ -226,21 +253,11 @@ def plan_hetero(
             continue
         if not pruner.admit(inter):
             continue
-        pruner.begin_candidate()
-        # evaluate() applies pruner.record and the costed/profile-miss
-        # counters itself; this driver keeps the pruned tally, the results
-        # list, and the heartbeat (a family-level miss does not tick,
-        # matching the historical accounting)
-        for kind, item in ctx.evaluate(inter, pruner):
-            if kind == "plan":
-                best_ms = min(best_ms, item.cost.total_ms)
-                results.append(item)
-                _tick()
-            else:
-                pruned += 1
-                if item:
-                    _tick()
-        pruner.end_candidate(inter)
+        batch.append(inter)
+        if len(batch) >= bsize:
+            _drain()
+    if batch:
+        _drain()
 
     enum_acc.close()
     intra_acc.close()

@@ -18,7 +18,6 @@ tests/test_search_parity.py); the implementation is our own.
 """
 from __future__ import annotations
 
-from functools import lru_cache
 from itertools import chain
 from typing import Iterator, Sequence
 
@@ -124,12 +123,21 @@ def arrangements_of_composition(
         yield tuple(chain.from_iterable(perm))
 
 
+# Arrangement-space memo: explicit bounded dict (was an lru_cache) so the
+# hit/miss/evict traffic is observable through the flight recorder's
+# counters like every other PR-4 memo layer.  Wholesale clear past the
+# bound — the space count per key is small, the values are what's big.
+_MEMO_MAX = 4096
+_memo: dict[tuple, tuple[tuple[int, ...], ...]] = {}
+
+
 def enumerate_device_groups(
     num_stages: int,
     num_devices: int,
     variance: float = 1.0,
     max_permute_len: int = 6,
     shapes: Sequence[int] | None = None,
+    counters=None,
 ) -> Sequence[tuple[int, ...]]:
     """Every candidate per-stage device-count arrangement for a stage count.
 
@@ -141,13 +149,28 @@ def enumerate_device_groups(
     arguments, and both replanning (``planner/replan.replan_on_drift``) and
     the sharded parallel workers re-enumerate the identical space.  Callers
     receive a shared immutable tuple — iterate, don't mutate.
+
+    ``counters``: optional ``core.trace.Counters`` — bumps
+    ``memo.device_groups.{hit,miss,evict}``.
     """
-    return _enumerate_device_groups(
-        num_stages, num_devices, variance, max_permute_len,
-        None if shapes is None else tuple(shapes))
+    key = (num_stages, num_devices, variance, max_permute_len,
+           None if shapes is None else tuple(shapes))
+    cached = _memo.get(key)
+    if cached is not None:
+        if counters is not None:
+            counters.inc("memo.device_groups.hit")
+        return cached
+    if counters is not None:
+        counters.inc("memo.device_groups.miss")
+    out = _enumerate_device_groups(*key)
+    if len(_memo) > _MEMO_MAX:
+        _memo.clear()
+        if counters is not None:
+            counters.inc("memo.device_groups.evict")
+    _memo[key] = out
+    return out
 
 
-@lru_cache(maxsize=4096)
 def _enumerate_device_groups(
     num_stages: int,
     num_devices: int,

@@ -41,7 +41,8 @@ def inter_stage_plans(
     # Group arrangements don't depend on the node sequence — compute once per
     # stage count, not once per device-type permutation.
     groups_by_stage = {
-        n: enumerate_device_groups(n, num_devices, variance, max_permute_len)
+        n: enumerate_device_groups(n, num_devices, variance, max_permute_len,
+                                   counters=counters)
         for n in range(1, cap + 1)
     }
 

@@ -280,6 +280,18 @@ def escalate_dp_to_tp(
     return tuple(out)
 
 
+# Escalation-prefix memo for the base (cp=1, ep=1, zero=0, sp=False) family:
+# until the first non-RETRY verdict no partition has run, so memory_state is
+# None and the walk — classify, escalate on 1/dp pressure, repeat — is a pure
+# function of (device_groups, gbs, batches, max_tp, max_bs).  Thousands of
+# inter plans share the same few compositions, so the leading RETRY
+# iterations (mbs == 0 shapes) collapse to one dict hit.  The cached tuple
+# is exactly what the uncached walk would hold when it first leaves RETRY
+# (or None if it exhausts first), so downstream behavior is identical.
+_BASE_WALK_MEMO: dict[tuple, tuple[Strategy, ...] | None] = {}
+_BASE_WALK_MAX = 200_000
+
+
 def intra_stage_plans(
     plan: InterStagePlan,
     evaluator: StageEvaluator,
@@ -314,6 +326,20 @@ def intra_stage_plans(
         strategies = initial_strategies(plan, cp, cp_eligible, ep, zero, sp,
                                         cp_mode)
         memory_state: tuple[float, ...] | None = None
+        if cp == 1 and ep == 1 and zero == 0 and not sp:
+            # fast-forward the deterministic RETRY prefix (see _BASE_WALK_MEMO;
+            # cp_eligible and num_heads are no-ops at cp == 1)
+            wkey = (plan.device_groups, plan.gbs, plan.batches, max_tp, max_bs)
+            walked = _BASE_WALK_MEMO.get(wkey, _BASE_WALK_MEMO)
+            if walked is _BASE_WALK_MEMO:
+                walked = strategies
+                while walked is not None and classify_strategies(
+                        plan, walked, max_tp, max_bs) is RETRY:
+                    walked = escalate_dp_to_tp(walked, None)
+                if len(_BASE_WALK_MEMO) > _BASE_WALK_MAX:
+                    _BASE_WALK_MEMO.clear()
+                _BASE_WALK_MEMO[wkey] = walked
+            strategies = walked
 
         while strategies is not None:
             verdict = classify_strategies(plan, strategies, max_tp, max_bs,

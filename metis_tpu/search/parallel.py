@@ -56,6 +56,7 @@ from metis_tpu.core.trace import NULL_SPAN, Counters, Tracer, timed_iter
 from metis_tpu.core.types import RankedPlan
 from metis_tpu.balance.layers import LayerBalancer
 from metis_tpu.balance.stage_perf import StagePerformanceModel, rank_device_types
+from metis_tpu.cost.batch import BatchCostEstimator
 from metis_tpu.cost.context_parallel import cp_candidates
 from metis_tpu.cost.estimator import EstimatorOptions, HeteroCostEstimator
 from metis_tpu.cost.expert_parallel import ep_candidates
@@ -97,8 +98,10 @@ class CandidateEvaluator:
         self.estimator = HeteroCostEstimator(
             cluster, profiles, volume, options, bandwidth_factory,
             counters=counters)
-        self.evaluator = StagePerformanceModel(cluster, profiles)
-        self.balancer = LayerBalancer(cluster, profiles, config, model=model)
+        self.evaluator = StagePerformanceModel(cluster, profiles,
+                                               counters=counters)
+        self.balancer = LayerBalancer(cluster, profiles, config, model=model,
+                                      counters=counters)
         # GQA: the a2a head split must divide BOTH head counts — their gcd
         self.a2a_head_limit = math.gcd(
             model.num_heads, model.num_kv_heads or model.num_heads)
@@ -134,6 +137,18 @@ class CandidateEvaluator:
             for vs in config.virtual_stage_candidates:
                 sched_families.append(("interleaved", vs))
         self.sched_families = sched_families
+        # Batched table-driven costing (cost/batch.py) prices whole intra
+        # candidate lists per inter plan.  It takes over whenever the family
+        # grid is exactly the base (cp=1, ep=1, zero=0, sp=False, gpipe)
+        # family — the parity and scale workloads, and every strict_compat
+        # search; richer family grids keep the per-family scalar loop.
+        self._batch_fast = bool(
+            getattr(config, "use_batch_eval", True)
+            and not sched_families
+            and self.families == [((1, "ring"), 1, 0, False)])
+        self.batch_estimator = (
+            BatchCostEstimator(self.estimator, counters=counters)
+            if self._batch_fast else None)
         # serial-path tracing hooks: plan_hetero routes the intra generators
         # through its intra_stage accum span and costing through cost_acc;
         # workers leave them dark (no EventLog crosses the process boundary)
@@ -222,6 +237,73 @@ class CandidateEvaluator:
                 self._inc("pruned_profile_miss")
                 yield "miss", False
 
+    def evaluate_batch(self, inters, pruner):
+        """Price a buffered run of ADMITTED inter plans, batched.
+
+        Yields ``(inter, events)`` per input in order, where ``events`` is
+        the exact ``evaluate`` stream for that inter; ``begin_candidate``/
+        ``end_candidate`` are handled here (begin before generation, end
+        after the caller consumed the events — generator resumption
+        guarantees end(i) runs before begin(i+1), so pruner state evolves
+        exactly as in the one-at-a-time loop).  Drivers buffer ONE inter
+        when the bound/beam prunes are active — ``pruner.admit`` must see
+        each candidate's results before judging the next — and a real batch
+        otherwise.
+
+        The fast path collects each inter's intra candidates first (their
+        generation never consults costing or the pruner, so collect-then-
+        cost reorders nothing), prices them in one ``cost_many`` call, and
+        replays the event stream: per-candidate misses tick like the serial
+        loop, and a family-level miss lands last — exactly where generation
+        aborted.  An empty events list is a valid yield (admitted inter
+        with no candidates).
+        """
+        if not self._batch_fast:
+            for inter in inters:
+                pruner.begin_candidate()
+                yield inter, list(self.evaluate(inter, pruner))
+                pruner.end_candidate(inter)
+            return
+        config = self.config
+        for inter in inters:
+            pruner.begin_candidate()
+            intras = []
+            fam_miss = False
+            try:
+                intra_gen = intra_stage_plans(
+                    inter, self.evaluator, self.balancer,
+                    max_tp=config.max_profiled_tp,
+                    max_bs=config.max_profiled_bs,
+                    cp_degrees=(1,), cp_eligible=None,
+                    ep_degrees=(1,), zero_stages=(0,),
+                    sp_variants=(False,), cp_modes=("ring",),
+                    num_heads=self.a2a_head_limit,
+                )
+                if self.intra_acc is not None:
+                    intra_gen = timed_iter(intra_gen, self.intra_acc)
+                for intra in intra_gen:
+                    intras.append(intra)
+            except KeyError:
+                fam_miss = True
+            with self.cost_acc:
+                costs = self.batch_estimator.cost_many(inter, intras)
+            events = []
+            for intra, cost in zip(intras, costs):
+                if cost is None:
+                    self._inc("pruned_profile_miss")
+                    events.append(("miss", True))
+                else:
+                    pruner.record(cost.total_ms)
+                    self._inc("costed")
+                    events.append(
+                        ("plan", RankedPlan(inter=inter, intra=intra,
+                                            cost=cost)))
+            if fam_miss:
+                self._inc("pruned_profile_miss")
+                events.append(("miss", False))
+            yield inter, events
+            pruner.end_candidate(inter)
+
 
 def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
                  model, config, bandwidth_factory, inter_filter, top_k,
@@ -254,6 +336,41 @@ def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
             cluster.device_types, cluster.total_devices, config.gbs,
             model.num_layers, variance=config.min_group_scale_variance,
             max_permute_len=config.max_permute_len)
+        # With the bound/beam prunes active, admit() must see each
+        # candidate's recorded costs before judging the next — batching
+        # would admit with stale bounds and change the prune counters.
+        # Batch size 1 keeps every mode byte-identical to the serial loop.
+        batch: list[tuple[int, object]] = []
+        bsize = 1 if pruner.active else 64
+
+        def _drain():
+            nonlocal ticks, pruned, best_ms, next_emit
+            pos = 0
+            for _inter, events in ctx.evaluate_batch(
+                    [rec[1] for rec in batch], pruner):
+                idx = batch[pos][0]
+                pos += 1
+                seq = 0
+                for kind, item in events:
+                    if kind == "plan":
+                        if item.cost.total_ms < best_ms:
+                            best_ms = item.cost.total_ms
+                        plans.append((item.cost.total_ms, idx, seq, item))
+                        seq += 1
+                        ticks += 1
+                    else:
+                        pruned += 1
+                        if item:
+                            ticks += 1
+                    if ticks >= next_emit:
+                        next_emit = ticks + every
+                        elapsed = time.perf_counter() - t0
+                        out_queue.put((
+                            "progress", worker_id, ticks, elapsed,
+                            best_ms if best_ms != float("inf") else None,
+                            len(plans), pruned))
+            batch.clear()
+
         for idx, inter in enumerate(stream):
             if idx % num_workers != worker_id:
                 continue
@@ -266,27 +383,11 @@ def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
                 continue
             if not pruner.admit(inter):
                 continue
-            pruner.begin_candidate()
-            seq = 0
-            for kind, item in ctx.evaluate(inter, pruner):
-                if kind == "plan":
-                    if item.cost.total_ms < best_ms:
-                        best_ms = item.cost.total_ms
-                    plans.append((item.cost.total_ms, idx, seq, item))
-                    seq += 1
-                    ticks += 1
-                else:
-                    pruned += 1
-                    if item:
-                        ticks += 1
-                if ticks >= next_emit:
-                    next_emit = ticks + every
-                    elapsed = time.perf_counter() - t0
-                    out_queue.put((
-                        "progress", worker_id, ticks, elapsed,
-                        best_ms if best_ms != float("inf") else None,
-                        len(plans), pruned))
-            pruner.end_candidate(inter)
+            batch.append((idx, inter))
+            if len(batch) >= bsize:
+                _drain()
+        if batch:
+            _drain()
         num_costed = len(plans)
         # local sort by the global stable-tie-break key; with a top_k the
         # merged top-k is a subset of the union of local top-ks, so the

@@ -139,7 +139,21 @@ def test_fallback_on_unpicklable_inputs(workload, serial_result, tmp_path):
 
 def test_regression_gate_passes():
     """The CI gate (tools/check_search_regression.py) must hold: frozen
-    golden costed count, parallel byte-identity, grid-vs-oracle agreement."""
+    golden costed count, parallel byte-identity, batched-vs-scalar
+    byte-identity, grid-vs-oracle agreement."""
     from tools.check_search_regression import main
 
     assert main([]) == 0
+
+
+def test_throughput_gate_passes():
+    """The ``--throughput`` gate: batched whole-search plans/sec, normalized
+    by the scalar oracle's plans/sec on the same host, must stay within 20%
+    of the checked-in baseline (tools/search_throughput_baseline.json)."""
+    from tools.check_search_regression import (
+        THROUGHPUT_BASELINE,
+        run_throughput_check,
+    )
+
+    assert THROUGHPUT_BASELINE.exists(), "baseline json must be checked in"
+    assert run_throughput_check() == []

@@ -71,6 +71,138 @@ def test_inter_stage_plan_set_parity(ref):
     assert extra and all(len(groups) == 1 for (_, groups, _) in extra)
 
 
+# ---------------------------------------------------------------------------
+# Batched-vs-scalar costing parity (self-contained — no reference checkout):
+# the array-native primary path (cost/batch.py) against the scalar estimator
+# it demoted to parity oracle.  Property-style over two workloads and the
+# degenerate plan shapes most likely to break table indexing.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def hetero_eval(parity_fixture_dir):
+    """CandidateEvaluator on the hetero parity workload (8xA100 + 8xT4)."""
+    return _make_evaluator(parity_fixture_dir)
+
+
+@pytest.fixture(scope="module")
+def uniform_eval(tmp_path_factory):
+    """CandidateEvaluator on a single-type (uniform) 8-device workload."""
+    import json as _json
+
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+    d = tmp_path_factory.mktemp("uniform")
+    synthesize_profiles(
+        tiny_test_model(), ["A100"], tps=[1, 2, 4],
+        bss=[1, 2, 4, 8, 16]).dump_to_dir(d / "profiles")
+    (d / "hostfile").write_text("0.0.0.1 slots=4\n0.0.0.2 slots=4\n")
+    (d / "clusterfile.json").write_text(_json.dumps({
+        ip: {"instance_type": "A100", "inter_bandwidth": 10,
+             "intra_bandwidth": 46, "memory": 80}
+        for ip in ("0.0.0.1", "0.0.0.2")}))
+    return _make_evaluator(d)
+
+
+def _make_evaluator(fixture_dir):
+    from metis_tpu.cluster.spec import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.search.parallel import CandidateEvaluator
+
+    cluster = ClusterSpec.from_files(
+        fixture_dir / "hostfile", fixture_dir / "clusterfile.json")
+    store = ProfileStore.from_dir(fixture_dir / "profiles")
+    return CandidateEvaluator(
+        cluster, store, tiny_test_model(),
+        SearchConfig(gbs=128, strict_compat=True))
+
+
+def _candidate(node_sequence, device_groups, batches, strategies, partition):
+    from metis_tpu.core.types import InterStagePlan, IntraStagePlan, Strategy
+
+    inter = InterStagePlan(node_sequence=node_sequence,
+                           device_groups=device_groups,
+                           batches=batches, gbs=128)
+    intra = IntraStagePlan(
+        strategies=tuple(Strategy(dp=d, tp=t) for d, t in strategies),
+        layer_partition=tuple(partition),
+        memory_state=(), num_repartition=1)
+    return inter, intra
+
+
+_HETERO_SHAPES = [
+    # one stage spanning the whole (mixed-type) cluster
+    ("one_stage", (16,), 8, [(4, 4)], (0, 10)),
+    # tp = full node slice (tp == slots-per-node == 4)
+    ("tp_full_slice", (8, 8), 8, [(2, 4), (2, 4)], (0, 5, 10)),
+    # a single-layer first stage
+    ("one_layer_stage", (8, 8), 8, [(2, 4), (2, 4)], (0, 1, 10)),
+    # microbatch above the profiled range -> both paths report a miss
+    ("profile_miss", (16,), 1, [(4, 4)], (0, 10)),
+]
+
+_UNIFORM_SHAPES = [
+    ("one_stage", (8,), 8, [(2, 4)], (0, 10)),
+    ("tp_full_slice", (4, 4), 8, [(1, 4), (1, 4)], (0, 5, 10)),
+    ("one_layer_stage", (4, 4), 8, [(1, 4), (1, 4)], (0, 1, 10)),
+    ("profile_miss", (8,), 1, [(2, 4)], (0, 10)),
+]
+
+
+def _assert_batched_equals_scalar(ev, inter, intra):
+    [batched] = ev.batch_estimator.cost_many(inter, [intra])
+    try:
+        scalar = ev.estimator.get_cost(
+            inter, intra.strategies, intra.layer_partition,
+            schedule=intra.schedule, virtual_stages=intra.virtual_stages)
+    except KeyError:
+        scalar = None
+    # exact equality, not approx: the batched fast family is bit-identical
+    # by contract, and misses must replay at the same candidates
+    assert batched == scalar
+
+
+@pytest.mark.parametrize(
+    "shape", _HETERO_SHAPES, ids=[s[0] for s in _HETERO_SHAPES])
+def test_batched_equals_scalar_hetero(hetero_eval, shape):
+    _, groups, batches, strats, part = shape
+    inter, intra = _candidate(("A100", "T4"), groups, batches, strats, part)
+    _assert_batched_equals_scalar(hetero_eval, inter, intra)
+
+
+@pytest.mark.parametrize(
+    "shape", _UNIFORM_SHAPES, ids=[s[0] for s in _UNIFORM_SHAPES])
+def test_batched_equals_scalar_uniform(uniform_eval, shape):
+    _, groups, batches, strats, part = shape
+    inter, intra = _candidate(("A100",), groups, batches, strats, part)
+    _assert_batched_equals_scalar(uniform_eval, inter, intra)
+
+
+@pytest.mark.parametrize("eval_fixture", ["hetero_eval", "uniform_eval"])
+def test_grid_matches_scalar_oracle(eval_fixture, request):
+    """The rtol-1e-9 grid-vs-oracle agreement, promoted from the standalone
+    gate: every (device_type, tp, layer-range) on both workloads — including
+    the empty range start == end the double loop sweeps through."""
+    from tools.check_search_regression import _check_grid_oracle
+
+    ev = request.getfixturevalue(eval_fixture)
+    assert _check_grid_oracle(ev.cluster, ev.estimator.profiles) == []
+
+
+def test_empty_candidate_batch(hetero_eval):
+    """Empty batches are a no-op at both layers: ``cost_many`` returns []
+    without touching tables, ``evaluate_batch`` yields nothing."""
+    from metis_tpu.search.prune import SearchPruner
+
+    inter, _ = _candidate(("A100", "T4"), (16,), 8, [(4, 4)], (0, 10))
+    assert hetero_eval.batch_estimator.cost_many(inter, []) == []
+    pruner = SearchPruner(hetero_eval.config, hetero_eval.cluster,
+                          hetero_eval.estimator.profiles,
+                          hetero_eval.model)
+    assert list(hetero_eval.evaluate_batch([], pruner)) == []
+
+
 def test_uniform_plan_parity_exact_divisible_subset(ref):
     """Reference uniform plans admit ragged batch splits (gbs not divisible
     by dp*mbs — plan.py:84 truncates); ours require exact divisibility

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Search-regression gate: the parity workload, serial vs parallel.
 
-Three frozen invariants, any drift exits 1:
+Four frozen invariants, any drift exits 1:
 
 1. **Golden costed count.**  The serial search on the shared parity workload
    (metis_tpu.testing.write_parity_fixture: 8xA100 + 8xT4, 4/node, GPT-10L,
@@ -13,11 +13,21 @@ Three frozen invariants, any drift exits 1:
 2. **Parallel byte-identity.**  ``SearchConfig.workers=2`` must reproduce
    the serial ranking byte-for-byte (``dump_ranked_plans`` equality) and
    the same ``num_costed`` / ``num_pruned`` / ``num_bound_pruned``.
-3. **Vectorized-grid oracle.**  ``HeteroCostEstimator.stage_time_grid``
+3. **Batched-vs-scalar byte-identity.**  The array-native costing path
+   (``SearchConfig.use_batch_eval=True``, the default) must reproduce the
+   scalar estimator's ranking byte-for-byte — the scalar path is the parity
+   oracle the batched tables are demoted against.
+4. **Vectorized-grid oracle.**  ``HeteroCostEstimator.stage_time_grid``
    must agree with the scalar ``LayerProfile.time_slice`` path within
    rtol 1e-9 for every (device_type, tp, layer-range) of the fixture.
 
-Usage:  python tools/check_search_regression.py
+``--throughput`` adds a performance gate: the batched whole-search
+plan-throughput on the parity workload, NORMALIZED by the scalar path's
+throughput on the same run (so host-speed differences divide out), must be
+at least 80% of the checked-in baseline (tools/search_throughput_baseline
+.json, recorded with ``--update-baseline``).
+
+Usage:  python tools/check_search_regression.py [--throughput]
 Also importable: ``main(argv) -> int`` — the tier-1 test
 (tests/test_parallel_search.py) runs it in-process so regressions break
 the build, not the dashboards.
@@ -25,8 +35,10 @@ the build, not the dashboards.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import tempfile
+import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -35,6 +47,16 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 # ONLY when a deliberate search-space change lands, with the rationale in
 # the commit that changes it.
 GOLDEN_NUM_COSTED = 1764
+
+# Throughput baseline: batched + scalar plans/sec recorded on one host by
+# ``--update-baseline``; the check compares host-normalized numbers, so the
+# file does not need re-recording when CI hardware changes speed uniformly.
+THROUGHPUT_BASELINE = Path(__file__).resolve().parent / (
+    "search_throughput_baseline.json")
+
+# Fail when normalized batched throughput drops below this share of the
+# baseline (ISSUE: >20% regression on plans_per_sec fails the gate).
+THROUGHPUT_FLOOR = 0.8
 
 
 def _check_grid_oracle(cluster, store) -> list[str]:
@@ -113,16 +135,113 @@ def run_checks(workers: int = 2) -> list[str]:
                 problems.append(
                     f"workers={workers} {field} = {p}, serial = {s}")
 
+        scalar = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                         use_batch_eval=False))
+        if dump_ranked_plans(serial.plans) != dump_ranked_plans(
+                scalar.plans):
+            problems.append(
+                "batched ranking (use_batch_eval=True) is not byte-identical"
+                " to the scalar-oracle ranking (use_batch_eval=False)")
+        for field in ("num_costed", "num_pruned", "num_bound_pruned"):
+            s, p = getattr(scalar, field), getattr(serial, field)
+            if s != p:
+                problems.append(
+                    f"batched {field} = {p}, scalar oracle = {s}")
+
         problems.extend(_check_grid_oracle(cluster, store))
     return problems
+
+
+def measure_throughput(repeats: int = 3) -> dict:
+    """Best-of-``repeats`` whole-search plans/sec on the parity workload for
+    the batched (primary) and scalar (oracle) costing paths.  Best-of damps
+    scheduler noise; interleaving the two paths makes a load spike hit both."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import PARITY_GBS, write_parity_fixture
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        model = tiny_test_model()
+        # one untimed warm-up pair: imports, profile parsing, and the native
+        # kernel build land here, so cold and warm processes measure alike
+        for batched in (True, False):
+            plan_hetero(cluster, store, model,
+                        SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                                     use_batch_eval=batched))
+        best: dict[bool, float] = {}
+        for _ in range(repeats):
+            for batched in (True, False):
+                t0 = time.perf_counter()
+                res = plan_hetero(
+                    cluster, store, model,
+                    SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                                 use_batch_eval=batched))
+                pps = res.num_costed / (time.perf_counter() - t0)
+                if pps > best.get(batched, 0.0):
+                    best[batched] = pps
+    return {
+        "workload": "parity (8xA100+8xT4, GPT-10L, gbs=128, strict_compat)",
+        "plans": GOLDEN_NUM_COSTED,
+        "batched_plans_per_sec": round(best[True], 1),
+        "scalar_plans_per_sec": round(best[False], 1),
+    }
+
+
+def run_throughput_check() -> list[str]:
+    """The ``--throughput`` gate: normalized batched plans/sec vs baseline.
+
+    ``normalized = batched_now * (scalar_baseline / scalar_now)`` — the
+    scalar path is the per-host speed yardstick, so only a change in the
+    batched path RELATIVE to the scalar one can trip the gate."""
+    if not THROUGHPUT_BASELINE.exists():
+        return [f"throughput baseline missing: {THROUGHPUT_BASELINE} "
+                "(record one with --update-baseline)"]
+    base = json.loads(THROUGHPUT_BASELINE.read_text())
+    now = measure_throughput()
+    normalized = (now["batched_plans_per_sec"]
+                  * base["scalar_plans_per_sec"]
+                  / now["scalar_plans_per_sec"])
+    floor = THROUGHPUT_FLOOR * base["batched_plans_per_sec"]
+    print(f"throughput: batched {now['batched_plans_per_sec']:.0f} p/s, "
+          f"scalar {now['scalar_plans_per_sec']:.0f} p/s, normalized "
+          f"{normalized:.0f} vs baseline {base['batched_plans_per_sec']:.0f} "
+          f"(floor {floor:.0f})")
+    if normalized < floor:
+        return [
+            f"batched search throughput regressed: normalized "
+            f"{normalized:.0f} plans/sec < {THROUGHPUT_FLOOR:.0%} of the "
+            f"baseline {base['batched_plans_per_sec']:.0f} plans/sec"]
+    return []
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--workers", type=int, default=2,
                         help="worker count for the parallel leg")
+    parser.add_argument("--throughput", action="store_true",
+                        help="also gate batched plans/sec vs the checked-in "
+                             "baseline (host-normalized, 20%% floor)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="re-measure and overwrite "
+                             "tools/search_throughput_baseline.json")
     args = parser.parse_args(argv)
+    if args.update_baseline:
+        entry = measure_throughput()
+        THROUGHPUT_BASELINE.write_text(json.dumps(entry, indent=2) + "\n")
+        print(f"throughput baseline written: {entry}")
+        return 0
     problems = run_checks(workers=args.workers)
+    if args.throughput:
+        problems.extend(run_throughput_check())
     if problems:
         print(f"{len(problems)} problem(s):")
         for p in problems:
@@ -130,7 +249,7 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     print(f"search regression gate OK (golden num_costed = "
           f"{GOLDEN_NUM_COSTED}, workers={args.workers} byte-identical, "
-          f"time grid matches the scalar oracle)")
+          f"batched == scalar oracle, time grid matches)")
     return 0
 
 
