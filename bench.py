@@ -1067,6 +1067,39 @@ def resilience_bench(record: dict) -> None:
     record["resilience"] = entry
 
 
+def overlap_bench(record: dict) -> None:
+    """Communication overlap, measured not assumed: the same pipeline train
+    step built lockstep vs overlapped (double-buffered boundary ppermute +
+    chunked dp all-reduce, execution/pipeline.py), plus a bare ppermute
+    yardstick — cost.measure_pipeline_overlap.  Headline is
+    ``overlap_hidden_frac``; on a single-host CPU mesh the "transfer" is a
+    memcpy, so a noise_limited ~0 frac is the honest expected result —
+    the number earns its keep on real multi-chip meshes."""
+    import jax
+
+    from metis_tpu.cost import measure_pipeline_overlap
+
+    cpus = jax.devices("cpu")
+    if len(cpus) < 4:
+        record["overlap"] = {
+            "skipped_reason": f"needs >= 4 cpu devices, have {len(cpus)}"}
+        return
+    entry: dict = {}
+    for schedule in ("1f1b", "gpipe"):
+        entry[schedule] = measure_pipeline_overlap(
+            cpus[:4], pp=2, dp=2, microbatches=4, schedule=schedule,
+            iters=5, warmup=2)
+    # headline frac: the manual-backward schedule (chunked dp + both rings
+    # double-buffered) — the one the planner prices
+    entry["overlap_hidden_frac"] = entry["1f1b"]["overlap_hidden_frac"]
+    entry["noise_limited"] = entry["1f1b"]["noise_limited"]
+    if entry["1f1b"]["noise_limited"]:
+        entry["skipped_reason"] = (
+            "noise_limited: single-host CPU mesh — saving within run "
+            "jitter; frac not meaningful, recorded for plumbing only")
+    record["overlap"] = entry
+
+
 def tpu_validation(record: dict) -> None:
     """North-star error on REAL hardware: profile per-layer times on the TPU
     chip, plan a single-chip uniform schedule from those profiles, execute
@@ -1432,6 +1465,7 @@ def main() -> None:
     recorder.run("northstar", northstar, record)
     recorder.run("validation", validation_error, record)
     recorder.run("resilience", resilience_bench, record)
+    recorder.run("overlap", overlap_bench, record)
 
     # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
     # proves the tunnel was alive at bench start — it wedged MID-RUN once
@@ -1519,6 +1553,10 @@ def _headline(record: dict) -> dict:
         "resilience_ckpt_save_ms": (((record.get("resilience") or {})
                                      .get("checkpoint") or {})
                                     .get("save_ms")),
+        "overlap_hidden_frac": (record.get("overlap") or {})
+        .get("overlap_hidden_frac"),
+        "overlap_skipped": (record.get("overlap") or {})
+        .get("skipped_reason"),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),
         "tpu_step": _tpu_brief(record, "tpu_step"),

@@ -1,0 +1,55 @@
+"""Version-compat shims over the jax API surface.
+
+The executor and manual-collective ops are written against the modern
+spelling: ``jax.shard_map`` plus ``jax.lax.pcast(..., to='varying')``
+varying-manual-axes annotations.  Older jax releases (< 0.5) expose
+shard_map under ``jax.experimental.shard_map`` and have no ``pcast`` —
+there we disable the replication checker (``check_rep=False``), which is
+exactly the machinery the pcast annotations feed, so every annotation
+degrades to the identity.  Import ``shard_map`` / ``pcast`` from here
+instead of from jax directly.
+"""
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(name):
+        """Static mesh-axis size inside shard_map: ``psum(1, name)`` is the
+        classic spelling — special-cased to a Python int, no collective."""
+        return jax.lax.psum(1, name)
+
+if hasattr(jax, "typeof"):
+    def vma_of(x):
+        """The varying-manual-axes set of ``x``'s type (empty where the
+        concept does not exist)."""
+        return getattr(jax.typeof(x), "vma", frozenset())
+else:
+    def vma_of(x):
+        """Old jax has no varying-axes tracking; with check_rep=False the
+        annotations are no-ops, so the empty set is always right."""
+        del x
+        return frozenset()
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+    pcast = jax.lax.pcast
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *args, check_vma=False, **kwargs):
+        """``check_vma`` is the modern name of ``check_rep``; it is forced
+        off here — the pcast annotations that would discharge the check
+        are no-ops on this jax, so the old tracker cannot prove
+        replication for the manual-collective bodies."""
+        del check_vma
+        kwargs["check_rep"] = False
+        return _shard_map(f, *args, **kwargs)
+
+    def pcast(x, axes, to):
+        """No-op stand-in: with check_rep=False nothing consumes the
+        varying-axes annotation."""
+        del axes, to
+        return x

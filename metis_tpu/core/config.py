@@ -150,6 +150,16 @@ class SearchConfig:
     # construction (the scalar path is the parity oracle —
     # tools/check_search_regression.py); False forces the scalar loop.
     use_batch_eval: bool = True
+    # Overlap-aware comm pricing (cost/estimator.py): charge only the
+    # EXPOSED share of each collective — per pp boundary
+    # ``max(0, send - sender stage compute)`` (the executor double-buffers
+    # the ppermute under the next tick's compute) and per stage
+    # ``max(0, dp sync - optimizer)`` (the chunked gradient all-reduce
+    # overlaps the optimizer step).  The hidden remainder is reported in
+    # ``CostBreakdown.hidden``.  Inert under strict_compat (the reference
+    # prices every collective fully exposed); False restores the serial
+    # pricing in native mode too.
+    use_overlap_model: bool = True
 
     def __post_init__(self) -> None:
         if self.gbs < 1:

@@ -158,9 +158,15 @@ class PlanCost:
 
 # Canonical additive component order for a CostBreakdown: every key the
 # estimators emit, rendered in this order by ``metis-tpu explain``.
+# ``pp_comm``/``dp_comm`` are the serial (fully exposed) pricing;
+# ``pp_comm_exposed``/``dp_comm_exposed`` replace them when the overlap
+# model is on (SearchConfig.use_overlap_model) — only the exposed share
+# rides the additive total, the hidden remainder lives in
+# ``CostBreakdown.hidden``.
 COST_COMPONENTS = (
     "compute", "imbalance", "cp_comm", "ep_comm", "step_overhead",
-    "pp_comm", "dp_comm", "fb_sync", "optimizer", "batch_gen",
+    "pp_comm", "pp_comm_exposed", "dp_comm", "dp_comm_exposed",
+    "fb_sync", "optimizer", "batch_gen",
 )
 
 
@@ -183,6 +189,11 @@ class CostBreakdown:
     schedule charged them — leveled for uneven 1f1b), the cp+ep comm share,
     the gradient-sync and optimizer candidates (the cost model takes the max
     over stages for those two).
+
+    ``hidden`` (overlap model only) records the comm milliseconds the
+    estimator priced as overlapped with compute — NOT part of the additive
+    ``components`` sum; ``hidden["pp_comm"] + components["pp_comm_exposed"]``
+    is the full serial pp send cost (likewise dp).
     """
 
     total_ms: float
@@ -192,6 +203,7 @@ class CostBreakdown:
     stage_dp_comm_ms: tuple[float, ...] = ()
     stage_optimizer_ms: tuple[float, ...] = ()
     schedule: str = "gpipe"
+    hidden: dict[str, float] = field(default_factory=dict)
 
     @property
     def component_sum_ms(self) -> float:
@@ -213,7 +225,7 @@ class CostBreakdown:
         return name, d[name]
 
     def to_json_dict(self) -> dict:
-        return {
+        d = {
             "total_ms": self.total_ms,
             "components": dict(self.components),
             "stage_execution_ms": list(self.stage_execution_ms),
@@ -222,6 +234,9 @@ class CostBreakdown:
             "stage_optimizer_ms": list(self.stage_optimizer_ms),
             "schedule": self.schedule,
         }
+        if self.hidden:
+            d["hidden"] = dict(self.hidden)
+        return d
 
     @staticmethod
     def from_json_dict(d: dict) -> "CostBreakdown":
@@ -233,6 +248,7 @@ class CostBreakdown:
             stage_dp_comm_ms=tuple(d.get("stage_dp_comm_ms", ())),
             stage_optimizer_ms=tuple(d.get("stage_optimizer_ms", ())),
             schedule=d.get("schedule", "gpipe"),
+            hidden=dict(d.get("hidden", {})),
         )
 
 

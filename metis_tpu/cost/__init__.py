@@ -19,6 +19,7 @@ from metis_tpu.cost.calibration import (
     fit_ledger_correction,
     fit_samples,
     measure_dp_overlap,
+    measure_pipeline_overlap,
     microbenchmark_collectives,
     microbenchmark_chip,
 )
@@ -46,6 +47,7 @@ __all__ = [
     "fit_ledger_correction",
     "fit_samples",
     "measure_dp_overlap",
+    "measure_pipeline_overlap",
     "microbenchmark_collectives",
     "microbenchmark_chip",
     "EstimatorOptions",

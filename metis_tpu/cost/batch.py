@@ -86,6 +86,7 @@ class BatchCostEstimator:
         self._L = scalar.volume.num_layers
         # hoisted invariants of the per-stage assembly
         self._share = scalar.options.dp_exposed_share
+        self._overlap = scalar.options.overlap_active
         self._so = scalar._step_overhead
         self._bg_per = scalar.profiles.model.batch_generator_ms
         # cross-placement memos
@@ -148,10 +149,11 @@ class BatchCostEstimator:
         pmap = self._pmap
         omap = self._omap
         share = self._share
+        ov = self._overlap
         L = self._L
         sum_l = 0.0
-        max_l = max_opt = max_dp = None
-        pp_cost = 0.0
+        max_l = max_opt = max_dp = max_dpe = None
+        pp_cost = pp_exposed = 0.0
         fb_sync = 0.0
         for s in range(S):
             strat = strategies[s]
@@ -193,7 +195,14 @@ class BatchCostEstimator:
                 if strat.sp:
                     # the scalar divides by cp (==1 here, exact) then tp
                     act = act / tp
-                pp_cost += act / pp_den[s]
+                t_pp = act / pp_den[s]
+                pp_cost += t_pp
+                if ov:
+                    # overlap model: the same floats, same max(0, send -
+                    # sender compute) as the scalar path (gpipe send
+                    # factor is 1.0 so the post-loop scaling is skipped
+                    # exactly, like pp_cost itself)
+                    pp_exposed += max(0.0, t_pp - stage_ms)
             # the ring factor is tp-independent (dp_bandwidth never reads tp)
             dkey = (s, dp)
             q = dpfac.get(dkey)
@@ -223,6 +232,12 @@ class BatchCostEstimator:
             opt = o * (end - start) / L
             if max_opt is None or opt > max_opt:
                 max_opt = opt
+            if ov:
+                # chunked dp sync hides under the optimizer: same dpv/opt
+                # floats as the scalar, so the exposed max is bit-identical
+                dpe = max(0.0, dpv - opt)
+                if max_dpe is None or dpe > max_dpe:
+                    max_dpe = dpe
 
         # gpipe fill-drain (cost/schedule.py) inlined; pp send factor is 1.0
         # and the cp/ep comm delta is exactly 0.0 in this family
@@ -249,14 +264,17 @@ class BatchCostEstimator:
             batch_gen = self._bg_per * batches
         else:
             batch_gen = P.batch_gen
-        total = (execution + fb_sync + max_opt + max_dp + pp_cost + batch_gen)
+        dp_charge = max_dpe if ov else max_dp
+        pp_charge = pp_exposed if ov else pp_cost
+        total = (execution + fb_sync + max_opt + dp_charge + pp_charge
+                 + batch_gen)
         return PlanCost(
             total_ms=total,
             execution_ms=execution,
             fb_sync_ms=fb_sync,
             optimizer_ms=max_opt,
-            dp_comm_ms=max_dp,
-            pp_comm_ms=pp_cost,
+            dp_comm_ms=dp_charge,
+            pp_comm_ms=pp_charge,
             batch_gen_ms=batch_gen,
             cp_comm_ms=0.0,
             ep_comm_ms=0.0,

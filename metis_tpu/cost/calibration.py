@@ -231,6 +231,8 @@ def microbenchmark_collectives(
 
     import jax
     import jax.numpy as jnp
+
+    from metis_tpu.core.compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = list(devices if devices is not None else jax.devices())
@@ -256,7 +258,7 @@ def microbenchmark_collectives(
             # out_specs are P("x", None) for every collective: all_gather's
             # per-device copy is emitted as a varying value (global shape
             # n*rows) rather than asking shard_map to prove replication.
-            shard = jax.shard_map(
+            shard = shard_map(
                 fn, mesh=mesh, in_specs=P("x", None), out_specs=P("x", None))
             jitted = jax.jit(shard)
             try:
@@ -370,6 +372,8 @@ def measure_dp_overlap(
 
     import jax
     import jax.numpy as jnp
+
+    from metis_tpu.core.compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = list(devices if devices is not None else jax.devices())
@@ -396,7 +400,7 @@ def measure_dp_overlap(
             # rank-1 output so the dp-varying value concatenates over "dp"
             return (loss + sum(jnp.sum(g) for g in grads) * 1e-9)[None]
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             local, mesh=mesh, in_specs=(P(), P("dp", None)),
             out_specs=P("dp")))
 
@@ -410,7 +414,7 @@ def measure_dp_overlap(
             np.ones((n * max(grad_bytes // 4 // hidden, 1), hidden),
                     np.float32),
             NamedSharding(mesh, P("dp", None)))
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda b: jax.lax.psum(b, "dp"), mesh=mesh,
             in_specs=P("dp", None), out_specs=P("dp", None)))
         return fn, buf
@@ -466,6 +470,141 @@ def measure_dp_overlap(
         "noise_limited": noise_limited,
         "overlap_fraction": round(min(max(overlap, 0.0), 1.0), 4),
     }
+
+
+def measure_pipeline_overlap(
+    devices: Sequence | None = None,
+    pp: int = 2,
+    dp: int = 2,
+    microbatches: int = 4,
+    hidden: int = 64,
+    blocks: int = 4,
+    seq: int = 32,
+    vocab: int = 256,
+    schedule: str = "1f1b",
+    iters: int = 5,
+    warmup: int = 2,
+    events=None,
+) -> dict:
+    """Measure what the overlap schedule actually buys on THIS backend:
+    the SAME pipeline train step built lockstep vs overlapped
+    (``execution.pipeline.make_pipeline_train_step(overlap=...)``), plus a
+    bare ppermute ring of the boundary activation as the comm yardstick —
+
+        saved_ms            = lockstep_ms - overlapped_ms
+        overlap_hidden_frac = clamp(saved_ms / bare_comm_ms, 0, 1)
+
+    the measured analogue of the cost model's exposed-vs-hidden split
+    (``SearchConfig.use_overlap_model``).  Emits one ``overlap_measured``
+    event.  Same noise discipline as :func:`measure_dp_overlap`: when the
+    saving doesn't stand above the run-to-run spread the result is flagged
+    ``noise_limited`` — single-host CPU meshes route the "transfer"
+    through memcpy, so a near-zero (even negative-before-clamp) saving
+    there is expected, not a failed measurement."""
+    import statistics
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from jax.sharding import Mesh
+
+    from metis_tpu.core.compat import shard_map
+    from metis_tpu.core.events import NULL_LOG
+    from metis_tpu.execution import (
+        DP, PP, TP, make_pipeline_train_step, microbatch_split)
+    from metis_tpu.models import GPTConfig
+
+    events = events if events is not None else NULL_LOG
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < pp * dp:
+        raise ValueError(
+            f"pipeline overlap calibration needs >= {pp * dp} devices, "
+            f"have {len(devs)}")
+    mesh = Mesh(np.array(devs[: pp * dp]).reshape(pp, dp, 1), (PP, DP, TP))
+    cfg = GPTConfig(vocab_size=vocab, seq_len=seq, hidden=hidden,
+                    num_heads=max(hidden // 16, 1), num_blocks=blocks,
+                    ffn_multiplier=2, dtype=jnp.float32)
+    batch = microbatches * dp
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (batch, seq), 0, vocab)
+    tok_mbs = microbatch_split(tokens, microbatches)
+
+    def timed(fn, *args) -> tuple[float, float]:
+        jax.block_until_ready(fn(*args))
+        for _ in range(warmup - 1):
+            jax.block_until_ready(fn(*args))
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            samples.append((time.perf_counter() - t0) * 1e3)
+        srt = sorted(samples)
+        return (statistics.median(samples),
+                srt[(3 * len(srt)) // 4] - srt[len(srt) // 4])
+
+    def step_ms(overlap: bool) -> tuple[float, float]:
+        init_fn, step = make_pipeline_train_step(
+            cfg, mesh, microbatches, schedule=schedule, overlap=overlap)
+        state = list(init_fn(jax.random.PRNGKey(1)))
+
+        def run():
+            # the step donates params/opt_state — re-thread them each call
+            state[0], state[1], loss = step(state[0], state[1],
+                                            tok_mbs, tok_mbs)
+            return loss
+
+        return timed(run)
+
+    lockstep_ms, lockstep_iqr = step_ms(False)
+    overlapped_ms, overlapped_iqr = step_ms(True)
+
+    # comm yardstick: the boundary activation around the pp ring for every
+    # tick's forward+backward send (what the schedule tries to hide)
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    ticks = microbatches + pp - 1
+
+    def bare(buf):
+        def body(b, _):
+            return jax.lax.ppermute(b, PP, perm), None
+        out, _ = jax.lax.scan(body, buf, None, length=2 * ticks)
+        return out
+
+    mbs_local = batch // microbatches // dp
+    buf = jnp.ones((pp * mbs_local, seq, hidden), jnp.float32)
+    from jax.sharding import PartitionSpec as P
+    bare_fn = jax.jit(shard_map(
+        bare, mesh=mesh, in_specs=P(PP), out_specs=P(PP)))
+    bare_ms, _ = timed(bare_fn, buf)
+
+    saved_ms = lockstep_ms - overlapped_ms
+    frac = saved_ms / bare_ms if bare_ms > 0 else 0.0
+    frac = min(max(frac, 0.0), 1.0)
+    noise_ms = max(lockstep_iqr, overlapped_iqr)
+    noise_limited = bool(noise_ms > 0.0 and abs(saved_ms) <= noise_ms)
+    dev0 = devs[0]
+    out = {
+        "platform": dev0.platform,
+        "device_kind": getattr(dev0, "device_kind", dev0.platform),
+        "pp": pp,
+        "dp": dp,
+        "microbatches": microbatches,
+        "schedule": schedule,
+        "lockstep_ms": round(lockstep_ms, 4),
+        "overlapped_ms": round(overlapped_ms, 4),
+        "lockstep_iqr_ms": round(lockstep_iqr, 4),
+        "overlapped_iqr_ms": round(overlapped_iqr, 4),
+        "bare_comm_ms": round(bare_ms, 4),
+        "saved_ms": round(saved_ms, 4),
+        "noise_limited": noise_limited,
+        "overlap_hidden_frac": round(frac, 4),
+    }
+    events.emit("overlap_measured", lockstep_ms=out["lockstep_ms"],
+                overlapped_ms=out["overlapped_ms"],
+                overlap_hidden_frac=out["overlap_hidden_frac"],
+                noise_limited=noise_limited, schedule=schedule)
+    return out
 
 
 # ---------------------------------------------------------------------------

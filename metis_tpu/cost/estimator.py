@@ -74,6 +74,14 @@ class EstimatorOptions:
     # measured fwd share of a fwd+bwd stage time for remat-schedule pricing
     # (cost/schedule.schedule_execution_ms); None = analytic default
     remat_fwd_fraction: float | None = None
+    # Overlap-aware comm pricing (SearchConfig.use_overlap_model): charge
+    # only the exposed share of each collective — per pp boundary
+    # ``max(0, send - sender stage compute)``, per stage
+    # ``max(0, dp sync - optimizer)`` — matching the executor's
+    # double-buffered ppermute and chunked gradient all-reduce
+    # (execution/pipeline.py).  Never active under strict_compat: the
+    # reference prices every collective fully exposed.
+    use_overlap_model: bool = True
     # Native mode: affine-smooth the profile's bs axis and charge the fitted
     # per-program fixed cost once per step instead of once per microbatch
     # (ProfileStore.affine_view — the executors scan microbatches inside one
@@ -89,7 +97,13 @@ class EstimatorOptions:
             max_profiled_bs=cfg.max_profiled_bs,
             dp_overlap_fraction=cfg.dp_overlap_fraction,
             remat_fwd_fraction=cfg.remat_fwd_fraction,
+            use_overlap_model=cfg.use_overlap_model,
         )
+
+    @property
+    def overlap_active(self) -> bool:
+        """Whether the exposed-vs-hidden comm split applies."""
+        return self.use_overlap_model and not self.strict_compat
 
     @property
     def dp_exposed_share(self) -> float:
@@ -229,14 +243,21 @@ def _assemble_breakdown(
     actual = schedule_execution_ms(
         schedule, lens_nocomm, batches, virtual_stages,
         remat_fraction=remat_fraction)
+    # Overlap model: the PlanCost comm fields carry the EXPOSED (charged)
+    # values, so the additive component keys switch to *_exposed and the
+    # hidden remainder rides the side-channel ``hidden`` dict.
+    hidden = detail.get("overlap_hidden")
+    pp_key, dp_key = (
+        ("pp_comm_exposed", "dp_comm_exposed") if hidden is not None
+        else ("pp_comm", "dp_comm"))
     components = {
         "compute": balanced,
         "imbalance": actual - balanced,
         "cp_comm": cost.cp_comm_ms,
         "ep_comm": cost.ep_comm_ms,
         "step_overhead": detail["overhead_ms"],
-        "pp_comm": cost.pp_comm_ms,
-        "dp_comm": cost.dp_comm_ms,
+        pp_key: cost.pp_comm_ms,
+        dp_key: cost.dp_comm_ms,
         "fb_sync": cost.fb_sync_ms,
         "optimizer": cost.optimizer_ms,
         "batch_gen": cost.batch_gen_ms,
@@ -249,6 +270,7 @@ def _assemble_breakdown(
         stage_dp_comm_ms=detail.get("dp_costs", ()),
         stage_optimizer_ms=detail.get("opt_costs", ()),
         schedule=schedule,
+        hidden=dict(hidden) if hidden else {},
     )
 
 
@@ -279,10 +301,11 @@ class UniformCostEstimator(_EstimatorBase):
         params = self.volume.parameter_bytes_per_layer(plan.tp)
         num_mbs = plan.gbs // plan.mbs // plan.dp
 
+        overlap = self.options.overlap_active
         lens: list[float] = []
         stage_params: list[float] = []
         stage_memory: list[float] = []
-        fb_sync = pp_cost = 0.0
+        fb_sync = pp_cost = pp_exposed = 0.0
         for s in range(plan.pp):
             start = sum(counts[:s])
             end = start + counts[s]
@@ -293,8 +316,13 @@ class UniformCostEstimator(_EstimatorBase):
                 fb_sync = self._fb_sync_ms([device_type], plan.tp, plan.mbs) * num_mbs
             else:
                 bw = self.bandwidth.pp_bandwidth(plan.pp, plan.tp, s)
-                pp_cost += self._pp_cost_ms(
+                t_pp = self._pp_cost_ms(
                     self._activation(end, plan.mbs, plan.tp), bw)
+                pp_cost += t_pp
+                if overlap:
+                    # double-buffered send: only what outlasts the sender
+                    # stage's per-microbatch compute stays exposed
+                    pp_exposed += max(0.0, t_pp - lens[-1])
 
         # Per-device capacity of the profiled type (the reference reads node
         # 0's memory regardless of the device type being costed,
@@ -313,17 +341,32 @@ class UniformCostEstimator(_EstimatorBase):
             plan.dp) * self.options.dp_exposed_share
         batch_gen = self._batch_gen_ms(num_mbs, device_type)
 
+        # Overlap model: the chunked gradient all-reduce hides under the
+        # optimizer step, the double-buffered send under stage compute —
+        # PlanCost charges the exposed remainders (additivity preserved).
+        if overlap:
+            dp_charge = max(0.0, dp_cost - optimizer)
+            pp_charge = pp_exposed
+        else:
+            dp_charge = dp_cost
+            pp_charge = pp_cost
+
         if _detail is not None:
             _detail.update(
                 sched_lens=tuple(lens), lens_nocomm=tuple(lens),
                 comm_by_stage=(0.0,) * plan.pp, overhead_ms=overhead)
+            if overlap:
+                _detail["overlap_hidden"] = {
+                    "pp_comm": pp_cost - pp_charge,
+                    "dp_comm": dp_cost - dp_charge,
+                }
         return PlanCost(
-            total_ms=execution + fb_sync + optimizer + dp_cost + pp_cost + batch_gen,
+            total_ms=execution + fb_sync + optimizer + dp_charge + pp_charge + batch_gen,
             execution_ms=execution,
             fb_sync_ms=fb_sync,
             optimizer_ms=optimizer,
-            dp_comm_ms=dp_cost,
-            pp_comm_ms=pp_cost,
+            dp_comm_ms=dp_charge,
+            pp_comm_ms=pp_charge,
             batch_gen_ms=batch_gen,
             oom=oom,
         )
@@ -584,12 +627,14 @@ class HeteroCostEstimator(_EstimatorBase):
         bandwidth = self._bandwidth_for(plan)
         L = self.volume.num_layers
 
+        overlap = self.options.overlap_active
         lens: list[float] = []
         comm_by_stage: list[float] = []  # cp + ep, for breakdown reconcile
         cp_total = a2a_total = 0.0
         dp_costs: list[float] = []
+        dp_exposed_costs: list[float] = []  # overlap model: max(0, dp - opt)
         opt_costs: list[float] = []
-        fb_sync = pp_cost = 0.0
+        fb_sync = pp_cost = pp_exposed = 0.0
         for stage_id, strat in enumerate(strategies):
             start_l, end_l = layer_partition[stage_id], layer_partition[stage_id + 1]
             r0, r1 = plan.stage_rank_range(stage_id)
@@ -597,6 +642,10 @@ class HeteroCostEstimator(_EstimatorBase):
 
             stage_ms = self._stage_execution_ms(
                 plan, strat, stage_types, start_l, end_l)
+            # overlap window for the double-buffered boundary send: the
+            # sender's compute-only per-microbatch time (cp/ep comm extends
+            # the critical path and cannot hide another collective)
+            compute_window = stage_ms
             mbs = plan.gbs // strat.dp // plan.batches
             cp_bw = None
             cp_ms = a2a_ms = 0.0
@@ -633,9 +682,12 @@ class HeteroCostEstimator(_EstimatorBase):
                 # additionally sequence-shards it over the tp group, so each
                 # rank's p2p volume divides by tp too.
                 sp_div = strat.tp if strat.sp else 1
-                pp_cost += self._pp_cost_ms(
+                t_pp = self._pp_cost_ms(
                     self._activation(end_l, mbs, strat.tp) / strat.cp / sp_div,
                     self._pp_bw(bandwidth, stage_id))
+                pp_cost += t_pp
+                if overlap:
+                    pp_exposed += max(0.0, t_pp - compute_window)
 
             stage_params = self.volume.stage_parameter_bytes(strat.tp, start_l, end_l)
             # Weights are replicated across cp (ring attention shards only the
@@ -687,6 +739,12 @@ class HeteroCostEstimator(_EstimatorBase):
             opt_costs.append(
                 self._optimizer_ms(opt_type) / strat.tp / opt_shard
                 * (end_l - start_l) / L)
+            if overlap:
+                # chunked gradient all-reduce overlaps the optimizer step:
+                # only what outlasts this stage's optimizer stays exposed
+                # (the latency floors inside dp_costs are charged within it)
+                dp_exposed_costs.append(
+                    max(0.0, dp_costs[-1] - opt_costs[-1]))
 
         # the schedule is a plan axis (cost/schedule.py): gpipe reproduces
         # the reference fill-drain verbatim; 1f1b adds the remat factor;
@@ -705,8 +763,11 @@ class HeteroCostEstimator(_EstimatorBase):
         execution = schedule_execution_ms(
             schedule, sched_lens, plan.batches, virtual_stages,
             remat_fraction=self.options.remat_fwd_fraction)
-        pp_cost *= schedule_pp_send_factor(
+        send_factor = schedule_pp_send_factor(
             schedule, plan.num_stages, virtual_stages)
+        pp_cost *= send_factor
+        if overlap:
+            pp_exposed *= send_factor
         # cp_comm_ms / ep_comm_ms report exactly the cp (ring or a2a) /
         # MoE all-to-all traffic's contribution to the schedule's execution
         # total (the with-comm minus without-comm delta, split pro rata), so
@@ -745,6 +806,17 @@ class HeteroCostEstimator(_EstimatorBase):
         first_stage_type = ranks[0] if ranks else None
         batch_gen = self._batch_gen_ms(plan.batches, first_stage_type)
 
+        # Overlap model: charge only the exposed remainders — the per-stage
+        # max of the dp sync that outlasts its optimizer, and the boundary
+        # sends that outlast their sender's compute.  PlanCost stays
+        # additive; the hidden share is reported through ``_detail``.
+        if overlap:
+            dp_charge = max(dp_exposed_costs)
+            pp_charge = pp_exposed
+        else:
+            dp_charge = max(dp_costs)
+            pp_charge = pp_cost
+
         if _detail is not None:
             # explainability dump (get_breakdown): the exact intermediates
             # the total was assembled from, so the component decomposition
@@ -753,18 +825,23 @@ class HeteroCostEstimator(_EstimatorBase):
                 sched_lens=tuple(sched_lens),
                 lens_nocomm=tuple(lens_nocomm),
                 comm_by_stage=tuple(comm_by_stage),
-                dp_costs=tuple(dp_costs),
+                dp_costs=tuple(dp_exposed_costs if overlap else dp_costs),
                 opt_costs=tuple(opt_costs),
                 overhead_ms=overhead_term)
+            if overlap:
+                _detail["overlap_hidden"] = {
+                    "pp_comm": pp_cost - pp_charge,
+                    "dp_comm": max(dp_costs) - dp_charge,
+                }
 
         return PlanCost(
-            total_ms=(execution + fb_sync + max(opt_costs) + max(dp_costs)
-                      + pp_cost + batch_gen),
+            total_ms=(execution + fb_sync + max(opt_costs) + dp_charge
+                      + pp_charge + batch_gen),
             execution_ms=execution,
             fb_sync_ms=fb_sync,
             optimizer_ms=max(opt_costs),
-            dp_comm_ms=max(dp_costs),
-            pp_comm_ms=pp_cost,
+            dp_comm_ms=dp_charge,
+            pp_comm_ms=pp_charge,
             batch_gen_ms=batch_gen,
             cp_comm_ms=cp_cost,
             ep_comm_ms=ep_cost,

@@ -207,6 +207,7 @@ def build_executable(
     schedule: str | None = None,
     virtual_stages: int | None = None,
     events=None,
+    overlap: bool = True,
 ) -> Executable:
     """Route ``artifact`` to the execution path that realizes it.
 
@@ -226,7 +227,12 @@ def build_executable(
 
     ``events`` (optional ``core.events.EventLog``): forwarded to the
     pipeline route for build/first-step-compile phase spans via the flight
-    recorder (``execution/pipeline.py``)."""
+    recorder (``execution/pipeline.py``).
+
+    ``overlap`` (default on, pipeline route only): the communication-
+    overlap schedule — double-buffered boundary ppermute + chunked dp
+    gradient all-reduce; gradients identical to lockstep
+    (``execution/pipeline.py``).  False forces the lockstep schedule."""
     schedule, virtual_stages = resolve_schedule(
         artifact, schedule, virtual_stages)
     if schedule not in ("gpipe", "1f1b", "interleaved"):
@@ -260,13 +266,13 @@ def build_executable(
         if _uniform_block_split(artifact, cfg, pp):
             return _pipeline_executable(
                 cfg, artifact, s0, pp, devices, optimizer,
-                schedule, virtual_stages, events=events)
+                schedule, virtual_stages, events=events, overlap=overlap)
         counts = _uneven_1f1b_split(artifact, cfg, pp, schedule)
         if counts is not None:
             return _pipeline_executable(
                 cfg, artifact, s0, pp, devices, optimizer,
                 schedule, virtual_stages, block_counts=counts,
-                events=events)
+                events=events, overlap=overlap)
 
     return _hetero_executable(
         cfg, artifact, strategies, devices, optimizer, cluster, profiles)
@@ -295,7 +301,7 @@ def _gspmd_executable(cfg, artifact, s0, devices, optimizer) -> Executable:
 def _pipeline_executable(cfg, artifact, s0, pp, devices,
                          optimizer, schedule="gpipe",
                          virtual_stages=2, block_counts=None,
-                         events=None) -> Executable:
+                         events=None, overlap=True) -> Executable:
     import numpy as np
     from jax.sharding import Mesh
 
@@ -311,7 +317,8 @@ def _pipeline_executable(cfg, artifact, s0, pp, devices,
         cfg, mesh, artifact.microbatches, optimizer=optimizer,
         schedule=schedule, virtual_stages=virtual_stages,
         block_counts=block_counts,
-        events=events if events is not None else NULL_LOG)
+        events=events if events is not None else NULL_LOG,
+        overlap=overlap)
 
     def init(key):
         return init_fn(key)

@@ -10,6 +10,21 @@ GPipe fill-drain the planner's cost model prices as
 ``(M - 1) * max_stage + sum(stages)`` (``cost/estimator.py``), closing the
 predicted-vs-executed loop.
 
+**Communication overlap** (``overlap=True``, the default): the gpipe/1f1b
+tick bodies are double-buffered — the scan carry holds the previous tick's
+UNPERMUTED boundary send and its ``ppermute`` is issued at the TOP of the
+next tick's body, where it has no data dependency on that tick's embed (or,
+for the 1f1b cotangent ring, the whole forward slot), so XLA's async
+collective scheduler can run the transfer under compute.  The manual-
+backward schedules additionally chunk the dp gradient all-reduce
+(``execution.train.chunked_pmean``) so it pipelines against the backward
+tail and the optimizer step.  Both transformations are value-identical to
+the lockstep schedule: tick t still consumes the permute of tick t-1's
+output (zeros permute to zeros at t=0), and pmean is elementwise so
+chunking is exact — pinned by the overlapped-vs-lockstep grad-parity
+tests.  The cost model prices the exposed remainder accordingly
+(``SearchConfig.use_overlap_model``).
+
 Inside ``shard_map`` GSPMD does not apply, so tensor parallelism here is
 explicit Megatron-style SPMD: column-parallel qkv/mlp-in (per-head shards),
 row-parallel proj/mlp-out followed by ``psum`` over "tp", vocab-parallel
@@ -29,8 +44,10 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from metis_tpu.core.compat import axis_size, pcast, shard_map, vma_of
 from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.trace import Tracer
+from metis_tpu.execution import train as _train
 from metis_tpu.execution.mesh import DP, PP, TP, gpt_param_specs, shard_params
 from metis_tpu.models.gpt import (
     GPTConfig, _layer_norm, default_attention, init_params)
@@ -129,15 +146,15 @@ def tp_head_loss(params: dict, x: jnp.ndarray, targets: jnp.ndarray,
 def _varying(x, axes=(PP, DP)):
     """Cast up to varying over ``axes``, skipping axes the value already
     varies over (param-derived zeros inherit the shards' vma)."""
-    need = tuple(a for a in axes if a not in jax.typeof(x).vma)
-    return jax.lax.pcast(x, need, to='varying') if need else x
+    need = tuple(a for a in axes if a not in vma_of(x))
+    return pcast(x, need, to='varying') if need else x
 
 
 def _match_vma(ct, primal):
     """A cotangent must carry the primal output's exact vma."""
-    need = tuple(a for a in jax.typeof(primal).vma
-                 if a not in jax.typeof(ct).vma)
-    return jax.lax.pcast(ct, need, to='varying') if need else ct
+    need = tuple(a for a in vma_of(primal)
+                 if a not in vma_of(ct))
+    return pcast(ct, need, to='varying') if need else ct
 
 
 def _vary_params_for_manual_vjp(params):
@@ -180,14 +197,22 @@ def _gated_head_loss(params, x_out, tgt, is_last, cfg):
         x_out)
 
 
-def _reduce_pipeline_grads(gacc, loss_sum, M):
+def _reduce_pipeline_grads(gacc, loss_sum, M, dp_chunk_elems=None):
     """Final reductions shared by the manual-backward schedules: loss and
     grads average over microbatches and dp; pipeline-replicated leaves
     (embed/head) live on one stage each — psum over pp rebuilds the
-    replicated gradient (contributions elsewhere are exactly zero)."""
+    replicated gradient (contributions elsewhere are exactly zero).
+
+    ``dp_chunk_elems`` (overlap schedule): chunk the dp all-reduce so the
+    collectives pipeline against the backward tail and the optimizer step
+    — exactly equal values, pmean is elementwise."""
     loss = jax.lax.psum(loss_sum, PP) / M
     loss = jax.lax.pmean(loss, DP)
-    grads = jax.tree.map(lambda g: jax.lax.pmean(g / M, DP), gacc)
+    scaled = jax.tree.map(lambda g: g / M, gacc)
+    if dp_chunk_elems is None:
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, DP), scaled)
+    else:
+        grads = _train.chunked_pmean(scaled, DP, dp_chunk_elems)
     grads = {
         "embed": jax.tree.map(lambda g: jax.lax.psum(g, PP), grads["embed"]),
         "blocks": grads["blocks"],
@@ -257,9 +282,17 @@ def _pipeline_loss_local(
     mask=None,                 # local [per_stage] bool, or None (even split)
     *,
     cfg: GPTConfig,
+    overlap: bool = False,
 ) -> jnp.ndarray:
-    """Per-device GPipe body (inside shard_map over (pp, dp, tp))."""
-    num_stages = jax.lax.axis_size(PP)
+    """Per-device GPipe body (inside shard_map over (pp, dp, tp)).
+
+    ``overlap``: double-buffer the boundary send — the carry holds the
+    previous tick's UNPERMUTED output and its ``ppermute`` is issued at the
+    top of the body, before the embed it has no dependency on, so the
+    transfer can run under that compute.  Tick t still consumes the permute
+    of tick t-1's output either way (zeros permute to zeros at t=0), so
+    loss and gradients are identical to lockstep."""
+    num_stages = axis_size(PP)
     stage = jax.lax.axis_index(PP)
     M = tokens_mbs.shape[0]
     ticks = M + num_stages - 1
@@ -272,6 +305,10 @@ def _pipeline_loss_local(
 
     def tick(carry, t):
         buf, loss_sum = carry
+        if overlap and num_stages > 1:
+            # previous tick's unpermuted output: rotate it now, while the
+            # embed below (which does not read it) can run concurrently
+            buf = jax.lax.ppermute(buf, PP, fwd_perm)
         feed_idx = jnp.clip(t, 0, M - 1)
         tok = jax.lax.dynamic_index_in_dim(tokens_mbs, feed_idx, 0, False)
         # NOTE masked (where), not cond-gated like the manual-vjp schedules:
@@ -289,16 +326,16 @@ def _pipeline_loss_local(
         loss_sum = loss_sum + jnp.where(is_emitting, mb_loss, 0.0)
 
         buf_next = (
-            jax.lax.ppermute(x_out, PP, fwd_perm)
-            if num_stages > 1 else x_out)
+            x_out if overlap or num_stages == 1
+            else jax.lax.ppermute(x_out, PP, fwd_perm))
         return (buf_next, loss_sum), None
 
     # initial carries are replicated values but become device-varying inside
     # the loop (ppermute over pp, data over dp) — cast them up front so the
     # scan carry types match under the vma checker
-    buf0 = jax.lax.pcast(
+    buf0 = pcast(
         jnp.zeros((mbs_local, seq, cfg.hidden), cfg.dtype), (PP, DP), to='varying')
-    loss0 = jax.lax.pcast(jnp.zeros((), jnp.float32), (PP, DP), to='varying')
+    loss0 = pcast(jnp.zeros((), jnp.float32), (PP, DP), to='varying')
     (_, loss_sum), _ = jax.lax.scan(tick, (buf0, loss0), jnp.arange(ticks))
 
     # loss lives on the last stage; share it, and average over dp shards
@@ -313,8 +350,16 @@ def _pipeline_1f1b_local(
     mask=None,                 # local [per_stage] bool, or None (even split)
     *,
     cfg: GPTConfig,
+    overlap: bool = False,
 ) -> tuple[jnp.ndarray, dict]:
     """Per-device memory-bounded 1F1B body: returns ``(loss, grads)``.
+
+    ``overlap``: the carries hold the previous tick's UNPERMUTED sends and
+    both rings rotate at the top of the body — the cotangent permute is
+    then in flight during the entire forward slot (and the activation
+    permute during the stage-0 embed) instead of barriering the tick; the
+    final dp gradient all-reduce additionally runs chunked
+    (``execution.train.chunked_pmean``).  Values identical to lockstep.
 
     Schedule (global tick t, stage s, S stages, M microbatches):
 
@@ -338,7 +383,7 @@ def _pipeline_1f1b_local(
     so per-leaf contributions live on their owning stage; the caller psums
     pipeline-replicated leaves over "pp" and pmeans everything over "dp".
     """
-    num_stages = jax.lax.axis_size(PP)
+    num_stages = axis_size(PP)
     stage = jax.lax.axis_index(PP)
     M, mbs_local, seq = tokens_mbs.shape
     S = num_stages
@@ -363,6 +408,12 @@ def _pipeline_1f1b_local(
 
     def tick(carry, t):
         buf_fwd, buf_ct, ring, gacc, loss_sum = carry
+        if overlap and S > 1:
+            # previous tick's unpermuted sends: rotate both rings now —
+            # buf_ct is not read until the backward slot, so its transfer
+            # runs under the whole forward slot's compute
+            buf_fwd = jax.lax.ppermute(buf_fwd, PP, fwd_perm)
+            buf_ct = jax.lax.ppermute(buf_ct, PP, bwd_perm)
 
         # ---- forward slot: microbatch t - stage
         mf = t - stage
@@ -403,11 +454,14 @@ def _pipeline_1f1b_local(
             gacc, g_params)
         loss_sum = loss_sum + jnp.where(active_b & is_last, loss_p, 0.0)
 
-        # ---- rotate: activations forward, cotangents backward
-        buf_fwd = jax.lax.ppermute(x_out, PP, fwd_perm) if S > 1 else x_out
+        # ---- rotate: activations forward, cotangents backward (overlap:
+        # carry the unpermuted sends, the next tick rotates them at its top)
         ct_send = jnp.where(active_b, g_x, jnp.zeros_like(g_x))
-        buf_ct = (jax.lax.ppermute(ct_send, PP, bwd_perm)
-                  if S > 1 else ct_send)
+        if overlap or S == 1:
+            buf_fwd, buf_ct = x_out, ct_send
+        else:
+            buf_fwd = jax.lax.ppermute(x_out, PP, fwd_perm)
+            buf_ct = jax.lax.ppermute(ct_send, PP, bwd_perm)
         return (buf_fwd, buf_ct, ring, gacc, loss_sum), None
 
     act = jnp.zeros((mbs_local, seq, cfg.hidden), cfg.dtype)
@@ -421,7 +475,9 @@ def _pipeline_1f1b_local(
     )
     (_, _, _, gacc, loss_sum), _ = jax.lax.scan(
         tick, carry0, jnp.arange(ticks))
-    return _reduce_pipeline_grads(gacc, loss_sum, M)
+    return _reduce_pipeline_grads(
+        gacc, loss_sum, M,
+        dp_chunk_elems=_train.DP_CHUNK_ELEMS if overlap else None)
 
 
 def uneven_pad_indices(block_counts) -> list[int]:
@@ -482,8 +538,15 @@ def _pipeline_interleaved_local(
     targets_mbs: jnp.ndarray,
     cfg: GPTConfig,
     vs: int,
+    overlap: bool = False,
 ) -> tuple[jnp.ndarray, dict]:
     """Per-device interleaved-pipeline body: returns ``(loss, grads)``.
+
+    ``overlap`` here chunks the final dp gradient all-reduce only — the
+    wraparound chunk rings stay lockstep: their permute result feeds the
+    ring-slot bookkeeping at the top of the next tick, so hoisting them
+    buys no scheduling freedom (unlike gpipe/1f1b, whose hoisted permute
+    is independent of the next tick's embed/forward slot).
 
     Each device holds ``vs`` virtual chunks of ``K = L/(S*vs)`` blocks
     (device-major interleaved layout, ``interleave_block_order``); a
@@ -505,7 +568,7 @@ def _pipeline_interleaved_local(
     which ride the same links).  Peak boundary storage is vs*S inputs per
     device per group.
     """
-    S = jax.lax.axis_size(PP)
+    S = axis_size(PP)
     stage = jax.lax.axis_index(PP)
     M, mbs_local, seq = tokens_mbs.shape
     if M % S:
@@ -607,7 +670,9 @@ def _pipeline_interleaved_local(
     (gacc, loss_sum), _ = jax.lax.scan(
         run_group, (gacc0, _varying(jnp.zeros((), jnp.float32))),
         jnp.arange(groups))
-    return _reduce_pipeline_grads(gacc, loss_sum, M)
+    return _reduce_pipeline_grads(
+        gacc, loss_sum, M,
+        dp_chunk_elems=_train.DP_CHUNK_ELEMS if overlap else None)
 
 
 def make_pipeline_train_step(
@@ -619,8 +684,18 @@ def make_pipeline_train_step(
     virtual_stages: int = 2,
     block_counts=None,
     events: EventLog = NULL_LOG,
+    overlap: bool = True,
 ):
     """Jitted pipeline train step over a (pp, dp, tp) mesh.
+
+    ``overlap`` (default on) runs the communication-overlap schedule:
+    double-buffered boundary ``ppermute`` (gpipe/1f1b — the send is issued
+    at the top of the next tick's body, under compute it has no dependency
+    on) and chunked dp gradient all-reduce (manual-backward schedules,
+    ``execution.train.chunked_pmean``).  Loss and gradients are identical
+    to the lockstep schedule (``overlap=False``) — the transformations
+    only reorder when collectives are issued; emits one
+    ``pipeline_overlap`` event when active.
 
     ``schedule`` picks "gpipe" (forward scan + autodiff backward; activation
     memory grows with the microbatch count), "1f1b" (memory-bounded
@@ -706,19 +781,27 @@ def make_pipeline_train_step(
     # the pipeline-replicated embed/head leaves.  No manual grad collectives
     # — adding them double-counts (caught by the grad-parity test).
     if schedule == "gpipe":
-        local = jax.value_and_grad(partial(_pipeline_loss_local, cfg=cfg))
+        local = jax.value_and_grad(
+            partial(_pipeline_loss_local, cfg=cfg, overlap=overlap))
     elif schedule == "1f1b":
-        local = partial(_pipeline_1f1b_local, cfg=cfg)
+        local = partial(_pipeline_1f1b_local, cfg=cfg, overlap=overlap)
     else:
         local = partial(_pipeline_interleaved_local, cfg=cfg,
-                        vs=virtual_stages)
+                        vs=virtual_stages, overlap=overlap)
+    if overlap:
+        # gpipe's dp reduction is autodiff-inserted (the loss pmean
+        # transposes), so only the manual-backward schedules chunk it
+        events.emit(
+            "pipeline_overlap", schedule=schedule,
+            dp_chunk_elems=(0 if schedule == "gpipe"
+                            else _train.DP_CHUNK_ELEMS))
     # uneven split: the per-slot real-block mask rides along as an extra
     # sharded operand (a closure capture would be pp-replicated; the mask
     # must vary per stage)
     mask_global = (jnp.asarray([b >= 0 for b in uneven_pad_indices(counts)])
                    if counts is not None else None)
     mask_specs = (P(PP),) if counts is not None else ()
-    sharded_step = jax.shard_map(
+    sharded_step = shard_map(
         local, mesh=mesh,
         in_specs=(specs, data_spec, data_spec) + mask_specs,
         out_specs=(P(), specs),

@@ -126,6 +126,39 @@ def loss_fn_for(cfg: GPTConfig):
     return next_token_loss
 
 
+# Elements per chunk of the manual dp gradient all-reduce when the overlap
+# pipeline schedule is on (execution/pipeline._reduce_pipeline_grads): 2^20
+# f32 elements = 4 MB per collective.  Module-level so tests and benches can
+# monkeypatch the granularity.
+DP_CHUNK_ELEMS = 1 << 20
+
+
+def chunked_pmean(tree, axis: str, chunk_elems: int = 0):
+    """``pmean`` every leaf of ``tree`` over ``axis`` in flat chunks of at
+    most ``chunk_elems`` elements (``<= 0`` uses ``DP_CHUNK_ELEMS``).
+
+    pmean is elementwise, so the chunked result EQUALS the whole-leaf
+    pmean bit-for-bit; the point is scheduling — splitting large leaves
+    into several smaller all-reduces lets XLA start reducing early grads
+    while the backward tail still computes and start the optimizer update
+    on reduced chunks' leaves instead of waiting on one monolithic
+    collective per leaf.
+    """
+    if chunk_elems <= 0:
+        chunk_elems = DP_CHUNK_ELEMS
+
+    def reduce_leaf(g):
+        n = g.size
+        if n <= chunk_elems:
+            return jax.lax.pmean(g, axis)
+        flat = g.reshape(-1)
+        parts = [jax.lax.pmean(flat[i:i + chunk_elems], axis)
+                 for i in range(0, n, chunk_elems)]
+        return jnp.concatenate(parts).reshape(g.shape)
+
+    return jax.tree.map(reduce_leaf, tree)
+
+
 @jax.tree_util.register_dataclass
 @dataclass
 class TrainState:

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from metis_tpu.core.compat import vma_of
 NEG_INF = -1e30  # large-negative mask value; -inf would make exp(m-m) = nan
 
 # Shipped default tiling — measured on-chip (tools/tpu_deep_capture.py,
@@ -55,7 +56,7 @@ def _out_vma(*arrays) -> frozenset:
     checker rejects the call; outside shard_map this is the empty set."""
     vma: frozenset = frozenset()
     for a in arrays:
-        vma |= getattr(jax.typeof(a), "vma", frozenset())
+        vma |= vma_of(a)
     return vma
 
 

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from metis_tpu.core.compat import axis_size, pcast, shard_map, vma_of
 from metis_tpu.ops.flash_attention import (
     DEFAULT_BLOCK_KV,
     DEFAULT_BLOCK_Q,
@@ -77,15 +78,15 @@ def _ring_dense(q, k, v, axis_name: str):
     GQA K/V rotate GROUPED (the wire bytes the cost model prices); each
     step expands the visiting block locally for the dense einsums."""
     gqa_rep = q.shape[1] // k.shape[1]
-    ring = jax.lax.axis_size(axis_name)
+    ring = axis_size(axis_name)
     my_pos = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
 
     q32 = q.astype(jnp.float32)
     # accumulators start replicated but the scan makes them ring-varying
-    m = jax.lax.pcast(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), (axis_name,), to='varying')
-    l = jax.lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), (axis_name,), to='varying')
-    o = jax.lax.pcast(jnp.zeros(q32.shape, jnp.float32), (axis_name,), to='varying')
+    m = pcast(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), (axis_name,), to='varying')
+    l = pcast(jnp.zeros(q.shape[:3], jnp.float32), (axis_name,), to='varying')
+    o = pcast(jnp.zeros(q32.shape, jnp.float32), (axis_name,), to='varying')
 
     diag_mask = jnp.tril(jnp.ones((s_local, s_local), bool))
     perm = [(i, (i + 1) % ring) for i in range(ring)]
@@ -130,9 +131,9 @@ def _zero_stats(q, match_vma_of=()):
     l = jnp.zeros(shape, jnp.float32)
     vma: frozenset = frozenset()
     for a in (q, *match_vma_of):
-        vma |= getattr(jax.typeof(a), "vma", frozenset())
+        vma |= vma_of(a)
     if vma:
-        acc, m, l = (jax.lax.pcast(t, tuple(vma), to='varying')
+        acc, m, l = (pcast(t, tuple(vma), to='varying')
                      for t in (acc, m, l))
     return acc, m, l
 
@@ -144,7 +145,7 @@ def _branch_index(src, my_pos):
 
 def _ring_flash_forward(q, k, v, axis_name, bq, bkv, interpret):
     """One ring pass of flash-kernel block attention; returns (out, lse)."""
-    ring = jax.lax.axis_size(axis_name)
+    ring = axis_size(axis_name)
     my_pos = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % ring) for i in range(ring)]
 
@@ -201,7 +202,7 @@ def _ring_flash_bwd(axis_name, bq, bkv, interpret, residuals, g):
     q, k, v, out, lse = residuals
     b, h, s, d = q.shape
     kvh = k.shape[1]
-    ring = jax.lax.axis_size(axis_name)
+    ring = axis_size(axis_name)
     my_pos = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % ring) for i in range(ring)]
 
@@ -224,8 +225,8 @@ def _ring_flash_bwd(axis_name, bq, bkv, interpret, residuals, g):
         z = jnp.zeros((b, heads, s, d), jnp.float32)
         vma: frozenset = frozenset()
         for a in match:
-            vma |= getattr(jax.typeof(a), "vma", frozenset())
-        return jax.lax.pcast(z, tuple(vma), to='varying') if vma else z
+            vma |= vma_of(a)
+        return pcast(z, tuple(vma), to='varying') if vma else z
 
     def self_blk(args):
         return grads(args, True)
@@ -302,7 +303,7 @@ def make_ring_attention(mesh: Mesh, seq_axis: str, impl: str | None = None,
     # Only the sequence axis is manual; every other mesh axis (dp, tp, ...)
     # stays under GSPMD so batch/head shardings pass straight through instead
     # of being gathered at the shard_map boundary.
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names={seq_axis},
     )

@@ -137,6 +137,11 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--enable-schedule-search", action="store_true",
                    help="search 1f1b/interleaved pipeline-schedule plan "
                         "families (gpipe is always searched)")
+    g.add_argument("--no-overlap-model", action="store_true",
+                   help="price every collective fully exposed instead of "
+                        "charging only the share not hidden under compute "
+                        "(SearchConfig.use_overlap_model; overlap pricing "
+                        "is always inert under --strict-compat)")
     g.add_argument("--dp-overlap", type=float, default=0.0,
                    help="measured fraction of the dp gradient all-reduce "
                         "hidden under backward compute "
@@ -203,6 +208,7 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         enable_schedule_search=getattr(args, "enable_schedule_search", False),
         dp_overlap_fraction=getattr(args, "dp_overlap", 0.0),
         workers=getattr(args, "workers", 1),
+        use_overlap_model=not getattr(args, "no_overlap_model", False),
     )
 
 
@@ -629,6 +635,16 @@ def _cmd_explain(args: argparse.Namespace, profiles, model, config,
         row = [k] + [f"{b.components.get(k, 0.0):.3f}" for b in bds]
         if len(bds) == 2:
             row.append(f"{delta[k]:+.3f}")
+        rows.append(row)
+    # Overlap-hidden comm shares: informational, NOT part of total_ms —
+    # exposed + hidden reconstructs the serial collective cost.
+    hidden_keys = sorted({k for b in bds
+                          for k, v in b.hidden.items() if abs(v) > 1e-12})
+    for k in hidden_keys:
+        row = ([f"{k} (hidden)"]
+               + [f"{b.hidden.get(k, 0.0):.3f}" for b in bds])
+        if len(bds) == 2:
+            row.append(f"{bds[1].hidden.get(k, 0.0) - bds[0].hidden.get(k, 0.0):+.3f}")
         rows.append(row)
     total_row = ["total"] + [f"{b.total_ms:.3f}" for b in bds]
     if len(bds) == 2:

@@ -449,12 +449,6 @@ def test_accuracy_subcommand_missing_file(tmp_path):
 
 
 def test_validate_ledger_records_pairs(fixture_dir, tmp_path):
-    import jax
-
-    if not hasattr(jax, "shard_map"):
-        pytest.skip("jax lacks jax.shard_map — validate's pipeline "
-                    "measurement path (pre-existing env limitation; see "
-                    "test_validate_subcommand_end_to_end)")
     ledger = tmp_path / "vledger.jsonl"
     rc = main(["validate", "--hostfile", str(fixture_dir / "hostfile_small"),
                "--clusterfile", str(fixture_dir / "cluster.json"),

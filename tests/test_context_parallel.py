@@ -122,8 +122,11 @@ class TestActivationSplit:
 class TestCpCostEstimation:
     def _cost(self, cluster, profiles, model, strategies, bandwidth=None):
         volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
+        # serial collective pricing: this class pins the raw ring formulas;
+        # the overlap-window pricing has its own suite (test_overlap.py)
         est = HeteroCostEstimator(
-            cluster, profiles, volume, EstimatorOptions(), bandwidth)
+            cluster, profiles, volume,
+            EstimatorOptions(use_overlap_model=False), bandwidth)
         plan = InterStagePlan(
             node_sequence=("tpu_v5e",), device_groups=(8,), batches=4, gbs=32)
         return est.get_cost(plan, strategies, (0, model.num_layers))

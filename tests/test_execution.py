@@ -18,6 +18,7 @@ from metis_tpu.execution import (
     microbatch_split,
     shard_params,
 )
+from metis_tpu.core.compat import shard_map
 from metis_tpu.core.types import UniformPlan
 from metis_tpu.models import GPTConfig, forward, init_params, next_token_loss
 
@@ -97,7 +98,7 @@ class TestPipelinePath:
         from metis_tpu.execution.pipeline import _pipeline_loss_local
         from functools import partial
 
-        loss_fn = jax.shard_map(
+        loss_fn = shard_map(
             partial(_pipeline_loss_local, cfg=CFG),
             mesh=mesh,
             in_specs=(specs, P(None, DP, None), P(None, DP, None)),
@@ -125,7 +126,7 @@ class TestPipelinePath:
 
         specs = gpt_param_specs(CFG, tp_axis=TP, pp_axis=PP)
         sharded = shard_params(params, mesh, specs)
-        grad_fn = jax.shard_map(
+        grad_fn = shard_map(
             jax.value_and_grad(partial(_pipeline_loss_local, cfg=CFG)),
             mesh=mesh,
             in_specs=(specs, P(None, DP, None), P(None, DP, None)),
@@ -157,7 +158,7 @@ class TestPipelinePath:
         M = 4
         specs = gpt_param_specs(CFG, tp_axis=TP, pp_axis=PP)
         sharded = shard_params(params, mesh, specs)
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(_pipeline_1f1b_local, cfg=CFG),
             mesh=mesh,
             in_specs=(specs, P(None, DP, None), P(None, DP, None)),
@@ -191,7 +192,7 @@ class TestPipelinePath:
         mesh = _mesh((4, 1, 2), (PP, DP, TP))
         specs = gpt_param_specs(CFG, tp_axis=TP, pp_axis=PP)
         sharded = shard_params(params, mesh, specs)
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(_pipeline_1f1b_local, cfg=CFG),
             mesh=mesh,
             in_specs=(specs, P(None, DP, None), P(None, DP, None)),
@@ -231,7 +232,7 @@ class TestPipelinePath:
             lambda a: a[order], params["blocks"])}
         specs = gpt_param_specs(CFG, tp_axis=TP, pp_axis=PP)
         sharded = shard_params(permuted, mesh, specs)
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(_pipeline_interleaved_local, cfg=CFG, vs=vs),
             mesh=mesh,
             in_specs=(specs, P(None, DP, None), P(None, DP, None)),
@@ -371,3 +372,140 @@ class TestPlanArtifact:
         plan = UniformPlan(dp=2, pp=2, tp=2, mbs=2, gbs=16)
         mesh = mesh_for_uniform_plan(plan)
         assert mesh.shape == {"pp": 2, "dp": 2, "tp": 2}
+
+
+class TestCommOverlap:
+    """The overlap schedule's correctness bar: double-buffered boundary
+    sends and the chunked dp all-reduce must reproduce the LOCKSTEP
+    schedule's loss and gradients (``pipeline.py`` "Communication
+    overlap") — both legs run the same collectives in the same arithmetic
+    association, only the issue order moves."""
+
+    def _grads(self, body, data, overlap, mesh_shape=(2, 2, 2), M=4,
+               **body_kw):
+        from functools import partial
+
+        params, tokens, targets = data
+        mesh = _mesh(mesh_shape, (PP, DP, TP))
+        specs = gpt_param_specs(CFG, tp_axis=TP, pp_axis=PP)
+        sharded = shard_params(params, mesh, specs)
+        fn = shard_map(
+            partial(body, cfg=CFG, overlap=overlap, **body_kw),
+            mesh=mesh,
+            in_specs=(specs, P(None, DP, None), P(None, DP, None)),
+            out_specs=(P(), specs))
+        with mesh:
+            loss, grads = jax.jit(fn)(
+                sharded, microbatch_split(tokens, M),
+                microbatch_split(targets, M))
+        return float(loss), jax.tree.map(np.asarray, grads)
+
+    def _assert_parity(self, ref, got):
+        assert got[0] == pytest.approx(ref[0], rel=1e-6)
+        flat_ref = jax.tree_util.tree_flatten_with_path(ref[1])[0]
+        flat_got = dict(jax.tree_util.tree_flatten_with_path(got[1])[0])
+        for path, rg in flat_ref:
+            np.testing.assert_allclose(
+                flat_got[path], rg, rtol=1e-6, atol=1e-8,
+                err_msg=jax.tree_util.keystr(path))
+
+    def test_gpipe_overlap_grads_match_lockstep(self, data):
+        from functools import partial
+
+        from metis_tpu.execution.pipeline import _pipeline_loss_local
+
+        def body(params, tok, tgt, *, cfg, overlap):
+            return jax.value_and_grad(partial(
+                _pipeline_loss_local, cfg=cfg, overlap=overlap))(
+                    params, tok, tgt)
+
+        ref = self._grads(body, data, overlap=False)
+        got = self._grads(body, data, overlap=True)
+        self._assert_parity(ref, got)
+
+    def test_1f1b_overlap_grads_match_lockstep(self, data, monkeypatch):
+        from metis_tpu.execution import train as _train
+        from metis_tpu.execution.pipeline import _pipeline_1f1b_local
+
+        # small chunks so the chunked dp all-reduce actually splits leaves
+        monkeypatch.setattr(_train, "DP_CHUNK_ELEMS", 64)
+        ref = self._grads(_pipeline_1f1b_local, data, overlap=False)
+        got = self._grads(_pipeline_1f1b_local, data, overlap=True)
+        self._assert_parity(ref, got)
+
+    @pytest.mark.slow  # redundant leg: 1f1b parity above is the tier-1 pin
+    def test_1f1b_overlap_ring_reuse_parity(self, data, monkeypatch):
+        """M=8 on a 4-stage pipeline: the hoisted top-of-body permutes must
+        stay value-identical through ring-slot wraparound too."""
+        from metis_tpu.execution import train as _train
+        from metis_tpu.execution.pipeline import _pipeline_1f1b_local
+
+        monkeypatch.setattr(_train, "DP_CHUNK_ELEMS", 64)
+        ref = self._grads(_pipeline_1f1b_local, data, overlap=False,
+                          mesh_shape=(4, 1, 2), M=8)
+        got = self._grads(_pipeline_1f1b_local, data, overlap=True,
+                          mesh_shape=(4, 1, 2), M=8)
+        self._assert_parity(ref, got)
+
+    @pytest.mark.slow  # redundant leg: gpipe+1f1b parity are the tier-1 pins
+    def test_interleaved_overlap_grads_match_lockstep(self, data,
+                                                      monkeypatch):
+        from metis_tpu.execution import train as _train
+        from metis_tpu.execution.pipeline import _pipeline_interleaved_local
+
+        monkeypatch.setattr(_train, "DP_CHUNK_ELEMS", 64)
+        ref = self._grads(_pipeline_interleaved_local, data, overlap=False,
+                          vs=2)
+        got = self._grads(_pipeline_interleaved_local, data, overlap=True,
+                          vs=2)
+        self._assert_parity(ref, got)
+
+    def test_chunked_pmean_matches_whole_leaf(self):
+        from metis_tpu.execution.train import chunked_pmean
+
+        mesh = _mesh((4,), (DP,))
+        tree = {"a": jnp.arange(120, dtype=jnp.float32).reshape(8, 15),
+                "b": jnp.ones((4,), jnp.float32)}
+
+        def body(t):
+            return (chunked_pmean(t, DP, 16),
+                    jax.tree.map(lambda g: jax.lax.pmean(g, DP), t))
+
+        with mesh:
+            chunked, whole = jax.jit(shard_map(
+                body, mesh=mesh, in_specs=(P(DP),),
+                out_specs=(P(DP), P(DP))))(tree)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(chunked[k]),
+                                          np.asarray(whole[k]))
+
+    def test_pipeline_overlap_event_emitted(self):
+        import io
+        import json
+
+        from metis_tpu.core.events import EventLog
+
+        cfg = GPTConfig(vocab_size=64, seq_len=8, hidden=16, num_heads=2,
+                        num_blocks=2, ffn_multiplier=2, dtype=jnp.float32)
+        mesh = _mesh((2, 2, 2), (PP, DP, TP))
+        buf = io.StringIO()
+        make_pipeline_train_step(cfg, mesh, 4, schedule="1f1b",
+                                 events=EventLog(stream=buf))
+        events = [json.loads(l) for l in buf.getvalue().splitlines()]
+        ov = [e for e in events if e["event"] == "pipeline_overlap"]
+        assert len(ov) == 1
+        assert ov[0]["schedule"] == "1f1b"
+        assert ov[0]["dp_chunk_elems"] > 0
+
+    def test_no_overlap_event_when_lockstep(self):
+        import io
+
+        cfg = GPTConfig(vocab_size=64, seq_len=8, hidden=16, num_heads=2,
+                        num_blocks=2, ffn_multiplier=2, dtype=jnp.float32)
+        from metis_tpu.core.events import EventLog
+
+        mesh = _mesh((2, 2, 2), (PP, DP, TP))
+        buf = io.StringIO()
+        make_pipeline_train_step(cfg, mesh, 4, overlap=False,
+                                 events=EventLog(stream=buf))
+        assert "pipeline_overlap" not in buf.getvalue()

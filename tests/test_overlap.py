@@ -51,26 +51,49 @@ def model_spec() -> ModelSpec:
                      sequence_length=32, vocab_size=256, num_heads=4)
 
 
-def hetero_cost(frac, strict=False):
+def hetero_estimator(frac=0.0, strict=False, overlap=False):
+    # overlap=False by default: these helpers exercise the measured
+    # linear-share pathway (dp_overlap_fraction) in isolation, without the
+    # structural exposed-window model layered on top.
     store = make_store()
     model = model_spec()
     volume = TransformerVolume(model, store.model.params_per_layer_bytes)
-    est = HeteroCostEstimator(
+    return HeteroCostEstimator(
         make_cluster(), store, volume,
         EstimatorOptions(max_profiled_bs=2, dp_overlap_fraction=frac,
-                         strict_compat=strict))
-    plan = InterStagePlan(node_sequence=("X",), device_groups=(8,),
-                          batches=2, gbs=16)
-    return est.get_cost(plan, (Strategy(dp=8, tp=1),), (0, 6))
+                         strict_compat=strict, use_overlap_model=overlap))
 
 
-def uniform_cost(frac):
+def _plan_args(groups=(8,), dp=8):
+    plan = InterStagePlan(node_sequence=("X",) * len(groups),
+                          device_groups=groups, batches=2, gbs=16)
+    strategies = tuple(Strategy(dp=dp, tp=1) for _ in groups)
+    bounds = [0]
+    per = L // len(groups)
+    for _ in groups:
+        bounds.append(bounds[-1] + per)
+    bounds[-1] = L
+    return plan, strategies, tuple(bounds)
+
+
+def hetero_cost(frac, strict=False, overlap=False, groups=(8,), dp=8):
+    est = hetero_estimator(frac, strict, overlap)
+    return est.get_cost(*_plan_args(groups, dp))
+
+
+def hetero_breakdown(frac=0.0, overlap=False, groups=(8,), dp=8):
+    est = hetero_estimator(frac, False, overlap)
+    return est.get_breakdown(*_plan_args(groups, dp))
+
+
+def uniform_cost(frac, overlap=False):
     store = make_store()
     model = model_spec()
     volume = TransformerVolume(model, store.model.params_per_layer_bytes)
     est = UniformCostEstimator(
         make_cluster(), store, volume,
-        EstimatorOptions(max_profiled_bs=2, dp_overlap_fraction=frac))
+        EstimatorOptions(max_profiled_bs=2, dp_overlap_fraction=frac,
+                         use_overlap_model=overlap))
     return est.get_cost(UniformPlan(dp=8, pp=1, tp=1, mbs=2, gbs=16), "X")
 
 
@@ -117,6 +140,79 @@ class TestEstimatorOverlap:
         cfg = SearchConfig(gbs=16, dp_overlap_fraction=0.3)
         opts = EstimatorOptions.from_config(cfg)
         assert opts.dp_overlap_fraction == 0.3
+
+
+class TestOverlapWindowModel:
+    """Structural exposed-vs-hidden comm split (use_overlap_model): per pp
+    boundary ``max(0, send - sender compute window)``, per stage
+    ``max(0, dp sync - optimizer)``; the hidden share is reported in
+    ``CostBreakdown.hidden`` but never charged to ``total_ms``."""
+
+    def test_overlap_active_needs_native_mode(self):
+        assert EstimatorOptions().overlap_active
+        assert not EstimatorOptions(use_overlap_model=False).overlap_active
+        assert not EstimatorOptions(strict_compat=True).overlap_active
+
+    def test_config_plumbs_flag(self):
+        assert EstimatorOptions.from_config(
+            SearchConfig(gbs=16)).use_overlap_model
+        assert not EstimatorOptions.from_config(
+            SearchConfig(gbs=16, use_overlap_model=False)).use_overlap_model
+
+    def test_hetero_dp_exposed_is_comm_minus_optimizer(self):
+        (off, bd_off) = hetero_breakdown(overlap=False)
+        (on, bd_on) = hetero_breakdown(overlap=True)
+        assert off.dp_comm_ms > 0
+        # single stage: exposed = max(0, dp - optimizer window)
+        opt = bd_off.components["optimizer"]
+        assert on.dp_comm_ms == pytest.approx(
+            max(off.dp_comm_ms - opt, 0.0))
+        # only the comm charges move
+        assert on.execution_ms == off.execution_ms
+        assert on.total_ms == pytest.approx(
+            off.total_ms - (off.dp_comm_ms - on.dp_comm_ms))
+
+    def test_hidden_reconstructs_serial_cost(self):
+        (off, _) = hetero_breakdown(overlap=False)
+        (on, bd) = hetero_breakdown(overlap=True)
+        assert bd.components.get("dp_comm_exposed") == pytest.approx(
+            on.dp_comm_ms)
+        assert "dp_comm" not in bd.components
+        # exposed + hidden == the full serial collective cost
+        assert bd.hidden["dp_comm"] + on.dp_comm_ms == pytest.approx(
+            off.dp_comm_ms)
+        assert bd.hidden["pp_comm"] + on.pp_comm_ms == pytest.approx(
+            off.pp_comm_ms)
+        # breakdown stays additive with the exposed keys
+        assert sum(bd.components.values()) == pytest.approx(
+            on.total_ms, rel=1e-9)
+
+    def test_hetero_pp_exposed_capped_by_compute_window(self):
+        off = hetero_cost(0.0, groups=(4, 4), dp=4)
+        on = hetero_cost(0.0, overlap=True, groups=(4, 4), dp=4)
+        assert off.pp_comm_ms > 0
+        # the sender stage's compute window hides part (or all) of the send
+        assert 0.0 <= on.pp_comm_ms <= off.pp_comm_ms
+        hidden = ((off.pp_comm_ms - on.pp_comm_ms)
+                  + (off.dp_comm_ms - on.dp_comm_ms))
+        assert on.total_ms == pytest.approx(off.total_ms - hidden)
+
+    def test_overlap_off_restores_serial_pricing(self):
+        off = hetero_cost(0.0, overlap=False)
+        assert off.dp_comm_ms == hetero_cost(0.0, strict=False).dp_comm_ms
+
+    def test_strict_compat_keeps_overlap_inert(self):
+        a = hetero_cost(0.0, strict=True, overlap=True)
+        b = hetero_cost(0.0, strict=True, overlap=False)
+        assert a == b
+
+    def test_uniform_dp_exposed(self):
+        off = uniform_cost(0.0)
+        on = uniform_cost(0.0, overlap=True)
+        assert off.dp_comm_ms > 0
+        assert 0.0 <= on.dp_comm_ms <= off.dp_comm_ms
+        assert on.total_ms == pytest.approx(
+            off.total_ms - (off.dp_comm_ms - on.dp_comm_ms))
 
 
 class TestContentionCalibration:
@@ -301,3 +397,30 @@ class TestMeasuredCalibration:
         if out["noise_limited"]:
             assert out["overlap_fraction"] <= 0.9
         assert out["with_reduce_iqr_ms"] >= 0.0
+
+    def test_measure_pipeline_overlap_on_cpu_mesh(self):
+        import io
+        import json
+
+        import jax
+
+        from metis_tpu.core.events import EventLog
+        from metis_tpu.cost import measure_pipeline_overlap
+        from tools.check_events_schema import validate_events
+
+        buf = io.StringIO()
+        out = measure_pipeline_overlap(
+            jax.devices("cpu")[:4], pp=2, dp=2, microbatches=2,
+            hidden=16, blocks=2, seq=8, vocab=64, iters=2, warmup=1,
+            events=EventLog(stream=buf))
+        assert out["pp"] == 2 and out["dp"] == 2
+        assert 0.0 <= out["overlap_hidden_frac"] <= 1.0
+        assert out["bare_comm_ms"] > 0
+        assert out["lockstep_ms"] > 0 and out["overlapped_ms"] > 0
+        # measured fields reconcile, and the frac is honest about noise
+        assert out["saved_ms"] == pytest.approx(
+            out["lockstep_ms"] - out["overlapped_ms"], abs=1e-3)
+        assert "noise_limited" in out
+        events = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert [e["event"] for e in events] == ["overlap_measured"]
+        assert validate_events(events) == []

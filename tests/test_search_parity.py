@@ -104,7 +104,7 @@ def uniform_eval(tmp_path_factory):
     return _make_evaluator(d)
 
 
-def _make_evaluator(fixture_dir):
+def _make_evaluator(fixture_dir, strict_compat=True, use_overlap_model=True):
     from metis_tpu.cluster.spec import ClusterSpec
     from metis_tpu.core.config import SearchConfig
     from metis_tpu.profiles import ProfileStore, tiny_test_model
@@ -115,7 +115,8 @@ def _make_evaluator(fixture_dir):
     store = ProfileStore.from_dir(fixture_dir / "profiles")
     return CandidateEvaluator(
         cluster, store, tiny_test_model(),
-        SearchConfig(gbs=128, strict_compat=True))
+        SearchConfig(gbs=128, strict_compat=strict_compat,
+                     use_overlap_model=use_overlap_model))
 
 
 def _candidate(node_sequence, device_groups, batches, strategies, partition):
@@ -177,6 +178,43 @@ def test_batched_equals_scalar_uniform(uniform_eval, shape):
     _, groups, batches, strats, part = shape
     inter, intra = _candidate(("A100",), groups, batches, strats, part)
     _assert_batched_equals_scalar(uniform_eval, inter, intra)
+
+
+# --- native mode (strict_compat off): the overlap-aware exposed-comm
+# pricing is live, and the batched path must STILL be bit-identical to the
+# scalar oracle — the exposed-window max() runs on identical floats.
+
+
+@pytest.fixture(scope="module")
+def hetero_native_eval(parity_fixture_dir):
+    """Hetero parity workload with overlap pricing live."""
+    return _make_evaluator(parity_fixture_dir, strict_compat=False)
+
+
+@pytest.mark.parametrize(
+    "shape", _HETERO_SHAPES, ids=[s[0] for s in _HETERO_SHAPES])
+def test_batched_equals_scalar_hetero_native(hetero_native_eval, shape):
+    _, groups, batches, strats, part = shape
+    inter, intra = _candidate(("A100", "T4"), groups, batches, strats, part)
+    _assert_batched_equals_scalar(hetero_native_eval, inter, intra)
+
+
+def test_native_overlap_charges_at_most_serial(parity_fixture_dir,
+                                               hetero_native_eval):
+    """On a multi-stage dp>1 shape the exposed charge never exceeds the
+    serial pricing of the same candidate (native mode, overlap on vs off),
+    and everything the overlap model cannot touch (execution) is
+    unchanged."""
+    serial_eval = _make_evaluator(parity_fixture_dir, strict_compat=False,
+                                  use_overlap_model=False)
+    inter, intra = _candidate(
+        ("A100", "T4"), (8, 8), 8, [(2, 4), (2, 4)], (0, 5, 10))
+    [serial] = serial_eval.batch_estimator.cost_many(inter, [intra])
+    [native] = hetero_native_eval.batch_estimator.cost_many(inter, [intra])
+    assert native.execution_ms == serial.execution_ms
+    assert native.dp_comm_ms <= serial.dp_comm_ms
+    assert native.pp_comm_ms <= serial.pp_comm_ms
+    assert native.total_ms <= serial.total_ms
 
 
 @pytest.mark.parametrize("eval_fixture", ["hetero_eval", "uniform_eval"])

@@ -380,6 +380,7 @@ class TestBenchSections:
         assert line["status"] == "skipped"
         assert "BENCH_DEADLINE_S" in line["data"]["skipped"]
 
+    @pytest.mark.slow  # ~90 s (bench subprocess) — the heaviest tier-1 test
     def test_truncated_bench_leaves_startup_record(self, tmp_path):
         """The acceptance criterion: an artificially truncated bench run
         (tiny deadline standing in for `timeout 5`) leaves >= 1

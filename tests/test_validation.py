@@ -64,6 +64,8 @@ def test_rejects_undersized_device_list():
         measure_uniform_plan_ms(plan, TINY, jax.devices("cpu"), steps=1)
 
 
+@pytest.mark.slow  # ~30 s profile+validate e2e; the CLI validate e2e in
+# test_cli.py keeps the loop covered in tier-1
 def test_planner_to_validator_composes():
     """Plan with measured profiles, then validate the chosen plan — the
     complete north-star loop on one host."""
@@ -94,6 +96,7 @@ def test_planner_to_validator_composes():
     assert 0.001 < report.predicted_ms / report.measured_ms < 1000
 
 
+@pytest.mark.slow  # ~60 s profile+hetero-validate e2e (see note above)
 def test_hetero_planner_to_validator_composes():
     """plan_hetero -> multi-mesh per-stage executor -> error report: the
     north-star loop now closes for the planner's flagship non-uniform

@@ -47,6 +47,12 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "accuracy_sample": {"fingerprint", "predicted_ms", "measured_ms",
                         "error_pct"},
     "drift_alarm": {"mape_pct", "band_pct", "n"},
+    # communication overlap (execution/pipeline.py, cost/calibration.py);
+    # dp_chunk_elems is 0 on the gpipe path (autodiff-inserted dp
+    # reduction — chunking does not apply)
+    "pipeline_overlap": {"schedule", "dp_chunk_elems"},
+    "overlap_measured": {"lockstep_ms", "overlapped_ms",
+                         "overlap_hidden_frac"},
     # fault tolerance (resilience/ — faults.py, retry.py, supervisor.py)
     "fault_injected": {"point"},
     "retry_attempt": {"op", "attempt"},

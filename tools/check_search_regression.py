@@ -20,6 +20,12 @@ Four frozen invariants, any drift exits 1:
 4. **Vectorized-grid oracle.**  ``HeteroCostEstimator.stage_time_grid``
    must agree with the scalar ``LayerProfile.time_slice`` path within
    rtol 1e-9 for every (device_type, tp, layer-range) of the fixture.
+5. **Overlap invariants.**  ``SearchConfig.use_overlap_model=False`` must
+   stay byte-identical to the frozen golden run (and under strict_compat
+   the flag is inert either way); the native-mode overlap-on ranking must
+   match its own checked-in golden (tools/search_overlap_golden.json,
+   recorded with ``--update-baseline``) and stay batched==scalar
+   byte-identical.
 
 ``--throughput`` adds a performance gate: the batched whole-search
 plan-throughput on the parity workload, NORMALIZED by the scalar path's
@@ -47,6 +53,13 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 # ONLY when a deliberate search-space change lands, with the rationale in
 # the commit that changes it.
 GOLDEN_NUM_COSTED = 1764
+
+# Native-mode (strict_compat=False, use_overlap_model=True) ranking golden:
+# num_costed + sha256 of the serialized ranking + best-plan total, recorded
+# by ``--update-baseline``.  Freezes the overlap-aware pricing the way
+# GOLDEN_NUM_COSTED freezes the strict-compat search space.
+OVERLAP_GOLDEN = Path(__file__).resolve().parent / (
+    "search_overlap_golden.json")
 
 # Throughput baseline: batched + scalar plans/sec recorded on one host by
 # ``--update-baseline``; the check compares host-normalized numbers, so the
@@ -150,8 +163,87 @@ def run_checks(workers: int = 2) -> list[str]:
                 problems.append(
                     f"batched {field} = {p}, scalar oracle = {s}")
 
+        # overlap leg (a): turning the overlap model OFF must leave the
+        # frozen strict-compat golden untouched (under strict_compat the
+        # flag is inert, so this doubles as an inertness check)
+        overlap_off = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                         use_overlap_model=False))
+        if dump_ranked_plans(serial.plans) != dump_ranked_plans(
+                overlap_off.plans):
+            problems.append(
+                "use_overlap_model=False drifted from the frozen golden "
+                "ranking under strict_compat (the flag must be inert there)")
+
+        # overlap legs (b)+(c): native mode, overlap pricing live —
+        # batched must still equal the scalar oracle byte-for-byte, and
+        # the ranking must match the checked-in overlap golden
+        native = plan_hetero(
+            cluster, store, model, SearchConfig(gbs=PARITY_GBS))
+        native_scalar = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, use_batch_eval=False))
+        native_dump = dump_ranked_plans(native.plans)
+        if native_dump != dump_ranked_plans(native_scalar.plans):
+            problems.append(
+                "native-mode overlap pricing: batched ranking is not "
+                "byte-identical to the scalar oracle")
+        if OVERLAP_GOLDEN.exists():
+            golden = json.loads(OVERLAP_GOLDEN.read_text())
+            entry = _overlap_fingerprint(native, native_dump)
+            for key in ("num_costed", "dump_sha256", "best_total_ms"):
+                if golden.get(key) != entry[key]:
+                    problems.append(
+                        f"overlap golden drift: {key} = {entry[key]}, "
+                        f"frozen golden is {golden.get(key)} "
+                        f"(re-record deliberately with --update-baseline)")
+        else:
+            problems.append(
+                f"overlap golden missing: {OVERLAP_GOLDEN} "
+                "(record one with --update-baseline)")
+
         problems.extend(_check_grid_oracle(cluster, store))
     return problems
+
+
+def _overlap_fingerprint(result, dump: str | None = None) -> dict:
+    """Golden entry for the native-mode overlap-on parity run."""
+    import hashlib
+
+    from metis_tpu.core.types import dump_ranked_plans
+
+    if dump is None:
+        dump = dump_ranked_plans(result.plans)
+    return {
+        "workload": "parity (8xA100+8xT4, GPT-10L, gbs=128, native mode, "
+                    "use_overlap_model=True)",
+        "num_costed": result.num_costed,
+        "dump_sha256": hashlib.sha256(dump.encode()).hexdigest(),
+        "best_total_ms": (round(result.plans[0].cost.total_ms, 4)
+                          if result.plans else None),
+    }
+
+
+def record_overlap_golden() -> dict:
+    """Run the native-mode overlap-on parity search and write its golden."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import PARITY_GBS, write_parity_fixture
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        native = plan_hetero(cluster, store, tiny_test_model(),
+                             SearchConfig(gbs=PARITY_GBS))
+    entry = _overlap_fingerprint(native)
+    OVERLAP_GOLDEN.write_text(json.dumps(entry, indent=2) + "\n")
+    return entry
 
 
 def measure_throughput(repeats: int = 3) -> dict:
@@ -232,9 +324,12 @@ def main(argv: list[str] | None = None) -> int:
                              "baseline (host-normalized, 20%% floor)")
     parser.add_argument("--update-baseline", action="store_true",
                         help="re-measure and overwrite "
-                             "tools/search_throughput_baseline.json")
+                             "tools/search_throughput_baseline.json and "
+                             "tools/search_overlap_golden.json")
     args = parser.parse_args(argv)
     if args.update_baseline:
+        golden = record_overlap_golden()
+        print(f"overlap golden written: {golden}")
         entry = measure_throughput()
         THROUGHPUT_BASELINE.write_text(json.dumps(entry, indent=2) + "\n")
         print(f"throughput baseline written: {entry}")
@@ -249,7 +344,8 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     print(f"search regression gate OK (golden num_costed = "
           f"{GOLDEN_NUM_COSTED}, workers={args.workers} byte-identical, "
-          f"batched == scalar oracle, time grid matches)")
+          f"batched == scalar oracle, time grid matches, overlap-off "
+          f"inert + overlap golden matches)")
     return 0
 
 
