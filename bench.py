@@ -1100,6 +1100,122 @@ def overlap_bench(record: dict) -> None:
     record["overlap"] = entry
 
 
+def serve_bench(record: dict) -> None:
+    """Planner-as-a-service latencies (metis_tpu/serve): boot the daemon
+    in-process on loopback TCP and measure, on the parity workload,
+
+    - ``serve_cache_hit_ms`` (headline): cached-answer p50 over 50 queries
+      — the number that must sit under the 10 ms serving budget;
+    - cold-vs-warm: first query (builds search state) vs a re-search after
+      cache invalidation with the warm state retained, vs a fresh-process
+      CLI plan of the same workload (imports + profile load + search —
+      what every query cost before the daemon existed);
+    - ``qps_concurrent`` under 64 client threads of cached queries;
+    - ``byte_identical``: daemon response vs in-process plan_hetero.
+
+    Socket setup can fail on locked-down hosts (no loopback bind) — that
+    skips with the honest reason rather than failing the bench."""
+    import statistics
+
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.planner.api import plan_hetero
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from tools.serve_smoke import SMOKE_TOP_K, parity_inputs
+
+    entry: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        cluster, profiles, model, config = parity_inputs(tmp)
+
+        # the pre-daemon baseline: one full CLI invocation per query
+        repo_root = str(Path(__file__).resolve().parent)
+        cli_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                   "PYTHONPATH": os.pathsep.join(
+                       [repo_root, os.environ.get("PYTHONPATH", "")])}
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "metis_tpu.planner.cli", "hetero",
+             "--hostfile", str(tmp / "hostfile"),
+             "--clusterfile", str(tmp / "clusterfile.json"),
+             "--profile-dir", str(tmp / "profiles"),
+             "--model-name", model.name,
+             "--num-layers", str(model.num_layers),
+             "--hidden-size", str(model.hidden_size),
+             "--seq-len", str(model.sequence_length),
+             "--vocab-size", str(model.vocab_size),
+             "--num-heads", str(model.num_heads),
+             "--gbs", str(config.gbs), "--top-k", str(SMOKE_TOP_K),
+             "--output", str(tmp / "cli_plans.json")],
+            capture_output=True, text=True, env=cli_env)
+        fresh_process_s = time.perf_counter() - t0
+        if proc.returncode == 0:
+            entry["fresh_process_plan_s"] = round(fresh_process_s, 3)
+
+        offline_json = dump_ranked_plans(
+            plan_hetero(cluster, profiles, model, config,
+                        top_k=SMOKE_TOP_K).plans)
+
+        try:
+            service = PlanService(cluster, profiles)
+            server, thread, address = serve_in_thread(service)
+        except OSError as e:
+            record["serve"] = {
+                "skipped_reason": f"socket setup failed: {e}"}
+            return
+        try:
+            client = PlanServiceClient(address)
+            t0 = time.perf_counter()
+            cold = client.plan(model, config, top_k=SMOKE_TOP_K)
+            entry["cold_plan_s"] = round(time.perf_counter() - t0, 4)
+            entry["byte_identical"] = cold["plans"] == offline_json
+
+            # warm-state cold: same search, memo tables already built
+            client.invalidate()
+            t0 = time.perf_counter()
+            warm = client.plan(model, config, top_k=SMOKE_TOP_K)
+            entry["warm_state_plan_s"] = round(time.perf_counter() - t0, 4)
+            entry["byte_identical"] &= warm["plans"] == offline_json
+            if proc.returncode == 0 and entry["warm_state_plan_s"] > 0:
+                entry["warm_vs_fresh_process"] = round(
+                    fresh_process_s / entry["warm_state_plan_s"], 2)
+            entry["warm_vs_cold"] = round(
+                entry["cold_plan_s"] / max(entry["warm_state_plan_s"],
+                                           1e-9), 2)
+
+            lat = []
+            for _ in range(50):
+                t0 = time.perf_counter()
+                hit = client.plan(model, config, top_k=SMOKE_TOP_K)
+                lat.append((time.perf_counter() - t0) * 1e3)
+                entry["byte_identical"] &= hit["plans"] == offline_json
+            entry["serve_cache_hit_ms"] = round(statistics.median(lat), 3)
+            entry["serve_cache_hit_p95_ms"] = round(
+                sorted(lat)[int(0.95 * (len(lat) - 1))], 3)
+
+            from concurrent.futures import ThreadPoolExecutor
+            n = 64 * 2
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=64) as pool:
+                got = list(pool.map(
+                    lambda _i: client.plan(model, config,
+                                           top_k=SMOKE_TOP_K)["plans"],
+                    range(n)))
+            dt = time.perf_counter() - t0
+            entry["qps_concurrent"] = round(n / dt, 1)
+            entry["concurrent_threads"] = 64
+            entry["byte_identical"] &= all(g == offline_json for g in got)
+            entry["cache"] = client.stats()["cache"]
+        finally:
+            try:
+                client.shutdown()
+            except Exception:
+                server.shutdown()
+            thread.join(10)
+            server.server_close()
+    record["serve"] = entry
+
+
 def tpu_validation(record: dict) -> None:
     """North-star error on REAL hardware: profile per-layer times on the TPU
     chip, plan a single-chip uniform schedule from those profiles, execute
@@ -1466,6 +1582,7 @@ def main() -> None:
     recorder.run("validation", validation_error, record)
     recorder.run("resilience", resilience_bench, record)
     recorder.run("overlap", overlap_bench, record)
+    recorder.run("serve", serve_bench, record)
 
     # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
     # proves the tunnel was alive at bench start — it wedged MID-RUN once
@@ -1556,6 +1673,16 @@ def _headline(record: dict) -> dict:
         "overlap_hidden_frac": (record.get("overlap") or {})
         .get("overlap_hidden_frac"),
         "overlap_skipped": (record.get("overlap") or {})
+        .get("skipped_reason"),
+        "serve_cache_hit_ms": (record.get("serve") or {})
+        .get("serve_cache_hit_ms"),
+        "serve_warm_vs_fresh_process": (record.get("serve") or {})
+        .get("warm_vs_fresh_process"),
+        "serve_qps_concurrent": (record.get("serve") or {})
+        .get("qps_concurrent"),
+        "serve_byte_identical": (record.get("serve") or {})
+        .get("byte_identical"),
+        "serve_skipped": (record.get("serve") or {})
         .get("skipped_reason"),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),

@@ -8,6 +8,7 @@ safe to tail.  A disabled log (no sink) is a no-op so call sites never guard.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from pathlib import Path
 from typing import IO, Any
@@ -21,13 +22,19 @@ class EventLog:
     append) — span/heartbeat instrumentation emits from search inner loops,
     where an open() per event would cost O(events) syscalls.  Line buffering
     keeps every record tail-able the moment it is written; ``close()`` (or
-    use as a context manager) releases the handle."""
+    use as a context manager) releases the handle.
+
+    Thread-safe: the serve daemon emits from many request threads into one
+    log, and a torn write would corrupt the JSONL contract that
+    tools/check_events_schema.py enforces, so one lock covers open/write/
+    flush/close."""
 
     def __init__(self, path: str | Path | None = None,
                  stream: IO[str] | None = None):
         self._stream: IO[str] | None = stream
         self._path = Path(path) if path is not None else None
         self._fh: IO[str] | None = None
+        self._lock = threading.Lock()
         if self._path is not None and stream is not None:
             raise ValueError("pass either path or stream, not both")
 
@@ -40,19 +47,21 @@ class EventLog:
             return
         record = {"ts": time.time(), "event": event, **fields}
         line = json.dumps(record, default=str) + "\n"
-        if self._stream is not None:
-            self._stream.write(line)
-            self._stream.flush()
-        else:
-            if self._fh is None:
-                self._fh = open(self._path, "a", buffering=1)
-            self._fh.write(line)
+        with self._lock:
+            if self._stream is not None:
+                self._stream.write(line)
+                self._stream.flush()
+            else:
+                if self._fh is None:
+                    self._fh = open(self._path, "a", buffering=1)
+                self._fh.write(line)
 
     def close(self) -> None:
         """Release the held file handle (emit after close reopens it)."""
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self) -> "EventLog":
         return self

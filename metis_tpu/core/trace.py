@@ -25,6 +25,7 @@ and render the recorded tree — the engine behind ``metis-tpu report``.
 """
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -35,28 +36,37 @@ from metis_tpu.core.events import EventLog, NULL_LOG
 class Counters:
     """Monotonic named counters.  ``inc`` is a dict add — cheap enough for
     per-candidate accounting in search loops; pass ``None`` instead of a
-    Counters to instrumented code when tracing is off to skip even that."""
+    Counters to instrumented code when tracing is off to skip even that.
 
-    __slots__ = ("_c",)
+    Thread-safe: the serve daemon shares one registry across request
+    threads, and the read-modify-write in ``inc`` is not atomic under
+    threads, so a lock covers every mutation and snapshot."""
+
+    __slots__ = ("_c", "_lock")
 
     def __init__(self) -> None:
         self._c: dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def inc(self, name: str, n: int = 1) -> None:
-        self._c[name] = self._c.get(name, 0) + n
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
 
     def get(self, name: str) -> int:
-        return self._c.get(name, 0)
+        with self._lock:
+            return self._c.get(name, 0)
 
     def merge(self, other: dict[str, int]) -> None:
         """Fold another run's counter dict into this registry — how the
         parallel search parent (search/parallel.py) reconciles per-worker
         accounting into the one ``counters`` event the run emits."""
-        for name, n in other.items():
-            self._c[name] = self._c.get(name, 0) + n
+        with self._lock:
+            for name, n in other.items():
+                self._c[name] = self._c.get(name, 0) + n
 
     def as_dict(self) -> dict[str, int]:
-        return dict(self._c)
+        with self._lock:
+            return dict(self._c)
 
     def __bool__(self) -> bool:
         return bool(self._c)

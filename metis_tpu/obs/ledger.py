@@ -148,6 +148,73 @@ def fingerprint_artifact(art) -> str:
 
 
 # ---------------------------------------------------------------------------
+# query fingerprints (serve-layer cache keys)
+# ---------------------------------------------------------------------------
+
+# SearchConfig fields that cannot change the ranked result, only how fast
+# (or how verbosely) it is computed: the parallel worker count and the
+# heartbeat cadence.  Byte-identity across these is the contract the
+# serial/parallel parity tests already pin, so two queries differing only
+# here may share a cache entry.  Every OTHER field — including the cost-
+# model toggles ``use_overlap_model``/``use_batch_eval`` — is hashed, so a
+# config flip can never return a stale cached plan.
+_RESULT_NEUTRAL_CONFIG_FIELDS = frozenset({"workers", "progress_every"})
+
+
+def calibration_fingerprint(calibration) -> str | None:
+    """12-hex identity of a ``cost.calibration.CollectiveCalibration``'s
+    pricing-relevant content (fitted curves, not raw samples); None for
+    None.  Two calibrations that price collectives identically — same
+    platform/device/group-size fits — fingerprint identically."""
+    if calibration is None:
+        return None
+    if hasattr(calibration, "to_json_dict"):
+        d = dict(calibration.to_json_dict())
+        d.pop("samples", None)
+    else:  # already a plain dict (e.g. loaded JSON)
+        d = {k: v for k, v in dict(calibration).items() if k != "samples"}
+    payload = json.dumps(d, sort_keys=True, separators=(",", ":"),
+                         default=str)
+    return hashlib.sha1(payload.encode()).hexdigest()[:12]
+
+
+def query_fingerprint(model, cluster, config, *, calibration=None,
+                      extra: dict | None = None) -> str:
+    """Stable 12-hex identity of a plan *query*: model × cluster × gbs ×
+    every cost-relevant ``SearchConfig`` field × calibration identity.
+
+    This is the serve-layer cache key (``serve/cache.PlanCache``), distinct
+    from :func:`plan_fingerprint` on purpose: a plan fingerprint identifies
+    a search *result*'s execution shape (it must stay stable across cost-
+    model changes so predictions join with measurements), while a query
+    fingerprint identifies a search *input* — flip any knob that could
+    change the ranking and the key must change.  sha1 over canonical JSON,
+    not ``hash()``, so the key is stable across processes and restarts.
+    """
+    cfg = dataclasses.asdict(config)
+    for name in _RESULT_NEUTRAL_CONFIG_FIELDS:
+        cfg.pop(name, None)
+    canonical = {
+        "model": dataclasses.asdict(model),
+        "cluster": {
+            "nodes": [[n.device_type, int(n.num_devices)]
+                      for n in cluster.nodes],
+            "devices": {
+                name: dataclasses.asdict(dev)
+                for name, dev in sorted(cluster.devices.items())
+            },
+        },
+        "config": cfg,
+        "calibration": calibration_fingerprint(calibration),
+    }
+    if extra:
+        canonical.update(extra)
+    payload = json.dumps(canonical, sort_keys=True, separators=(",", ":"),
+                         default=str)
+    return hashlib.sha1(payload.encode()).hexdigest()[:12]
+
+
+# ---------------------------------------------------------------------------
 # ledger records
 # ---------------------------------------------------------------------------
 

@@ -112,6 +112,34 @@ def _check_profile_attn(profiles: ProfileStore, model: ModelSpec) -> None:
             "--attn or change the model spec")
 
 
+def make_search_state(
+    cluster: ClusterSpec,
+    profiles: ProfileStore,
+    model: ModelSpec,
+    config: SearchConfig,
+    bandwidth_factory=None,
+    counters=None,
+) -> CandidateEvaluator:
+    """Build the search state ``plan_hetero`` otherwise constructs in its
+    setup span: the cost estimator, stage-performance model, layer
+    balancer, family grids, and (when enabled) the batched-costing tables.
+
+    A long-lived caller — the serve daemon (``serve/daemon.py``) — builds
+    this once per query shape and passes it back via
+    ``plan_hetero(search_state=...)`` so repeat searches start with every
+    memo table warm instead of rebuilding them per invocation.
+
+    Contract: the state is valid only for searches over exactly the
+    ``(cluster, profiles, model, config, bandwidth_factory)`` it was built
+    with (key on :func:`metis_tpu.obs.ledger.query_fingerprint`), and it is
+    NOT reentrant — one search at a time per state.
+    """
+    _check_profile_attn(profiles, model)
+    return CandidateEvaluator(
+        cluster, profiles, model, config,
+        bandwidth_factory=bandwidth_factory, counters=counters)
+
+
 def plan_hetero(
     cluster: ClusterSpec,
     profiles: ProfileStore,
@@ -121,6 +149,7 @@ def plan_hetero(
     top_k: int | None = None,
     events: EventLog = NULL_LOG,
     inter_filter=None,
+    search_state: CandidateEvaluator | None = None,
 ) -> PlannerResult:
     """Full heterogeneous search: inter-stage × intra-stage candidates,
     costed and ranked (≅ ``cost_het_cluster``).
@@ -140,7 +169,14 @@ def plan_hetero(
     With ``config.workers > 1`` the search runs sharded across worker
     processes (search/parallel.py) — same ranking, byte-for-byte — falling
     back to this serial loop (and emitting a ``parallel_fallback`` event)
-    when multiprocessing is unavailable or the inputs don't pickle."""
+    when multiprocessing is unavailable or the inputs don't pickle.
+
+    ``search_state``: a warm :func:`make_search_state` evaluator to reuse
+    instead of rebuilding estimator/balancer/grid tables — must have been
+    built for this exact (cluster, profiles, model, config,
+    bandwidth_factory); ranking is byte-identical either way because the
+    memo tables cache the same floats the cold path computes.  Ignored by
+    the ``workers > 1`` parallel path (workers build their own shards)."""
     _check_profile_attn(profiles, model)
     if config.workers > 1:
         from metis_tpu.search.parallel import try_parallel_plan_hetero
@@ -163,10 +199,13 @@ def plan_hetero(
     # cp/ep/zero/sp + schedule family grids, and the evaluate() generator)
     # lives in search/parallel.CandidateEvaluator so this serial driver and
     # the sharded workers run literally the same code.
-    ctx = CandidateEvaluator(
-        cluster, profiles, model, config,
-        bandwidth_factory=bandwidth_factory,
-        counters=tracer.counters if tracer.enabled else None)
+    if search_state is not None:
+        ctx = search_state
+    else:
+        ctx = CandidateEvaluator(
+            cluster, profiles, model, config,
+            bandwidth_factory=bandwidth_factory,
+            counters=tracer.counters if tracer.enabled else None)
     setup_span.__exit__(None, None, None)
     events.emit(
         "search_started", mode="hetero", devices=cluster.total_devices,
@@ -217,7 +256,9 @@ def plan_hetero(
         )
     if tracer.enabled:
         inter_iter = timed_iter(inter_iter, enum_acc)
-        ctx.intra_acc = intra_acc
+    # (Re)assign per-run accum hooks unconditionally: a reused search_state
+    # would otherwise carry a closed accum span from its previous run.
+    ctx.intra_acc = intra_acc if tracer.enabled else None
     ctx.cost_acc = cost_acc
     # Admitted inters are buffered and priced through evaluate_batch —
     # the batched table-driven costing path (cost/batch.py) when the

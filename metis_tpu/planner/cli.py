@@ -475,9 +475,48 @@ def main(argv: list[str] | None = None) -> int:
     _add_model_args(p_rep)
     _add_search_args(p_rep)
 
+    p_srv = sub.add_parser(
+        "serve", help="long-lived planner daemon (serve/daemon.py): answer "
+                      "plan queries over local HTTP (TCP or unix socket) "
+                      "from an LRU plan cache keyed by query fingerprint, "
+                      "with warm search state and drift-driven replanning")
+    p_srv.add_argument("--hostfile", required=True)
+    p_srv.add_argument("--clusterfile", required=True)
+    p_srv.add_argument("--profile-dir", required=True)
+    p_srv.add_argument("--host", default="127.0.0.1")
+    p_srv.add_argument("--port", type=int, default=0,
+                       help="TCP port (0 = ephemeral; the bound address is "
+                            "printed as JSON at boot)")
+    p_srv.add_argument("--socket", default=None,
+                       help="serve on this unix socket path instead of TCP")
+    p_srv.add_argument("--cache-size", type=int, default=128,
+                       help="plan cache capacity (LRU entries)")
+    p_srv.add_argument("--state-cache-size", type=int, default=8,
+                       help="warm search states retained (one per query "
+                            "shape; each holds estimator + memo tables)")
+    p_srv.add_argument("--drift-band", type=float, default=20.0,
+                       help="rolling MAPE %% band posted accuracy samples "
+                            "must stay inside before a replan fires")
+    p_srv.add_argument("--events", default=None,
+                       help="append structured JSONL daemon events here")
+
+    p_plan = sub.add_parser(
+        "plan", help="query a running plan daemon (metis-tpu serve) instead "
+                     "of searching in-process; output is byte-identical to "
+                     "'hetero' on the same workload")
+    p_plan.add_argument("--remote", required=True,
+                        help="daemon address: http://HOST:PORT or "
+                             "unix:/path/to.sock")
+    _add_model_args(p_plan)
+    _add_search_args(p_plan)
+
     args = parser.parse_args(argv)
 
     _pin_platform(args)
+    if args.command == "serve":
+        return _cmd_serve(args)
+    if args.command == "plan":
+        return _cmd_plan_remote(args)
     if args.command == "report":
         return _cmd_report(args)
     if args.command == "accuracy":
@@ -542,6 +581,53 @@ def main(argv: list[str] | None = None) -> int:
     print(
         f"costed {result.num_costed} plans ({result.num_pruned} pruned) "
         f"in {result.search_seconds:.2f}s",
+        file=sys.stderr)
+    return 0
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Boot the plan daemon and serve until interrupted (or POST
+    /shutdown).  Prints the bound address as one JSON line so wrappers
+    can parse it even with --port 0."""
+    from metis_tpu.serve.daemon import PlanService, make_server, run_server
+
+    cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    profiles = ProfileStore.from_dir(args.profile_dir)
+    events = EventLog(args.events) if args.events else NULL_LOG
+    service = PlanService(
+        cluster, profiles, cache_capacity=args.cache_size,
+        state_capacity=args.state_cache_size, events=events,
+        drift_band_pct=args.drift_band)
+    server = make_server(service, host=args.host, port=args.port,
+                         socket_path=args.socket)
+    print(json.dumps({
+        "serving": server.address,
+        "devices": cluster.total_devices,
+        "device_types": list(cluster.device_types),
+        "cache_capacity": args.cache_size,
+    }), flush=True)
+    run_server(server)
+    events.close()
+    return 0
+
+
+def _cmd_plan_remote(args: argparse.Namespace) -> int:
+    """Thin client: send the plan query to a running daemon and print its
+    response — the same dump_ranked_plans JSON 'hetero' emits."""
+    from metis_tpu.serve.client import PlanServiceClient
+
+    model = _model_from_args(args)
+    config = _config_from_args(args)
+    client = PlanServiceClient(args.remote)
+    resp = client.plan(model, config, top_k=args.top_k)
+    _emit(args, resp["plans"])
+    how = "cache hit" if resp.get("cached") else "cold search"
+    print(
+        f"{how} fingerprint={resp.get('fingerprint')} "
+        f"costed {resp.get('num_costed')} plans "
+        f"({resp.get('num_pruned')} pruned) in "
+        f"{resp.get('search_seconds', 0):.2f}s "
+        f"(served in {resp.get('serve_ms', 0):.1f}ms)",
         file=sys.stderr)
     return 0
 

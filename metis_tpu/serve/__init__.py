@@ -1,0 +1,20 @@
+"""Planner-as-a-service: persistent daemon, plan cache, thin client.
+
+The offline CLI pays full price — process start, profile load, estimator
+and memo-table construction — on every invocation.  This package keeps a
+planner resident: :mod:`serve.daemon` answers plan queries over local HTTP
+(TCP or unix socket, stdlib only) from an LRU cache keyed by
+``obs.ledger.query_fingerprint``, reuses warm search state
+(``planner.api.make_search_state``) for cold queries, and replans in the
+background when posted accuracy samples drift out of band.
+"""
+from metis_tpu.serve.cache import PlanCache
+from metis_tpu.serve.client import PlanServiceClient
+from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+__all__ = [
+    "PlanCache",
+    "PlanService",
+    "PlanServiceClient",
+    "serve_in_thread",
+]

@@ -1,0 +1,115 @@
+"""LRU plan cache for the serve daemon.
+
+Keys are serve-layer query fingerprints (``obs.ledger.query_fingerprint``
+— model × cluster × every cost-relevant SearchConfig field — suffixed
+with the requested top_k); values are fully rendered response payloads so
+a hit is a dict copy, not a re-serialization.  Accounting lands in the
+``serve.cache.*`` counters the daemon's ``/stats`` endpoint exposes:
+``hit``/``miss`` per lookup, ``evict`` when capacity pushes out the
+least-recently-used entry, ``invalidate`` per entry dropped by a drift
+alarm or cluster delta.
+
+Thread-safe: one lock serializes lookups and mutations — request threads
+hit this on every query, but the critical section is an OrderedDict move/
+pop, microseconds against the <10 ms cached-answer budget.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any
+
+from metis_tpu.core.trace import Counters
+
+
+class PlanCache:
+    """Bounded LRU mapping query fingerprint -> response payload."""
+
+    def __init__(self, capacity: int = 128,
+                 counters: Counters | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.counters = counters
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, dict] = OrderedDict()
+
+    def _inc(self, name: str) -> None:
+        if self.counters is not None:
+            self.counters.inc(f"serve.cache.{name}")
+
+    def get(self, key: str) -> dict | None:
+        """Payload for ``key`` (refreshing its recency), or None."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self._inc("miss")
+                return None
+            self._entries.move_to_end(key)
+        self._inc("hit")
+        return entry
+
+    def put(self, key: str, payload: dict) -> None:
+        """Insert/refresh ``key``, evicting LRU entries beyond capacity."""
+        evicted = 0
+        with self._lock:
+            self._entries[key] = payload
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                evicted += 1
+        for _ in range(evicted):
+            self._inc("evict")
+
+    def invalidate(self, key: str) -> bool:
+        """Drop one entry; True when it existed."""
+        with self._lock:
+            existed = self._entries.pop(key, None) is not None
+        if existed:
+            self._inc("invalidate")
+        return existed
+
+    def invalidate_where(self, predicate) -> list[str]:
+        """Drop every entry whose (key, payload) satisfies ``predicate``;
+        returns the dropped keys — how a drift alarm clears exactly the
+        queries whose cached best plan went stale."""
+        with self._lock:
+            doomed = [k for k, v in self._entries.items() if predicate(k, v)]
+            for k in doomed:
+                del self._entries[k]
+        for _ in doomed:
+            self._inc("invalidate")
+        return doomed
+
+    def invalidate_all(self) -> int:
+        """Drop everything (cluster topology changed); returns the count."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+        for _ in range(n):
+            self._inc("invalidate")
+        return n
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def keys(self) -> list[str]:
+        """Snapshot of keys, LRU-first (eviction order)."""
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> dict[str, Any]:
+        counters = self.counters.as_dict() if self.counters else {}
+        return {
+            "size": len(self),
+            "capacity": self.capacity,
+            "hits": counters.get("serve.cache.hit", 0),
+            "misses": counters.get("serve.cache.miss", 0),
+            "evictions": counters.get("serve.cache.evict", 0),
+            "invalidations": counters.get("serve.cache.invalidate", 0),
+        }

@@ -1,0 +1,147 @@
+"""Thin stdlib client for the plan daemon.
+
+Speaks the ``serve/daemon.py`` JSON-over-HTTP protocol against either a
+TCP address (``http://127.0.0.1:8642`` or bare ``127.0.0.1:8642``) or a
+unix socket (``unix:/run/metis-plan.sock``).  One connection per request —
+thread-safe by construction, which is what the ≥64-thread concurrency
+contract of ``tools/serve_smoke.py`` leans on.
+"""
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import socket
+import time
+from typing import Any
+
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.errors import MetisError
+
+
+class ServeClientError(MetisError):
+    """Daemon unreachable, or it answered with an error status."""
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, path: str, timeout: float):
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._socket_path)
+        self.sock = sock
+
+
+class PlanServiceClient:
+    """Client for one daemon address; every method is one round-trip."""
+
+    def __init__(self, address: str, timeout: float = 300.0):
+        self.address = address
+        self.timeout = timeout
+        if address.startswith("unix:"):
+            self._unix_path: str | None = address[len("unix:"):]
+            self._host, self._port = "localhost", 0
+        else:
+            self._unix_path = None
+            hostport = address
+            if hostport.startswith("http://"):
+                hostport = hostport[len("http://"):]
+            hostport = hostport.rstrip("/")
+            host, _, port = hostport.rpartition(":")
+            if not host or not port.isdigit():
+                raise ServeClientError(
+                    f"bad daemon address {address!r} — expected "
+                    "http://HOST:PORT or unix:/path/to.sock")
+            self._host, self._port = host, int(port)
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._unix_path is not None:
+            return _UnixHTTPConnection(self._unix_path, self.timeout)
+        return http.client.HTTPConnection(self._host, self._port,
+                                          timeout=self.timeout)
+
+    def _request(self, method: str, path: str,
+                 payload: dict | None = None, _retries: int = 3) -> dict:
+        conn = self._connection()
+        try:
+            body = json.dumps(payload).encode() if payload is not None \
+                else None
+            headers = {"Content-Type": "application/json"} if body else {}
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                status = resp.status
+            except ConnectionError as e:
+                # a connect burst can still outrun the daemon's accept
+                # backlog; every endpoint is idempotent (plan answers are
+                # deterministic + cached), so a short retry is safe
+                if _retries > 0:
+                    conn.close()
+                    time.sleep(0.05)
+                    return self._request(method, path, payload,
+                                         _retries=_retries - 1)
+                raise ServeClientError(
+                    f"plan daemon at {self.address} unreachable: {e}") \
+                    from e
+            except (OSError, http.client.HTTPException) as e:
+                raise ServeClientError(
+                    f"plan daemon at {self.address} unreachable: {e}") \
+                    from e
+            try:
+                out = json.loads(data) if data else {}
+            except json.JSONDecodeError as e:
+                raise ServeClientError(
+                    f"daemon sent invalid JSON ({e.msg})") from e
+            if status >= 400:
+                detail = out.get("error") if isinstance(out, dict) else None
+                raise ServeClientError(
+                    f"daemon error {status}: {detail or data!r}")
+            return out
+        finally:
+            conn.close()
+
+    # -- endpoints ----------------------------------------------------------
+    def plan(self, model: ModelSpec, config: SearchConfig,
+             top_k: int | None = None) -> dict:
+        """Plan query; the response's ``plans`` field is the exact
+        ``dump_ranked_plans`` JSON string the offline CLI prints."""
+        return self._request("POST", "/plan", {
+            "model": dataclasses.asdict(model),
+            "config": dataclasses.asdict(config),
+            "top_k": top_k,
+        })
+
+    def accuracy_sample(self, fingerprint: str, measured_ms: float,
+                        step: int | None = None, stage_ms=(),
+                        predicted_ms: float | None = None) -> dict:
+        payload: dict[str, Any] = {
+            "fingerprint": fingerprint, "measured_ms": measured_ms,
+            "step": step, "stage_ms": list(stage_ms),
+        }
+        if predicted_ms is not None:
+            payload["predicted_ms"] = predicted_ms
+        return self._request("POST", "/accuracy_sample", payload)
+
+    def cluster_delta(self, removed: dict[str, int]) -> dict:
+        return self._request("POST", "/cluster_delta", {"removed": removed})
+
+    def invalidate(self, fingerprint: str | None = None,
+                   drop_states: bool = False) -> dict:
+        return self._request("POST", "/invalidate", {
+            "fingerprint": fingerprint, "drop_states": drop_states})
+
+    def notifications(self, since: int = 0,
+                      timeout_s: float = 0.0) -> list[dict]:
+        out = self._request(
+            "GET", f"/notifications?since={since}&timeout={timeout_s}")
+        return out.get("notifications", [])
+
+    def stats(self) -> dict:
+        return self._request("GET", "/stats")
+
+    def shutdown(self) -> dict:
+        return self._request("POST", "/shutdown", {})

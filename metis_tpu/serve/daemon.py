@@ -1,0 +1,600 @@
+"""Planner daemon: long-lived plan service over local HTTP.
+
+The offline CLI rebuilds everything per invocation — process, profiles,
+estimator, memo tables.  :class:`PlanService` keeps all of it resident:
+
+- **Plan cache** (:mod:`serve.cache`): responses keyed by
+  ``obs.ledger.query_fingerprint`` (model × cluster × every cost-relevant
+  SearchConfig field) + requested top_k, so a repeat query is a dict copy
+  (<10 ms) instead of a search.  ``plan_request`` / ``plan_cache_hit`` /
+  ``plan_cache_miss`` events per query.
+- **Warm search state**: cold queries run through
+  ``planner.api.plan_hetero`` with a retained
+  ``make_search_state`` evaluator (estimator, balancer, stage grids,
+  batched-costing tables), so repeat cold searches skip setup.  States
+  are not reentrant, so one search runs at a time (``_search_lock``);
+  concurrency comes from the cache, and identical concurrent misses
+  coalesce single-flight behind one search.
+- **Drift-driven replanning**: trainers POST ``accuracy_sample``s; the
+  daemon owns the ``AccuracyMonitor``/``DriftDetector`` per plan
+  fingerprint and, when an alarm fires, runs
+  ``planner.replan.replan_on_drift`` in a background thread, invalidates
+  the affected cache entries, re-caches the fresh plan, and pushes a
+  ``replan_push`` notification that subscribed trainers collect via
+  long-polled ``GET /notifications``.
+
+Transport is stdlib-only: ``http.server.ThreadingHTTPServer`` on
+localhost TCP or an ``AF_UNIX`` socket.  Responses are byte-identical to
+the offline path — the ``plans`` field is the exact
+``core.types.dump_ranked_plans`` rendering the CLI prints.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any
+from urllib.parse import parse_qs, urlparse
+
+from metis_tpu.cluster.spec import ClusterSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.errors import MetisError
+from metis_tpu.core.events import EventLog, NULL_LOG
+from metis_tpu.core.trace import Counters, Tracer
+from metis_tpu.core.types import dump_ranked_plans
+from metis_tpu.obs.ledger import (
+    AccuracyLedger,
+    AccuracyMonitor,
+    fingerprint_ranked_plan,
+    query_fingerprint,
+)
+from metis_tpu.planner.api import make_search_state, plan_hetero
+from metis_tpu.planner.replan import (
+    ClusterDelta,
+    replan_on_drift,
+    shrink_cluster,
+)
+from metis_tpu.profiles.store import ProfileStore
+from metis_tpu.serve.cache import PlanCache
+
+
+def model_spec_from_dict(d: dict) -> ModelSpec:
+    """Rebuild a ModelSpec from its ``dataclasses.asdict`` JSON form."""
+    return ModelSpec(**{k: tuple(v) if isinstance(v, list) else v
+                        for k, v in d.items()})
+
+
+def search_config_from_dict(d: dict) -> SearchConfig:
+    """Rebuild a SearchConfig from JSON (lists back to tuples)."""
+    return SearchConfig(**{k: tuple(v) if isinstance(v, list) else v
+                           for k, v in d.items()})
+
+
+@dataclass
+class _QueryRecord:
+    """What the daemon remembers about a served query — enough to re-run
+    it when its plan drifts, even after the cache entry is invalidated."""
+
+    model: ModelSpec
+    config: SearchConfig
+    top_k: int | None
+    key: str
+    plan_fingerprint: str | None
+
+
+class PlanService:
+    """Transport-agnostic daemon core; the HTTP layer is a thin shim so
+    tests and the smoke tool can drive this in-process."""
+
+    def __init__(
+        self,
+        cluster: ClusterSpec,
+        profiles: ProfileStore,
+        *,
+        cache_capacity: int = 128,
+        state_capacity: int = 8,
+        events: EventLog = NULL_LOG,
+        calibration=None,
+        drift_band_pct: float = 20.0,
+        drift_min_samples: int = 5,
+        search_wait_s: float = 300.0,
+    ):
+        self.cluster = cluster
+        self.profiles = profiles
+        self.events = events
+        self.calibration = calibration
+        self.drift_band_pct = drift_band_pct
+        self.drift_min_samples = drift_min_samples
+        self.search_wait_s = search_wait_s
+        self.counters = Counters()
+        self.cache = PlanCache(cache_capacity, counters=self.counters)
+        self.state_capacity = state_capacity
+        self.ledger = AccuracyLedger(None)  # in-memory: daemon-lifetime
+        # _lock: registry/state-table mutations.  _search_lock: serializes
+        # searches (warm evaluators are not reentrant).  _accuracy_lock:
+        # ledger + monitors.  Ordering: never take _lock while holding it
+        # inside cache/_note locks; searches never hold _lock.
+        self._lock = threading.Lock()
+        self._search_lock = threading.Lock()
+        self._accuracy_lock = threading.Lock()
+        self._states: dict[str, Any] = {}  # query fp -> CandidateEvaluator
+        self._state_order: list[str] = []
+        self._inflight: dict[str, threading.Event] = {}
+        self._queries: dict[str, _QueryRecord] = {}
+        self._monitors: dict[str, AccuracyMonitor] = {}
+        self._handled_alarms: dict[str, int] = {}
+        self._notes: list[dict] = []
+        self._note_seq = 0
+        self._note_cond = threading.Condition()
+        self._t_start = time.monotonic()
+
+    # -- cache keys ---------------------------------------------------------
+    @staticmethod
+    def _cache_key(qfp: str, top_k: int | None) -> str:
+        return f"{qfp}/k={top_k if top_k is not None else 'all'}"
+
+    # -- warm search state --------------------------------------------------
+    def _state_for(self, qfp: str, model: ModelSpec, config: SearchConfig):
+        """Warm evaluator for this query shape, building (and LRU-bounding)
+        on demand.  Caller must hold ``_search_lock``."""
+        with self._lock:
+            state = self._states.get(qfp)
+            if state is not None:
+                self._state_order.remove(qfp)
+                self._state_order.append(qfp)
+                return state
+        state = make_search_state(self.cluster, self.profiles, model,
+                                  config, counters=self.counters)
+        with self._lock:
+            self._states[qfp] = state
+            self._state_order.append(qfp)
+            while len(self._state_order) > self.state_capacity:
+                evicted = self._state_order.pop(0)
+                self._states.pop(evicted, None)
+                self.counters.inc("serve.state_evict")
+        return state
+
+    # -- plan queries -------------------------------------------------------
+    def plan_query(self, model: ModelSpec, config: SearchConfig,
+                   top_k: int | None = None) -> dict:
+        """Answer one plan query: cache hit, coalesced wait, or cold
+        search with warm state.  Byte-identical to the offline path."""
+        t_req = time.perf_counter()
+        qfp = query_fingerprint(model, self.cluster, config,
+                                calibration=self.calibration)
+        key = self._cache_key(qfp, top_k)
+        self.counters.inc("serve.requests")
+        tracer = Tracer(self.events)
+        with tracer.span("serve_request", fingerprint=qfp,
+                         model=model.name, gbs=config.gbs) as span:
+            self.events.emit("plan_request", fingerprint=qfp,
+                             model=model.name, gbs=config.gbs, top_k=top_k)
+            entry = self.cache.get(key)
+            if entry is not None:
+                self.events.emit("plan_cache_hit", fingerprint=qfp)
+                span.set(cached=True)
+                return self._respond(entry, cached=True, t_req=t_req)
+            self.events.emit("plan_cache_miss", fingerprint=qfp)
+            span.set(cached=False)
+            # single-flight: identical concurrent misses wait for the
+            # leader's search to land in the cache instead of repeating it
+            while True:
+                with self._lock:
+                    waiter = self._inflight.get(key)
+                    if waiter is None:
+                        self._inflight[key] = threading.Event()
+                        break
+                waiter.wait(timeout=self.search_wait_s)
+                entry = self.cache.get(key)
+                if entry is not None:
+                    return self._respond(entry, cached=True, t_req=t_req)
+                # leader failed or timed out — loop to become the leader
+            try:
+                entry = self._search(qfp, key, model, config, top_k)
+            finally:
+                with self._lock:
+                    done = self._inflight.pop(key, None)
+                if done is not None:
+                    done.set()
+            return self._respond(entry, cached=False, t_req=t_req)
+
+    def _search(self, qfp: str, key: str, model: ModelSpec,
+                config: SearchConfig, top_k: int | None) -> dict:
+        with self._search_lock:
+            # warm state only helps the serial path; workers>1 queries go
+            # through search/parallel.py's own per-worker shards
+            state = (self._state_for(qfp, model, config)
+                     if config.workers == 1 else None)
+            result = plan_hetero(self.cluster, self.profiles, model, config,
+                                 top_k=top_k, events=self.events,
+                                 search_state=state)
+        best = result.best
+        plan_fp = fingerprint_ranked_plan(best) if best is not None else None
+        entry = {
+            "fingerprint": qfp,
+            "plan_fingerprint": plan_fp,
+            "top_k": top_k,
+            "plans": dump_ranked_plans(result.plans),
+            "best_cost_ms": best.cost.total_ms if best else None,
+            "num_costed": result.num_costed,
+            "num_pruned": result.num_pruned,
+            "num_bound_pruned": result.num_bound_pruned,
+            "search_seconds": round(result.search_seconds, 6),
+        }
+        with self._lock:
+            self._queries[key] = _QueryRecord(
+                model=model, config=config, top_k=top_k, key=key,
+                plan_fingerprint=plan_fp)
+        if best is not None and plan_fp is not None:
+            with self._accuracy_lock:
+                if plan_fp not in self.ledger.predictions:
+                    self.ledger.record_prediction(
+                        plan_fp, best.cost.total_ms, source="serve")
+        self.cache.put(key, entry)
+        return entry
+
+    @staticmethod
+    def _respond(entry: dict, *, cached: bool, t_req: float) -> dict:
+        out = dict(entry)
+        out["cached"] = cached
+        out["serve_ms"] = round((time.perf_counter() - t_req) * 1000, 3)
+        return out
+
+    # -- accuracy + drift ---------------------------------------------------
+    def post_accuracy_sample(self, fingerprint: str, measured_ms: float,
+                             step: int | None = None,
+                             stage_ms=(), predicted_ms=None) -> dict:
+        """Feed one measured step for a served plan; on a drift alarm a
+        background thread replans every query whose cached best is that
+        plan and pushes ``replan_push`` notifications."""
+        self.counters.inc("serve.accuracy_samples")
+        with self._accuracy_lock:
+            if (predicted_ms is not None
+                    and fingerprint not in self.ledger.predictions):
+                self.ledger.record_prediction(
+                    fingerprint, float(predicted_ms), source="serve")
+            monitor = self._monitors.get(fingerprint)
+            if monitor is None:
+                monitor = AccuracyMonitor(
+                    self.ledger, fingerprint, events=self.events,
+                    band_pct=self.drift_band_pct,
+                    min_samples=self.drift_min_samples,
+                    skip_steps=0, source="serve")
+                self._monitors[fingerprint] = monitor
+            monitor.observe(float(measured_ms), step=step,
+                            stage_ms=tuple(stage_ms))
+            status = monitor.status()
+            handled = self._handled_alarms.get(fingerprint, 0)
+            fire = status.alarms > handled
+            if fire:
+                self._handled_alarms[fingerprint] = status.alarms
+        if fire:
+            self.counters.inc("serve.drift_replans")
+            threading.Thread(
+                target=self._replan_for, args=(fingerprint, status),
+                name="metis-serve-replan", daemon=True).start()
+        return {
+            "fingerprint": fingerprint,
+            "in_drift": status.in_drift,
+            "rolling_mape_pct": status.rolling_mape_pct,
+            "n": status.n,
+            "alarms": status.alarms,
+            "replanning": fire,
+        }
+
+    def _replan_for(self, plan_fp: str, status) -> list[dict]:
+        """Drift-alarm fallout: re-search every registered query whose
+        best plan is ``plan_fp``, refresh the cache, notify trainers."""
+        with self._lock:
+            targets = [rec for rec in self._queries.values()
+                       if rec.plan_fingerprint == plan_fp]
+        notes: list[dict] = []
+        for rec in targets:
+            self.cache.invalidate(rec.key)
+            # re-key against the CURRENT topology — after a cluster delta
+            # the same (model, config) maps to a different fingerprint
+            qfp = query_fingerprint(rec.model, self.cluster, rec.config,
+                                    calibration=self.calibration)
+            new_key = self._cache_key(qfp, rec.top_k)
+            with self._search_lock:
+                state = (self._state_for(qfp, rec.model, rec.config)
+                         if rec.config.workers == 1 else None)
+                report = replan_on_drift(
+                    status, self.cluster, self.profiles, rec.model,
+                    rec.config, top_k=rec.top_k, events=self.events,
+                    search_state=state)
+            if report is None or report.result.best is None:
+                continue
+            best = report.result.best
+            new_fp = fingerprint_ranked_plan(best)
+            entry = {
+                "fingerprint": qfp,
+                "plan_fingerprint": new_fp,
+                "top_k": rec.top_k,
+                "plans": dump_ranked_plans(report.result.plans),
+                "best_cost_ms": best.cost.total_ms,
+                "num_costed": report.result.num_costed,
+                "num_pruned": report.result.num_pruned,
+                "num_bound_pruned": report.result.num_bound_pruned,
+                "search_seconds": round(report.result.search_seconds, 6),
+            }
+            self.cache.put(new_key, entry)
+            with self._lock:
+                self._queries.pop(rec.key, None)
+                self._queries[new_key] = _QueryRecord(
+                    model=rec.model, config=rec.config, top_k=rec.top_k,
+                    key=new_key, plan_fingerprint=new_fp)
+            with self._accuracy_lock:
+                if new_fp not in self.ledger.predictions:
+                    self.ledger.record_prediction(
+                        new_fp, best.cost.total_ms, source="serve")
+            changed = bool(report.plan_changed) and new_fp != plan_fp
+            note = self._push_note({
+                "kind": "replan_push",
+                "fingerprint": plan_fp,
+                "new_fingerprint": new_fp,
+                "query_fingerprint": qfp,
+                "plan_changed": changed,
+                "new_best_cost_ms": best.cost.total_ms,
+                "reason": "drift_alarm",
+            })
+            self.events.emit(
+                "replan_push", fingerprint=plan_fp, new_fingerprint=new_fp,
+                reason="drift_alarm", plan_changed=changed,
+                seq=note["seq"])
+            notes.append(note)
+        return notes
+
+    # -- topology change ----------------------------------------------------
+    def apply_cluster_delta(self, removed: dict[str, int]) -> dict:
+        """Lose devices (type -> count): swap in the survivor topology,
+        drop every cache entry and warm state, notify subscribers."""
+        removed = {str(t): int(n) for t, n in removed.items()}
+        with self._search_lock:
+            new_cluster = shrink_cluster(self.cluster, removed)
+            delta = ClusterDelta.between(self.cluster, new_cluster)
+            with self._lock:
+                self.cluster = new_cluster
+                self._states.clear()
+                self._state_order.clear()
+            invalidated = self.cache.invalidate_all()
+        note = self._push_note({
+            "kind": "cluster_delta",
+            "removed": delta.removed,
+            "added": delta.added,
+            "invalidated": invalidated,
+            "devices": new_cluster.total_devices,
+        })
+        return {"invalidated": invalidated, "removed": delta.removed,
+                "devices": new_cluster.total_devices, "seq": note["seq"]}
+
+    def invalidate(self, fingerprint: str | None = None,
+                   drop_states: bool = False) -> dict:
+        """Drop cache entries (all, or those for one query fingerprint);
+        warm states survive unless ``drop_states`` — the knob bench uses
+        to separate warm-state from cold-process search cost."""
+        if fingerprint is None:
+            n = self.cache.invalidate_all()
+        else:
+            n = len(self.cache.invalidate_where(
+                lambda _k, v: v.get("fingerprint") == fingerprint))
+        if drop_states:
+            with self._lock:
+                self._states.clear()
+                self._state_order.clear()
+        return {"invalidated": n}
+
+    # -- notifications ------------------------------------------------------
+    def _push_note(self, note: dict) -> dict:
+        with self._note_cond:
+            self._note_seq += 1
+            note = {"seq": self._note_seq, "ts": time.time(), **note}
+            self._notes.append(note)
+            del self._notes[:-256]  # bounded backlog
+            self._note_cond.notify_all()
+        return note
+
+    def notifications(self, since: int = 0,
+                      timeout_s: float = 0.0) -> list[dict]:
+        """Notes with seq > ``since``; blocks up to ``timeout_s`` for the
+        first new one (long-poll)."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._note_cond:
+            while True:
+                out = [n for n in self._notes if n["seq"] > since]
+                remaining = deadline - time.monotonic()
+                if out or remaining <= 0:
+                    return out
+                self._note_cond.wait(remaining)
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            "cluster_devices": self.cluster.total_devices,
+            "device_types": list(self.cluster.device_types),
+            "cache": self.cache.stats(),
+            "counters": self.counters.as_dict(),
+            "warm_states": len(self._states),
+            "monitors": len(self._monitors),
+            "queries": len(self._queries),
+            "note_seq": self._note_seq,
+        }
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport (stdlib http.server; TCP or AF_UNIX)
+# ---------------------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "metis-serve/1"
+
+    # quiet by default (the daemon's story is the events JSONL, not stderr)
+    def log_message(self, format: str, *args: Any) -> None:
+        pass
+
+    def address_string(self) -> str:
+        # AF_UNIX peers have no (host, port); BaseHTTPRequestHandler's
+        # default unpack would crash on the empty client_address
+        addr = self.client_address
+        return addr[0] if isinstance(addr, tuple) and addr else "unix"
+
+    @property
+    def service(self) -> PlanService:
+        return self.server.service  # type: ignore[attr-defined]
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return {}
+        data = self.rfile.read(length)
+        loaded = json.loads(data)
+        if not isinstance(loaded, dict):
+            raise ValueError("request body must be a JSON object")
+        return loaded
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        parsed = urlparse(self.path)
+        if parsed.path in ("/stats", "/healthz"):
+            self._json(200, self.service.stats())
+        elif parsed.path == "/notifications":
+            q = parse_qs(parsed.query)
+            since = int(q.get("since", ["0"])[0])
+            timeout_s = float(q.get("timeout", ["0"])[0])
+            notes = self.service.notifications(since=since,
+                                               timeout_s=timeout_s)
+            self._json(200, {"notifications": notes})
+        else:
+            self._json(404, {"error": f"no such endpoint: {parsed.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            body = self._body()
+            if self.path == "/plan":
+                model = model_spec_from_dict(body["model"])
+                config = search_config_from_dict(body["config"])
+                top_k = body.get("top_k")
+                out = self.service.plan_query(
+                    model, config,
+                    top_k=int(top_k) if top_k is not None else None)
+                self._json(200, out)
+            elif self.path == "/accuracy_sample":
+                out = self.service.post_accuracy_sample(
+                    str(body["fingerprint"]), float(body["measured_ms"]),
+                    step=body.get("step"),
+                    stage_ms=body.get("stage_ms", ()),
+                    predicted_ms=body.get("predicted_ms"))
+                self._json(200, out)
+            elif self.path == "/cluster_delta":
+                out = self.service.apply_cluster_delta(body["removed"])
+                self._json(200, out)
+            elif self.path == "/invalidate":
+                out = self.service.invalidate(
+                    fingerprint=body.get("fingerprint"),
+                    drop_states=bool(body.get("drop_states", False)))
+                self._json(200, out)
+            elif self.path == "/shutdown":
+                self._json(200, {"ok": True})
+                # shutdown() must run off the handler thread — it joins
+                # the serve_forever loop that is waiting on this handler
+                threading.Thread(target=self.server.shutdown,
+                                 daemon=True).start()
+            else:
+                self._json(404, {"error": f"no such endpoint: {self.path}"})
+        except (KeyError, TypeError, ValueError, MetisError) as e:
+            self._json(400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception as e:  # pragma: no cover - last-resort 500
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class _TCPServer(ThreadingHTTPServer):
+    """Loopback TCP server tuned for bursty local clients: the default
+    listen backlog of 5 resets connections the moment 64 threads connect
+    at once, which the smoke tool's concurrency contract forbids."""
+
+    request_queue_size = 128
+    daemon_threads = True
+
+
+class _UnixHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer over an AF_UNIX socket path."""
+
+    address_family = socket.AF_UNIX
+    request_queue_size = 128
+    daemon_threads = True
+
+    def __init__(self, path: str, handler) -> None:
+        self._socket_path = path
+        if os.path.exists(path):
+            os.unlink(path)
+        super().__init__(path, handler)
+
+    def server_bind(self) -> None:
+        # HTTPServer.server_bind assumes a (host, port) address; a unix
+        # path has neither, so bind directly and stub the name fields
+        self.socket.bind(self.server_address)
+        self.server_name = "localhost"
+        self.server_port = 0
+
+    def server_close(self) -> None:
+        super().server_close()
+        try:
+            os.unlink(self._socket_path)
+        except OSError:
+            pass
+
+
+def make_server(service: PlanService, host: str = "127.0.0.1",
+                port: int = 0, socket_path: str | Path | None = None):
+    """Bound, ready-to-serve HTTP server; ``server.address`` is the
+    client-facing address string (``http://...`` or ``unix:...``)."""
+    if socket_path is not None:
+        server = _UnixHTTPServer(str(socket_path), _Handler)
+        server.address = f"unix:{socket_path}"
+    else:
+        server = _TCPServer((host, port), _Handler)
+        bound_host, bound_port = server.server_address[:2]
+        server.address = f"http://{bound_host}:{bound_port}"
+    server.service = service
+    return server
+
+
+def serve_in_thread(service: PlanService, host: str = "127.0.0.1",
+                    port: int = 0, socket_path: str | Path | None = None):
+    """Start serving on a background thread.
+
+    Returns ``(server, thread, address)`` — the in-process boot path the
+    smoke tool, tests, and bench use.  ``POST /shutdown`` (or
+    ``server.shutdown()``) ends the thread; then ``server.server_close()``.
+    """
+    server = make_server(service, host=host, port=port,
+                         socket_path=socket_path)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="metis-serve", daemon=True)
+    thread.start()
+    return server, thread, server.address
+
+
+def run_server(server) -> None:
+    """Blocking serve loop for the CLI; Ctrl-C exits cleanly."""
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()

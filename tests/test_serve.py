@@ -1,0 +1,381 @@
+"""Planner-as-a-service tests: plan cache, query fingerprint, daemon.
+
+Covers the serve-layer contracts:
+- PlanCache: LRU eviction order, capacity bound, serve.cache.* counters,
+  invalidation.
+- query_fingerprint: inequality across every cost-relevant SearchConfig
+  toggle (the stale-cache regression), equality across processes,
+  neutrality of result-neutral fields.
+- PlanService in-process: hit/miss semantics, byte-identity with the
+  offline path, warm-state reuse, drift-alarm replan + notification,
+  ClusterDelta invalidation.
+- tools/serve_smoke.py wired in as the tier-1 end-to-end gate (HTTP
+  transport, 64-thread concurrency, p50 budget, schema-valid events).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from metis_tpu.cluster import ClusterSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.trace import Counters
+from metis_tpu.obs.ledger import calibration_fingerprint, query_fingerprint
+from metis_tpu.serve.cache import PlanCache
+
+
+# ---------------------------------------------------------------------------
+# PlanCache
+# ---------------------------------------------------------------------------
+
+
+class TestPlanCache:
+    def test_hit_miss_and_counters(self):
+        c = Counters()
+        cache = PlanCache(capacity=4, counters=c)
+        assert cache.get("a") is None
+        cache.put("a", {"v": 1})
+        assert cache.get("a") == {"v": 1}
+        assert c.get("serve.cache.miss") == 1
+        assert c.get("serve.cache.hit") == 1
+
+    def test_lru_eviction_order(self):
+        c = Counters()
+        cache = PlanCache(capacity=2, counters=c)
+        cache.put("a", {"v": 1})
+        cache.put("b", {"v": 2})
+        cache.get("a")  # refresh a: b becomes LRU
+        cache.put("c", {"v": 3})  # evicts b
+        assert "b" not in cache
+        assert cache.get("a") == {"v": 1}
+        assert cache.get("c") == {"v": 3}
+        assert c.get("serve.cache.evict") == 1
+
+    def test_capacity_bound(self):
+        cache = PlanCache(capacity=3)
+        for i in range(10):
+            cache.put(f"k{i}", {"v": i})
+        assert len(cache) == 3
+        assert cache.keys() == ["k7", "k8", "k9"]
+
+    def test_put_refreshes_recency(self):
+        cache = PlanCache(capacity=2)
+        cache.put("a", {"v": 1})
+        cache.put("b", {"v": 2})
+        cache.put("a", {"v": 10})  # refresh: b is now LRU
+        cache.put("c", {"v": 3})
+        assert "b" not in cache
+        assert cache.get("a") == {"v": 10}
+
+    def test_invalidate_single_and_counters(self):
+        c = Counters()
+        cache = PlanCache(capacity=4, counters=c)
+        cache.put("a", {"v": 1})
+        assert cache.invalidate("a") is True
+        assert cache.invalidate("a") is False  # already gone: no counter
+        assert c.get("serve.cache.invalidate") == 1
+        assert cache.get("a") is None
+
+    def test_invalidate_where_and_all(self):
+        c = Counters()
+        cache = PlanCache(capacity=8, counters=c)
+        for i in range(4):
+            cache.put(f"k{i}", {"fingerprint": "x" if i < 2 else "y"})
+        dropped = cache.invalidate_where(
+            lambda _k, v: v["fingerprint"] == "x")
+        assert sorted(dropped) == ["k0", "k1"]
+        assert len(cache) == 2
+        assert cache.invalidate_all() == 2
+        assert len(cache) == 0
+        assert c.get("serve.cache.invalidate") == 4
+
+    def test_rejects_nonpositive_capacity(self):
+        with pytest.raises(ValueError):
+            PlanCache(capacity=0)
+
+    def test_stats_shape(self):
+        cache = PlanCache(capacity=4, counters=Counters())
+        cache.put("a", {})
+        cache.get("a")
+        cache.get("zz")
+        s = cache.stats()
+        assert s["size"] == 1 and s["capacity"] == 4
+        assert s["hits"] == 1 and s["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# query_fingerprint
+# ---------------------------------------------------------------------------
+
+
+def _mini_model(**over) -> ModelSpec:
+    base = dict(name="m", num_layers=4, hidden_size=256,
+                sequence_length=128, vocab_size=1000, num_heads=4)
+    base.update(over)
+    return ModelSpec(**base)
+
+
+def _mini_cluster() -> ClusterSpec:
+    return ClusterSpec.of(("A100", 1, 4))
+
+
+class TestQueryFingerprint:
+    def test_stable_for_identical_inputs(self):
+        a = query_fingerprint(_mini_model(), _mini_cluster(),
+                              SearchConfig(gbs=16))
+        b = query_fingerprint(_mini_model(), _mini_cluster(),
+                              SearchConfig(gbs=16))
+        assert a == b
+        assert len(a) == 12
+
+    @pytest.mark.parametrize("flip", [
+        {"use_overlap_model": False},
+        {"use_batch_eval": False},
+        {"strict_compat": True},
+        {"gbs": 32},
+        {"max_profiled_tp": 2},
+        {"max_profiled_bs": 8},
+        {"mem_coef": 1.0},
+        {"enable_sp": True},
+        {"enable_zero": True},
+        {"enable_schedule_search": True},
+        {"dp_overlap_fraction": 0.5},
+        {"prune_to_top_k": 10},
+    ])
+    def test_cost_relevant_toggle_changes_fingerprint(self, flip):
+        """The stale-cache regression: flipping ANY cost-relevant config
+        field must produce a different cache key."""
+        base = SearchConfig(gbs=16)
+        flipped = dataclasses.replace(base, **flip)
+        assert (query_fingerprint(_mini_model(), _mini_cluster(), base)
+                != query_fingerprint(_mini_model(), _mini_cluster(),
+                                     flipped))
+
+    @pytest.mark.parametrize("flip", [
+        {"workers": 4},
+        {"progress_every": 17},
+    ])
+    def test_result_neutral_fields_do_not_change_fingerprint(self, flip):
+        """Fields that by construction cannot change the ranked result
+        (serial/parallel byte-identity, heartbeat cadence) share a key."""
+        base = SearchConfig(gbs=16)
+        flipped = dataclasses.replace(base, **flip)
+        assert (query_fingerprint(_mini_model(), _mini_cluster(), base)
+                == query_fingerprint(_mini_model(), _mini_cluster(),
+                                     flipped))
+
+    def test_model_and_cluster_change_fingerprint(self):
+        cfg = SearchConfig(gbs=16)
+        base = query_fingerprint(_mini_model(), _mini_cluster(), cfg)
+        assert query_fingerprint(_mini_model(num_layers=8),
+                                 _mini_cluster(), cfg) != base
+        bigger = ClusterSpec.of(("A100", 2, 4))
+        assert query_fingerprint(_mini_model(), bigger, cfg) != base
+
+    def test_calibration_identity(self):
+        cfg = SearchConfig(gbs=16)
+        none = query_fingerprint(_mini_model(), _mini_cluster(), cfg)
+        cal = {"platform": "tpu", "device_kind": "v5e", "group_size": 8,
+               "fits": {"all_reduce": [1.0, 2.0]},
+               "samples": [[1, 2, 3]]}
+        with_cal = query_fingerprint(_mini_model(), _mini_cluster(), cfg,
+                                     calibration=cal)
+        assert with_cal != none
+        # samples are measurement noise, not pricing: excluded
+        cal2 = dict(cal, samples=[[9, 9, 9]])
+        assert query_fingerprint(_mini_model(), _mini_cluster(), cfg,
+                                 calibration=cal2) == with_cal
+        cal3 = dict(cal, fits={"all_reduce": [9.0, 9.0]})
+        assert query_fingerprint(_mini_model(), _mini_cluster(), cfg,
+                                 calibration=cal3) != with_cal
+        assert calibration_fingerprint(None) is None
+
+    def test_equal_across_processes(self):
+        """sha1-of-canonical-JSON, not hash(): a daemon restart (new
+        PYTHONHASHSEED) must produce the same cache keys."""
+        local = query_fingerprint(_mini_model(), _mini_cluster(),
+                                  SearchConfig(gbs=16))
+        script = (
+            "from metis_tpu.cluster import ClusterSpec\n"
+            "from metis_tpu.core.config import ModelSpec, SearchConfig\n"
+            "from metis_tpu.obs.ledger import query_fingerprint\n"
+            "m = ModelSpec(name='m', num_layers=4, hidden_size=256,\n"
+            "              sequence_length=128, vocab_size=1000,\n"
+            "              num_heads=4)\n"
+            "print(query_fingerprint(m, ClusterSpec.of(('A100', 1, 4)),\n"
+            "                        SearchConfig(gbs=16)))\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, check=True,
+            cwd=Path(__file__).resolve().parent.parent,
+            env={**os.environ, "PYTHONHASHSEED": "12345",
+                 "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.stdout.strip() == local
+
+
+# ---------------------------------------------------------------------------
+# PlanService (in-process, no HTTP — transport is covered by the smoke)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_workload():
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+    model = tiny_test_model(num_layers=4)
+    profiles = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2],
+                                   bss=[1, 2, 4])
+    cluster = ClusterSpec.of(("A100", 1, 4), ("T4", 1, 4))
+    config = SearchConfig(gbs=16, max_profiled_tp=2, max_profiled_bs=4)
+    return cluster, profiles, model, config
+
+
+@pytest.fixture()
+def service(small_workload):
+    from metis_tpu.serve.daemon import PlanService
+
+    cluster, profiles, model, config = small_workload
+    return PlanService(cluster, profiles, drift_min_samples=5)
+
+
+class TestPlanService:
+    def test_miss_then_hit_byte_identical_to_offline(self, small_workload,
+                                                     service):
+        from metis_tpu.core.types import dump_ranked_plans
+        from metis_tpu.planner.api import plan_hetero
+
+        cluster, profiles, model, config = small_workload
+        offline = dump_ranked_plans(
+            plan_hetero(cluster, profiles, model, config, top_k=5).plans)
+        cold = service.plan_query(model, config, top_k=5)
+        assert cold["cached"] is False
+        assert cold["plans"] == offline
+        hit = service.plan_query(model, config, top_k=5)
+        assert hit["cached"] is True
+        assert hit["plans"] == offline
+        assert service.counters.get("serve.cache.hit") == 1
+        assert service.counters.get("serve.cache.miss") == 1
+
+    def test_distinct_config_distinct_entry(self, small_workload, service):
+        _, _, model, config = small_workload
+        a = service.plan_query(model, config, top_k=5)
+        flipped = dataclasses.replace(config, use_overlap_model=False)
+        b = service.plan_query(model, flipped, top_k=5)
+        assert b["cached"] is False
+        assert a["fingerprint"] != b["fingerprint"]
+        assert len(service.cache) == 2
+
+    def test_warm_state_reuse_is_byte_identical(self, small_workload,
+                                                service):
+        _, _, model, config = small_workload
+        first = service.plan_query(model, config, top_k=5)
+        service.invalidate()  # drop cache, KEEP warm state
+        assert service.stats()["warm_states"] == 1
+        again = service.plan_query(model, config, top_k=5)
+        assert again["cached"] is False
+        assert again["plans"] == first["plans"]
+
+    def test_drift_alarm_replans_and_notifies(self, small_workload,
+                                              service):
+        _, _, model, config = small_workload
+        cold = service.plan_query(model, config, top_k=5)
+        fp = cold["plan_fingerprint"]
+        status = None
+        for step in range(8):
+            status = service.post_accuracy_sample(
+                fp, measured_ms=cold["best_cost_ms"] * 2.0, step=step)
+        assert status["in_drift"] is True
+        assert status["alarms"] == 1
+        notes = service.notifications(since=0, timeout_s=30.0)
+        pushes = [n for n in notes if n["kind"] == "replan_push"]
+        assert len(pushes) == 1
+        assert pushes[0]["fingerprint"] == fp
+        # same topology: identical ranking re-primed under the same key
+        refreshed = service.plan_query(model, config, top_k=5)
+        assert refreshed["cached"] is True
+        assert refreshed["plans"] == cold["plans"]
+        # hysteresis: more bad samples fire no second alarm/replan
+        before = service.stats()["note_seq"]
+        for step in range(8, 12):
+            service.post_accuracy_sample(
+                fp, measured_ms=cold["best_cost_ms"] * 2.0, step=step)
+        assert service.notifications(since=before) == []
+
+    def test_in_band_samples_do_not_replan(self, small_workload, service):
+        _, _, model, config = small_workload
+        cold = service.plan_query(model, config, top_k=5)
+        fp = cold["plan_fingerprint"]
+        for step in range(10):
+            out = service.post_accuracy_sample(
+                fp, measured_ms=cold["best_cost_ms"] * 1.01, step=step)
+            assert out["replanning"] is False
+        assert service.notifications(since=0) == []
+
+    def test_cluster_delta_invalidates_and_rekeys(self, small_workload,
+                                                  service):
+        _, _, model, config = small_workload
+        cold = service.plan_query(model, config, top_k=5)
+        out = service.apply_cluster_delta({"T4": 4})
+        assert out["invalidated"] == 1
+        assert out["devices"] == 4
+        assert service.stats()["warm_states"] == 0
+        notes = service.notifications(since=0)
+        assert notes and notes[-1]["kind"] == "cluster_delta"
+        shrunk = service.plan_query(model, config, top_k=5)
+        assert shrunk["cached"] is False
+        assert shrunk["fingerprint"] != cold["fingerprint"]
+        assert shrunk["plans"] != cold["plans"]
+
+    def test_cluster_delta_rejects_overdraw(self, service):
+        from metis_tpu.core.errors import ClusterSpecError
+
+        with pytest.raises(ClusterSpecError):
+            service.apply_cluster_delta({"T4": 99})
+
+    def test_stats_shape(self, small_workload, service):
+        _, _, model, config = small_workload
+        service.plan_query(model, config, top_k=5)
+        s = service.stats()
+        assert s["cluster_devices"] == 8
+        assert s["cache"]["size"] == 1
+        assert s["warm_states"] == 1
+        assert s["queries"] == 1
+        assert json.dumps(s)  # JSON-serializable end to end
+
+
+# ---------------------------------------------------------------------------
+# end-to-end smoke (HTTP transport, concurrency, p50, event schema)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_smoke_tier1(tmp_path):
+    """The acceptance gate: byte-identical daemon responses, cached p50
+    under budget, >= 64 clean concurrent queries, valid event JSONL."""
+    from tools.serve_smoke import run_smoke
+
+    out = run_smoke(threads=64, per_thread=2, cached_queries=50,
+                    work_dir=tmp_path)
+    assert out["ok"] is True
+    assert out["serve_cache_hit_p50_ms"] < 10.0
+    assert out["concurrent_queries"] >= 64
+
+
+def test_serve_smoke_unix_socket(tmp_path):
+    """Same contract over AF_UNIX — the deployment mode the CLI's
+    --socket flag uses."""
+    from tools.serve_smoke import run_smoke
+
+    out = run_smoke(threads=16, per_thread=1, cached_queries=20,
+                    unix_socket=True, work_dir=tmp_path)
+    assert out["ok"] is True
+    assert out["address"].startswith("unix:")

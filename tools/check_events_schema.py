@@ -53,6 +53,14 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "pipeline_overlap": {"schedule", "dp_chunk_elems"},
     "overlap_measured": {"lockstep_ms", "overlapped_ms",
                          "overlap_hidden_frac"},
+    # planner-as-a-service (serve/daemon.py): one plan_request per query,
+    # then exactly one of plan_cache_hit / plan_cache_miss; replan_push
+    # when a drift alarm re-searched a served plan (carries
+    # new_fingerprint + the notification seq subscribers long-poll for)
+    "plan_request": {"fingerprint"},
+    "plan_cache_hit": {"fingerprint"},
+    "plan_cache_miss": {"fingerprint"},
+    "replan_push": {"fingerprint", "new_fingerprint", "reason"},
     # fault tolerance (resilience/ — faults.py, retry.py, supervisor.py)
     "fault_injected": {"point"},
     "retry_attempt": {"op", "attempt"},

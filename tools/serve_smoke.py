@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Serve-daemon smoke: boot in-process, prove the serving contract.
+
+The tier-1 gate for ``metis_tpu/serve``: on the parity workload (the same
+2xT4 + 2xA100 fixture the cost-parity tests pin) it
+
+1. plans offline via ``plan_hetero`` and renders ``dump_ranked_plans``,
+2. boots ``PlanService`` + HTTP server in-process (loopback TCP, or a
+   unix socket with ``--unix-socket``),
+3. asserts the daemon's cold response is byte-identical to the offline
+   rendering (same fingerprint, same ranked costs, same JSON bytes),
+4. asserts cached-answer p50 latency < the budget (10 ms),
+5. fires >= 64 concurrent threads of cached queries plus one concurrent
+   cold wave (single-flight coalescing) — every response must be
+   byte-identical, none dropped,
+6. drives the drift path: posts out-of-band accuracy samples until the
+   daemon replans and pushes a ``replan_push`` notification,
+7. shuts the daemon down cleanly and validates the whole event JSONL
+   against tools/check_events_schema.py.
+
+Usage:  python tools/serve_smoke.py [--threads 64] [--json]
+Also importable: ``run_smoke(...) -> dict`` (tests/test_serve.py) and
+``parity_inputs(tmpdir)`` (bench.py's serve section).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SMOKE_TOP_K = 10
+P50_BUDGET_MS = 10.0
+
+
+def parity_inputs(tmpdir: str | Path):
+    """(cluster, profiles, model, config) for the parity workload."""
+    from metis_tpu.cluster.spec import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.profiles import tiny_test_model
+    from metis_tpu.profiles.store import ProfileStore
+    from metis_tpu.testing import (
+        PARITY_GBS,
+        PARITY_MAX_BS,
+        PARITY_MAX_TP,
+        write_parity_fixture,
+    )
+
+    tmpdir = Path(tmpdir)
+    if not (tmpdir / "hostfile").exists():
+        write_parity_fixture(tmpdir)
+    cluster = ClusterSpec.from_files(tmpdir / "hostfile",
+                                     tmpdir / "clusterfile.json")
+    profiles = ProfileStore.from_dir(tmpdir / "profiles")
+    model = tiny_test_model()
+    config = SearchConfig(gbs=PARITY_GBS, max_profiled_tp=PARITY_MAX_TP,
+                          max_profiled_bs=PARITY_MAX_BS)
+    return cluster, profiles, model, config
+
+
+def run_smoke(threads: int = 64, per_thread: int = 2,
+              cached_queries: int = 50,
+              p50_budget_ms: float = P50_BUDGET_MS,
+              drift_timeout_s: float = 60.0,
+              unix_socket: bool = False,
+              work_dir: str | Path | None = None) -> dict:
+    """Full smoke; raises AssertionError on any contract violation,
+    returns the measurement dict on success."""
+    from metis_tpu.core.events import EventLog
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.planner.api import plan_hetero
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from tools.check_events_schema import validate_file
+
+    own_tmp = None
+    if work_dir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="metis-serve-smoke-")
+        work_dir = own_tmp.name
+    work_dir = Path(work_dir)
+    out: dict = {"threads": threads, "p50_budget_ms": p50_budget_ms}
+    try:
+        cluster, profiles, model, config = parity_inputs(work_dir)
+
+        # 1. offline reference: the byte-identity oracle
+        t0 = time.perf_counter()
+        offline = plan_hetero(cluster, profiles, model, config,
+                              top_k=SMOKE_TOP_K)
+        out["offline_plan_s"] = round(time.perf_counter() - t0, 4)
+        offline_json = dump_ranked_plans(offline.plans)
+        assert offline.plans, "offline search produced no plans"
+
+        # 2. daemon in-process
+        events_path = work_dir / "serve_events.jsonl"
+        events = EventLog(events_path)
+        service = PlanService(cluster, profiles, events=events,
+                              drift_min_samples=5)
+        socket_path = (work_dir / "serve.sock") if unix_socket else None
+        server, thread, address = serve_in_thread(
+            service, socket_path=socket_path)
+        out["address"] = address
+        client = PlanServiceClient(address)
+
+        try:
+            # 3. cold query: byte-identical to offline
+            t0 = time.perf_counter()
+            cold = client.plan(model, config, top_k=SMOKE_TOP_K)
+            out["cold_plan_s"] = round(time.perf_counter() - t0, 4)
+            assert cold["cached"] is False, "first query must be a miss"
+            assert cold["plans"] == offline_json, (
+                "daemon cold response is not byte-identical to offline "
+                "dump_ranked_plans")
+            assert cold["num_costed"] == offline.num_costed
+
+            # 4. cached p50
+            lat_ms = []
+            for _ in range(cached_queries):
+                t0 = time.perf_counter()
+                hit = client.plan(model, config, top_k=SMOKE_TOP_K)
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+                assert hit["cached"] is True
+                assert hit["plans"] == offline_json
+            out["serve_cache_hit_p50_ms"] = round(
+                statistics.median(lat_ms), 3)
+            out["serve_cache_hit_p95_ms"] = round(
+                sorted(lat_ms)[int(0.95 * (len(lat_ms) - 1))], 3)
+            assert out["serve_cache_hit_p50_ms"] < p50_budget_ms, (
+                f"cached p50 {out['serve_cache_hit_p50_ms']}ms over the "
+                f"{p50_budget_ms}ms budget")
+
+            # 5a. concurrent cached queries: none dropped, none corrupt
+            def _one_query(_i: int) -> str:
+                return client.plan(model, config,
+                                   top_k=SMOKE_TOP_K)["plans"]
+
+            n_queries = threads * per_thread
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                got = list(pool.map(_one_query, range(n_queries)))
+            dt = time.perf_counter() - t0
+            assert len(got) == n_queries, "dropped concurrent responses"
+            bad = sum(1 for g in got if g != offline_json)
+            assert bad == 0, f"{bad}/{n_queries} corrupt concurrent responses"
+            out["concurrent_queries"] = n_queries
+            out["concurrent_qps"] = round(n_queries / dt, 1)
+
+            # 5b. concurrent cold wave: invalidate (keep warm state) and
+            # hit the same miss from every thread — single-flight must
+            # coalesce them onto one search, all byte-identical
+            client.invalidate()
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                got = list(pool.map(_one_query, range(threads)))
+            out["concurrent_cold_s"] = round(time.perf_counter() - t0, 4)
+            assert all(g == offline_json for g in got), (
+                "corrupt response in the concurrent cold wave")
+
+            # 6. drift: post 2x-predicted samples until the replan lands
+            plan_fp = cold["plan_fingerprint"]
+            predicted = cold["best_cost_ms"]
+            seq_before = client.stats()["note_seq"]
+            for step in range(8):
+                client.accuracy_sample(plan_fp,
+                                       measured_ms=predicted * 2.0,
+                                       step=step)
+            notes = client.notifications(since=seq_before,
+                                         timeout_s=drift_timeout_s)
+            pushes = [n for n in notes if n.get("kind") == "replan_push"]
+            assert pushes, (
+                f"no replan_push within {drift_timeout_s}s of drift "
+                f"samples (notes: {notes})")
+            out["replan_push"] = {
+                k: pushes[0].get(k)
+                for k in ("fingerprint", "new_fingerprint", "plan_changed")}
+            # replan re-primed the cache: next query is a hit again
+            refreshed = client.plan(model, config, top_k=SMOKE_TOP_K)
+            assert refreshed["cached"] is True, (
+                "replan did not re-prime the cache")
+            assert refreshed["plans"] == offline_json, (
+                "replan on the same topology must rank identically")
+
+            stats = client.stats()
+            out["cache"] = stats["cache"]
+        finally:
+            # 7. clean shutdown
+            try:
+                client.shutdown()
+            except Exception:
+                server.shutdown()
+            thread.join(10)
+            alive = thread.is_alive()
+            server.server_close()
+            events.close()
+        assert not alive, "server thread survived shutdown"
+
+        n_events, problems = validate_file(events_path)
+        assert not problems, (
+            f"daemon event JSONL failed schema check: {problems[:5]}")
+        out["events"] = n_events
+        out["ok"] = True
+        return out
+    finally:
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--threads", type=int, default=64)
+    parser.add_argument("--cached-queries", type=int, default=50)
+    parser.add_argument("--p50-budget-ms", type=float, default=P50_BUDGET_MS)
+    parser.add_argument("--unix-socket", action="store_true",
+                        help="serve over AF_UNIX instead of loopback TCP")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+    try:
+        out = run_smoke(threads=args.threads,
+                        cached_queries=args.cached_queries,
+                        p50_budget_ms=args.p50_budget_ms,
+                        unix_socket=args.unix_socket)
+    except AssertionError as e:
+        print(f"serve smoke FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"serve smoke OK: cold {out['cold_plan_s']}s, cached p50 "
+              f"{out['serve_cache_hit_p50_ms']}ms, "
+              f"{out['concurrent_queries']} concurrent queries at "
+              f"{out['concurrent_qps']}/s, {out['events']} schema-valid "
+              f"events")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
