@@ -1216,6 +1216,77 @@ def serve_bench(record: dict) -> None:
     record["serve"] = entry
 
 
+def inference_bench(record: dict) -> None:
+    """Latency-SLO serving planner (metis_tpu/inference) on the parity
+    workload:
+
+    - ``slo_p99_ttft_ms`` (headline): the best disaggregated plan's p99
+      TTFT under the PARITY_INFERENCE SLOs, plus TPOT/throughput and the
+      search wall time;
+    - ``replay_slo_attainment`` (headline): request-weighted SLO
+      attainment over one diurnal traffic cycle replayed against the
+      in-process serve daemon with elastic cluster deltas (replan pushes
+      counted).
+
+    Socket setup can fail on locked-down hosts — the replay half skips
+    with the honest reason while the offline search numbers survive."""
+    from metis_tpu.inference.planner import plan_inference
+    from metis_tpu.inference.replay import replay_traffic
+    from metis_tpu.inference.workload import InferenceWorkload
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from metis_tpu.testing import PARITY_INFERENCE
+    from tools.serve_smoke import parity_inputs
+
+    entry: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        cluster, profiles, model, config = parity_inputs(Path(td))
+        workload = InferenceWorkload(**PARITY_INFERENCE)
+
+        t0 = time.perf_counter()
+        result = plan_inference(cluster, profiles, model, config, workload)
+        entry["search_s"] = round(time.perf_counter() - t0, 3)
+        entry["num_costed"] = result.num_costed
+        entry["num_splits"] = result.num_splits
+        best = result.best
+        if best is not None:
+            entry["slo_p99_ttft_ms"] = round(best.cost.ttft_p99_ms, 3)
+            entry["slo_p99_tpot_ms"] = round(best.cost.tpot_p99_ms, 3)
+            entry["max_rps"] = round(best.cost.throughput_rps, 2)
+            entry["slo_ok"] = best.cost.slo_ok
+            entry["prefill_devices"] = best.prefill.num_devices
+            entry["decode_devices"] = best.decode.num_devices
+
+        try:
+            service = PlanService(cluster, profiles)
+            server, thread, address = serve_in_thread(service)
+        except OSError as e:
+            entry["replay_skipped_reason"] = f"socket setup failed: {e}"
+            record["inference"] = entry
+            return
+        try:
+            client = PlanServiceClient(address)
+            t0 = time.perf_counter()
+            report = replay_traffic(
+                client, cluster, model, config, workload,
+                base_rps=4.0, peak_rps=40.0, ticks_per_cycle=12, cycles=1)
+            entry["replay_wall_s"] = round(time.perf_counter() - t0, 2)
+            entry["replay_slo_attainment"] = round(
+                report.slo_attainment, 4)
+            entry["replay_ticks"] = len(report.ticks)
+            entry["replay_replan_pushes"] = report.replan_pushes
+            entry["replay_devices_min"] = min(report.device_trajectory)
+            entry["replay_devices_max"] = max(report.device_trajectory)
+        finally:
+            try:
+                client.shutdown()
+            except Exception:
+                server.shutdown()
+            thread.join(10)
+            server.server_close()
+    record["inference"] = entry
+
+
 def tpu_validation(record: dict) -> None:
     """North-star error on REAL hardware: profile per-layer times on the TPU
     chip, plan a single-chip uniform schedule from those profiles, execute
@@ -1583,6 +1654,7 @@ def main() -> None:
     recorder.run("resilience", resilience_bench, record)
     recorder.run("overlap", overlap_bench, record)
     recorder.run("serve", serve_bench, record)
+    recorder.run("inference", inference_bench, record)
 
     # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
     # proves the tunnel was alive at bench start — it wedged MID-RUN once
@@ -1684,6 +1756,14 @@ def _headline(record: dict) -> dict:
         .get("byte_identical"),
         "serve_skipped": (record.get("serve") or {})
         .get("skipped_reason"),
+        "slo_p99_ttft_ms": (record.get("inference") or {})
+        .get("slo_p99_ttft_ms"),
+        "replay_slo_attainment": (record.get("inference") or {})
+        .get("replay_slo_attainment"),
+        "inference_skipped": ((record.get("inference") or {})
+                              .get("skipped")
+                              or (record.get("inference") or {})
+                              .get("replay_skipped_reason")),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),
         "tpu_step": _tpu_brief(record, "tpu_step"),

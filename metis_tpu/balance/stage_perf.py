@@ -10,7 +10,7 @@ from __future__ import annotations
 from typing import Sequence
 
 from metis_tpu.cluster.spec import ClusterSpec
-from metis_tpu.core.errors import ProfileMissError
+from metis_tpu.core.errors import KvCacheOomError, ProfileMissError
 from metis_tpu.core.types import InterStagePlan, Strategy
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.balance.data import DataBalancer, power_of_two_chunks, replica_chunks
@@ -45,6 +45,32 @@ def node_device_types(cluster: ClusterSpec, node_sequence: Sequence[str]) -> lis
         n_nodes = sum(1 for n in cluster.nodes if n.device_type == device_type)
         out.extend([device_type] * n_nodes)
     return out
+
+
+def max_kv_concurrency(
+    capacity_mb: float,
+    weights_bytes: float,
+    kv_bytes_per_seq: float,
+    *,
+    stage: int = 0,
+) -> int:
+    """Max sequences a stage can hold KV for after its weights are resident.
+
+    ``capacity_mb`` uses the profile-store MB convention (×1024² to bytes,
+    matching ``DeviceSpec.memory_mb``).  Weights that already meet or exceed
+    capacity raise :class:`KvCacheOomError` — the placement can never serve,
+    and a silent 0 would be indistinguishable from "free memory fits no
+    sequence yet", which IS reported as 0 and prunes the candidate."""
+    capacity_bytes = capacity_mb * 1024 * 1024
+    free = capacity_bytes - weights_bytes
+    if free <= 0:
+        raise KvCacheOomError(stage, weights_bytes / (1024 * 1024),
+                              capacity_mb)
+    if kv_bytes_per_seq <= 0:
+        # A stage holding only the embed/head pseudo-layers caches no KV —
+        # concurrency is unbounded by THIS stage; callers min() across stages.
+        return 1 << 30
+    return int(free // kv_bytes_per_seq)
 
 
 # Cross-candidate memo bound (entries, not bytes): thousands of inter-stage
@@ -121,6 +147,15 @@ class StagePerformanceModel:
         else:
             self._count("memo.stage_cap.hit")
         return out
+
+    def stage_min_device_memory_mb(self, plan: InterStagePlan,
+                                   stage_id: int) -> float:
+        """Smallest per-device HBM among a stage's members, MB.  The serving
+        KV check is per-RANK (each rank holds its tp shard of weights + KV),
+        so a mixed stage is bounded by its most memory-poor device."""
+        start, end = plan.stage_rank_range(stage_id)
+        ranks = rank_device_types(self.cluster, plan.node_sequence)
+        return min(self.cluster.memory_mb(t) for t in ranks[start:end])
 
     def _stage_structure(self, plan: InterStagePlan) -> tuple:
         """Per-stage (is_homo, device types) of a placement — resolved once

@@ -33,12 +33,22 @@ class DeviceSpec:
     memory_gb: float
     intra_bw_gbps: float  # within a node (NVLink) / within a slice (ICI)
     inter_bw_gbps: float  # across nodes (IB/Ethernet) / across slices (DCN)
+    hbm_gbps: float = 0.0  # device memory bandwidth; 0 = unknown
 
     @property
     def memory_mb(self) -> float:
         # The reference converts GB→MB with ×1024 (gpu_cluster.py:45); profile
         # memory is recorded in MB, so we keep the same convention.
         return self.memory_gb * 1024
+
+    @property
+    def effective_hbm_gbps(self) -> float:
+        """HBM bandwidth for roofline pricing (decode KV reads).  When the
+        clusterfile/registry carries no measured value, fall back to a
+        conservative multiple of the intra-node link: accelerator HBM is
+        typically 10-40x NVLink/ICI, so 16x keeps decode memory-bound
+        without wildly flattering unknown hardware."""
+        return self.hbm_gbps if self.hbm_gbps > 0 else 16.0 * self.intra_bw_gbps
 
 
 # Open registry — callers may register new types at runtime (the reference's
@@ -56,10 +66,15 @@ def register_device(spec: DeviceSpec, overwrite: bool = False) -> DeviceSpec:
     return spec
 
 
-# Baseline GPU presets (bandwidths are placeholders; real runs take values from
-# the clusterfile, which overrides these per cluster).
-for _name, _mem in [("A100", 80), ("V100", 16), ("P100", 16), ("T4", 15)]:
-    register_device(DeviceSpec(_name, _mem, intra_bw_gbps=50, inter_bw_gbps=10))
+# Baseline GPU presets (link bandwidths are placeholders; real runs take
+# values from the clusterfile, which overrides these per cluster).  HBM
+# bandwidths are the published part numbers (A100-80GB SXM / V100 / P100 /
+# T4) — the decode-phase KV-read roofline needs them and clusterfiles
+# predate the field, so from_files backfills from here by instance type.
+for _name, _mem, _hbm in [("A100", 80, 2039), ("V100", 16, 900),
+                          ("P100", 16, 732), ("T4", 15, 320)]:
+    register_device(DeviceSpec(_name, _mem, intra_bw_gbps=50,
+                               inter_bw_gbps=10, hbm_gbps=_hbm))
 
 
 @dataclass(frozen=True)
@@ -164,11 +179,14 @@ class ClusterSpec:
         devices: dict[str, DeviceSpec] = {}
         for entry in info.values():
             t = str(entry["instance_type"])
+            preset = DEVICE_REGISTRY.get(t)
             devices[t] = DeviceSpec(
                 name=t,
                 memory_gb=float(entry["memory"]),
                 intra_bw_gbps=float(entry["intra_bandwidth"]),
                 inter_bw_gbps=float(entry["inter_bandwidth"]),
+                hbm_gbps=float(entry.get(
+                    "hbm_bandwidth", preset.hbm_gbps if preset else 0.0)),
             )
 
         nodes: list[NodeSpec] = []

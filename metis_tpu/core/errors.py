@@ -29,6 +29,21 @@ class InfeasiblePlanError(MetisError):
     """No memory-feasible layer partition exists for a candidate."""
 
 
+class KvCacheOomError(MetisError):
+    """A serving placement's weights already exhaust the stage's HBM — there
+    is no headroom for even one sequence of KV cache.  Raised instead of
+    returning a max batch of 0 so callers can't mistake "this placement can
+    never serve" for "serve with batch 0" (``balance/stage_perf.py``)."""
+
+    def __init__(self, stage: int, weights_mb: float, capacity_mb: float):
+        super().__init__(
+            f"stage {stage}: weights {weights_mb:.1f} MB >= HBM capacity "
+            f"{capacity_mb:.1f} MB — no KV-cache headroom")
+        self.stage = stage
+        self.weights_mb = weights_mb
+        self.capacity_mb = capacity_mb
+
+
 class ClusterSpecError(MetisError):
     """Malformed cluster description."""
 

@@ -252,6 +252,80 @@ class CostBreakdown:
         )
 
 
+# Canonical additive component order for an InferenceCostBreakdown
+# (``metis-tpu explain --workload inference``).  The TTFT keys sum to
+# ``ttft_p99_ms`` and the TPOT keys to ``tpot_p99_ms`` — same additive
+# contract CostBreakdown pins for training plans.
+TTFT_COMPONENTS = ("queueing", "prefill_compute", "prefill_pp_comm",
+                   "kv_handoff")
+TPOT_COMPONENTS = ("decode_compute", "kv_read", "decode_pp_comm")
+INFERENCE_COST_COMPONENTS = TTFT_COMPONENTS + TPOT_COMPONENTS
+
+
+@dataclass(frozen=True)
+class InferenceCostBreakdown:
+    """Per-component decomposition of one serving plan's SLO metrics.
+
+    Unlike a training CostBreakdown there are TWO additive scalars:
+    ``components[TTFT_COMPONENTS]`` sums to ``ttft_p99_ms`` (queue wait at
+    the arrival rate + prefill pipeline latency + prefill boundary sends +
+    prefill->decode KV handoff) and ``components[TPOT_COMPONENTS]`` sums to
+    ``tpot_p99_ms`` (decode compute + the HBM-bound KV/weight-read excess +
+    decode boundary sends).  ``throughput_rps`` is the max request rate the
+    plan sustains with both p99 SLOs met; ``slo_ok`` says whether that
+    covers the workload's offered arrival rate."""
+
+    ttft_p99_ms: float
+    tpot_p99_ms: float
+    throughput_rps: float
+    slo_ok: bool
+    components: dict[str, float]
+    max_concurrency: int = 0
+
+    @property
+    def ttft_component_sum_ms(self) -> float:
+        return sum(self.components.get(k, 0.0) for k in TTFT_COMPONENTS)
+
+    @property
+    def tpot_component_sum_ms(self) -> float:
+        return sum(self.components.get(k, 0.0) for k in TPOT_COMPONENTS)
+
+    def delta(self, other: "InferenceCostBreakdown") -> dict[str, float]:
+        """Per-component ``other - self`` (positive = other costs more)."""
+        keys = [k for k in INFERENCE_COST_COMPONENTS
+                if k in self.components or k in other.components]
+        keys += [k for k in self.components if k not in keys]
+        keys += [k for k in other.components if k not in keys]
+        return {k: other.components.get(k, 0.0) - self.components.get(k, 0.0)
+                for k in keys}
+
+    def decisive_component(self, other: "InferenceCostBreakdown") -> tuple[str, float]:
+        d = self.delta(other)
+        name = max(d, key=lambda k: abs(d[k]))
+        return name, d[name]
+
+    def to_json_dict(self) -> dict:
+        return {
+            "ttft_p99_ms": self.ttft_p99_ms,
+            "tpot_p99_ms": self.tpot_p99_ms,
+            "throughput_rps": self.throughput_rps,
+            "slo_ok": self.slo_ok,
+            "components": dict(self.components),
+            "max_concurrency": self.max_concurrency,
+        }
+
+    @staticmethod
+    def from_json_dict(d: dict) -> "InferenceCostBreakdown":
+        return InferenceCostBreakdown(
+            ttft_p99_ms=d["ttft_p99_ms"],
+            tpot_p99_ms=d["tpot_p99_ms"],
+            throughput_rps=d["throughput_rps"],
+            slo_ok=bool(d["slo_ok"]),
+            components=dict(d["components"]),
+            max_concurrency=int(d.get("max_concurrency", 0)),
+        )
+
+
 @dataclass(frozen=True)
 class RankedPlan:
     """One fully-specified, costed candidate — the planner's output unit.

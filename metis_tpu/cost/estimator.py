@@ -118,6 +118,37 @@ class EstimatorOptions:
         return bw_gbps * (1024 * 1024 if self.strict_compat else 1e6)
 
 
+def kv_bytes_per_token(model, kv_dtype_bytes: int = 2, tp: int = 1) -> float:
+    """KV-cache bytes one sequence adds per token per transformer block.
+
+    ``2 ×`` is K and V; GQA/MQA shrink the footprint through
+    ``num_kv_heads`` (0 on the spec means full multi-head attention).
+    Tensor parallelism shards heads, so a tp-way stage holds ``1/tp`` of the
+    cache per rank — the per-rank figure is what the HBM check needs."""
+    kv_heads = model.num_kv_heads or model.num_heads
+    return 2.0 * kv_heads * model.head_dim * kv_dtype_bytes / tp
+
+
+def kv_stage_bytes(
+    model,
+    batch: int,
+    context_len: int,
+    start: int,
+    end: int,
+    kv_dtype_bytes: int = 2,
+    tp: int = 1,
+) -> float:
+    """Per-rank KV footprint for ``batch`` sequences of ``context_len`` tokens
+    on a stage holding layers ``[start, end)``.
+
+    Only transformer blocks hold KV — the embed (layer 0) and head (layer
+    ``num_layers-1``) pseudo-layers the partition convention carries are
+    clamped out, so a stage that owns only those prices to zero."""
+    blocks = max(0, min(end, model.num_layers - 1) - max(start, 1))
+    return batch * context_len * blocks * kv_bytes_per_token(
+        model, kv_dtype_bytes=kv_dtype_bytes, tp=tp)
+
+
 # Memo bounds (entries) for the PR-4 costing caches: wholesale clear beyond
 # these, so a long-lived daemon sweeping many clusters cannot grow them
 # unboundedly.  Evictions are visible as ``memo.*.evict`` counters.

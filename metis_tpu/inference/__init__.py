@@ -1,0 +1,29 @@
+"""Latency-SLO inference planning (ISSUE 9 / ROADMAP item 3).
+
+A serving workload is a first-class planning target beside training:
+:mod:`workload` models the traffic (arrival rate, prompt/output lengths,
+SLOs) and derives prefill/decode phase timings from the SAME per-layer
+profiles the training planner runs on; :mod:`planner` searches disaggregated
+prefill/decode pool splits and ranks them by sustainable throughput under
+p99 TTFT/TPOT SLOs; :mod:`replay` sweeps a diurnal arrival-rate curve
+against the serve daemon and drives elastic scale-up/down through
+``POST /cluster_delta``.
+"""
+from metis_tpu.inference.workload import InferenceWorkload, workload_from_dict
+from metis_tpu.inference.planner import (
+    InferencePlannerResult,
+    PoolPlan,
+    RankedInferencePlan,
+    dump_inference_plans,
+    plan_inference,
+)
+
+__all__ = [
+    "InferenceWorkload",
+    "workload_from_dict",
+    "InferencePlannerResult",
+    "PoolPlan",
+    "RankedInferencePlan",
+    "dump_inference_plans",
+    "plan_inference",
+]

@@ -1,0 +1,158 @@
+"""Traffic-replay bench: a diurnal arrival-rate sweep against the serve
+daemon, driving elastic scale-up/down through ``POST /cluster_delta``.
+
+The generator models one day of serving load as a raised-cosine between a
+base and a peak rate.  Each tick queries the daemon for the best plan at
+the CURRENT rate and topology (the workload's arrival rate is part of the
+query fingerprint, so every rate level is its own cache entry — repeat
+cycles hit the cache), records whether the SLOs hold, and applies a simple
+hysteresis policy: when the offered rate falls below ``scale_down_frac`` of
+the plan's sustainable throughput, the last node is released (a
+``ClusterDelta`` the daemon answers with replan + ``replan_push``); when it
+climbs above ``scale_up_frac``, the most recently released node is
+restored.  Simulated time only — ticks never sleep, so a full diurnal
+cycle completes in seconds of wall clock.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass, field
+
+from metis_tpu.cluster.spec import ClusterSpec, NodeSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.events import EventLog, NULL_LOG
+from metis_tpu.inference.workload import InferenceWorkload
+
+
+def diurnal_rate(tick: int, ticks_per_cycle: int, base_rps: float,
+                 peak_rps: float) -> float:
+    """Raised-cosine day curve: base at tick 0, peak mid-cycle."""
+    phase = 2.0 * math.pi * (tick % ticks_per_cycle) / ticks_per_cycle
+    return base_rps + (peak_rps - base_rps) * 0.5 * (1.0 - math.cos(phase))
+
+
+@dataclass(frozen=True)
+class ReplayTick:
+    """One simulated tick's outcome."""
+
+    t_s: float
+    arrival_rps: float
+    devices: int
+    slo_ok: bool
+    throughput_rps: float | None
+    scaled: str  # "", "down", "up"
+
+    def to_json_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class ReplayReport:
+    """Whole-replay outcome: the SLO-attainment headline plus the device
+    trajectory the elastic policy traced."""
+
+    ticks: list[ReplayTick] = field(default_factory=list)
+    replan_pushes: int = 0
+    cycles: int = 0
+
+    @property
+    def slo_attainment(self) -> float:
+        """Request-weighted fraction of offered traffic served inside the
+        SLOs (a miss at peak hurts more than a miss at 3am)."""
+        offered = sum(t.arrival_rps for t in self.ticks)
+        if not offered:
+            return 1.0
+        met = sum(t.arrival_rps for t in self.ticks if t.slo_ok)
+        return met / offered
+
+    @property
+    def device_trajectory(self) -> list[int]:
+        return [t.devices for t in self.ticks]
+
+    def to_json_dict(self) -> dict:
+        return {
+            "slo_attainment": self.slo_attainment,
+            "cycles": self.cycles,
+            "replan_pushes": self.replan_pushes,
+            "min_devices": min(self.device_trajectory, default=0),
+            "max_devices": max(self.device_trajectory, default=0),
+            "ticks": [t.to_json_dict() for t in self.ticks],
+        }
+
+
+def replay_traffic(
+    client,
+    cluster: ClusterSpec,
+    model: ModelSpec,
+    config: SearchConfig,
+    workload: InferenceWorkload,
+    *,
+    base_rps: float,
+    peak_rps: float,
+    ticks_per_cycle: int = 24,
+    cycles: int = 1,
+    tick_seconds: float = 3600.0,
+    scale_down_frac: float = 0.5,
+    scale_up_frac: float = 0.9,
+    min_nodes: int = 2,
+    top_k: int = 5,
+    events: EventLog = NULL_LOG,
+) -> ReplayReport:
+    """Run ``cycles`` diurnal cycles against a live daemon (``client`` is a
+    ``serve.client.PlanServiceClient``; ``cluster`` mirrors the daemon's
+    boot topology so the driver knows node widths for whole-node deltas).
+
+    Every elastic action goes through ``client.cluster_delta(...,
+    replan=True)`` so the daemon re-searches and pushes ``replan_push``
+    notifications, which the report counts."""
+    # local mirror of the daemon's node list: deltas remove from the END
+    # (shrink_cluster's convention) and restore in LIFO order
+    live_nodes = list(cluster.nodes)
+    released: list[dict[str, int]] = []
+    report = ReplayReport(cycles=cycles)
+    note_seq = 0
+    total_ticks = ticks_per_cycle * cycles
+
+    for tick in range(total_ticks):
+        rate = diurnal_rate(tick, ticks_per_cycle, base_rps, peak_rps)
+        wl = dataclasses.replace(workload, arrival_rate_rps=rate)
+        resp = client.plan(model, config, top_k=top_k, workload=wl)
+        throughput = resp.get("best_max_rps")
+        slo_ok = bool(resp.get("slo_ok")) and throughput is not None
+        devices = sum(n.num_devices for n in live_nodes)
+
+        scaled = ""
+        if (throughput is None or rate > scale_up_frac * throughput) \
+                and released:
+            delta = released.pop()
+            client.cluster_delta(added=delta, replan=True)
+            t = next(iter(delta))
+            live_nodes.append(NodeSpec(t, delta[t]))
+            scaled = "up"
+        elif (throughput is not None
+              and rate < scale_down_frac * throughput
+              and len(live_nodes) > min_nodes):
+            node = live_nodes.pop()
+            delta = {node.device_type: node.num_devices}
+            client.cluster_delta(removed=delta, replan=True)
+            released.append(delta)
+            scaled = "down"
+
+        t_s = tick * tick_seconds
+        report.ticks.append(ReplayTick(
+            t_s=t_s, arrival_rps=rate, devices=devices, slo_ok=slo_ok,
+            throughput_rps=throughput, scaled=scaled))
+        events.emit("replay_tick", t_s=t_s, arrival_rps=rate,
+                    devices=devices, slo_ok=slo_ok)
+        if not slo_ok:
+            events.emit("slo_violation", metric="throughput_rps",
+                        value=throughput if throughput is not None else 0.0,
+                        slo=rate)
+        notes = client.notifications(since=note_seq)
+        if notes:
+            note_seq = max(n["seq"] for n in notes)
+            report.replan_pushes += sum(
+                1 for n in notes if n.get("kind") == "replan_push")
+
+    return report

@@ -179,9 +179,10 @@ def calibration_fingerprint(calibration) -> str | None:
 
 
 def query_fingerprint(model, cluster, config, *, calibration=None,
-                      extra: dict | None = None) -> str:
+                      workload=None, extra: dict | None = None) -> str:
     """Stable 12-hex identity of a plan *query*: model × cluster × gbs ×
-    every cost-relevant ``SearchConfig`` field × calibration identity.
+    every cost-relevant ``SearchConfig`` field × calibration identity ×
+    workload kind.
 
     This is the serve-layer cache key (``serve/cache.PlanCache``), distinct
     from :func:`plan_fingerprint` on purpose: a plan fingerprint identifies
@@ -190,6 +191,13 @@ def query_fingerprint(model, cluster, config, *, calibration=None,
     fingerprint identifies a search *input* — flip any knob that could
     change the ranking and the key must change.  sha1 over canonical JSON,
     not ``hash()``, so the key is stable across processes and restarts.
+
+    ``workload`` (an ``inference.workload.InferenceWorkload``, or None for
+    training) is hashed structurally: a training query hashes the literal
+    string "training" while an inference query hashes its kind tag plus
+    every SLO/traffic field, so a cached training plan can never alias an
+    inference query for the same model/cluster — nor can two inference
+    queries differing in any SLO field alias each other.
     """
     cfg = dataclasses.asdict(config)
     for name in _RESULT_NEUTRAL_CONFIG_FIELDS:
@@ -206,6 +214,9 @@ def query_fingerprint(model, cluster, config, *, calibration=None,
         },
         "config": cfg,
         "calibration": calibration_fingerprint(calibration),
+        "workload": ("training" if workload is None
+                     else {"kind": "inference",
+                           **dataclasses.asdict(workload)}),
     }
     if extra:
         canonical.update(extra)

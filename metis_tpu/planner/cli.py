@@ -212,6 +212,70 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
     )
 
 
+def _add_inference_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("serving workload")
+    g.add_argument("--workload", choices=("training", "inference"),
+                   default="training",
+                   help="planning target: training (min step-ms) or "
+                        "inference (max throughput under p99 TTFT/TPOT "
+                        "SLOs, prefill/decode disaggregated)")
+    g.add_argument("--workload-spec", default=None,
+                   help="JSON file with InferenceWorkload fields; explicit "
+                        "flags below override its entries")
+    g.add_argument("--arrival-rate", type=float, default=None,
+                   help="offered request rate, requests/s")
+    g.add_argument("--prompt-len", type=int, default=None,
+                   help="mean prompt length, tokens")
+    g.add_argument("--output-len", type=int, default=None,
+                   help="mean generated length, tokens")
+    g.add_argument("--slo-ttft", type=float, default=None,
+                   help="p99 time-to-first-token SLO, ms")
+    g.add_argument("--slo-tpot", type=float, default=None,
+                   help="p99 time-per-output-token SLO, ms")
+    g.add_argument("--prompt-len-p99", type=int, default=None,
+                   help="p99 prompt length (0/omitted = deterministic)")
+    g.add_argument("--output-len-p99", type=int, default=None,
+                   help="p99 generated length (0/omitted = deterministic)")
+    g.add_argument("--kv-dtype-bytes", type=int, default=None,
+                   help="KV-cache element bytes (2 = bf16 default, 1 = int8)")
+
+
+def _workload_from_args(args: argparse.Namespace,
+                        default_arrival_rps: float | None = None):
+    """InferenceWorkload from --workload-spec JSON + override flags, or
+    None for a training query."""
+    if getattr(args, "workload", "training") != "inference":
+        return None
+    from metis_tpu.inference.workload import workload_from_dict
+
+    spec: dict = {}
+    if args.workload_spec:
+        with open(args.workload_spec) as f:
+            spec = json.load(f)
+    overrides = {
+        "arrival_rate_rps": args.arrival_rate,
+        "prompt_len": args.prompt_len,
+        "output_len": args.output_len,
+        "slo_ttft_p99_ms": args.slo_ttft,
+        "slo_tpot_p99_ms": args.slo_tpot,
+        "prompt_len_p99": args.prompt_len_p99,
+        "output_len_p99": args.output_len_p99,
+        "kv_dtype_bytes": args.kv_dtype_bytes,
+    }
+    for k, v in overrides.items():
+        if v is not None:
+            spec[k] = v
+    if "arrival_rate_rps" not in spec and default_arrival_rps is not None:
+        spec["arrival_rate_rps"] = default_arrival_rps
+    try:
+        return workload_from_dict(spec)
+    except (TypeError, ValueError) as e:
+        raise SystemExit(
+            f"bad inference workload: {e} — pass --arrival-rate, "
+            "--prompt-len, --output-len, --slo-ttft and --slo-tpot (or a "
+            "--workload-spec JSON carrying them)")
+
+
 def _emit(args: argparse.Namespace, payload: str) -> None:
     if args.output == "-":
         print(payload)
@@ -434,6 +498,7 @@ def main(argv: list[str] | None = None) -> int:
     p_exp.add_argument("--profile-dir", required=True)
     _add_model_args(p_exp)
     _add_search_args(p_exp)
+    _add_inference_args(p_exp)
     p_exp.add_argument("--ranks", default="1,2",
                        help="1-based ranks to compare, e.g. 1,3 "
                             "(default: the top two)")
@@ -501,14 +566,50 @@ def main(argv: list[str] | None = None) -> int:
                        help="append structured JSONL daemon events here")
 
     p_plan = sub.add_parser(
-        "plan", help="query a running plan daemon (metis-tpu serve) instead "
-                     "of searching in-process; output is byte-identical to "
-                     "'hetero' on the same workload")
-    p_plan.add_argument("--remote", required=True,
+        "plan", help="plan query: against a running daemon (--remote) or "
+                     "in-process (--hostfile/--clusterfile/--profile-dir); "
+                     "--workload inference ranks prefill/decode-"
+                     "disaggregated serving plans by throughput under p99 "
+                     "TTFT/TPOT SLOs (output byte-identical either way)")
+    p_plan.add_argument("--remote", default=None,
                         help="daemon address: http://HOST:PORT or "
-                             "unix:/path/to.sock")
+                             "unix:/path/to.sock (omit to search "
+                             "in-process)")
+    p_plan.add_argument("--hostfile", default=None,
+                        help="MPI-style hostfile (in-process path)")
+    p_plan.add_argument("--clusterfile", default=None,
+                        help="device-type JSON (in-process path)")
+    p_plan.add_argument("--profile-dir", default=None,
+                        help="profile store (in-process path)")
     _add_model_args(p_plan)
     _add_search_args(p_plan)
+    _add_inference_args(p_plan)
+
+    p_rpl = sub.add_parser(
+        "replay", help="traffic-replay bench: sweep a diurnal arrival-rate "
+                       "curve against the plan daemon, scale the fleet "
+                       "up/down through cluster deltas (replan pushes), "
+                       "and report SLO attainment + device trajectory")
+    p_rpl.add_argument("--remote", default=None,
+                       help="existing daemon address (default: boot one "
+                            "in-process for the bench)")
+    _add_cluster_args(p_rpl)
+    p_rpl.add_argument("--profile-dir", required=True)
+    _add_model_args(p_rpl)
+    _add_search_args(p_rpl)
+    _add_inference_args(p_rpl)
+    g_rpl = p_rpl.add_argument_group("replay")
+    g_rpl.add_argument("--base-rps", type=float, required=True,
+                       help="trough arrival rate, requests/s")
+    g_rpl.add_argument("--peak-rps", type=float, required=True,
+                       help="peak arrival rate, requests/s")
+    g_rpl.add_argument("--ticks-per-cycle", type=int, default=24,
+                       help="ticks per diurnal cycle (default hourly)")
+    g_rpl.add_argument("--cycles", type=int, default=1)
+    g_rpl.add_argument("--tick-seconds", type=float, default=3600.0,
+                       help="simulated seconds per tick (no wall sleeps)")
+    g_rpl.add_argument("--min-nodes", type=int, default=2,
+                       help="scale-down floor, nodes")
 
     args = parser.parse_args(argv)
 
@@ -516,7 +617,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "serve":
         return _cmd_serve(args)
     if args.command == "plan":
-        return _cmd_plan_remote(args)
+        return _cmd_plan(args)
+    if args.command == "replay":
+        return _cmd_replay(args)
     if args.command == "report":
         return _cmd_report(args)
     if args.command == "accuracy":
@@ -611,24 +714,102 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
-def _cmd_plan_remote(args: argparse.Namespace) -> int:
-    """Thin client: send the plan query to a running daemon and print its
-    response — the same dump_ranked_plans JSON 'hetero' emits."""
+def _cmd_plan(args: argparse.Namespace) -> int:
+    """Plan query — remote (daemon round-trip) or in-process; either way
+    the printed `plans` JSON is the same dump for the same query, so the
+    daemon answer is byte-identical to the offline search."""
+    model = _model_from_args(args)
+    config = _config_from_args(args)
+    workload = _workload_from_args(args)
+
+    if args.remote:
+        from metis_tpu.serve.client import PlanServiceClient
+
+        client = PlanServiceClient(args.remote)
+        resp = client.plan(model, config, top_k=args.top_k,
+                           workload=workload)
+        _emit(args, resp["plans"])
+        how = "cache hit" if resp.get("cached") else "cold search"
+        print(
+            f"{how} fingerprint={resp.get('fingerprint')} "
+            f"costed {resp.get('num_costed')} plans "
+            f"({resp.get('num_pruned')} pruned) in "
+            f"{resp.get('search_seconds', 0):.2f}s "
+            f"(served in {resp.get('serve_ms', 0):.1f}ms)",
+            file=sys.stderr)
+        return 0
+
+    if not (args.hostfile and args.clusterfile and args.profile_dir):
+        print("in-process plan needs --hostfile, --clusterfile and "
+              "--profile-dir (or point --remote at a daemon)",
+              file=sys.stderr)
+        return 2
+    cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    profiles = ProfileStore.from_dir(args.profile_dir)
+    events = EventLog(args.events) if args.events else NULL_LOG
+    if workload is not None:
+        from metis_tpu.inference.planner import (
+            dump_inference_plans,
+            plan_inference,
+        )
+
+        result = plan_inference(cluster, profiles, model, config, workload,
+                                top_k=args.top_k, events=events)
+        _emit(args, dump_inference_plans(result, workload))
+        print(f"costed {result.num_costed} pool candidates "
+              f"({result.num_pruned} pruned) across {result.num_splits} "
+              f"prefill/decode splits", file=sys.stderr)
+    else:
+        result = plan_hetero(cluster, profiles, model, config,
+                             top_k=args.top_k, events=events)
+        _emit(args, dump_ranked_plans(result.plans))
+        print(f"costed {result.num_costed} plans ({result.num_pruned} "
+              f"pruned) in {result.search_seconds:.2f}s", file=sys.stderr)
+    events.close()
+    return 0
+
+
+def _cmd_replay(args: argparse.Namespace) -> int:
+    """Traffic-replay bench (inference/replay.py): boot or dial a daemon,
+    sweep the diurnal curve, print the ReplayReport JSON."""
+    from metis_tpu.inference.replay import replay_traffic
     from metis_tpu.serve.client import PlanServiceClient
 
     model = _model_from_args(args)
     config = _config_from_args(args)
-    client = PlanServiceClient(args.remote)
-    resp = client.plan(model, config, top_k=args.top_k)
-    _emit(args, resp["plans"])
-    how = "cache hit" if resp.get("cached") else "cold search"
-    print(
-        f"{how} fingerprint={resp.get('fingerprint')} "
-        f"costed {resp.get('num_costed')} plans "
-        f"({resp.get('num_pruned')} pruned) in "
-        f"{resp.get('search_seconds', 0):.2f}s "
-        f"(served in {resp.get('serve_ms', 0):.1f}ms)",
-        file=sys.stderr)
+    args.workload = "inference"  # replay is a serving bench by definition
+    workload = _workload_from_args(args, default_arrival_rps=args.base_rps)
+    cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    events = EventLog(args.events) if args.events else NULL_LOG
+
+    server = None
+    if args.remote:
+        client = PlanServiceClient(args.remote)
+    else:
+        from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+        profiles = ProfileStore.from_dir(args.profile_dir)
+        service = PlanService(cluster, profiles, events=events)
+        server, _thread, address = serve_in_thread(service)
+        client = PlanServiceClient(address)
+    try:
+        report = replay_traffic(
+            client, cluster, model, config, workload,
+            base_rps=args.base_rps, peak_rps=args.peak_rps,
+            ticks_per_cycle=args.ticks_per_cycle, cycles=args.cycles,
+            tick_seconds=args.tick_seconds, min_nodes=args.min_nodes,
+            top_k=args.top_k, events=events)
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+    _emit(args, json.dumps(report.to_json_dict(), indent=2))
+    print(f"slo attainment {report.slo_attainment:.3f} over "
+          f"{report.cycles} cycle(s), devices "
+          f"{min(report.device_trajectory, default=0)}-"
+          f"{max(report.device_trajectory, default=0)}, "
+          f"{report.replan_pushes} replan push(es)", file=sys.stderr)
+    events.close()
     return 0
 
 
@@ -662,6 +843,20 @@ def _cmd_report(args: argparse.Namespace) -> int:
     return 0
 
 
+def _parse_ranks(args: argparse.Namespace) -> list[int] | None:
+    try:
+        ranks = sorted({int(r) for r in args.ranks.split(",")})
+    except ValueError:
+        print(f"--ranks must be comma-separated 1-based integers, got "
+              f"{args.ranks!r}", file=sys.stderr)
+        return None
+    if not ranks or ranks[0] < 1 or len(ranks) > 2:
+        print("--ranks takes one or two 1-based ranks (e.g. 1,2)",
+              file=sys.stderr)
+        return None
+    return ranks
+
+
 def _cmd_explain(args: argparse.Namespace, profiles, model, config,
                  events) -> int:
     """Per-component plan delta table: the cost term that decided a hetero
@@ -669,15 +864,10 @@ def _cmd_explain(args: argparse.Namespace, profiles, model, config,
     from metis_tpu.core.types import COST_COMPONENTS
     from metis_tpu.obs.ledger import fingerprint_ranked_plan
 
-    try:
-        ranks = sorted({int(r) for r in args.ranks.split(",")})
-    except ValueError:
-        print(f"--ranks must be comma-separated 1-based integers, got "
-              f"{args.ranks!r}", file=sys.stderr)
-        return 2
-    if not ranks or ranks[0] < 1 or len(ranks) > 2:
-        print("--ranks takes one or two 1-based ranks (e.g. 1,2)",
-              file=sys.stderr)
+    if getattr(args, "workload", "training") == "inference":
+        return _cmd_explain_inference(args, profiles, model, config, events)
+    ranks = _parse_ranks(args)
+    if ranks is None:
         return 2
     cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
     result = plan_hetero(cluster, profiles, model, config,
@@ -768,6 +958,106 @@ def _cmd_explain(args: argparse.Namespace, profiles, model, config,
     _emit(args, "\n".join(lines))
     print(f"costed {result.num_costed} plans ({result.num_pruned} pruned) "
           f"in {result.search_seconds:.2f}s", file=sys.stderr)
+    return 0
+
+
+def _cmd_explain_inference(args: argparse.Namespace, profiles, model,
+                           config, events) -> int:
+    """Serving counterpart of `explain`: per-component TTFT/TPOT delta
+    table over InferenceCostBreakdown (components sum to the two p99
+    latencies the SLO check judged)."""
+    from metis_tpu.core.types import TPOT_COMPONENTS, TTFT_COMPONENTS
+    from metis_tpu.inference.planner import (
+        fingerprint_inference_plan,
+        plan_inference,
+    )
+
+    ranks = _parse_ranks(args)
+    if ranks is None:
+        return 2
+    workload = _workload_from_args(args)
+    cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
+    result = plan_inference(cluster, profiles, model, config, workload,
+                            top_k=max(args.top_k, ranks[-1]), events=events)
+    if len(result.plans) < ranks[-1]:
+        print(f"search ranked only {len(result.plans)} serving plans "
+              f"({result.num_pruned} pruned) across {result.num_splits} "
+              f"splits; cannot explain rank {ranks[-1]}", file=sys.stderr)
+        return 1
+    chosen = [result.plans[r - 1] for r in ranks]
+    fps = [fingerprint_inference_plan(p) for p in chosen]
+    bds = [p.cost for p in chosen]
+
+    if args.as_json:
+        payload: dict = {
+            "workload": workload.to_json_dict(),
+            "plans": [{"rank": r, "fingerprint": fp, **p.to_json_dict()}
+                      for r, fp, p in zip(ranks, fps, chosen)]}
+        if len(chosen) == 2:
+            payload["delta"] = {k: round(v, 4)
+                                for k, v in bds[0].delta(bds[1]).items()}
+            name, d = bds[0].decisive_component(bds[1])
+            payload["decisive"] = {"component": name,
+                                   "delta_ms": round(d, 4)}
+        _emit(args, json.dumps(payload, indent=2))
+        return 0
+
+    header = ["component"] + [f"#{r} ({fp})" for r, fp in zip(ranks, fps)]
+    rows: list[list[str]] = []
+    if len(bds) == 2:
+        header.append(f"delta (#{ranks[1]}-#{ranks[0]})")
+        delta = bds[0].delta(bds[1])
+    # grouped so each block visibly sums to its p99 latency
+    for title, keys, total in (
+            ("ttft_p99", TTFT_COMPONENTS,
+             [b.ttft_p99_ms for b in bds]),
+            ("tpot_p99", TPOT_COMPONENTS,
+             [b.tpot_p99_ms for b in bds])):
+        for k in keys:
+            if all(abs(b.components.get(k, 0.0)) <= 1e-12 for b in bds):
+                continue
+            row = [k] + [f"{b.components.get(k, 0.0):.3f}" for b in bds]
+            if len(bds) == 2:
+                row.append(f"{delta[k]:+.3f}")
+            rows.append(row)
+        trow = [title] + [f"{t:.3f}" for t in total]
+        if len(bds) == 2:
+            trow.append(f"{total[1] - total[0]:+.3f}")
+        rows.append(trow)
+    tput_row = (["throughput_rps"]
+                + [f"{b.throughput_rps:.2f}" for b in bds])
+    if len(bds) == 2:
+        tput_row.append(
+            f"{bds[1].throughput_rps - bds[0].throughput_rps:+.2f}")
+    rows.append(tput_row)
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    lines = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(header)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(widths[i]) for i, c in enumerate(row)).rstrip()
+              for row in rows]
+    for r, p in zip(ranks, chosen):
+        pf, dc = p.prefill, p.decode
+        lines.append("")
+        lines.append(
+            f"#{r}: prefill {dict(sorted(pf.node_counts.items()))} "
+            f"dp={pf.dp} tp={list(pf.tp_per_stage)} "
+            f"(max {pf.max_rps:.1f} rps) | decode "
+            f"{dict(sorted(dc.node_counts.items()))} dp={dc.dp} "
+            f"tp={list(dc.tp_per_stage)} batch/lane={dc.batch_per_lane} "
+            f"(max {dc.max_rps:.1f} rps); "
+            f"slo {'ok' if p.cost.slo_ok else 'VIOLATED'}")
+    if len(bds) == 2:
+        name, d = bds[0].decisive_component(bds[1])
+        lines.append("")
+        lines.append(
+            f"decisive: {name} ({d:+.3f} ms) — the latency term that most "
+            f"separates #{ranks[1]} from #{ranks[0]} (ranking is by "
+            "SLO-feasibility, then throughput)")
+    _emit(args, "\n".join(lines))
+    print(f"costed {result.num_costed} pool candidates "
+          f"({result.num_pruned} pruned) across {result.num_splits} "
+          f"prefill/decode splits", file=sys.stderr)
     return 0
 
 

@@ -88,6 +88,37 @@ def shrink_cluster(cluster: ClusterSpec,
                        devices=dict(cluster.devices))
 
 
+def grow_cluster(cluster: ClusterSpec, full: ClusterSpec,
+                 added: dict[str, int]) -> ClusterSpec:
+    """Restore ``added`` devices (type -> count) toward a reference ``full``
+    topology — the inverse of :func:`shrink_cluster` for elastic scale-up
+    (the replay driver and the serve daemon's ``cluster_delta`` use it).
+
+    ``cluster`` must be (equivalent to) a shrink of ``full``; the grown
+    topology is rebuilt as ``full`` shrunk by whatever is STILL missing, so
+    shrink-then-grow round-trips exactly and node order always matches the
+    reference topology.  Raises :class:`ClusterSpecError` when a type would
+    exceed the reference's capacity or is unknown to it."""
+    still_missing: dict[str, int] = {}
+    types = {n.device_type for n in full.nodes} | \
+            {n.device_type for n in cluster.nodes} | set(added)
+    for t in sorted(types):
+        add = int(added.get(t, 0))
+        if add < 0:
+            raise ClusterSpecError(f"added[{t!r}] must be >= 0, got {add}")
+        have = cluster.num_devices_by_type(t)
+        cap = full.num_devices_by_type(t)
+        if have + add > cap:
+            raise ClusterSpecError(
+                f"cannot add {add}x{t}: cluster has {have}, reference "
+                f"topology caps the type at {cap}")
+        if cap - have - add > 0:
+            still_missing[t] = cap - have - add
+    if not still_missing:
+        return ClusterSpec(nodes=full.nodes, devices=dict(full.devices))
+    return shrink_cluster(full, still_missing)
+
+
 @dataclass(frozen=True)
 class ReplanReport:
     """Outcome of an elastic re-plan."""

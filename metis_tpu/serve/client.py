@@ -106,14 +106,19 @@ class PlanServiceClient:
 
     # -- endpoints ----------------------------------------------------------
     def plan(self, model: ModelSpec, config: SearchConfig,
-             top_k: int | None = None) -> dict:
+             top_k: int | None = None, workload=None) -> dict:
         """Plan query; the response's ``plans`` field is the exact
-        ``dump_ranked_plans`` JSON string the offline CLI prints."""
-        return self._request("POST", "/plan", {
+        ``dump_ranked_plans`` (training) or ``dump_inference_plans``
+        (``workload`` set) JSON string the offline CLI prints."""
+        payload = {
             "model": dataclasses.asdict(model),
             "config": dataclasses.asdict(config),
             "top_k": top_k,
-        })
+        }
+        if workload is not None:
+            payload["workload"] = (workload if isinstance(workload, dict)
+                                   else dataclasses.asdict(workload))
+        return self._request("POST", "/plan", payload)
 
     def accuracy_sample(self, fingerprint: str, measured_ms: float,
                         step: int | None = None, stage_ms=(),
@@ -126,8 +131,12 @@ class PlanServiceClient:
             payload["predicted_ms"] = predicted_ms
         return self._request("POST", "/accuracy_sample", payload)
 
-    def cluster_delta(self, removed: dict[str, int]) -> dict:
-        return self._request("POST", "/cluster_delta", {"removed": removed})
+    def cluster_delta(self, removed: dict[str, int] | None = None,
+                      added: dict[str, int] | None = None,
+                      replan: bool = False) -> dict:
+        return self._request("POST", "/cluster_delta", {
+            "removed": removed or {}, "added": added or {},
+            "replan": replan})
 
     def invalidate(self, fingerprint: str | None = None,
                    drop_states: bool = False) -> dict:

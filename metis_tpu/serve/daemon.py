@@ -54,9 +54,16 @@ from metis_tpu.obs.ledger import (
     fingerprint_ranked_plan,
     query_fingerprint,
 )
+from metis_tpu.inference.planner import (
+    dump_inference_plans,
+    fingerprint_inference_plan,
+    plan_inference,
+)
+from metis_tpu.inference.workload import InferenceWorkload, workload_from_dict
 from metis_tpu.planner.api import make_search_state, plan_hetero
 from metis_tpu.planner.replan import (
     ClusterDelta,
+    grow_cluster,
     replan_on_drift,
     shrink_cluster,
 )
@@ -86,6 +93,7 @@ class _QueryRecord:
     top_k: int | None
     key: str
     plan_fingerprint: str | None
+    workload: InferenceWorkload | None = None  # None = training query
 
 
 class PlanService:
@@ -106,6 +114,9 @@ class PlanService:
         search_wait_s: float = 300.0,
     ):
         self.cluster = cluster
+        # boot topology: the elastic ceiling scale-up deltas grow back toward
+        # (planner.replan.grow_cluster needs the reference node order)
+        self.full_cluster = cluster
         self.profiles = profiles
         self.events = events
         self.calibration = calibration
@@ -162,19 +173,28 @@ class PlanService:
 
     # -- plan queries -------------------------------------------------------
     def plan_query(self, model: ModelSpec, config: SearchConfig,
-                   top_k: int | None = None) -> dict:
+                   top_k: int | None = None,
+                   workload: InferenceWorkload | None = None) -> dict:
         """Answer one plan query: cache hit, coalesced wait, or cold
-        search with warm state.  Byte-identical to the offline path."""
+        search with warm state.  Byte-identical to the offline path.
+
+        ``workload`` switches the query to the serving planner
+        (``inference.planner.plan_inference``); the fingerprint hashes the
+        workload kind + SLO fields, so training and inference queries for
+        the same model/cluster never share a cache entry."""
         t_req = time.perf_counter()
         qfp = query_fingerprint(model, self.cluster, config,
-                                calibration=self.calibration)
+                                calibration=self.calibration,
+                                workload=workload)
         key = self._cache_key(qfp, top_k)
         self.counters.inc("serve.requests")
         tracer = Tracer(self.events)
+        kind = "inference" if workload is not None else "training"
         with tracer.span("serve_request", fingerprint=qfp,
                          model=model.name, gbs=config.gbs) as span:
             self.events.emit("plan_request", fingerprint=qfp,
-                             model=model.name, gbs=config.gbs, top_k=top_k)
+                             model=model.name, gbs=config.gbs, top_k=top_k,
+                             workload=kind)
             entry = self.cache.get(key)
             if entry is not None:
                 self.events.emit("plan_cache_hit", fingerprint=qfp)
@@ -196,7 +216,11 @@ class PlanService:
                     return self._respond(entry, cached=True, t_req=t_req)
                 # leader failed or timed out — loop to become the leader
             try:
-                entry = self._search(qfp, key, model, config, top_k)
+                if workload is not None:
+                    entry = self._search_inference(qfp, key, model, config,
+                                                   workload, top_k)
+                else:
+                    entry = self._search(qfp, key, model, config, top_k)
             finally:
                 with self._lock:
                     done = self._inflight.pop(key, None)
@@ -236,6 +260,44 @@ class PlanService:
                 if plan_fp not in self.ledger.predictions:
                     self.ledger.record_prediction(
                         plan_fp, best.cost.total_ms, source="serve")
+        self.cache.put(key, entry)
+        return entry
+
+    def _search_inference(self, qfp: str, key: str, model: ModelSpec,
+                          config: SearchConfig,
+                          workload: InferenceWorkload,
+                          top_k: int | None) -> dict:
+        """Cold inference search.  No warm state — the pool search is
+        orders of magnitude smaller than a training search — but it still
+        serializes behind ``_search_lock`` so the cluster it reads cannot
+        be swapped mid-enumeration by a concurrent ``cluster_delta``."""
+        with self._search_lock:
+            t0 = time.perf_counter()
+            result = plan_inference(self.cluster, self.profiles, model,
+                                    config, workload,
+                                    top_k=top_k if top_k is not None else 20,
+                                    events=self.events)
+            elapsed = time.perf_counter() - t0
+        best = result.best
+        plan_fp = fingerprint_inference_plan(best) if best else None
+        entry = {
+            "fingerprint": qfp,
+            "plan_fingerprint": plan_fp,
+            "workload_kind": "inference",
+            "top_k": top_k,
+            "plans": dump_inference_plans(result, workload),
+            "best_ttft_p99_ms": best.cost.ttft_p99_ms if best else None,
+            "best_tpot_p99_ms": best.cost.tpot_p99_ms if best else None,
+            "best_max_rps": best.cost.throughput_rps if best else None,
+            "slo_ok": best.cost.slo_ok if best else None,
+            "num_costed": result.num_costed,
+            "num_pruned": result.num_pruned,
+            "search_seconds": round(elapsed, 6),
+        }
+        with self._lock:
+            self._queries[key] = _QueryRecord(
+                model=model, config=config, top_k=top_k, key=key,
+                plan_fingerprint=plan_fp, workload=workload)
         self.cache.put(key, entry)
         return entry
 
@@ -352,12 +414,25 @@ class PlanService:
         return notes
 
     # -- topology change ----------------------------------------------------
-    def apply_cluster_delta(self, removed: dict[str, int]) -> dict:
-        """Lose devices (type -> count): swap in the survivor topology,
-        drop every cache entry and warm state, notify subscribers."""
-        removed = {str(t): int(n) for t, n in removed.items()}
+    def apply_cluster_delta(self, removed: dict[str, int] | None = None,
+                            added: dict[str, int] | None = None,
+                            replan: bool = False) -> dict:
+        """Elastic topology change: lose ``removed`` devices and/or restore
+        ``added`` (type -> count, capped by the boot topology).  Swaps in
+        the new cluster, drops every cache entry and warm state, notifies
+        subscribers; ``replan=True`` additionally re-searches every
+        registered query against the new topology on a background thread,
+        pushing one ``replan_push`` note per refreshed plan (the elastic
+        scale path the traffic-replay driver exercises)."""
+        removed = {str(t): int(n) for t, n in (removed or {}).items()}
+        added = {str(t): int(n) for t, n in (added or {}).items()}
         with self._search_lock:
-            new_cluster = shrink_cluster(self.cluster, removed)
+            new_cluster = self.cluster
+            if removed:
+                new_cluster = shrink_cluster(new_cluster, removed)
+            if added:
+                new_cluster = grow_cluster(new_cluster, self.full_cluster,
+                                           added)
             delta = ClusterDelta.between(self.cluster, new_cluster)
             with self._lock:
                 self.cluster = new_cluster
@@ -371,8 +446,61 @@ class PlanService:
             "invalidated": invalidated,
             "devices": new_cluster.total_devices,
         })
+        if replan:
+            self.counters.inc("serve.delta_replans")
+            threading.Thread(
+                target=self._replan_all, args=("cluster_delta",),
+                name="metis-serve-delta-replan", daemon=True).start()
         return {"invalidated": invalidated, "removed": delta.removed,
-                "devices": new_cluster.total_devices, "seq": note["seq"]}
+                "added": delta.added,
+                "devices": new_cluster.total_devices, "seq": note["seq"],
+                "replanning": replan}
+
+    def _replan_all(self, reason: str) -> list[dict]:
+        """Re-search every registered query against the CURRENT topology
+        and push a ``replan_push`` note per query — the cluster-delta
+        counterpart of the drift path's ``_replan_for``."""
+        with self._lock:
+            targets = list(self._queries.values())
+        notes: list[dict] = []
+        for rec in targets:
+            self.cache.invalidate(rec.key)
+            qfp = query_fingerprint(rec.model, self.cluster, rec.config,
+                                    calibration=self.calibration,
+                                    workload=rec.workload)
+            new_key = self._cache_key(qfp, rec.top_k)
+            try:
+                if rec.workload is not None:
+                    entry = self._search_inference(
+                        qfp, new_key, rec.model, rec.config, rec.workload,
+                        rec.top_k)
+                else:
+                    entry = self._search(qfp, new_key, rec.model,
+                                         rec.config, rec.top_k)
+            except MetisError:
+                # the shrunken topology may not fit this query at all —
+                # subscribers learn from the absence of a push
+                continue
+            with self._lock:
+                if rec.key != new_key:
+                    self._queries.pop(rec.key, None)
+            new_fp = entry.get("plan_fingerprint")
+            changed = new_fp != rec.plan_fingerprint
+            note = self._push_note({
+                "kind": "replan_push",
+                "fingerprint": rec.plan_fingerprint,
+                "new_fingerprint": new_fp,
+                "query_fingerprint": qfp,
+                "plan_changed": changed,
+                "new_best_cost_ms": entry.get("best_cost_ms"),
+                "reason": reason,
+            })
+            self.events.emit(
+                "replan_push", fingerprint=rec.plan_fingerprint,
+                new_fingerprint=new_fp, reason=reason,
+                plan_changed=changed, seq=note["seq"])
+            notes.append(note)
+        return notes
 
     def invalidate(self, fingerprint: str | None = None,
                    drop_states: bool = False) -> dict:
@@ -489,9 +617,11 @@ class _Handler(BaseHTTPRequestHandler):
                 model = model_spec_from_dict(body["model"])
                 config = search_config_from_dict(body["config"])
                 top_k = body.get("top_k")
+                wl = body.get("workload")
                 out = self.service.plan_query(
                     model, config,
-                    top_k=int(top_k) if top_k is not None else None)
+                    top_k=int(top_k) if top_k is not None else None,
+                    workload=workload_from_dict(wl) if wl else None)
                 self._json(200, out)
             elif self.path == "/accuracy_sample":
                 out = self.service.post_accuracy_sample(
@@ -501,7 +631,10 @@ class _Handler(BaseHTTPRequestHandler):
                     predicted_ms=body.get("predicted_ms"))
                 self._json(200, out)
             elif self.path == "/cluster_delta":
-                out = self.service.apply_cluster_delta(body["removed"])
+                out = self.service.apply_cluster_delta(
+                    removed=body.get("removed"),
+                    added=body.get("added"),
+                    replan=bool(body.get("replan", False)))
                 self._json(200, out)
             elif self.path == "/invalidate":
                 out = self.service.invalidate(

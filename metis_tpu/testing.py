@@ -21,6 +21,12 @@ from pathlib import Path
 PARITY_GBS = 128
 PARITY_MAX_TP = 4
 PARITY_MAX_BS = 16
+# the serving counterpart of the parity workload: feasible on the fixture
+# topology (A100 prefill pool, T4 decode pool) with headroom on both SLOs,
+# so golden/regression runs exercise the full ranking rather than the
+# everything-violates degenerate case
+PARITY_INFERENCE = dict(arrival_rate_rps=4.0, prompt_len=512, output_len=128,
+                        slo_ttft_p99_ms=2000.0, slo_tpot_p99_ms=100.0)
 DEFAULT_REFERENCE_ROOT = Path("/root/reference")
 
 

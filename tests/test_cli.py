@@ -601,3 +601,106 @@ def test_train_slice_controller_loss_parity(fixture_dir, tmp_path):
         single["first_loss"], rel=1e-5)
     assert slice_summary["final_loss"] == pytest.approx(
         single["final_loss"], rel=1e-5)
+
+
+INFER_ARGS = ["--workload", "inference", "--arrival-rate", "1",
+              "--prompt-len", "16", "--output-len", "8",
+              "--slo-ttft", "10000", "--slo-tpot", "1000"]
+
+
+def test_plan_inference_offline(fixture_dir, tmp_path):
+    out = tmp_path / "serving.json"
+    rc = main(["plan", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-tp", "2", "--max-bs", "4",
+               *INFER_ARGS, "--top-k", "3", "--output", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    assert payload["workload"]["prompt_len"] == 16
+    assert payload["plans"] and payload["plans"][0]["rank"] == 1
+    best = payload["plans"][0]
+    assert best["prefill"]["role"] == "prefill"
+    assert best["decode"]["batch_per_lane"] >= 1
+    assert best["cost"]["slo_ok"] is True
+
+
+def test_plan_inference_workload_spec_file(fixture_dir, tmp_path):
+    spec = tmp_path / "wl.json"
+    spec.write_text(json.dumps({
+        "arrival_rate_rps": 1.0, "prompt_len": 16, "output_len": 8,
+        "slo_ttft_p99_ms": 10000.0, "slo_tpot_p99_ms": 1000.0}))
+    out = tmp_path / "serving.json"
+    rc = main(["plan", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-tp", "2", "--max-bs", "4",
+               "--workload", "inference", "--workload-spec", str(spec),
+               "--output", str(out)])
+    assert rc == 0
+    assert json.loads(out.read_text())["workload"]["output_len"] == 8
+
+
+def test_plan_offline_requires_cluster(fixture_dir, tmp_path):
+    rc = main(["plan", *MODEL_ARGS, "--gbs", "8",
+               "--output", str(tmp_path / "x.json")])
+    assert rc == 2
+
+
+def test_plan_offline_training_matches_hetero(fixture_dir, tmp_path):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    common = [*_cluster_args(fixture_dir),
+              "--profile-dir", str(fixture_dir / "profiles"),
+              *MODEL_ARGS, "--gbs", "8", "--max-bs", "4", "--top-k", "3"]
+    assert main(["hetero", *common, "--output", str(a)]) == 0
+    assert main(["plan", *common, "--output", str(b)]) == 0
+    assert a.read_text() == b.read_text()
+
+
+def test_explain_inference_json_components_sum(fixture_dir, tmp_path):
+    out = tmp_path / "explain.json"
+    rc = main(["explain", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-tp", "2", "--max-bs", "4",
+               *INFER_ARGS, "--ranks", "1,2", "--json",
+               "--output", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    assert len(payload["plans"]) == 2
+    assert "decisive" in payload
+    for p in payload["plans"]:
+        c = p["cost"]
+        ttft_sum = sum(c["components"][k] for k in
+                       ("queueing", "prefill_compute", "prefill_pp_comm",
+                        "kv_handoff"))
+        tpot_sum = sum(c["components"][k] for k in
+                       ("decode_compute", "kv_read", "decode_pp_comm"))
+        assert c["ttft_p99_ms"] == pytest.approx(ttft_sum)
+        assert c["tpot_p99_ms"] == pytest.approx(tpot_sum)
+
+
+def test_explain_inference_table(fixture_dir, tmp_path):
+    out = tmp_path / "explain.txt"
+    rc = main(["explain", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-tp", "2", "--max-bs", "4",
+               *INFER_ARGS, "--output", str(out)])
+    assert rc == 0
+    text = out.read_text()
+    assert "ttft_p99" in text and "tpot_p99" in text
+    assert "decisive:" in text
+
+
+def test_replay_subcommand(fixture_dir, tmp_path):
+    out = tmp_path / "replay.json"
+    rc = main(["replay", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-tp", "2", "--max-bs", "4",
+               "--prompt-len", "16", "--output-len", "8",
+               "--slo-ttft", "10000", "--slo-tpot", "1000",
+               "--base-rps", "1", "--peak-rps", "4",
+               "--ticks-per-cycle", "4", "--cycles", "1",
+               "--output", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["cycles"] == 1
+    assert len(report["ticks"]) == 4
+    assert 0.0 <= report["slo_attainment"] <= 1.0

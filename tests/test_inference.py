@@ -1,0 +1,397 @@
+"""Latency-SLO inference planning: KV memory model, disaggregated search,
+query-fingerprint isolation, daemon parity, and the traffic-replay bench.
+
+The search-level golden (ranking bytes frozen against
+tools/search_inference_golden.json) lives in the regression gate, run
+in-process by tests/test_parallel_search.py; this file covers the unit
+semantics and the serve/replay integration around it.
+"""
+import dataclasses
+import json
+import time
+
+import pytest
+
+from metis_tpu.balance.stage_perf import max_kv_concurrency
+from metis_tpu.cluster import ClusterSpec
+from metis_tpu.cluster.spec import DeviceSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.errors import KvCacheOomError
+from metis_tpu.cost.estimator import kv_bytes_per_token, kv_stage_bytes
+from metis_tpu.inference.planner import dump_inference_plans, plan_inference
+from metis_tpu.inference.workload import InferenceWorkload, workload_from_dict
+from metis_tpu.profiles import ProfileStore, synthesize_profiles, tiny_test_model
+from metis_tpu.testing import (
+    PARITY_GBS,
+    PARITY_INFERENCE,
+    PARITY_MAX_BS,
+    PARITY_MAX_TP,
+)
+
+
+def _parity_config() -> SearchConfig:
+    return SearchConfig(gbs=PARITY_GBS, max_profiled_tp=PARITY_MAX_TP,
+                        max_profiled_bs=PARITY_MAX_BS)
+
+
+def _parity_workload(**over) -> InferenceWorkload:
+    return InferenceWorkload(**{**PARITY_INFERENCE, **over})
+
+
+@pytest.fixture(scope="module")
+def parity_inputs(tmp_path_factory):
+    from metis_tpu.testing import write_parity_fixture
+
+    d = tmp_path_factory.mktemp("inf_parity")
+    write_parity_fixture(d)
+    cluster = ClusterSpec.from_files(d / "hostfile", d / "clusterfile.json")
+    store = ProfileStore.from_dir(d / "profiles")
+    return cluster, store, tiny_test_model()
+
+
+# ---------------------------------------------------------------------------
+# workload model
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadModel:
+    def test_rejects_bad_values(self):
+        with pytest.raises(ValueError):
+            _parity_workload(arrival_rate_rps=0.0)
+        with pytest.raises(ValueError):
+            _parity_workload(output_len=0)
+        with pytest.raises(ValueError):
+            _parity_workload(slo_tpot_p99_ms=-1.0)
+        with pytest.raises(ValueError):
+            _parity_workload(prompt_len_p99=10)  # undercuts prompt_len
+
+    def test_from_dict_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="slo_ttft_ms"):
+            workload_from_dict({**PARITY_INFERENCE, "slo_ttft_ms": 5.0})
+
+    def test_tail_lengths_default_to_means(self):
+        wl = _parity_workload()
+        assert wl.tail_prompt_len == wl.prompt_len
+        assert wl.max_context_len == wl.prompt_len + wl.output_len
+        tailed = _parity_workload(prompt_len_p99=1024, output_len_p99=256)
+        assert tailed.max_context_len == 1280
+
+
+# ---------------------------------------------------------------------------
+# KV-cache memory model (the edge cases ISSUE 9 calls out)
+# ---------------------------------------------------------------------------
+
+
+class TestKvMemoryModel:
+    def test_gqa_shrinks_footprint(self):
+        m = tiny_test_model()
+        full = kv_bytes_per_token(m)
+        gqa = kv_bytes_per_token(dataclasses.replace(m, num_kv_heads=8))
+        mqa = kv_bytes_per_token(dataclasses.replace(m, num_kv_heads=1))
+        assert gqa == full * 8 / m.num_heads
+        assert mqa == full / m.num_heads
+
+    def test_int8_kv_halves_footprint(self):
+        m = tiny_test_model()
+        assert kv_bytes_per_token(m, kv_dtype_bytes=1) \
+            == kv_bytes_per_token(m, kv_dtype_bytes=2) / 2
+
+    def test_tp_shards_the_cache(self):
+        m = tiny_test_model()
+        assert kv_bytes_per_token(m, tp=4) == kv_bytes_per_token(m) / 4
+
+    def test_embed_and_head_pseudo_layers_cache_nothing(self):
+        m = tiny_test_model()  # 10 profiled layers: embed + 8 blocks + head
+        # prefill-only shapes: a stage holding just the embed (or just the
+        # head) pseudo-layer has zero KV footprint
+        assert kv_stage_bytes(m, batch=4, context_len=640, start=0, end=1) == 0
+        assert kv_stage_bytes(
+            m, batch=4, context_len=640, start=m.num_layers - 1,
+            end=m.num_layers) == 0
+        # ... and the full model's footprint counts only the 8 blocks
+        full = kv_stage_bytes(m, batch=1, context_len=1, start=0,
+                              end=m.num_layers)
+        assert full == kv_bytes_per_token(m) * (m.num_layers - 2)
+
+    def test_zero_kv_stage_is_unbounded_not_zero(self):
+        # decode-only concern: a KV-free stage must not clamp the pool's
+        # concurrency to zero
+        assert max_kv_concurrency(100.0, 1024.0, 0.0) == 1 << 30
+
+    def test_weights_exceeding_hbm_raise_not_zero(self):
+        cap_bytes = 10 * 1024 * 1024
+        with pytest.raises(KvCacheOomError):
+            max_kv_concurrency(10.0, float(cap_bytes), 1.0)
+        with pytest.raises(KvCacheOomError):
+            max_kv_concurrency(10.0, float(cap_bytes + 1), 1.0)
+
+    def test_free_hbm_divides_into_sequences(self):
+        # 10 MB capacity, 2 MB weights, 1 MB per sequence -> 8 concurrent
+        assert max_kv_concurrency(
+            10.0, 2.0 * 1024 * 1024, 1.0 * 1024 * 1024) == 8
+
+    def test_planner_survives_oom_topology(self, parity_inputs):
+        # shrink every device to 32 MB: weights alone overflow, every decode
+        # candidate OOM-prunes, and the search reports that rather than
+        # fabricating batch=0 plans
+        cluster, store, model = parity_inputs
+        tiny = ClusterSpec(
+            nodes=cluster.nodes,
+            devices={name: dataclasses.replace(d, memory_gb=1 / 32)
+                     for name, d in cluster.devices.items()})
+        result = plan_inference(tiny, store, model, _parity_config(),
+                                _parity_workload())
+        assert result.plans == ()
+        assert result.num_pruned > 0
+
+
+# ---------------------------------------------------------------------------
+# disaggregated plan search
+# ---------------------------------------------------------------------------
+
+
+class TestInferenceSearch:
+    @pytest.fixture(scope="class")
+    def parity_result(self, parity_inputs):
+        cluster, store, model = parity_inputs
+        wl = _parity_workload()
+        return plan_inference(cluster, store, model, _parity_config(), wl), wl
+
+    def test_best_plan_meets_both_slos(self, parity_result):
+        result, wl = parity_result
+        best = result.best
+        assert best is not None and best.cost.slo_ok
+        assert best.cost.ttft_p99_ms <= wl.slo_ttft_p99_ms
+        assert best.cost.tpot_p99_ms <= wl.slo_tpot_p99_ms
+        assert best.cost.throughput_rps >= wl.arrival_rate_rps
+
+    def test_pools_disjoint_and_cover_devices(self, parity_result):
+        result, _ = parity_result
+        cluster_devices = 16
+        for p in result.plans:
+            assert p.prefill.num_devices >= 1
+            assert p.decode.num_devices >= 1
+            assert p.prefill.num_devices + p.decode.num_devices \
+                <= cluster_devices
+
+    def test_components_sum_to_headline_latencies(self, parity_result):
+        result, _ = parity_result
+        for p in result.plans:
+            c = p.cost
+            assert c.ttft_p99_ms == pytest.approx(c.ttft_component_sum_ms)
+            assert c.tpot_p99_ms == pytest.approx(c.tpot_component_sum_ms)
+
+    def test_ranking_prefers_feasible_then_throughput(self, parity_result):
+        result, _ = parity_result
+        flags = [p.cost.slo_ok for p in result.plans]
+        assert flags == sorted(flags, reverse=True)
+        for a, b in zip(result.plans, result.plans[1:]):
+            if a.cost.slo_ok == b.cost.slo_ok:
+                assert a.cost.throughput_rps >= b.cost.throughput_rps
+
+    def test_deterministic_dump(self, parity_inputs, parity_result):
+        cluster, store, model = parity_inputs
+        result, wl = parity_result
+        again = plan_inference(cluster, store, model, _parity_config(), wl)
+        assert dump_inference_plans(result, wl) \
+            == dump_inference_plans(again, wl)
+
+    def test_emits_valid_inference_plan_events(self, parity_inputs,
+                                               tmp_path):
+        from tools.check_events_schema import validate_events
+
+        from metis_tpu.core.events import EventLog, read_events
+
+        cluster, store, model = parity_inputs
+        path = tmp_path / "inf_events.jsonl"
+        log = EventLog(path)
+        # starved SLOs so the best plan violates and slo_violation fires too
+        plan_inference(cluster, store, model, _parity_config(),
+                       _parity_workload(slo_tpot_p99_ms=0.001), events=log)
+        log.close()
+        events = read_events(path)
+        names = {e["event"] for e in events}
+        assert "inference_plan" in names
+        assert "slo_violation" in names
+        assert validate_events(events) == []
+
+
+# ---------------------------------------------------------------------------
+# query-fingerprint isolation (training vs inference, SLO-field toggles)
+# ---------------------------------------------------------------------------
+
+
+class TestQueryFingerprintWorkloads:
+    def _fp(self, workload=None):
+        from metis_tpu.obs.ledger import query_fingerprint
+
+        cluster = ClusterSpec.of(("A100", 1, 4), ("T4", 1, 4))
+        return query_fingerprint(tiny_test_model(), cluster,
+                                 _parity_config(), workload=workload)
+
+    def test_training_never_aliases_inference(self):
+        assert self._fp() != self._fp(_parity_workload())
+
+    @pytest.mark.parametrize("flip", [
+        dict(arrival_rate_rps=5.0),
+        dict(prompt_len=513),
+        dict(output_len=129),
+        dict(slo_ttft_p99_ms=1000.0),
+        dict(slo_tpot_p99_ms=50.0),
+        dict(prompt_len_p99=1024),
+        dict(output_len_p99=256),
+        dict(kv_dtype_bytes=1),
+    ])
+    def test_every_workload_field_flips_the_key(self, flip):
+        assert self._fp(_parity_workload()) != self._fp(
+            _parity_workload(**flip))
+
+    def test_identical_workloads_agree(self):
+        assert self._fp(_parity_workload()) == self._fp(_parity_workload())
+
+
+# ---------------------------------------------------------------------------
+# serve daemon: byte-identity with the offline CLI path, cached-hit budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def inference_service(parity_inputs):
+    from metis_tpu.serve.daemon import PlanService
+
+    cluster, store, _ = parity_inputs
+    return PlanService(cluster, store)
+
+
+class TestServeInference:
+    def test_daemon_byte_identical_to_offline(self, parity_inputs,
+                                              inference_service):
+        cluster, store, model = parity_inputs
+        wl = _parity_workload()
+        offline = dump_inference_plans(
+            plan_inference(cluster, store, model, _parity_config(), wl,
+                           top_k=5), wl)
+        cold = inference_service.plan_query(model, _parity_config(),
+                                            top_k=5, workload=wl)
+        assert cold["cached"] is False
+        assert cold["workload_kind"] == "inference"
+        assert cold["plans"] == offline
+        assert cold["slo_ok"] is True
+
+    def test_cached_hit_under_budget(self, parity_inputs,
+                                     inference_service):
+        _, _, model = parity_inputs
+        wl = _parity_workload()
+        inference_service.plan_query(model, _parity_config(), top_k=5,
+                                     workload=wl)
+        t0 = time.perf_counter()
+        hit = inference_service.plan_query(model, _parity_config(),
+                                           top_k=5, workload=wl)
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        assert hit["cached"] is True
+        assert elapsed_ms < 10.0
+
+    def test_training_and_inference_entries_coexist(self, parity_inputs,
+                                                    inference_service):
+        _, _, model = parity_inputs
+        wl = _parity_workload()
+        inf = inference_service.plan_query(model, _parity_config(),
+                                           top_k=5, workload=wl)
+        train = inference_service.plan_query(model, _parity_config(),
+                                             top_k=5)
+        assert train["fingerprint"] != inf["fingerprint"]
+        assert "workload_kind" not in train or \
+            train.get("workload_kind") != "inference"
+        # the inference hit survives the training query
+        again = inference_service.plan_query(model, _parity_config(),
+                                             top_k=5, workload=wl)
+        assert again["cached"] is True
+
+
+# ---------------------------------------------------------------------------
+# traffic replay (serve daemon + cluster deltas, >= 1 diurnal cycle)
+# ---------------------------------------------------------------------------
+
+
+class TestTrafficReplay:
+    def test_diurnal_rate_shape(self):
+        from metis_tpu.inference.replay import diurnal_rate
+
+        ticks = 24
+        rates = [diurnal_rate(t, ticks, 2.0, 50.0) for t in range(ticks)]
+        assert rates[0] == pytest.approx(2.0)
+        assert max(rates) == pytest.approx(50.0)
+        assert rates[ticks // 2] == pytest.approx(50.0)
+        # symmetric about the peak
+        assert rates[1] == pytest.approx(rates[-1])
+
+    def test_full_cycle_with_elastic_deltas(self, parity_inputs, tmp_path):
+        from tools.check_events_schema import validate_events
+
+        from metis_tpu.core.events import EventLog, read_events
+        from metis_tpu.inference.replay import replay_traffic
+        from metis_tpu.serve.client import PlanServiceClient
+        from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+        cluster, store, model = parity_inputs
+        path = tmp_path / "replay_events.jsonl"
+        log = EventLog(path)
+        service = PlanService(cluster, store, events=log)
+        server, _thread, address = serve_in_thread(service)
+        try:
+            client = PlanServiceClient(address)
+            report = replay_traffic(
+                client, cluster, model, _parity_config(),
+                _parity_workload(),
+                base_rps=4.0, peak_rps=40.0, ticks_per_cycle=6, cycles=1,
+                events=log)
+        finally:
+            server.shutdown()
+            server.server_close()
+        log.close()
+
+        assert report.cycles == 1
+        assert len(report.ticks) == 6
+        assert 0.0 <= report.slo_attainment <= 1.0
+        # 4-40 rps against a ~220 rps plan: the hysteresis must shed nodes,
+        # and every delta goes through the daemon with replan=True, so the
+        # replan_push notifications the client saw are counted
+        assert any(t.scaled == "down" for t in report.ticks)
+        assert report.replan_pushes >= 1
+        # scale-down floor: never below min_nodes (default 2) * 4 devices
+        assert min(report.device_trajectory) >= 8
+        d = report.to_json_dict()
+        assert d["slo_attainment"] == report.slo_attainment
+        assert len(d["ticks"]) == 6
+        assert json.dumps(d)
+
+        events = read_events(path)
+        names = {e["event"] for e in events}
+        assert "replay_tick" in names
+        assert "plan_request" in names
+        assert validate_events(events) == []
+
+    def test_cluster_delta_during_replay_replans_cached_query(
+            self, parity_inputs):
+        from metis_tpu.serve.daemon import PlanService
+
+        cluster, store, model = parity_inputs
+        service = PlanService(cluster, store)
+        wl = _parity_workload()
+        cold = service.plan_query(model, _parity_config(), top_k=3,
+                                  workload=wl)
+        out = service.apply_cluster_delta({"T4": 4}, replan=True)
+        assert out["replanning"] is True
+        # the replan runs on a background thread; the cluster_delta note
+        # lands first, so poll until its push arrives
+        pushes: list[dict] = []
+        deadline = time.monotonic() + 30.0
+        while not pushes and time.monotonic() < deadline:
+            notes = service.notifications(since=0, timeout_s=1.0)
+            pushes = [n for n in notes if n["kind"] == "replan_push"]
+        assert len(pushes) == 1
+        assert pushes[0]["reason"] == "cluster_delta"
+        assert pushes[0]["query_fingerprint"] != cold["fingerprint"]
+        # restoring the node replans back toward the full topology
+        out = service.apply_cluster_delta(added={"T4": 4}, replan=True)
+        assert out["devices"] == 16

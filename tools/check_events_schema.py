@@ -61,6 +61,13 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "plan_cache_hit": {"fingerprint"},
     "plan_cache_miss": {"fingerprint"},
     "replan_push": {"fingerprint", "new_fingerprint", "reason"},
+    # serving-workload planning (inference/planner.py, inference/replay.py):
+    # one inference_plan per ranked serving plan; slo_violation when the
+    # best plan misses a p99 target (metric names which); replay_tick per
+    # simulated tick of the traffic-replay bench
+    "inference_plan": {"rank", "ttft_p99_ms", "tpot_p99_ms", "max_rps"},
+    "slo_violation": {"metric", "value", "slo"},
+    "replay_tick": {"t_s", "arrival_rps", "devices", "slo_ok"},
     # fault tolerance (resilience/ — faults.py, retry.py, supervisor.py)
     "fault_injected": {"point"},
     "retry_attempt": {"op", "attempt"},

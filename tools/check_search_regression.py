@@ -26,6 +26,12 @@ Four frozen invariants, any drift exits 1:
    match its own checked-in golden (tools/search_overlap_golden.json,
    recorded with ``--update-baseline``) and stay batched==scalar
    byte-identical.
+6. **Inference-search golden.**  The serving-workload search
+   (``inference/planner.plan_inference`` on the parity topology with
+   ``metis_tpu.testing.PARITY_INFERENCE``) must be run-to-run
+   deterministic (two dumps byte-identical) and match its checked-in
+   golden (tools/search_inference_golden.json, recorded with
+   ``--update-baseline``).
 
 ``--throughput`` adds a performance gate: the batched whole-search
 plan-throughput on the parity workload, NORMALIZED by the scalar path's
@@ -60,6 +66,12 @@ GOLDEN_NUM_COSTED = 1764
 # GOLDEN_NUM_COSTED freezes the strict-compat search space.
 OVERLAP_GOLDEN = Path(__file__).resolve().parent / (
     "search_overlap_golden.json")
+
+# Serving-workload ranking golden: num_costed/num_splits + sha256 of the
+# serialized dump_inference_plans ranking + the best plan's headline
+# latencies/throughput, recorded by ``--update-baseline``.
+INFERENCE_GOLDEN = Path(__file__).resolve().parent / (
+    "search_inference_golden.json")
 
 # Throughput baseline: batched + scalar plans/sec recorded on one host by
 # ``--update-baseline``; the check compares host-normalized numbers, so the
@@ -203,8 +215,91 @@ def run_checks(workers: int = 2) -> list[str]:
                 f"overlap golden missing: {OVERLAP_GOLDEN} "
                 "(record one with --update-baseline)")
 
+        # inference leg: run-to-run determinism + frozen serving golden
+        dump1, inf1 = _run_inference_search(cluster, store, model)
+        dump2, _ = _run_inference_search(cluster, store, model)
+        if dump1 != dump2:
+            problems.append(
+                "inference search is not run-to-run deterministic "
+                "(two dump_inference_plans differ on the parity workload)")
+        if INFERENCE_GOLDEN.exists():
+            golden = json.loads(INFERENCE_GOLDEN.read_text())
+            entry = _inference_fingerprint(inf1, dump1)
+            for key in ("num_costed", "num_splits", "dump_sha256",
+                        "best_ttft_p99_ms", "best_tpot_p99_ms",
+                        "best_max_rps"):
+                if golden.get(key) != entry[key]:
+                    problems.append(
+                        f"inference golden drift: {key} = {entry[key]}, "
+                        f"frozen golden is {golden.get(key)} "
+                        f"(re-record deliberately with --update-baseline)")
+        else:
+            problems.append(
+                f"inference golden missing: {INFERENCE_GOLDEN} "
+                "(record one with --update-baseline)")
+
         problems.extend(_check_grid_oracle(cluster, store))
     return problems
+
+
+def _run_inference_search(cluster, store, model):
+    """(dump, result) of the parity serving search."""
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.inference.planner import dump_inference_plans, plan_inference
+    from metis_tpu.inference.workload import InferenceWorkload
+    from metis_tpu.testing import (
+        PARITY_GBS,
+        PARITY_INFERENCE,
+        PARITY_MAX_BS,
+        PARITY_MAX_TP,
+    )
+
+    workload = InferenceWorkload(**PARITY_INFERENCE)
+    result = plan_inference(
+        cluster, store, model,
+        SearchConfig(gbs=PARITY_GBS, max_profiled_tp=PARITY_MAX_TP,
+                     max_profiled_bs=PARITY_MAX_BS),
+        workload)
+    return dump_inference_plans(result, workload), result
+
+
+def _inference_fingerprint(result, dump: str) -> dict:
+    """Golden entry for the parity serving search."""
+    import hashlib
+
+    best = result.best
+    return {
+        "workload": "parity serving (8xA100+8xT4, GPT-10L, 4 rps, "
+                    "prompt 512 / output 128, SLO ttft 2000ms tpot 100ms)",
+        "num_costed": result.num_costed,
+        "num_splits": result.num_splits,
+        "dump_sha256": hashlib.sha256(dump.encode()).hexdigest(),
+        "best_ttft_p99_ms": (round(best.cost.ttft_p99_ms, 4)
+                             if best else None),
+        "best_tpot_p99_ms": (round(best.cost.tpot_p99_ms, 4)
+                             if best else None),
+        "best_max_rps": (round(best.cost.throughput_rps, 4)
+                         if best else None),
+    }
+
+
+def record_inference_golden() -> dict:
+    """Run the parity serving search and write its golden."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import write_parity_fixture
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        dump, result = _run_inference_search(cluster, store,
+                                             tiny_test_model())
+    entry = _inference_fingerprint(result, dump)
+    INFERENCE_GOLDEN.write_text(json.dumps(entry, indent=2) + "\n")
+    return entry
 
 
 def _overlap_fingerprint(result, dump: str | None = None) -> dict:
@@ -330,6 +425,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.update_baseline:
         golden = record_overlap_golden()
         print(f"overlap golden written: {golden}")
+        inf_golden = record_inference_golden()
+        print(f"inference golden written: {inf_golden}")
         entry = measure_throughput()
         THROUGHPUT_BASELINE.write_text(json.dumps(entry, indent=2) + "\n")
         print(f"throughput baseline written: {entry}")
@@ -345,7 +442,8 @@ def main(argv: list[str] | None = None) -> int:
     print(f"search regression gate OK (golden num_costed = "
           f"{GOLDEN_NUM_COSTED}, workers={args.workers} byte-identical, "
           f"batched == scalar oracle, time grid matches, overlap-off "
-          f"inert + overlap golden matches)")
+          f"inert + overlap golden matches, inference search "
+          f"deterministic + golden matches)")
     return 0
 
 
