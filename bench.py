@@ -1287,6 +1287,52 @@ def inference_bench(record: dict) -> None:
     record["inference"] = entry
 
 
+def fleet_bench(record: dict) -> None:
+    """Availability-aware planning under fleet-scale chaos: the 256-device
+    mixed reserved/spot drill (tools/fleet_drill.py) in a CPU-pinned
+    subprocess.  ``spot_recover_s`` is seeded from the resilience drill's
+    measured end-to-end time-to-recover when that section ran, so the
+    ``expected_recovery`` cost term prices what THIS machine actually
+    measured, not the 30 s default."""
+    recover_s = (((record.get("resilience") or {}).get("drill") or {})
+                 .get("time_to_recover_s"))
+    args = [sys.executable,
+            str(Path(__file__).resolve().parent / "tools" / "fleet_drill.py"),
+            "--ticks", "24", "--skip-supervisor"]
+    if recover_s:
+        args += ["--spot-recover-s", str(recover_s)]
+    with tempfile.TemporaryDirectory() as td:
+        rep_path = Path(td) / "report.json"
+        proc = subprocess.run(
+            args + ["--report", str(rep_path)],
+            capture_output=True, text=True, timeout=600.0,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if proc.returncode != 0 or not rep_path.exists():
+            record["fleet"] = {
+                "error": f"rc={proc.returncode}: "
+                         + proc.stderr.strip().splitlines()[-1][:160]
+                         if proc.stderr.strip() else f"rc={proc.returncode}"}
+            return
+        rep = json.loads(rep_path.read_text())["fleet"]
+    record["fleet"] = {
+        "devices": rep["devices"],
+        "ticks": rep["ticks"],
+        "spot_recover_s_used": recover_s or 30.0,
+        "preempted_nodes": rep["preempted_nodes"],
+        "returned_nodes": rep["returned_nodes"],
+        "cluster_deltas": rep["cluster_deltas"],
+        "replan_pushes": rep["replan_pushes"],
+        "baseline_cost_ms": rep["baseline_cost_ms"],
+        "baseline_expected_recovery_ms":
+            rep["baseline_expected_recovery_ms"],
+        "fleet_goodput_frac": round(rep["fleet_goodput_frac"], 4),
+        "min_goodput_frac": round(rep["min_goodput_frac"], 4),
+        # per-tick recovery-cost trajectory (devices, cost, priced
+        # expected_recovery, realized downtime)
+        "trajectory": rep["trajectory"],
+    }
+
+
 def tpu_validation(record: dict) -> None:
     """North-star error on REAL hardware: profile per-layer times on the TPU
     chip, plan a single-chip uniform schedule from those profiles, execute
@@ -1655,6 +1701,7 @@ def main() -> None:
     recorder.run("overlap", overlap_bench, record)
     recorder.run("serve", serve_bench, record)
     recorder.run("inference", inference_bench, record)
+    recorder.run("fleet", fleet_bench, record)
 
     # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
     # proves the tunnel was alive at bench start — it wedged MID-RUN once
@@ -1764,6 +1811,10 @@ def _headline(record: dict) -> dict:
                               .get("skipped")
                               or (record.get("inference") or {})
                               .get("replay_skipped_reason")),
+        "fleet_goodput_frac": (record.get("fleet") or {})
+        .get("fleet_goodput_frac"),
+        "fleet_replan_pushes": (record.get("fleet") or {})
+        .get("replan_pushes"),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),
         "tpu_step": _tpu_brief(record, "tpu_step"),

@@ -25,15 +25,46 @@ from pathlib import Path
 from metis_tpu.core.errors import ClusterSpecError
 
 
+#: Valid availability tiers for a device type.
+DEVICE_TIERS = ("reserved", "spot")
+
+
 @dataclass(frozen=True)
 class DeviceSpec:
-    """One accelerator type.  Bandwidths in GB/s, memory in GB."""
+    """One accelerator type.  Bandwidths in GB/s, memory in GB.
+
+    ``tier``/``preemption_rate_per_hr`` are the availability prior the
+    spot-aware cost model prices (``SearchConfig.use_spot_model``): a
+    "spot" type may be preempted at the given expected rate, a "reserved"
+    type never is (its rate is ignored and treated as 0)."""
 
     name: str
     memory_gb: float
     intra_bw_gbps: float  # within a node (NVLink) / within a slice (ICI)
     inter_bw_gbps: float  # across nodes (IB/Ethernet) / across slices (DCN)
     hbm_gbps: float = 0.0  # device memory bandwidth; 0 = unknown
+    tier: str = "reserved"  # "reserved" | "spot"
+    preemption_rate_per_hr: float = 0.0  # expected per-device evictions/hour
+
+    def __post_init__(self) -> None:
+        if self.tier not in DEVICE_TIERS:
+            raise ClusterSpecError(
+                f"device {self.name!r}: tier must be one of {DEVICE_TIERS}, "
+                f"got {self.tier!r}")
+        if self.preemption_rate_per_hr < 0:
+            raise ClusterSpecError(
+                f"device {self.name!r}: preemption_rate_per_hr must be >= 0, "
+                f"got {self.preemption_rate_per_hr}")
+
+    @property
+    def is_spot(self) -> bool:
+        return self.tier == "spot"
+
+    @property
+    def hazard_per_hr(self) -> float:
+        """The rate the spot cost model charges: 0 unless the tier is spot
+        (a stale rate on a reserved type must not leak into rankings)."""
+        return self.preemption_rate_per_hr if self.tier == "spot" else 0.0
 
     @property
     def memory_mb(self) -> float:
@@ -187,6 +218,11 @@ class ClusterSpec:
                 inter_bw_gbps=float(entry["inter_bandwidth"]),
                 hbm_gbps=float(entry.get(
                     "hbm_bandwidth", preset.hbm_gbps if preset else 0.0)),
+                tier=str(entry.get(
+                    "tier", preset.tier if preset else "reserved")),
+                preemption_rate_per_hr=float(entry.get(
+                    "preemption_rate_per_hr",
+                    preset.preemption_rate_per_hr if preset else 0.0)),
             )
 
         nodes: list[NodeSpec] = []

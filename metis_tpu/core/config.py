@@ -160,10 +160,26 @@ class SearchConfig:
     # prices every collective fully exposed); False restores the serial
     # pricing in native mode too.
     use_overlap_model: bool = True
+    # Availability-aware pricing (cost/estimator.py): add an additive
+    # ``expected_recovery`` term — the plan's preemption hazard (sum of
+    # per-rank ``DeviceSpec.hazard_per_hr`` over the device set) times the
+    # measured time-to-recover — so the planner ranks by availability-
+    # adjusted goodput on spot-tier fleets.  Reserved-only fleets price a
+    # hazard of exactly 0, leaving every cost bit-identical to the model
+    # with the flag off.  Inert under strict_compat (the reference knows
+    # no availability tiers); False disables it in native mode too.
+    use_spot_model: bool = True
+    # Expected seconds to recover from one preemption (shrink -> replan ->
+    # restore).  Seeded from the bench ``resilience_recover_s`` headline
+    # (the chaos drill's measured time-to-recover); refit from observed
+    # recoveries via ``cost/calibration.fit_recovery_seconds``.
+    spot_recover_s: float = 30.0
 
     def __post_init__(self) -> None:
         if self.gbs < 1:
             raise ValueError("gbs must be positive")
+        if self.spot_recover_s < 0:
+            raise ValueError("spot_recover_s must be >= 0")
         if self.max_permute_len < 1:
             raise ValueError("max_permute_len must be >= 1")
         if any(v < 2 for v in self.virtual_stage_candidates):

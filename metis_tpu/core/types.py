@@ -153,6 +153,10 @@ class PlanCost:
     batch_gen_ms: float = 0.0
     cp_comm_ms: float = 0.0  # ring-attention K/V rotation (inside execution_ms)
     ep_comm_ms: float = 0.0  # MoE all-to-all dispatch/combine (inside execution_ms)
+    # expected preemption-recovery charge (SearchConfig.use_spot_model):
+    # step time x the plan's spot hazard x measured time-to-recover;
+    # exactly 0.0 on reserved-only fleets or with the spot model off
+    expected_recovery_ms: float = 0.0
     oom: bool = False
 
 
@@ -166,7 +170,7 @@ class PlanCost:
 COST_COMPONENTS = (
     "compute", "imbalance", "cp_comm", "ep_comm", "step_overhead",
     "pp_comm", "pp_comm_exposed", "dp_comm", "dp_comm_exposed",
-    "fb_sync", "optimizer", "batch_gen",
+    "fb_sync", "optimizer", "batch_gen", "expected_recovery",
 )
 
 
@@ -339,9 +343,15 @@ class RankedPlan:
     breakdown: CostBreakdown | None = None
 
     def to_json_dict(self) -> dict:
+        cb = asdict(self.cost)
+        # keep reserved-only dumps byte-identical to the pre-spot-model
+        # goldens: the field only appears when the charge is real (same
+        # omission contract as CostBreakdown's empty ``hidden``)
+        if cb.get("expected_recovery_ms") == 0.0:
+            del cb["expected_recovery_ms"]
         d = {
             "cost_ms": self.cost.total_ms,
-            "cost_breakdown": asdict(self.cost),
+            "cost_breakdown": cb,
             "node_sequence": list(self.inter.node_sequence),
             "device_groups": list(self.inter.device_groups),
             "num_stages": self.inter.num_stages,

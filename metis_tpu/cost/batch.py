@@ -65,7 +65,7 @@ class _StageMeta:
 class _PlacementTables:
     __slots__ = ("bw", "num_stages", "stages", "pp_den", "lat_fn", "latmap",
                  "dpfac", "ranks_uniform", "first_type", "batch_gen",
-                 "seq_key", "ranges")
+                 "seq_key", "ranges", "spot_scale")
 
 
 class BatchCostEstimator:
@@ -268,6 +268,14 @@ class BatchCostEstimator:
         pp_charge = pp_exposed if ov else pp_cost
         total = (execution + fb_sync + max_opt + dp_charge + pp_charge
                  + batch_gen)
+        # spot model: the scalar's placement-memoized scale verbatim
+        # (_placement stores the same float), so recovery and total stay
+        # bit-identical to HeteroCostEstimator.get_cost
+        recovery = 0.0
+        spot_scale = P.spot_scale
+        if spot_scale:
+            recovery = total * spot_scale
+            total = total + recovery
         return PlanCost(
             total_ms=total,
             execution_ms=execution,
@@ -278,6 +286,7 @@ class BatchCostEstimator:
             batch_gen_ms=batch_gen,
             cp_comm_ms=0.0,
             ep_comm_ms=0.0,
+            expected_recovery_ms=recovery,
         )
 
     # -- table builders ----------------------------------------------------
@@ -355,6 +364,7 @@ class BatchCostEstimator:
         P.batch_gen = (
             scalar.profiles.type_meta[P.first_type].batch_generator_ms
             if (not strict and P.first_type is not None) else 0.0)
+        P.spot_scale = scalar._spot_scale(plan)
         if len(self._pcache) >= _PLACEMENT_MEMO_MAX:
             self._pcache.clear()
             if self.counters is not None:

@@ -339,6 +339,56 @@ def fit_ledger_correction(samples) -> dict:
     }
 
 
+def fit_recovery_seconds(samples, kinds: Sequence[str] | None = None) -> dict:
+    """Refit ``SearchConfig.spot_recover_s`` from measured recoveries.
+
+    The spot-availability cost term charges ``hazard_per_hr x
+    spot_recover_s`` of expected recovery time per plan
+    (``cost/estimator.py``); the seed value comes from the bench
+    ``resilience`` headline, and THIS closes the loop from production:
+    ``samples`` is an iterable of recovery durations in seconds — floats,
+    ``(kind, recover_s)`` pairs, supervisor ``RecoveryRecord`` objects, or
+    their ``to_json_dict`` rows.  ``kinds`` (default: the replan-bearing
+    ones — ``device_loss``/``spot_preemption``/``spot_return``) filters
+    records that carry a kind; anomaly rollbacks re-jit nothing and would
+    drag the estimate down.
+
+    Returns ``{"spot_recover_s", "n", "mean_s", "p50_s", "p90_s"}`` —
+    ``spot_recover_s`` is the MEDIAN (one straggler recovery must not
+    dominate the prior every future plan is ranked with)."""
+    if kinds is None:
+        kinds = ("device_loss", "spot_preemption", "spot_return")
+    vals: list[float] = []
+    for s in samples:
+        kind = None
+        if hasattr(s, "recover_s"):
+            kind, sec = getattr(s, "kind", None), s.recover_s
+        elif isinstance(s, dict):
+            kind, sec = s.get("kind"), s.get("recover_s")
+        elif isinstance(s, tuple):
+            kind, sec = s
+        else:
+            sec = s
+        if sec is None or float(sec) <= 0:
+            continue
+        if kind is not None and kind not in kinds:
+            continue
+        vals.append(float(sec))
+    if not vals:
+        raise ValueError("no usable recovery samples to fit")
+    vals.sort()
+    n = len(vals)
+    p50 = vals[(n - 1) // 2]
+    p90 = vals[min(int(n * 0.9), n - 1)]
+    return {
+        "spot_recover_s": round(p50, 4),
+        "n": n,
+        "mean_s": round(sum(vals) / n, 4),
+        "p50_s": round(p50, 4),
+        "p90_s": round(p90, 4),
+    }
+
+
 # ---------------------------------------------------------------------------
 # dp gradient-sync overlap calibration
 # ---------------------------------------------------------------------------

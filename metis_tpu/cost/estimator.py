@@ -88,6 +88,14 @@ class EstimatorOptions:
     # jit).  Ignored under strict_compat (the reference charges the raw
     # profiled time per microbatch).
     mb_affine: bool = True
+    # Availability-aware pricing (SearchConfig.use_spot_model): charge the
+    # expected preemption-recovery cost per step — step time x the plan's
+    # summed spot hazard (DeviceSpec.hazard_per_hr) x measured recover
+    # seconds / 3600 — as an additive ``expected_recovery`` term.  Never
+    # active under strict_compat; a reserved-only fleet prices a hazard of
+    # exactly 0 and every cost stays bit-identical to the flag being off.
+    use_spot_model: bool = True
+    spot_recover_s: float = 30.0
 
     @staticmethod
     def from_config(cfg: SearchConfig) -> "EstimatorOptions":
@@ -98,12 +106,19 @@ class EstimatorOptions:
             dp_overlap_fraction=cfg.dp_overlap_fraction,
             remat_fwd_fraction=cfg.remat_fwd_fraction,
             use_overlap_model=cfg.use_overlap_model,
+            use_spot_model=cfg.use_spot_model,
+            spot_recover_s=cfg.spot_recover_s,
         )
 
     @property
     def overlap_active(self) -> bool:
         """Whether the exposed-vs-hidden comm split applies."""
         return self.use_overlap_model and not self.strict_compat
+
+    @property
+    def spot_active(self) -> bool:
+        """Whether the expected-recovery availability term applies."""
+        return self.use_spot_model and not self.strict_compat
 
     @property
     def dp_exposed_share(self) -> float:
@@ -231,6 +246,17 @@ class _EstimatorBase:
             factor = 2.0 if self.options.strict_compat else 1.0
         return raw * factor
 
+    def _spot_scale_of(self, hazard_per_hr: float) -> float:
+        """Dimensionless expected-recovery multiplier for a device set with
+        the given summed preemption hazard: a step of T ms sees
+        ``hazard * T / 3.6e6`` expected evictions, each costing
+        ``spot_recover_s * 1000`` ms of recovery, so the charge is
+        ``T * hazard * spot_recover_s / 3600`` — exactly 0.0 when the spot
+        model is inactive or the fleet is reserved-only."""
+        if not self.options.spot_active or hazard_per_hr == 0.0:
+            return 0.0
+        return hazard_per_hr * self.options.spot_recover_s / 3600.0
+
     def _batch_gen_ms(self, count: int, device_type: str | None = None) -> float:
         """Input-pipeline cost; native mode reads the feeding stage's device
         type (the host attached to stage 0's chips generates batches).
@@ -293,6 +319,10 @@ def _assemble_breakdown(
         "optimizer": cost.optimizer_ms,
         "batch_gen": cost.batch_gen_ms,
     }
+    # spot model: the expected-recovery charge joins the additive sum only
+    # when it is real (reserved-only breakdowns stay byte-identical)
+    if detail.get("spot_recovery") is not None:
+        components["expected_recovery"] = cost.expected_recovery_ms
     return CostBreakdown(
         total_ms=cost.total_ms,
         components=components,
@@ -382,6 +412,15 @@ class UniformCostEstimator(_EstimatorBase):
             dp_charge = dp_cost
             pp_charge = pp_cost
 
+        total = execution + fb_sync + optimizer + dp_charge + pp_charge + batch_gen
+        recovery = 0.0
+        spot_scale = self._spot_scale_of(
+            plan.dp * plan.pp * plan.tp
+            * self.cluster.devices[device_type].hazard_per_hr)
+        if spot_scale:
+            recovery = total * spot_scale
+            total = total + recovery
+
         if _detail is not None:
             _detail.update(
                 sched_lens=tuple(lens), lens_nocomm=tuple(lens),
@@ -391,14 +430,17 @@ class UniformCostEstimator(_EstimatorBase):
                     "pp_comm": pp_cost - pp_charge,
                     "dp_comm": dp_cost - dp_charge,
                 }
+            if recovery:
+                _detail["spot_recovery"] = recovery
         return PlanCost(
-            total_ms=execution + fb_sync + optimizer + dp_charge + pp_charge + batch_gen,
+            total_ms=total,
             execution_ms=execution,
             fb_sync_ms=fb_sync,
             optimizer_ms=optimizer,
             dp_comm_ms=dp_charge,
             pp_comm_ms=pp_charge,
             batch_gen_ms=batch_gen,
+            expected_recovery_ms=recovery,
             oom=oom,
         )
 
@@ -438,6 +480,10 @@ class HeteroCostEstimator(_EstimatorBase):
         self._stage_ms_cache: dict = {}
         # stage_time_grid prefix matrices per (device_type, tp)
         self._time_grid_cache: dict = {}
+        # spot-hazard scale per placement — a pure function of
+        # (node_sequence, device_groups); the batch path stores the SAME
+        # float in its placement tables so both paths stay bit-identical
+        self._spot_cache: dict = {}
 
     def _bandwidth_for(self, plan: InterStagePlan):
         key = (plan.node_sequence, plan.device_groups)
@@ -493,6 +539,25 @@ class HeteroCostEstimator(_EstimatorBase):
         else:
             self._count_cache(hit=True)
         return self._bw_cache[key]
+
+    def _spot_scale(self, plan: InterStagePlan) -> float:
+        """The plan's expected-recovery multiplier (``_spot_scale_of`` over
+        the per-rank hazards of the placement's device set), memoized per
+        (node_sequence, device_groups)."""
+        if not self.options.spot_active:
+            return 0.0
+        key = (plan.node_sequence, plan.device_groups)
+        scale = self._spot_cache.get(key)
+        if scale is None:
+            ranks = rank_device_types(self.cluster, plan.node_sequence)
+            hazard = 0.0
+            for t in ranks[:sum(plan.device_groups)]:
+                hazard += self.cluster.devices[t].hazard_per_hr
+            scale = self._spot_scale_of(hazard)
+            if len(self._spot_cache) > _BW_CACHE_MAX:
+                self._spot_cache.clear()
+            self._spot_cache[key] = scale
+        return scale
 
     def stage_time_grid(
         self, device_type: str, tp: int, start: int, end: int,
@@ -848,6 +913,14 @@ class HeteroCostEstimator(_EstimatorBase):
             dp_charge = max(dp_costs)
             pp_charge = pp_cost
 
+        total = (execution + fb_sync + max(opt_costs) + dp_charge
+                 + pp_charge + batch_gen)
+        recovery = 0.0
+        spot_scale = self._spot_scale(plan)
+        if spot_scale:
+            recovery = total * spot_scale
+            total = total + recovery
+
         if _detail is not None:
             # explainability dump (get_breakdown): the exact intermediates
             # the total was assembled from, so the component decomposition
@@ -864,10 +937,11 @@ class HeteroCostEstimator(_EstimatorBase):
                     "pp_comm": pp_cost - pp_charge,
                     "dp_comm": max(dp_costs) - dp_charge,
                 }
+            if recovery:
+                _detail["spot_recovery"] = recovery
 
         return PlanCost(
-            total_ms=(execution + fb_sync + max(opt_costs) + dp_charge
-                      + pp_charge + batch_gen),
+            total_ms=total,
             execution_ms=execution,
             fb_sync_ms=fb_sync,
             optimizer_ms=max(opt_costs),
@@ -876,4 +950,5 @@ class HeteroCostEstimator(_EstimatorBase):
             batch_gen_ms=batch_gen,
             cp_comm_ms=cp_cost,
             ep_comm_ms=ep_cost,
+            expected_recovery_ms=recovery,
         )

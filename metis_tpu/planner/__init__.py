@@ -18,8 +18,10 @@ __all__ = [
 from metis_tpu.planner.replan import (
     ClusterDelta,
     ReplanReport,
+    grow_cluster,
     replan,
     shrink_cluster,
 )
 
-__all__ += ["ClusterDelta", "ReplanReport", "replan", "shrink_cluster"]
+__all__ += ["ClusterDelta", "ReplanReport", "grow_cluster", "replan",
+            "shrink_cluster"]

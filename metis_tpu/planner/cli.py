@@ -142,6 +142,17 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
                         "charging only the share not hidden under compute "
                         "(SearchConfig.use_overlap_model; overlap pricing "
                         "is always inert under --strict-compat)")
+    g.add_argument("--no-spot-model", action="store_true",
+                   help="ignore spot-tier availability when ranking: drop "
+                        "the expected_recovery cost term (preemption hazard "
+                        "x time-to-recover over the plan's device set; "
+                        "SearchConfig.use_spot_model; always inert under "
+                        "--strict-compat)")
+    g.add_argument("--spot-recover-s", type=float, default=30.0,
+                   help="measured time-to-recover one preemption, seconds "
+                        "(seed: the bench resilience_recover_s headline; "
+                        "refit from supervisor recoveries via "
+                        "cost.calibration.fit_recovery_seconds)")
     g.add_argument("--dp-overlap", type=float, default=0.0,
                    help="measured fraction of the dp gradient all-reduce "
                         "hidden under backward compute "
@@ -209,6 +220,8 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         dp_overlap_fraction=getattr(args, "dp_overlap", 0.0),
         workers=getattr(args, "workers", 1),
         use_overlap_model=not getattr(args, "no_overlap_model", False),
+        use_spot_model=not getattr(args, "no_spot_model", False),
+        spot_recover_s=getattr(args, "spot_recover_s", 30.0),
     )
 
 
@@ -285,6 +298,18 @@ def _emit(args: argparse.Namespace, payload: str) -> None:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv[:1] == ["chaos"] and "--fleet" in argv:
+        # fleet-scale availability drill: its own arg surface (no hostfile/
+        # profiles — the drill synthesizes the mixed v5e/v6e spot fleet and
+        # drives the plan daemon itself; tools/fleet_drill.py)
+        from pathlib import Path as _Path
+
+        sys.path.insert(0, str(_Path(__file__).resolve().parents[2]))
+        from tools.fleet_drill import main as fleet_main
+
+        return fleet_main([a for a in argv[1:] if a != "--fleet"])
     parser = argparse.ArgumentParser(
         prog="metis-tpu", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -457,6 +482,14 @@ def main(argv: list[str] | None = None) -> int:
                       "report what it survived — the CI-runnable proof the "
                       "recovery paths work (tools/chaos_drill.py wraps "
                       "this for the canned scenario)")
+    p_chaos.add_argument("--fleet", action="store_true",
+                         help="run the fleet-scale availability drill "
+                              "instead (tools/fleet_drill.py): a simulated "
+                              "256-device mixed v5e/v6e spot fleet under "
+                              "seeded Poisson preemptions/returns, "
+                              "replanning through the plan daemon; ignores "
+                              "the flags below — see "
+                              "`python tools/fleet_drill.py --help`")
     _add_cluster_args(p_chaos)
     p_chaos.add_argument("--profile-dir", required=True)
     _add_model_args(p_chaos)

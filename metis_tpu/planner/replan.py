@@ -19,7 +19,7 @@ from __future__ import annotations
 from collections import Counter
 from dataclasses import dataclass
 
-from metis_tpu.cluster.spec import ClusterSpec, NodeSpec
+from metis_tpu.cluster.spec import ClusterSpec, NodeSpec, _registry_lookup
 from metis_tpu.core.config import ModelSpec, SearchConfig
 from metis_tpu.core.errors import ClusterSpecError
 from metis_tpu.planner.api import PlannerResult, plan_hetero
@@ -50,6 +50,34 @@ class ClusterDelta:
         removed = {t: old_counts[t] - new_counts[t]
                    for t in old_counts if old_counts[t] > new_counts.get(t, 0)}
         return ClusterDelta(added=added, removed=removed)
+
+    def apply(self, cluster: ClusterSpec,
+              full: ClusterSpec | None = None) -> ClusterSpec:
+        """The topology after this delta: removals peel from the end via
+        :func:`shrink_cluster`; additions restore toward ``full`` when one
+        is given (:func:`grow_cluster`'s node-order contract) or append one
+        node per added type otherwise.  Round-trip symmetric with
+        :meth:`between`: ``ClusterDelta.between(old, d.apply(old)) == d``
+        whenever ``d`` is applicable to ``old``.  Growth of a device type
+        unknown to both the cluster and the registry (or to ``full`` when
+        given) raises :class:`ClusterSpecError`."""
+        out = cluster
+        if self.removed:
+            out = shrink_cluster(out, self.removed)
+        if not self.added:
+            return out
+        if full is not None:
+            return grow_cluster(out, full, self.added)
+        nodes = list(out.nodes)
+        devices = dict(out.devices)
+        for t in sorted(self.added):
+            n = int(self.added[t])
+            if n < 1:
+                raise ClusterSpecError(f"added[{t!r}] must be >= 1, got {n}")
+            if t not in devices:
+                devices[t] = _registry_lookup(t)
+            nodes.append(NodeSpec(t, n))
+        return ClusterSpec(nodes=tuple(nodes), devices=devices)
 
 
 def shrink_cluster(cluster: ClusterSpec,
@@ -108,6 +136,10 @@ def grow_cluster(cluster: ClusterSpec, full: ClusterSpec,
             raise ClusterSpecError(f"added[{t!r}] must be >= 0, got {add}")
         have = cluster.num_devices_by_type(t)
         cap = full.num_devices_by_type(t)
+        if add > 0 and cap == 0:
+            raise ClusterSpecError(
+                f"cannot add {add}x{t}: device type {t!r} is unknown to "
+                "the reference topology")
         if have + add > cap:
             raise ClusterSpecError(
                 f"cannot add {add}x{t}: cluster has {have}, reference "

@@ -18,6 +18,11 @@ point               what the consulting site does when it fires
                       spike band (drills the spike detector)
 ``preempt``           simulated SIGTERM: drain the in-flight step, final
                       checkpoint, clean exit
+``spot_preemption``   spot-tier eviction: ``spec.lost_devices()`` vanish ->
+                      shrink -> replan on survivors -> restore (emits a
+                      ``preemption`` event first)
+``spot_return``       evicted spot capacity comes back: grow toward the
+                      full topology -> replan (emits ``spot_return``)
 ==================  =======================================================
 
 Scripts are fully deterministic: each entry names a point, the step it
@@ -50,7 +55,12 @@ INJECTION_POINTS = (
     "loss_nan",
     "loss_spike",
     "preempt",
+    "spot_preemption",
+    "spot_return",
 )
+
+#: Points whose arg is a ``TYPE=COUNT[,...]`` device map (lost_devices()).
+_DEVICE_MAP_POINTS = ("device_loss", "spot_preemption", "spot_return")
 
 _ENTRY_RE = re.compile(
     r"^(?P<point>[a-z_]+)"
@@ -82,8 +92,9 @@ class FaultSpec:
             raise ValueError("prob must be in (0, 1]")
 
     def lost_devices(self) -> dict[str, int]:
-        """Parse a ``device_loss`` arg like ``A100=4`` or ``A100=4,T4=2``
-        into a type -> count map (empty = "supervisor picks a default")."""
+        """Parse a device-map arg (``device_loss``/``spot_preemption``/
+        ``spot_return``) like ``A100=4`` or ``A100=4,T4=2`` into a type ->
+        count map (empty = "supervisor picks a default")."""
         if not self.arg:
             return {}
         out: dict[str, int] = {}
@@ -91,7 +102,7 @@ class FaultSpec:
             t, _, n = part.partition("=")
             if not t or not n.isdigit() or int(n) < 1:
                 raise ValueError(
-                    f"bad device_loss arg {self.arg!r} (want TYPE=COUNT[,..])")
+                    f"bad {self.point} arg {self.arg!r} (want TYPE=COUNT[,..])")
             out[t] = out.get(t, 0) + int(n)
         return out
 
@@ -103,10 +114,10 @@ def parse_fault_script(text: str) -> tuple[FaultSpec, ...]:
         raw = raw.strip()
         if not raw:
             continue
-        # device_loss args may themselves contain commas (A100=4,T4=2):
-        # glue a TYPE=COUNT fragment onto the previous device_loss entry
+        # device-map args may themselves contain commas (A100=4,T4=2): glue
+        # a TYPE=COUNT fragment onto the previous device-mapped entry
         if specs and re.fullmatch(r"[\w-]+=\d+", raw) \
-                and specs[-1].point == "device_loss":
+                and specs[-1].point in _DEVICE_MAP_POINTS:
             prev = specs.pop()
             arg = f"{prev.arg},{raw}" if prev.arg else raw
             specs.append(FaultSpec(prev.point, prev.step, prev.times, arg,

@@ -20,7 +20,13 @@ driver — it wraps the executable step loop with:
   the cluster to the survivor topology (``shrink_cluster``), re-plans on it
   (``replan(..., search_old=False)`` — the time-critical path), rebuilds
   the executable, and restores the latest checkpoint onto the NEW mesh
-  (orbax reshards on read), then resumes mid-stream (``recovery_complete``).
+  (orbax reshards on read), then resumes mid-stream (``recovery_complete``);
+- **elastic spot fleet**: a ``spot_preemption`` fault is the same
+  shrink→replan→restore flow preceded by a ``preemption`` event; a
+  ``spot_return`` fault grows the cluster back toward the retained full
+  reference topology (``grow_cluster``), re-plans on the larger fleet, and
+  resumes from the latest checkpoint — the loop ``tools/fleet_drill.py``
+  drives at fleet scale.
 
 Every decision is visible in the event stream; the whole loop is drillable
 on CPU in CI via ``resilience/faults.py`` (``tools/chaos_drill.py``).
@@ -59,7 +65,12 @@ from metis_tpu.execution.checkpoint import (
 from metis_tpu.execution.mesh import DP, EP, SP, PlanArtifact
 from metis_tpu.execution.train import LossAnomalyDetector, StepTimer
 from metis_tpu.planner.api import plan_hetero
-from metis_tpu.planner.replan import replan, shrink_cluster
+from metis_tpu.planner.replan import (
+    ClusterDelta,
+    grow_cluster,
+    replan,
+    shrink_cluster,
+)
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.resilience.faults import FaultInjector, NULL_INJECTOR
 from metis_tpu.resilience.retry import RetryPolicy
@@ -109,7 +120,7 @@ class RecoveryRecord:
     """One survived incident: what happened, where the run stood, where it
     resumed, and what the recovery cost."""
 
-    kind: str  # "device_loss" | "anomaly_rollback"
+    kind: str  # "device_loss" | "spot_preemption" | "spot_return" | "anomaly_rollback"
     step: int  # step count when the incident hit
     resumed_step: int  # checkpointed step the run resumed from
     recover_s: float
@@ -183,6 +194,9 @@ class TrainingSupervisor:
         if steps < 1:
             raise ValueError("steps must be >= 1")
         self.cluster = cluster
+        # the reference topology spot returns grow back toward; the live
+        # ``self.cluster`` shrinks/grows within it across recoveries
+        self.full_cluster = cluster
         self.profiles = profiles
         self.model = model
         self.search_config = search_config
@@ -358,19 +372,31 @@ class TrainingSupervisor:
                               start_step=step)
 
             while step < self.steps:
-                # -- device loss: checkpointed state + survivors -> replan
+                # -- device loss / spot eviction: checkpointed state +
+                #    survivors -> replan (spot evictions announce themselves
+                #    with a ``preemption`` event, then recover identically)
+                kind = "device_loss"
                 spec = self.faults.check("device_loss", step)
+                if spec is None:
+                    spec = self.faults.check("spot_preemption", step)
+                    if spec is not None:
+                        kind = "spot_preemption"
                 if spec is not None:
                     if len(report.recoveries) >= res.max_recoveries:
                         raise TrainingAnomalyError(
                             f"{len(report.recoveries)} recoveries exhausted "
                             f"max_recoveries={res.max_recoveries}")
                     t0 = time.perf_counter()
-                    with tracer.span("recovery", kind="device_loss"):
-                        lost = spec.lost_devices()
-                        if not lost:
-                            last = self.cluster.nodes[-1]
-                            lost = {last.device_type: last.num_devices}
+                    lost = spec.lost_devices()
+                    if not lost:
+                        last = self.cluster.nodes[-1]
+                        lost = {last.device_type: last.num_devices}
+                    if kind == "spot_preemption":
+                        self.events.emit(
+                            "preemption", step=step, tier="spot",
+                            lost=",".join(f"{t}={n}"
+                                          for t, n in lost.items()))
+                    with tracer.span("recovery", kind=kind):
                         survivor = shrink_cluster(self.cluster, lost)
                         rep = replan(self.cluster, survivor, self.profiles,
                                      self.model, self.search_config,
@@ -393,17 +419,77 @@ class TrainingSupervisor:
                                           start_step=step)
                     recover_s = time.perf_counter() - t0
                     self.events.emit(
-                        "recovery_complete", step=step, kind="device_loss",
+                        "recovery_complete", step=step, kind=kind,
                         recover_s=round(recover_s, 4),
                         plan_changed=rep.plan_changed,
                         survivor_devices=survivor.total_devices)
                     report.recoveries.append(RecoveryRecord(
-                        kind="device_loss", step=report.steps_done,
+                        kind=kind, step=report.steps_done,
                         resumed_step=step, recover_s=recover_s,
                         plan_changed=rep.plan_changed,
                         detail=",".join(f"{t}={n}" for t, n in lost.items())))
                     report.steps_done = step
                     continue
+
+                # -- spot return: evicted capacity is back -> grow + replan
+                spec = self.faults.check("spot_return", step)
+                if spec is not None:
+                    returned = spec.lost_devices()
+                    if not returned:
+                        # default: everything currently missing comes back
+                        returned = dict(ClusterDelta.between(
+                            self.cluster, self.full_cluster).added)
+                    if returned:
+                        if len(report.recoveries) >= res.max_recoveries:
+                            raise TrainingAnomalyError(
+                                f"{len(report.recoveries)} recoveries "
+                                f"exhausted max_recoveries="
+                                f"{res.max_recoveries}")
+                        t0 = time.perf_counter()
+                        self.events.emit(
+                            "spot_return", step=step,
+                            returned=",".join(f"{t}={n}"
+                                              for t, n in returned.items()))
+                        with tracer.span("recovery", kind="spot_return"):
+                            grown = grow_cluster(
+                                self.cluster, self.full_cluster, returned)
+                            rep = replan(self.cluster, grown, self.profiles,
+                                         self.model, self.search_config,
+                                         search_old=False)
+                            if rep.result.best is None:
+                                raise InfeasiblePlanError(
+                                    "no feasible plan on grown topology")
+                            art = PlanArtifact.from_ranked_plan(
+                                rep.result.best)
+                            self.cluster = grown
+                            exe, mesh, layout = self._build(art)
+                            fresh = exe.init(jax.random.PRNGKey(0))
+                            try:
+                                state, step = self._restore(exe, layout,
+                                                            fresh)
+                            except FileNotFoundError:
+                                state, step = fresh, 0
+                            batches = self._batches(art, exe, mesh,
+                                                    skip=step)
+                            detector.reset()
+                            timer = StepTimer(events=self.events,
+                                              tokens_per_step=tokens_per_step,
+                                              start_step=step)
+                        recover_s = time.perf_counter() - t0
+                        self.events.emit(
+                            "recovery_complete", step=step,
+                            kind="spot_return",
+                            recover_s=round(recover_s, 4),
+                            plan_changed=rep.plan_changed,
+                            survivor_devices=grown.total_devices)
+                        report.recoveries.append(RecoveryRecord(
+                            kind="spot_return", step=report.steps_done,
+                            resumed_step=step, recover_s=recover_s,
+                            plan_changed=rep.plan_changed,
+                            detail=",".join(f"{t}={n}"
+                                            for t, n in returned.items())))
+                        report.steps_done = step
+                        continue
 
                 # -- preemption: finish in-flight work, checkpoint, exit
                 if self.faults.check("preempt", step) is not None:

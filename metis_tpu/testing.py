@@ -28,6 +28,8 @@ PARITY_MAX_BS = 16
 PARITY_INFERENCE = dict(arrival_rate_rps=4.0, prompt_len=512, output_len=128,
                         slo_ttft_p99_ms=2000.0, slo_tpot_p99_ms=100.0)
 DEFAULT_REFERENCE_ROOT = Path("/root/reference")
+#: Spot-tier hazard used by the availability-aware parity variant.
+PARITY_SPOT_RATE = 0.05
 
 
 def write_parity_fixture(target_dir: Path) -> None:
@@ -46,6 +48,22 @@ def write_parity_fixture(target_dir: Path) -> None:
         for ip, t, bw, mem in [
             ("0.0.0.3", "T4", 50, 15), ("0.0.0.5", "T4", 50, 15),
             ("0.0.0.4", "A100", 46, 80), ("0.0.0.6", "A100", 46, 80)]}))
+
+
+def write_spot_parity_fixture(target_dir: Path) -> None:
+    """The parity workload with the T4 pool marked spot-tier
+    (``PARITY_SPOT_RATE`` evictions/hr per device): the golden workload for
+    the availability-aware ``expected_recovery`` pricing.  Identical to
+    ``write_parity_fixture`` in every other byte, so spot-off searches on
+    this fixture must reproduce the reserved golden exactly."""
+    write_parity_fixture(target_dir)
+    cf = target_dir / "clusterfile.json"
+    data = json.loads(cf.read_text())
+    for entry in data.values():
+        if entry["instance_type"] == "T4":
+            entry["tier"] = "spot"
+            entry["preemption_rate_per_hr"] = PARITY_SPOT_RATE
+    cf.write_text(json.dumps(data))
 
 
 def run_reference_planner(

@@ -113,6 +113,89 @@ class TestShrinkCluster:
             shrink_cluster(c, {"A100": 4})
 
 
+class TestGrowCluster:
+    def test_whole_node_restored(self):
+        from metis_tpu.planner import grow_cluster, shrink_cluster
+
+        full = ClusterSpec.of(("A100", 3, 4))
+        shrunk = shrink_cluster(full, {"A100": 4})
+        g = grow_cluster(shrunk, full, {"A100": 4})
+        assert g.nodes == full.nodes
+        assert g.devices == full.devices
+
+    def test_partial_node_widens_back(self):
+        from metis_tpu.planner import grow_cluster, shrink_cluster
+
+        full = ClusterSpec.of(("A100", 2, 4))
+        shrunk = shrink_cluster(full, {"A100": 2})
+        g = grow_cluster(shrunk, full, {"A100": 2})
+        assert g.nodes == full.nodes
+
+    def test_partial_return_still_missing_some(self):
+        from metis_tpu.cluster.spec import NodeSpec
+        from metis_tpu.planner import grow_cluster, shrink_cluster
+
+        full = ClusterSpec.of(("A100", 3, 4))
+        shrunk = shrink_cluster(full, {"A100": 8})
+        g = grow_cluster(shrunk, full, {"A100": 4})
+        # rebuilt as full shrunk by the 4 still missing: node order matches
+        # the reference topology
+        assert g.nodes == (NodeSpec("A100", 4), NodeSpec("A100", 4))
+
+    def test_mixed_types_only_named_type_grows(self):
+        from metis_tpu.planner import grow_cluster, shrink_cluster
+
+        full = ClusterSpec.of(("A100", 2, 4), ("T4", 2, 4))
+        shrunk = shrink_cluster(full, {"T4": 8})
+        g = grow_cluster(shrunk, full, {"T4": 4})
+        assert g.num_devices_by_type("T4") == 4
+        assert g.num_devices_by_type("A100") == 8
+
+    def test_growing_past_reference_raises(self):
+        from metis_tpu.core.errors import ClusterSpecError
+        from metis_tpu.planner import grow_cluster
+
+        full = ClusterSpec.of(("A100", 2, 4))
+        with pytest.raises(ClusterSpecError):
+            grow_cluster(full, full, {"A100": 4})
+
+    def test_unknown_type_raises_typed(self):
+        from metis_tpu.core.errors import ClusterSpecError
+        from metis_tpu.planner import grow_cluster, shrink_cluster
+
+        full = ClusterSpec.of(("A100", 2, 4))
+        shrunk = shrink_cluster(full, {"A100": 4})
+        with pytest.raises(ClusterSpecError, match="unknown to"):
+            grow_cluster(shrunk, full, {"H100": 4})
+
+    def test_shrink_grow_round_trip(self):
+        from metis_tpu.planner import grow_cluster, shrink_cluster
+
+        full = ClusterSpec.of(("A100", 2, 4), ("T4", 2, 4))
+        shrunk = shrink_cluster(full, {"A100": 2, "T4": 4})
+        g = grow_cluster(shrunk, full, {"A100": 2, "T4": 4})
+        assert g.nodes == full.nodes
+        assert ClusterDelta.between(full, g).is_empty
+
+    def test_delta_apply_round_trip(self):
+        """between/apply symmetry: between(old, d.apply(old)) == d."""
+        old = ClusterSpec.of(("A100", 2, 4), ("T4", 2, 4))
+        for d in (ClusterDelta(added={}, removed={"T4": 4}),
+                  ClusterDelta(added={}, removed={"A100": 2, "T4": 8}),
+                  ClusterDelta(added={"V100": 4}, removed={})):
+            new = d.apply(old)
+            assert ClusterDelta.between(old, new) == d
+
+    def test_delta_apply_toward_full(self):
+        from metis_tpu.planner import shrink_cluster
+
+        full = ClusterSpec.of(("A100", 2, 4))
+        shrunk = shrink_cluster(full, {"A100": 4})
+        d = ClusterDelta.between(shrunk, full)
+        assert d.added == {"A100": 4}
+        assert d.apply(shrunk, full=full).nodes == full.nodes
+
+
 class TestReplan:
     def test_lost_node_replans_slower(self, setup):
         """Dropping half the cluster re-plans successfully at higher cost."""

@@ -310,6 +310,58 @@ class TestSupervisorDrills:
         out = run_corruption_drill(tmp_path)
         assert out["fallback_step"] == 3
 
+    def test_supervisor_spot_drill_end_to_end(self, tmp_path):
+        """Scripted spot eviction + capacity return: the supervisor handles
+        the eviction as shrink -> replan -> restore and the return as
+        grow -> replan, in causal event order (asserts live in
+        run_supervisor_spot_drill)."""
+        from tools.fleet_drill import run_supervisor_spot_drill
+
+        rep = run_supervisor_spot_drill(tmp_path, steps=8)
+        assert rep["outcome"] == "completed"
+        assert [r["kind"] for r in rep["recoveries"]] == [
+            "spot_preemption", "spot_return"]
+
+
+class TestFleetDrill:
+    """The fleet simulation needs no training/jit — only plan searches
+    through the in-thread daemon — so a small run fits tier-1."""
+
+    def test_fleet_drill_smoke(self, tmp_path):
+        """A short seeded chaos run: evictions recovered, returns absorbed,
+        fleet drains back to a baseline-identical plan (asserts live in
+        run_fleet_drill)."""
+        from tools.fleet_drill import run_fleet_drill
+
+        rep = run_fleet_drill(tmp_path, ticks=12, seed=2,
+                              spot_rate_per_hr=0.15)
+        assert rep["preempted_nodes"] > 0
+        assert rep["cluster_deltas"] > 0
+        assert rep["replan_pushes"] >= rep["cluster_deltas"]
+        assert 0.0 < rep["fleet_goodput_frac"] <= 1.0
+        assert rep["baseline_expected_recovery_ms"] > 0.0
+        assert rep["trajectory"][-1]["devices"] == rep["devices"]
+
+    def test_fleet_drill_deterministic(self, tmp_path):
+        """Same seed, same trajectory — the chaos schedule and every cost
+        in it replay identically."""
+        from tools.fleet_drill import run_fleet_drill
+
+        reps = [run_fleet_drill(tmp_path / str(i), ticks=8, seed=7,
+                                spot_rate_per_hr=0.2)
+                for i in range(2)]
+        assert reps[0]["trajectory"] == reps[1]["trajectory"]
+        assert reps[0]["fleet_goodput_frac"] == reps[1]["fleet_goodput_frac"]
+
+    @pytest.mark.slow
+    def test_fleet_drill_full_scale(self, tmp_path):
+        """The bench-shaped 24-tick default run at 256 devices."""
+        from tools.fleet_drill import run_fleet_drill
+
+        rep = run_fleet_drill(tmp_path, seed=0)
+        assert rep["devices"] == 256
+        assert rep["fleet_goodput_frac"] > 0.5
+
 
 def test_resilience_events_registered_in_schema():
     """Every event the resilience stack emits is in the enforced schema."""
@@ -320,5 +372,6 @@ def test_resilience_events_registered_in_schema():
     from check_events_schema import EVENT_SCHEMA
 
     for name in ("fault_injected", "retry_attempt", "retry_exhausted",
-                 "anomaly_detected", "preempt_drain", "recovery_complete"):
+                 "anomaly_detected", "preempt_drain", "recovery_complete",
+                 "preemption", "spot_return", "fleet_tick", "recovery_cost"):
         assert name in EVENT_SCHEMA

@@ -241,6 +241,125 @@ def test_empty_candidate_batch(hetero_eval):
     assert list(hetero_eval.evaluate_batch([], pruner)) == []
 
 
+# --- spot availability (use_spot_model): the expected_recovery charge is
+# additive, reserved-fleets-invisible, and batched==scalar bit-identical.
+
+
+@pytest.fixture(scope="module")
+def spot_fixture_dir(tmp_path_factory):
+    """The parity fixture with the T4 pool marked spot-tier."""
+    from metis_tpu.testing import write_spot_parity_fixture
+
+    d = tmp_path_factory.mktemp("spot")
+    write_spot_parity_fixture(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def hetero_spot_eval(spot_fixture_dir):
+    """Hetero parity workload, T4 spot-tiered, spot pricing live."""
+    return _make_evaluator(spot_fixture_dir, strict_compat=False)
+
+
+@pytest.mark.parametrize(
+    "shape", _HETERO_SHAPES, ids=[s[0] for s in _HETERO_SHAPES])
+def test_batched_equals_scalar_hetero_spot(hetero_spot_eval, shape):
+    """Bit-identity survives the spot term on every degenerate shape."""
+    _, groups, batches, strats, part = shape
+    inter, intra = _candidate(("A100", "T4"), groups, batches, strats, part)
+    _assert_batched_equals_scalar(hetero_spot_eval, inter, intra)
+
+
+@pytest.mark.parametrize(
+    "shape", _HETERO_SHAPES, ids=[s[0] for s in _HETERO_SHAPES])
+def test_reserved_only_recovery_is_zero(hetero_native_eval, shape):
+    """On an all-reserved fleet the spot model (ON by default) charges
+    exactly 0.0 — reserved searches stay byte-identical to pre-spot runs."""
+    _, groups, batches, strats, part = shape
+    inter, intra = _candidate(("A100", "T4"), groups, batches, strats, part)
+    [cost] = hetero_native_eval.batch_estimator.cost_many(inter, [intra])
+    if cost is not None:
+        assert cost.expected_recovery_ms == 0.0
+
+
+def test_spot_exposure_prices_recovery(hetero_spot_eval):
+    """A plan touching the spot pool is charged; one confined to the
+    reserved pool is not — even on the same spot-tiered cluster."""
+    inter, intra = _candidate(("A100", "T4"), (16,), 8, [(4, 4)], (0, 10))
+    [exposed] = hetero_spot_eval.batch_estimator.cost_many(inter, [intra])
+    assert exposed.expected_recovery_ms > 0.0
+
+    inter, intra = _candidate(("A100",), (8,), 8, [(2, 4)], (0, 10))
+    [reserved] = hetero_spot_eval.batch_estimator.cost_many(inter, [intra])
+    assert reserved.expected_recovery_ms == 0.0
+
+
+def test_recovery_strictly_increases_with_hazard(spot_fixture_dir):
+    """Doubling the spot pool's eviction rate doubles the charge (the term
+    is linear in the plan's aggregate hazard)."""
+    import dataclasses
+
+    from metis_tpu.cluster.spec import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.search.parallel import CandidateEvaluator
+
+    cluster = ClusterSpec.from_files(
+        spot_fixture_dir / "hostfile", spot_fixture_dir / "clusterfile.json")
+    store = ProfileStore.from_dir(spot_fixture_dir / "profiles")
+    spec = cluster.devices["T4"]
+    hot = cluster.with_device_spec(dataclasses.replace(
+        spec, preemption_rate_per_hr=2 * spec.preemption_rate_per_hr))
+    inter, intra = _candidate(("A100", "T4"), (16,), 8, [(4, 4)], (0, 10))
+    costs = []
+    for c in (cluster, hot):
+        ev = CandidateEvaluator(c, store, tiny_test_model(),
+                                SearchConfig(gbs=128))
+        [cost] = ev.batch_estimator.cost_many(inter, [intra])
+        costs.append(cost)
+    assert costs[1].expected_recovery_ms > costs[0].expected_recovery_ms > 0
+    assert costs[1].expected_recovery_ms == pytest.approx(
+        2 * costs[0].expected_recovery_ms, rel=1e-12)
+
+
+def test_spot_components_sum_to_total(hetero_spot_eval):
+    """The recovery term is additive: every CostBreakdown component,
+    expected_recovery included, sums to the ranked total."""
+    inter, intra = _candidate(("A100", "T4"), (8, 8), 8,
+                              [(2, 4), (2, 4)], (0, 5, 10))
+    [cost] = hetero_spot_eval.batch_estimator.cost_many(inter, [intra])
+    parts = (cost.execution_ms + cost.fb_sync_ms + cost.optimizer_ms
+             + cost.dp_comm_ms + cost.pp_comm_ms + cost.batch_gen_ms
+             + cost.cp_comm_ms + cost.ep_comm_ms
+             + cost.expected_recovery_ms)
+    assert cost.expected_recovery_ms > 0.0
+    assert parts == pytest.approx(cost.total_ms, rel=1e-12, abs=0.0)
+
+
+def test_spot_model_off_matches_reserved(spot_fixture_dir, parity_fixture_dir):
+    """use_spot_model=False on the spot-tiered fixture reproduces the
+    reserved fixture's costs bit-for-bit (the fixtures differ only in
+    availability metadata)."""
+    from metis_tpu.cluster.spec import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.search.parallel import CandidateEvaluator
+
+    inter, intra = _candidate(("A100", "T4"), (16,), 8, [(4, 4)], (0, 10))
+    costs = []
+    for d, use_spot in ((spot_fixture_dir, False), (parity_fixture_dir, True)):
+        cluster = ClusterSpec.from_files(
+            d / "hostfile", d / "clusterfile.json")
+        store = ProfileStore.from_dir(d / "profiles")
+        ev = CandidateEvaluator(
+            cluster, store, tiny_test_model(),
+            SearchConfig(gbs=128, use_spot_model=use_spot))
+        [cost] = ev.batch_estimator.cost_many(inter, [intra])
+        costs.append(cost)
+    assert costs[0] == costs[1]
+    assert costs[0].expected_recovery_ms == 0.0
+
+
 def test_uniform_plan_parity_exact_divisible_subset(ref):
     """Reference uniform plans admit ragged batch splits (gbs not divisible
     by dp*mbs — plan.py:84 truncates); ours require exact divisibility

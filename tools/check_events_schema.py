@@ -75,6 +75,15 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "anomaly_detected": {"kind", "step"},
     "preempt_drain": {"step"},
     "recovery_complete": {"step", "recover_s"},
+    # spot-fleet availability (resilience/supervisor.py spot paths,
+    # tools/fleet_drill.py): one preemption per spot eviction (before its
+    # shrink->replan->restore recovery), one spot_return per capacity
+    # return (before its grow->replan), one fleet_tick per simulated tick
+    # of the fleet drill, one recovery_cost per realized recovery charge
+    "preemption": {"step", "lost", "tier"},
+    "spot_return": {"step", "returned"},
+    "fleet_tick": {"tick", "devices", "goodput_frac"},
+    "recovery_cost": {"tick", "recover_s"},
 }
 
 

@@ -26,7 +26,14 @@ Four frozen invariants, any drift exits 1:
    match its own checked-in golden (tools/search_overlap_golden.json,
    recorded with ``--update-baseline``) and stay batched==scalar
    byte-identical.
-6. **Inference-search golden.**  The serving-workload search
+6. **Spot invariants.**  On the spot-tiered parity fixture
+   (``metis_tpu.testing.write_spot_parity_fixture`` — the T4 pool marked
+   ``tier="spot"``), strict_compat must reproduce the frozen reserved
+   golden byte-for-byte, native-mode ``use_spot_model=False`` must match
+   the native reserved ranking, and spot-ON must stay batched==scalar
+   byte-identical and match its checked-in golden
+   (tools/search_spot_golden.json, recorded with ``--update-baseline``).
+7. **Inference-search golden.**  The serving-workload search
    (``inference/planner.plan_inference`` on the parity topology with
    ``metis_tpu.testing.PARITY_INFERENCE``) must be run-to-run
    deterministic (two dumps byte-identical) and match its checked-in
@@ -72,6 +79,12 @@ OVERLAP_GOLDEN = Path(__file__).resolve().parent / (
 # latencies/throughput, recorded by ``--update-baseline``.
 INFERENCE_GOLDEN = Path(__file__).resolve().parent / (
     "search_inference_golden.json")
+
+# Availability-aware ranking golden: the spot-tiered parity fixture
+# (testing.write_spot_parity_fixture — T4 pool marked spot) searched in
+# native mode with the spot model ON.  Freezes the expected_recovery
+# pricing; recorded by ``--update-baseline``.
+SPOT_GOLDEN = Path(__file__).resolve().parent / "search_spot_golden.json"
 
 # Throughput baseline: batched + scalar plans/sec recorded on one host by
 # ``--update-baseline``; the check compares host-normalized numbers, so the
@@ -127,7 +140,11 @@ def run_checks(workers: int = 2) -> list[str]:
     from metis_tpu.core.types import dump_ranked_plans
     from metis_tpu.planner import plan_hetero
     from metis_tpu.profiles import ProfileStore, tiny_test_model
-    from metis_tpu.testing import PARITY_GBS, write_parity_fixture
+    from metis_tpu.testing import (
+        PARITY_GBS,
+        write_parity_fixture,
+        write_spot_parity_fixture,
+    )
 
     problems: list[str] = []
     with tempfile.TemporaryDirectory() as td:
@@ -213,6 +230,59 @@ def run_checks(workers: int = 2) -> list[str]:
         else:
             problems.append(
                 f"overlap golden missing: {OVERLAP_GOLDEN} "
+                "(record one with --update-baseline)")
+
+        # spot legs: availability-aware pricing on the spot-tiered variant
+        # of the same fixture.  (a) strict_compat keeps the spot model inert
+        # — the frozen reserved golden must survive byte-for-byte even with
+        # a spot-tiered clusterfile; (b) native mode with use_spot_model
+        # OFF must match the native reserved ranking; (c) spot ON must stay
+        # batched==scalar byte-identical and match its checked-in golden.
+        with tempfile.TemporaryDirectory() as std:
+            stmp = Path(std)
+            write_spot_parity_fixture(stmp)
+            spot_cluster = ClusterSpec.from_files(
+                stmp / "hostfile", stmp / "clusterfile.json")
+            spot_store = ProfileStore.from_dir(stmp / "profiles")
+        spot_strict = plan_hetero(
+            spot_cluster, spot_store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True))
+        if dump_ranked_plans(serial.plans) != dump_ranked_plans(
+                spot_strict.plans):
+            problems.append(
+                "spot-tiered fixture under strict_compat drifted from the "
+                "frozen reserved golden (the spot model must be inert there)")
+        spot_off = plan_hetero(
+            spot_cluster, spot_store, model,
+            SearchConfig(gbs=PARITY_GBS, use_spot_model=False))
+        if native_dump != dump_ranked_plans(spot_off.plans):
+            problems.append(
+                "use_spot_model=False on the spot-tiered fixture is not "
+                "byte-identical to the native reserved ranking")
+        spot_on = plan_hetero(
+            spot_cluster, spot_store, model,
+            SearchConfig(gbs=PARITY_GBS))
+        spot_scalar = plan_hetero(
+            spot_cluster, spot_store, model,
+            SearchConfig(gbs=PARITY_GBS, use_batch_eval=False))
+        spot_dump = dump_ranked_plans(spot_on.plans)
+        if spot_dump != dump_ranked_plans(spot_scalar.plans):
+            problems.append(
+                "spot-model pricing: batched ranking is not byte-identical "
+                "to the scalar oracle")
+        if SPOT_GOLDEN.exists():
+            golden = json.loads(SPOT_GOLDEN.read_text())
+            entry = _spot_fingerprint(spot_on, spot_dump)
+            for key in ("num_costed", "dump_sha256", "best_total_ms",
+                        "best_expected_recovery_ms"):
+                if golden.get(key) != entry[key]:
+                    problems.append(
+                        f"spot golden drift: {key} = {entry[key]}, "
+                        f"frozen golden is {golden.get(key)} "
+                        f"(re-record deliberately with --update-baseline)")
+        else:
+            problems.append(
+                f"spot golden missing: {SPOT_GOLDEN} "
                 "(record one with --update-baseline)")
 
         # inference leg: run-to-run determinism + frozen serving golden
@@ -341,6 +411,47 @@ def record_overlap_golden() -> dict:
     return entry
 
 
+def _spot_fingerprint(result, dump: str | None = None) -> dict:
+    """Golden entry for the spot-model-on parity run."""
+    import hashlib
+
+    from metis_tpu.core.types import dump_ranked_plans
+
+    if dump is None:
+        dump = dump_ranked_plans(result.plans)
+    best = result.plans[0] if result.plans else None
+    return {
+        "workload": "spot parity (8xA100 reserved + 8xT4 spot @0.05/hr, "
+                    "GPT-10L, gbs=128, native mode, use_spot_model=True)",
+        "num_costed": result.num_costed,
+        "dump_sha256": hashlib.sha256(dump.encode()).hexdigest(),
+        "best_total_ms": (round(best.cost.total_ms, 4) if best else None),
+        "best_expected_recovery_ms": (
+            round(best.cost.expected_recovery_ms, 4) if best else None),
+    }
+
+
+def record_spot_golden() -> dict:
+    """Run the spot-model-on parity search and write its golden."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import PARITY_GBS, write_spot_parity_fixture
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_spot_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        result = plan_hetero(cluster, store, tiny_test_model(),
+                             SearchConfig(gbs=PARITY_GBS))
+    entry = _spot_fingerprint(result)
+    SPOT_GOLDEN.write_text(json.dumps(entry, indent=2) + "\n")
+    return entry
+
+
 def measure_throughput(repeats: int = 3) -> dict:
     """Best-of-``repeats`` whole-search plans/sec on the parity workload for
     the batched (primary) and scalar (oracle) costing paths.  Best-of damps
@@ -425,6 +536,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.update_baseline:
         golden = record_overlap_golden()
         print(f"overlap golden written: {golden}")
+        spot_golden = record_spot_golden()
+        print(f"spot golden written: {spot_golden}")
         inf_golden = record_inference_golden()
         print(f"inference golden written: {inf_golden}")
         entry = measure_throughput()
@@ -442,8 +555,8 @@ def main(argv: list[str] | None = None) -> int:
     print(f"search regression gate OK (golden num_costed = "
           f"{GOLDEN_NUM_COSTED}, workers={args.workers} byte-identical, "
           f"batched == scalar oracle, time grid matches, overlap-off "
-          f"inert + overlap golden matches, inference search "
-          f"deterministic + golden matches)")
+          f"inert + overlap golden matches, spot-off inert + spot golden "
+          f"matches, inference search deterministic + golden matches)")
     return 0
 
 

@@ -1,0 +1,378 @@
+#!/usr/bin/env python
+"""Fleet-scale availability chaos drill: spot preemptions against the
+serve daemon, end-to-end through the availability-aware cost model.
+
+Two legs, both CPU-only and fully deterministic for a given ``--seed``:
+
+1. **The fleet simulation** (``run_fleet_drill``): a 256-device mixed
+   fleet — a reserved v6e pool plus a spot v5e pool carrying a nonzero
+   ``preemption_rate_per_hr`` — planned by a live in-thread serve daemon.
+   Each simulated tick draws node-level spot evictions and returns from a
+   seeded Poisson process; every eviction becomes a
+   ``POST /cluster_delta`` shrink (the daemon replans on the survivors and
+   pushes ``replan_push``), every return a grow.  The drill records a
+   goodput/recovery-cost trajectory and asserts the recovery guarantees:
+   every shrunk topology replans feasibly, the fleet drains back to full
+   capacity, and the final plan is byte-identical to the pre-chaos
+   baseline.  The headline is ``fleet_goodput_frac`` — mean per-tick
+   throughput relative to the full healthy fleet, discounted by
+   recovery downtime (``SearchConfig.spot_recover_s`` per event).
+2. **The supervisor leg** (``run_supervisor_spot_drill``): a CPU-trainable
+   model under ``TrainingSupervisor`` with a scripted
+   ``spot_preemption`` -> ``spot_return`` pair — proves eviction is
+   handled as shrink -> replan -> checkpoint restore and returned capacity
+   as grow -> replan, with the event stream causally ordered
+   (``preemption`` before its ``recovery_complete``, ``spot_return``
+   before the grow's).
+
+Run directly (``python tools/fleet_drill.py``), via the planner CLI
+(``metis-tpu chaos --fleet``), or through ``bench.py``'s fleet section.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import os
+import random
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# the supervisor leg trains on virtual CPU devices; force them BEFORE the
+# first jax import (mirrors tests/conftest.py and tools/chaos_drill.py)
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from metis_tpu.cluster.spec import ClusterSpec, NodeSpec  # noqa: E402
+from metis_tpu.cluster.tpu import slice_from_name  # noqa: E402
+from metis_tpu.core.config import ModelSpec, SearchConfig  # noqa: E402
+from metis_tpu.core.events import EventLog, read_events  # noqa: E402
+from tools.check_events_schema import validate_events  # noqa: E402
+
+RESERVED_TYPE = "tpu_v6e"
+SPOT_TYPE = "tpu_v5e"
+
+
+def fleet_model() -> ModelSpec:
+    """Planner-scale model for the fleet simulation (never trained)."""
+    return ModelSpec(name="gpt-fleet", num_layers=24, hidden_size=2048,
+                     sequence_length=1024, vocab_size=32000, num_heads=16)
+
+
+def fleet_cluster(devices: int = 256, chips_per_node: int = 32,
+                  spot_rate_per_hr: float = 0.05) -> ClusterSpec:
+    """Half reserved v6e, half spot v5e (``tier="spot"`` with a per-device
+    preemption hazard).  Spot nodes sit at the END of the node sequence so
+    shrink's peel-from-the-end convention evicts spot capacity first."""
+    half = devices // 2
+    v6e = slice_from_name(f"v6e-{half}")
+    v5e = slice_from_name(f"v5e-{half}")
+    spot_spec = dataclasses.replace(
+        v5e.as_device_spec(), tier="spot",
+        preemption_rate_per_hr=spot_rate_per_hr)
+    nodes = (v6e.as_nodes(chips_per_node)
+             + v5e.as_nodes(chips_per_node))
+    return ClusterSpec(nodes=tuple(nodes),
+                       devices={RESERVED_TYPE: v6e.as_device_spec(),
+                                SPOT_TYPE: spot_spec})
+
+
+def fleet_search_config(spot_recover_s: float = 30.0) -> SearchConfig:
+    return SearchConfig(gbs=256, max_profiled_tp=4, max_profiled_bs=8,
+                        use_spot_model=True, spot_recover_s=spot_recover_s)
+
+
+def _best_recovery_ms(resp: dict) -> float:
+    """The ranked-best plan's expected_recovery_ms from a daemon /plan
+    response (absent = exactly 0.0 by the golden-stability contract)."""
+    try:
+        plans = json.loads(resp.get("plans") or "[]")
+        cost = (plans[0].get("cost_breakdown") or {}) if plans else {}
+        return float(cost.get("expected_recovery_ms", 0.0))
+    except (ValueError, AttributeError, IndexError):
+        return 0.0
+
+
+def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
+                    chips_per_node: int = 32, ticks: int = 24,
+                    tick_seconds: float = 3600.0,
+                    spot_rate_per_hr: float = 0.05,
+                    return_rate_per_hr: float = 0.35,
+                    spot_recover_s: float = 30.0, seed: int = 0,
+                    verbose: bool = False) -> dict:
+    """Seeded Poisson preemption chaos against a live daemon.  Returns the
+    fleet report dict; raises AssertionError when a recovery guarantee is
+    violated."""
+    from metis_tpu.profiles.synthetic import synthesize_profiles
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+    tmp_dir = Path(tmp_dir)
+    tmp_dir.mkdir(parents=True, exist_ok=True)
+    events_path = tmp_dir / "fleet_events.jsonl"
+    model = fleet_model()
+    cluster = fleet_cluster(devices, chips_per_node, spot_rate_per_hr)
+    config = fleet_search_config(spot_recover_s)
+    profiles = synthesize_profiles(model, [RESERVED_TYPE, SPOT_TYPE],
+                                   tps=[1, 2, 4], bss=[1, 2, 4, 8])
+    rng = random.Random(seed)
+    # node-level hazards: a spot node evicts (and an evicted one returns)
+    # within a tick with Poisson probability 1 - exp(-rate * hours)
+    hours = tick_seconds / 3600.0
+    p_evict = 1.0 - math.exp(-spot_rate_per_hr * hours)
+    p_return = 1.0 - math.exp(-return_rate_per_hr * hours)
+    n_spot_nodes = sum(1 for n in cluster.nodes if n.device_type == SPOT_TYPE)
+
+    trajectory: list[dict] = []
+    with EventLog(events_path) as events:
+        service = PlanService(cluster, profiles, events=events)
+        server, thread, address = serve_in_thread(service)
+        try:
+            client = PlanServiceClient(address)
+            base = client.plan(model, config, top_k=3)
+            c0 = base["best_cost_ms"]
+            assert c0 is not None, "full fleet is not plannable"
+            base_recovery_ms = _best_recovery_ms(base)
+            assert base_recovery_ms > 0.0, \
+                "spot-tiered fleet priced no expected_recovery"
+
+            live_spot = n_spot_nodes   # mirror of the daemon's spot pool
+            n_deltas = preemptions = returns = 0
+            # a final drain tick returns every evicted node so the fleet
+            # ends healthy and the closing plan must match the baseline
+            for tick in range(ticks + 1):
+                lost_nodes = returned_nodes = 0
+                if tick < ticks:
+                    for _ in range(live_spot):
+                        if rng.random() < p_evict:
+                            lost_nodes += 1
+                    for _ in range(n_spot_nodes - live_spot):
+                        if rng.random() < p_return:
+                            returned_nodes += 1
+                else:
+                    returned_nodes = n_spot_nodes - live_spot
+                if lost_nodes:
+                    lost = {SPOT_TYPE: lost_nodes * chips_per_node}
+                    events.emit("preemption", step=tick, tier="spot",
+                                lost=f"{SPOT_TYPE}={lost[SPOT_TYPE]}")
+                    client.cluster_delta(removed=lost, replan=True)
+                    live_spot -= lost_nodes
+                    n_deltas += 1
+                    preemptions += lost_nodes
+                if returned_nodes:
+                    back = {SPOT_TYPE: returned_nodes * chips_per_node}
+                    events.emit("spot_return", step=tick,
+                                returned=f"{SPOT_TYPE}={back[SPOT_TYPE]}")
+                    client.cluster_delta(added=back, replan=True)
+                    live_spot += returned_nodes
+                    n_deltas += 1
+                    returns += returned_nodes
+
+                resp = client.plan(model, config, top_k=3)
+                cost = resp["best_cost_ms"]
+                assert cost is not None, \
+                    f"tick {tick}: no feasible plan after delta " \
+                    f"(live spot nodes: {live_spot})"
+                n_devices = (devices // 2) + live_spot * chips_per_node
+                n_events = (1 if lost_nodes else 0) \
+                    + (1 if returned_nodes else 0)
+                recover_s = n_events * spot_recover_s
+                downtime_frac = min(recover_s / tick_seconds, 1.0)
+                goodput = (c0 / cost) * (1.0 - downtime_frac)
+                recovery_ms = _best_recovery_ms(resp)
+                if recover_s:
+                    events.emit("recovery_cost", tick=tick,
+                                recover_s=recover_s,
+                                expected_recovery_ms=recovery_ms)
+                events.emit("fleet_tick", tick=tick, devices=n_devices,
+                            goodput_frac=round(goodput, 6),
+                            cost_ms=cost)
+                trajectory.append({
+                    "tick": tick, "devices": n_devices, "cost_ms": cost,
+                    "expected_recovery_ms": recovery_ms,
+                    "recover_s": recover_s,
+                    "goodput_frac": goodput,
+                })
+
+            # drain the background replan notifications: one replan_push
+            # per registered query per delta
+            pushes, seen = 0, 0
+            for _ in range(120 if n_deltas else 0):
+                more = client.notifications(since=seen, timeout_s=1.0)
+                if more:
+                    seen = max(n["seq"] for n in more)
+                    pushes += sum(1 for n in more
+                                  if n.get("kind") == "replan_push")
+                if pushes >= n_deltas:
+                    break
+            final = client.plan(model, config, top_k=3)
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+
+    # -- the drill's guarantees -------------------------------------------
+    assert preemptions > 0, \
+        "seeded chaos produced no evictions — raise --ticks or --spot-rate"
+    assert trajectory[-1]["devices"] == devices, \
+        "fleet did not drain back to full capacity"
+    assert final["best_cost_ms"] == c0, \
+        f"post-chaos plan diverged from baseline: {final['best_cost_ms']} " \
+        f"!= {c0}"
+    assert pushes >= n_deltas, \
+        f"daemon pushed {pushes} replans for {n_deltas} topology deltas"
+
+    # -- schema-valid, causally ordered event stream ----------------------
+    evs = read_events(events_path)
+    problems = validate_events(evs)
+    assert not problems, "event schema problems:\n  " + "\n  ".join(problems)
+    tick_of = {}   # tick -> index of its fleet_tick event
+    for i, e in enumerate(evs):
+        if e["event"] == "fleet_tick":
+            tick_of[e["tick"]] = i
+    for i, e in enumerate(evs):
+        if e["event"] in ("preemption", "spot_return"):
+            # the eviction/return precedes the tick that absorbed it
+            assert i < tick_of[e["step"]], \
+                f"{e['event']} at tick {e['step']} logged after its " \
+                "fleet_tick"
+        if e["event"] == "recovery_cost":
+            assert i < tick_of[e["tick"]], \
+                "recovery_cost logged after its fleet_tick"
+
+    goodputs = [t["goodput_frac"] for t in trajectory]
+    report = {
+        "devices": devices,
+        "ticks": ticks,
+        "seed": seed,
+        "spot_rate_per_hr": spot_rate_per_hr,
+        "return_rate_per_hr": return_rate_per_hr,
+        "preempted_nodes": preemptions,
+        "returned_nodes": returns,
+        "cluster_deltas": n_deltas,
+        "replan_pushes": pushes,
+        "baseline_cost_ms": c0,
+        "baseline_expected_recovery_ms": base_recovery_ms,
+        "fleet_goodput_frac": sum(goodputs) / len(goodputs),
+        "min_goodput_frac": min(goodputs),
+        "trajectory": trajectory,
+    }
+    if verbose:
+        print(json.dumps({k: v for k, v in report.items()
+                          if k != "trajectory"}, indent=2))
+    return report
+
+
+def run_supervisor_spot_drill(tmp_dir: str | Path, steps: int = 8) -> dict:
+    """Scripted spot eviction + return under the training supervisor:
+    shrink -> replan -> restore, then grow -> replan, causally ordered."""
+    from metis_tpu.core.config import ResilienceConfig
+    from metis_tpu.resilience import FaultInjector, TrainingSupervisor
+    from tools.chaos_drill import _no_sleep, drill_setup
+
+    tmp_dir = Path(tmp_dir)
+    events_path = tmp_dir / "spot_events.jsonl"
+    cluster, profiles, model, config = drill_setup()
+    full_devices = cluster.total_devices
+    script = "spot_preemption@3:A100=4,spot_return@5"
+    with EventLog(events_path) as events:
+        faults = FaultInjector(script, seed=0, events=events)
+        supervisor = TrainingSupervisor(
+            cluster, profiles, model, config,
+            checkpoint_dir=tmp_dir / "spot-ckpt", steps=steps,
+            resilience=ResilienceConfig(checkpoint_every=2,
+                                        retry_attempts=3),
+            faults=faults, events=events, sleep=_no_sleep)
+        report = supervisor.run()
+
+    rep = report.to_json_dict()
+    assert report.outcome == "completed", \
+        f"spot drill did not complete: {rep['outcome']} ({rep['detail']})"
+    assert report.steps_done == steps
+    kinds = [r.kind for r in report.recoveries]
+    assert kinds == ["spot_preemption", "spot_return"], \
+        f"expected eviction then return recoveries, got {kinds}"
+    assert supervisor.cluster.total_devices == full_devices, \
+        "returned capacity was not grown back into the cluster"
+
+    evs = read_events(events_path)
+    problems = validate_events(evs)
+    assert not problems, "event schema problems:\n  " + "\n  ".join(problems)
+    names = [e["event"] for e in evs]
+    recs = [i for i, n in enumerate(names) if n == "recovery_complete"]
+    assert len(recs) == 2, f"expected 2 recoveries, saw {len(recs)}"
+    assert names.index("preemption") < recs[0] \
+        < names.index("spot_return") < recs[1], \
+        "preemption -> recovery -> spot_return -> recovery out of order"
+    pre = next(e for e in evs if e["event"] == "preemption")
+    assert pre["tier"] == "spot" and pre["lost"] == "A100=4"
+    ret = next(e for e in evs if e["event"] == "spot_return")
+    assert ret["returned"] == "A100=4"
+    return rep
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=256,
+                   help="fleet size (half reserved v6e, half spot v5e)")
+    p.add_argument("--chips-per-node", type=int, default=32)
+    p.add_argument("--ticks", type=int, default=24)
+    p.add_argument("--tick-seconds", type=float, default=3600.0)
+    p.add_argument("--spot-rate", type=float, default=0.05,
+                   help="per-node spot preemption rate (events/hr)")
+    p.add_argument("--return-rate", type=float, default=0.35,
+                   help="per-evicted-node return rate (events/hr)")
+    p.add_argument("--spot-recover-s", type=float, default=30.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--steps", type=int, default=8,
+                   help="training steps for the supervisor leg")
+    p.add_argument("--skip-supervisor", action="store_true",
+                   help="fleet simulation only (the supervisor leg trains "
+                        "a real model on CPU and dominates wall time)")
+    p.add_argument("--keep", default=None, metavar="DIR",
+                   help="run in DIR and keep the artifacts (default: a "
+                        "temp dir, removed afterwards)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="also write the drill reports as JSON to PATH "
+                        "(bench.py's fleet section consumes this)")
+    args = p.parse_args(argv)
+
+    def _run(d: str) -> None:
+        rep = run_fleet_drill(
+            d, devices=args.devices, chips_per_node=args.chips_per_node,
+            ticks=args.ticks, tick_seconds=args.tick_seconds,
+            spot_rate_per_hr=args.spot_rate,
+            return_rate_per_hr=args.return_rate,
+            spot_recover_s=args.spot_recover_s, seed=args.seed,
+            verbose=True)
+        print(f"fleet drill OK: {rep['preempted_nodes']} evictions, "
+              f"{rep['returned_nodes']} returns, goodput "
+              f"{rep['fleet_goodput_frac']:.4f}")
+        sup = None
+        if not args.skip_supervisor:
+            sup = run_supervisor_spot_drill(d, steps=args.steps)
+            print(f"supervisor spot drill OK: {sup['steps_done']} steps, "
+                  f"{len(sup['recoveries'])} recoveries")
+        if args.report:
+            Path(args.report).write_text(
+                json.dumps({"fleet": rep, "supervisor": sup}))
+
+    if args.keep:
+        Path(args.keep).mkdir(parents=True, exist_ok=True)
+        _run(args.keep)
+    else:
+        with tempfile.TemporaryDirectory(prefix="fleet-drill-") as d:
+            _run(d)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
