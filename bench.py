@@ -1333,6 +1333,53 @@ def fleet_bench(record: dict) -> None:
     }
 
 
+def migration_bench(record: dict, timeout_s: float = 600.0) -> None:
+    """Live migration vs checkpoint-restore: the chaos drill's migratable
+    pipeline pair (tools/chaos_drill.run_migration_drill) in a CPU-pinned
+    subprocess — a scripted device loss absorbed by a live reshard (no
+    rollback), a mid-flight verify fault degrading to checkpoint-restore,
+    and the measured stall comparison the ``migration_vs_ckpt_speedup``
+    headline reports."""
+    code = (
+        "import json, tempfile; from pathlib import Path; "
+        "from tools.chaos_drill import run_migration_drill; "
+        "rep = run_migration_drill("
+        "Path(tempfile.mkdtemp(prefix='mig-bench-'))); "
+        "print('MIGRATION_JSON ' + json.dumps({**rep['timing'], "
+        "'migrated': rep['migrate']['recoveries'][0]['migrated']}))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=Path(__file__).resolve().parent,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        record["migration"] = {
+            "skipped_reason": f"migration drill exceeded the "
+                              f"{timeout_s:.0f}s section budget"}
+        return
+    marker = [ln for ln in proc.stdout.splitlines()
+              if ln.startswith("MIGRATION_JSON ")]
+    if proc.returncode != 0 or not marker:
+        tail = (proc.stderr.strip().splitlines()[-1][:160]
+                if proc.stderr.strip() else f"rc={proc.returncode}")
+        record["migration"] = {"error": f"rc={proc.returncode}: {tail}"}
+        return
+    timing = json.loads(marker[-1].split(" ", 1)[1])
+    stall = timing["reshard_stall_ms"]
+    ckpt = timing["ckpt_restore_ms"]
+    record["migration"] = {
+        "migration_stall_ms": round(stall, 3),
+        "ckpt_restore_ms": round(ckpt, 3),
+        "migration_vs_ckpt_speedup": (round(ckpt / stall, 2)
+                                      if stall > 0 else None),
+        "moved_bytes": timing["moved_bytes"],
+        # the drill's own guarantees held end to end (live switch kept the
+        # current step; the faulted leg fell back and still completed)
+        "drill_migrated": bool(timing["migrated"]),
+    }
+
+
 def tpu_validation(record: dict) -> None:
     """North-star error on REAL hardware: profile per-layer times on the TPU
     chip, plan a single-chip uniform schedule from those profiles, execute
@@ -1703,6 +1750,17 @@ def main() -> None:
     recorder.run("inference", inference_bench, record)
     recorder.run("fleet", fleet_bench, record)
 
+    # the migration drill jit-builds several pipeline programs; clamp its
+    # subprocess to the remaining deadline so a slow host degrades to an
+    # honest skip instead of blowing the budget
+    def _migration_section(rec: dict) -> None:
+        remaining = recorder.remaining_s()
+        timeout = (600.0 if remaining is None
+                   else max(min(600.0, remaining), 60.0))
+        migration_bench(rec, timeout_s=timeout)
+
+    recorder.run("migration", _migration_section, record)
+
     # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
     # proves the tunnel was alive at bench start — it wedged MID-RUN once
     # (r4) and the inline tpu_step hung the whole bench past the driver's
@@ -1815,6 +1873,12 @@ def _headline(record: dict) -> dict:
         .get("fleet_goodput_frac"),
         "fleet_replan_pushes": (record.get("fleet") or {})
         .get("replan_pushes"),
+        "migration_stall_ms": (record.get("migration") or {})
+        .get("migration_stall_ms"),
+        "migration_vs_ckpt_speedup": (record.get("migration") or {})
+        .get("migration_vs_ckpt_speedup"),
+        "migration_skipped": (record.get("migration") or {})
+        .get("skipped_reason"),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),
         "tpu_step": _tpu_brief(record, "tpu_step"),

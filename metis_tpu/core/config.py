@@ -174,12 +174,29 @@ class SearchConfig:
     # (the chaos drill's measured time-to-recover); refit from observed
     # recoveries via ``cost/calibration.fit_recovery_seconds``.
     spot_recover_s: float = 30.0
+    # Migration-aware pricing (cost/estimator.py): when a replan searches
+    # with ``migrate_from`` set — the incumbent plan's per-stage layout as a
+    # tuple of (tp, layer_start, layer_end) triples — add an additive
+    # ``migration`` term: the parameter bytes the candidate must move off
+    # their current shards (execution/reshard.py computes the same delta
+    # for the live transfer), amortized over ``migration_amortize_steps``.
+    # An empty ``migrate_from`` (the default, and every fresh search)
+    # prices exactly 0.0 and stays byte-identical to the model being off.
+    # Inert under strict_compat.
+    use_migration_model: bool = True
+    migrate_from: tuple = ()
+    migration_bw_gbps: float = 100.0
+    migration_amortize_steps: int = 1000
 
     def __post_init__(self) -> None:
         if self.gbs < 1:
             raise ValueError("gbs must be positive")
         if self.spot_recover_s < 0:
             raise ValueError("spot_recover_s must be >= 0")
+        if self.migration_bw_gbps <= 0:
+            raise ValueError("migration_bw_gbps must be > 0")
+        if self.migration_amortize_steps < 1:
+            raise ValueError("migration_amortize_steps must be >= 1")
         if self.max_permute_len < 1:
             raise ValueError("max_permute_len must be >= 1")
         if any(v < 2 for v in self.virtual_stage_candidates):
@@ -216,6 +233,11 @@ class ResilienceConfig:
     # give up after this many recoveries (device loss + anomaly rollbacks
     # combined) — a persistently failing run must fail, not loop
     max_recoveries: int = 8
+    # prefer live in-memory resharding over checkpoint-restore on replan
+    # when the old and new device sets intersect and the priced transfer
+    # beats the measured restore time (resilience/supervisor.py migration
+    # decision layer; any migration fault falls back to checkpoint-restore)
+    live_migration: bool = True
 
     def __post_init__(self) -> None:
         if self.checkpoint_every < 0:

@@ -87,6 +87,14 @@ class DeviceLossError(MetisError):
         self.step = step
 
 
+class MigrationError(MetisError):
+    """A live plan migration cannot proceed or failed verification — an
+    incompatible src/dst state structure, a post-transfer digest mismatch,
+    or an injected ``reshard_verify`` fault.  The supervisor answers it by
+    degrading to the checkpoint-restore path (``migration_fallback``
+    event); state is never lost (``execution/reshard.py``)."""
+
+
 class TrainingAnomalyError(MetisError):
     """A loss anomaly (NaN/inf or spike) with no checkpoint to roll back
     to, or with rollback disabled — training cannot safely continue."""

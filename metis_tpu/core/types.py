@@ -157,6 +157,11 @@ class PlanCost:
     # step time x the plan's spot hazard x measured time-to-recover;
     # exactly 0.0 on reserved-only fleets or with the spot model off
     expected_recovery_ms: float = 0.0
+    # amortized plan-switch charge (SearchConfig.use_migration_model): the
+    # parameter bytes a candidate must reshard away from the incumbent
+    # layout (``migrate_from``), spread over migration_amortize_steps;
+    # exactly 0.0 for fresh searches or with the migration model off
+    migration_ms: float = 0.0
     oom: bool = False
 
 
@@ -170,7 +175,7 @@ class PlanCost:
 COST_COMPONENTS = (
     "compute", "imbalance", "cp_comm", "ep_comm", "step_overhead",
     "pp_comm", "pp_comm_exposed", "dp_comm", "dp_comm_exposed",
-    "fb_sync", "optimizer", "batch_gen", "expected_recovery",
+    "fb_sync", "optimizer", "batch_gen", "expected_recovery", "migration",
 )
 
 
@@ -349,6 +354,8 @@ class RankedPlan:
         # omission contract as CostBreakdown's empty ``hidden``)
         if cb.get("expected_recovery_ms") == 0.0:
             del cb["expected_recovery_ms"]
+        if cb.get("migration_ms") == 0.0:
+            del cb["migration_ms"]
         d = {
             "cost_ms": self.cost.total_ms,
             "cost_breakdown": cb,

@@ -87,6 +87,7 @@ class BatchCostEstimator:
         # hoisted invariants of the per-stage assembly
         self._share = scalar.options.dp_exposed_share
         self._overlap = scalar.options.overlap_active
+        self._mig_active = scalar.options.migration_active
         self._so = scalar._step_overhead
         self._bg_per = scalar.profiles.model.batch_generator_ms
         # cross-placement memos
@@ -276,6 +277,15 @@ class BatchCostEstimator:
         if spot_scale:
             recovery = total * spot_scale
             total = total + recovery
+        # migration model: the scalar's memoized helper verbatim — it is a
+        # pure function of (tps, partition), so the float here IS the
+        # scalar path's
+        migration = 0.0
+        if self._mig_active:
+            migration = self.scalar._migration_ms(
+                tuple(s.tp for s in strategies), tuple(partition))
+            if migration:
+                total = total + migration
         return PlanCost(
             total_ms=total,
             execution_ms=execution,
@@ -287,6 +297,7 @@ class BatchCostEstimator:
             cp_comm_ms=0.0,
             ep_comm_ms=0.0,
             expected_recovery_ms=recovery,
+            migration_ms=migration,
         )
 
     # -- table builders ----------------------------------------------------

@@ -96,6 +96,19 @@ class EstimatorOptions:
     # exactly 0 and every cost stays bit-identical to the flag being off.
     use_spot_model: bool = True
     spot_recover_s: float = 30.0
+    # Migration-aware pricing (SearchConfig.use_migration_model): when a
+    # replan carries the incumbent plan's layout (``migrate_from`` — a tuple
+    # of (tp, layer_start, layer_end) per old stage), charge each candidate
+    # the parameter bytes it must reshard away from that layout, amortized
+    # over ``migration_amortize_steps`` — so the planner can trade a
+    # slightly worse plan for a much cheaper live switch
+    # (execution/reshard.py prices the same delta for the actual transfer).
+    # An empty ``migrate_from`` prices exactly 0.0; never active under
+    # strict_compat.
+    use_migration_model: bool = True
+    migrate_from: tuple = ()
+    migration_bw_gbps: float = 100.0
+    migration_amortize_steps: int = 1000
 
     @staticmethod
     def from_config(cfg: SearchConfig) -> "EstimatorOptions":
@@ -108,6 +121,11 @@ class EstimatorOptions:
             use_overlap_model=cfg.use_overlap_model,
             use_spot_model=cfg.use_spot_model,
             spot_recover_s=cfg.spot_recover_s,
+            use_migration_model=cfg.use_migration_model,
+            migrate_from=tuple(
+                tuple(int(x) for x in t) for t in cfg.migrate_from),
+            migration_bw_gbps=cfg.migration_bw_gbps,
+            migration_amortize_steps=cfg.migration_amortize_steps,
         )
 
     @property
@@ -119,6 +137,12 @@ class EstimatorOptions:
     def spot_active(self) -> bool:
         """Whether the expected-recovery availability term applies."""
         return self.use_spot_model and not self.strict_compat
+
+    @property
+    def migration_active(self) -> bool:
+        """Whether the amortized plan-switch term applies."""
+        return (self.use_migration_model and not self.strict_compat
+                and bool(self.migrate_from))
 
     @property
     def dp_exposed_share(self) -> float:
@@ -205,6 +229,11 @@ class _EstimatorBase:
         if options.mb_affine and not options.strict_compat:
             profiles, self._step_overhead = profiles.affine_view()
         self.profiles = profiles
+        # migration term memo: a pure function of (per-stage tp tuple,
+        # layer partition) given frozen options — shared verbatim by the
+        # batch path so both stay bit-identical
+        self._migration_cache: dict = {}
+        self._migrate_from_tp: dict[int, int] | None = None
 
     def _step_overhead_ms(
             self, pairs: Sequence[tuple[str, int]]) -> float:
@@ -256,6 +285,43 @@ class _EstimatorBase:
         if not self.options.spot_active or hazard_per_hr == 0.0:
             return 0.0
         return hazard_per_hr * self.options.spot_recover_s / 3600.0
+
+    def _migration_ms(self, tps: tuple, partition: tuple) -> float:
+        """Amortized cost of resharding the incumbent layout
+        (``options.migrate_from``) into a candidate's (per-stage tp,
+        layer partition): every layer NOT already held at the candidate's
+        tp by some old stage must move its parameter bytes over the
+        migration fabric, spread over ``migration_amortize_steps`` so the
+        one-time transfer is comparable to per-step terms.  Depends only
+        on (tps, partition) + the frozen options — placement-free, so the
+        batch path calls this same memoized helper and stays
+        bit-identical.  Exactly 0.0 when the model is inactive."""
+        if not self.options.migration_active:
+            return 0.0
+        key = (tps, partition)
+        cached = self._migration_cache.get(key)
+        if cached is not None:
+            return cached
+        old_tp = self._migrate_from_tp
+        if old_tp is None:
+            old_tp = {}
+            for tp, start, end in self.options.migrate_from:
+                for layer in range(start, end):
+                    old_tp[layer] = tp
+            self._migrate_from_tp = old_tp
+        moved = 0.0
+        for s, tp in enumerate(tps):
+            per = self.volume.parameter_bytes_per_layer(tp)
+            for layer in range(partition[s], partition[s + 1]):
+                if old_tp.get(layer) != tp:
+                    moved += per[layer]
+        ms = (moved
+              / self.options.bw_to_bytes_per_ms(self.options.migration_bw_gbps)
+              / self.options.migration_amortize_steps)
+        if len(self._migration_cache) > _STAGE_MS_CACHE_MAX:
+            self._migration_cache.clear()
+        self._migration_cache[key] = ms
+        return ms
 
     def _batch_gen_ms(self, count: int, device_type: str | None = None) -> float:
         """Input-pipeline cost; native mode reads the feeding stage's device
@@ -323,6 +389,10 @@ def _assemble_breakdown(
     # when it is real (reserved-only breakdowns stay byte-identical)
     if detail.get("spot_recovery") is not None:
         components["expected_recovery"] = cost.expected_recovery_ms
+    # migration model: same omission contract — fresh searches stay
+    # byte-identical to pre-migration breakdowns
+    if detail.get("migration") is not None:
+        components["migration"] = cost.migration_ms
     return CostBreakdown(
         total_ms=cost.total_ms,
         components=components,
@@ -420,6 +490,15 @@ class UniformCostEstimator(_EstimatorBase):
         if spot_scale:
             recovery = total * spot_scale
             total = total + recovery
+        migration = 0.0
+        if self.options.migration_active:
+            bounds = [0]
+            for c in counts:
+                bounds.append(bounds[-1] + c)
+            migration = self._migration_ms(
+                (plan.tp,) * plan.pp, tuple(bounds))
+            if migration:
+                total = total + migration
 
         if _detail is not None:
             _detail.update(
@@ -432,6 +511,8 @@ class UniformCostEstimator(_EstimatorBase):
                 }
             if recovery:
                 _detail["spot_recovery"] = recovery
+            if migration:
+                _detail["migration"] = migration
         return PlanCost(
             total_ms=total,
             execution_ms=execution,
@@ -441,6 +522,7 @@ class UniformCostEstimator(_EstimatorBase):
             pp_comm_ms=pp_charge,
             batch_gen_ms=batch_gen,
             expected_recovery_ms=recovery,
+            migration_ms=migration,
             oom=oom,
         )
 
@@ -920,6 +1002,10 @@ class HeteroCostEstimator(_EstimatorBase):
         if spot_scale:
             recovery = total * spot_scale
             total = total + recovery
+        migration = self._migration_ms(
+            tuple(s.tp for s in strategies), tuple(layer_partition))
+        if migration:
+            total = total + migration
 
         if _detail is not None:
             # explainability dump (get_breakdown): the exact intermediates
@@ -939,6 +1025,8 @@ class HeteroCostEstimator(_EstimatorBase):
                 }
             if recovery:
                 _detail["spot_recovery"] = recovery
+            if migration:
+                _detail["migration"] = migration
 
         return PlanCost(
             total_ms=total,
@@ -951,4 +1039,5 @@ class HeteroCostEstimator(_EstimatorBase):
             cp_comm_ms=cp_cost,
             ep_comm_ms=ep_cost,
             expected_recovery_ms=recovery,
+            migration_ms=migration,
         )

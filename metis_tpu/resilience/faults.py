@@ -23,6 +23,11 @@ point               what the consulting site does when it fires
                       ``preemption`` event first)
 ``spot_return``       evicted spot capacity comes back: grow toward the
                       full topology -> replan (emits ``spot_return``)
+``reshard_send``      raise an ``OSError`` from a live-migration leaf
+                      transfer (drills retry, then checkpoint-restore
+                      fallback via ``migration_fallback``)
+``reshard_verify``    the post-transfer digest check reports a mismatch
+                      (drills the corruption guard on the migration path)
 ==================  =======================================================
 
 Scripts are fully deterministic: each entry names a point, the step it
@@ -57,6 +62,8 @@ INJECTION_POINTS = (
     "preempt",
     "spot_preemption",
     "spot_return",
+    "reshard_send",
+    "reshard_verify",
 )
 
 #: Points whose arg is a ``TYPE=COUNT[,...]`` device map (lost_devices()).

@@ -26,7 +26,18 @@ driver — it wraps the executable step loop with:
   ``spot_return`` fault grows the cluster back toward the retained full
   reference topology (``grow_cluster``), re-plans on the larger fleet, and
   resumes from the latest checkpoint — the loop ``tools/fleet_drill.py``
-  drives at fleet scale.
+  drives at fleet scale;
+- **live plan migration**: every replan-driven plan switch first asks
+  whether the running state can be RESHARDED in place
+  (``execution/reshard.py``) instead of round-tripping the filesystem:
+  eligible when ``ResilienceConfig.live_migration`` is on, the old and new
+  device sets intersect, the state schemas are shape-compatible, and the
+  priced transfer beats the checkpoint-restore baseline.  A successful
+  migration keeps the CURRENT step (no rollback to the last checkpoint);
+  any migration fault — ineligibility, exhausted ``reshard_send`` retries,
+  a digest mismatch, an injected ``reshard_verify`` — emits
+  ``migration_fallback`` and degrades to the checkpoint-restore path, so a
+  failed migration costs time, never state.
 
 Every decision is visible in the event stream; the whole loop is drillable
 on CPU in CI via ``resilience/faults.py`` (``tools/chaos_drill.py``).
@@ -44,7 +55,8 @@ import jax
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.core.config import ModelSpec, ResilienceConfig, SearchConfig
 from metis_tpu.core.errors import InfeasiblePlanError, MetisError, \
-    TrainingAnomalyError
+    MigrationError, TrainingAnomalyError
+from metis_tpu.cost.volume import TransformerVolume
 from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.trace import Tracer
 from metis_tpu.execution.builder import (
@@ -125,6 +137,7 @@ class RecoveryRecord:
     resumed_step: int  # checkpointed step the run resumed from
     recover_s: float
     plan_changed: bool = False
+    migrated: bool = False  # state resharded live (no checkpoint rollback)
     detail: str = ""
 
 
@@ -151,7 +164,8 @@ class SupervisorReport:
                 {"kind": r.kind, "step": r.step,
                  "resumed_step": r.resumed_step,
                  "recover_s": round(r.recover_s, 4),
-                 "plan_changed": r.plan_changed, "detail": r.detail}
+                 "plan_changed": r.plan_changed,
+                 "migrated": r.migrated, "detail": r.detail}
                 for r in self.recoveries],
             "retries": self.retries,
             "checkpoints": self.checkpoints,
@@ -304,6 +318,64 @@ class TrainingSupervisor:
             state = train_state_to_exec_state(exe.kind, ts)
         return state, meta.step
 
+    def _switch_state(self, old, exe, layout: str, art: PlanArtifact,
+                      fresh, step: int):
+        """Carry the running state across a plan switch: ``(state, step,
+        migrated)``.
+
+        Prefers the live reshard (``execution/reshard.py``) when enabled,
+        eligible, and priced under the checkpoint-restore baseline
+        (``SearchConfig.spot_recover_s``); a successful migration keeps the
+        CURRENT step.  Ineligibility or ANY mid-flight migration fault
+        emits ``migration_fallback`` and degrades to checkpoint-restore —
+        the switch is then exactly the pre-migration recovery path."""
+        # imported here, not at module top: reshard.py consults the fault
+        # injector, so a top-level import would close a cycle through
+        # resilience/__init__
+        from metis_tpu.execution.reshard import (
+            device_sets_intersect,
+            execute_reshard,
+            migration_eligible,
+            price_migration_ms,
+            stage_layout,
+        )
+
+        old_exe, old_layout, old_art, old_state, old_cluster = old
+        res = self.res
+        if res.live_migration:
+            try:
+                ok, reason = migration_eligible(
+                    old_exe.kind, exe.kind, old_layout, layout,
+                    device_sets_intersect(old_cluster, self.cluster))
+                if not ok:
+                    raise MigrationError(reason)
+                volume = TransformerVolume(
+                    self.model, self.profiles.model.params_per_layer_bytes)
+                price_ms = price_migration_ms(
+                    stage_layout(old_art, self.model.num_layers),
+                    stage_layout(art, self.model.num_layers),
+                    volume, self.search_config.migration_bw_gbps)
+                restore_ms = self.search_config.spot_recover_s * 1000.0
+                if price_ms >= restore_ms:
+                    raise MigrationError(
+                        f"priced transfer {price_ms:.1f} ms loses to "
+                        f"checkpoint-restore {restore_ms:.1f} ms")
+                policy = RetryPolicy(max_attempts=res.retry_attempts,
+                                     base_delay_s=res.retry_base_delay_s,
+                                     max_delay_s=res.retry_max_delay_s)
+                state, _ = execute_reshard(
+                    old_state, fresh, step=step, events=self.events,
+                    faults=self.faults, retry=policy, sleep=self._sleep)
+                return state, step, True
+            except (MetisError, OSError, ValueError) as e:
+                self.events.emit("migration_fallback", step=step,
+                                 reason=f"{type(e).__name__}: {e}")
+        try:
+            state, step = self._restore(exe, layout, fresh)
+        except FileNotFoundError:
+            state, step = fresh, 0
+        return state, step, False
+
     # -- the supervised loop ----------------------------------------------
 
     def _handle_sigterm(self, signum, frame) -> None:  # pragma: no cover
@@ -397,6 +469,7 @@ class TrainingSupervisor:
                             lost=",".join(f"{t}={n}"
                                           for t, n in lost.items()))
                     with tracer.span("recovery", kind=kind):
+                        old = (exe, layout, art, state, self.cluster)
                         survivor = shrink_cluster(self.cluster, lost)
                         rep = replan(self.cluster, survivor, self.profiles,
                                      self.model, self.search_config,
@@ -408,10 +481,8 @@ class TrainingSupervisor:
                         self.cluster = survivor
                         exe, mesh, layout = self._build(art)
                         fresh = exe.init(jax.random.PRNGKey(0))
-                        try:
-                            state, step = self._restore(exe, layout, fresh)
-                        except FileNotFoundError:
-                            state, step = fresh, 0
+                        state, step, migrated = self._switch_state(
+                            old, exe, layout, art, fresh, step)
                         batches = self._batches(art, exe, mesh, skip=step)
                         detector.reset()
                         timer = StepTimer(events=self.events,
@@ -421,12 +492,12 @@ class TrainingSupervisor:
                     self.events.emit(
                         "recovery_complete", step=step, kind=kind,
                         recover_s=round(recover_s, 4),
-                        plan_changed=rep.plan_changed,
+                        plan_changed=rep.plan_changed, migrated=migrated,
                         survivor_devices=survivor.total_devices)
                     report.recoveries.append(RecoveryRecord(
                         kind=kind, step=report.steps_done,
                         resumed_step=step, recover_s=recover_s,
-                        plan_changed=rep.plan_changed,
+                        plan_changed=rep.plan_changed, migrated=migrated,
                         detail=",".join(f"{t}={n}" for t, n in lost.items())))
                     report.steps_done = step
                     continue
@@ -451,6 +522,7 @@ class TrainingSupervisor:
                             returned=",".join(f"{t}={n}"
                                               for t, n in returned.items()))
                         with tracer.span("recovery", kind="spot_return"):
+                            old = (exe, layout, art, state, self.cluster)
                             grown = grow_cluster(
                                 self.cluster, self.full_cluster, returned)
                             rep = replan(self.cluster, grown, self.profiles,
@@ -464,11 +536,8 @@ class TrainingSupervisor:
                             self.cluster = grown
                             exe, mesh, layout = self._build(art)
                             fresh = exe.init(jax.random.PRNGKey(0))
-                            try:
-                                state, step = self._restore(exe, layout,
-                                                            fresh)
-                            except FileNotFoundError:
-                                state, step = fresh, 0
+                            state, step, migrated = self._switch_state(
+                                old, exe, layout, art, fresh, step)
                             batches = self._batches(art, exe, mesh,
                                                     skip=step)
                             detector.reset()
@@ -480,12 +549,12 @@ class TrainingSupervisor:
                             "recovery_complete", step=step,
                             kind="spot_return",
                             recover_s=round(recover_s, 4),
-                            plan_changed=rep.plan_changed,
+                            plan_changed=rep.plan_changed, migrated=migrated,
                             survivor_devices=grown.total_devices)
                         report.recoveries.append(RecoveryRecord(
                             kind="spot_return", step=report.steps_done,
                             resumed_step=step, recover_s=recover_s,
-                            plan_changed=rep.plan_changed,
+                            plan_changed=rep.plan_changed, migrated=migrated,
                             detail=",".join(f"{t}={n}"
                                             for t, n in returned.items())))
                         report.steps_done = step

@@ -94,6 +94,9 @@ class _QueryRecord:
     key: str
     plan_fingerprint: str | None
     workload: InferenceWorkload | None = None  # None = training query
+    # the served best's per-stage (tp, layer_start, layer_end) triples —
+    # what migration pricing compares when a replan displaces this plan
+    plan_layout: tuple | None = None
 
 
 class PlanService:
@@ -254,7 +257,8 @@ class PlanService:
         with self._lock:
             self._queries[key] = _QueryRecord(
                 model=model, config=config, top_k=top_k, key=key,
-                plan_fingerprint=plan_fp)
+                plan_fingerprint=plan_fp,
+                plan_layout=self._best_layout(best))
         if best is not None and plan_fp is not None:
             with self._accuracy_lock:
                 if plan_fp not in self.ledger.predictions:
@@ -300,6 +304,38 @@ class PlanService:
                 plan_fingerprint=plan_fp, workload=workload)
         self.cache.put(key, entry)
         return entry
+
+    @staticmethod
+    def _best_layout(best) -> tuple | None:
+        """Per-stage ``(tp, layer_start, layer_end)`` triples of a ranked
+        plan — the canonical layout key migration pricing compares
+        (``execution/reshard.py``); None when the plan records no usable
+        partition."""
+        if best is None:
+            return None
+        try:
+            bounds = list(best.intra.layer_partition)
+            return tuple(
+                (int(s.tp), int(bounds[i]), int(bounds[i + 1]))
+                for i, s in enumerate(best.intra.strategies))
+        except (AttributeError, IndexError, TypeError):
+            return None
+
+    def _migration_cost_ms(self, model: ModelSpec, old_layout,
+                           new_layout) -> float | None:
+        """One-time live-transfer estimate for switching a running job
+        between two served plans — the same moved-bytes rule the cost
+        model's additive ``migration`` term amortizes, un-amortized so
+        subscribers can weigh it against their measured checkpoint-restore
+        time.  None when either side's layout is unknown."""
+        if not old_layout or not new_layout:
+            return None
+        from metis_tpu.cost.volume import TransformerVolume
+        from metis_tpu.execution.reshard import price_migration_ms
+
+        volume = TransformerVolume(
+            model, self.profiles.model.params_per_layer_bytes)
+        return round(price_migration_ms(old_layout, new_layout, volume), 6)
 
     @staticmethod
     def _respond(entry: dict, *, cached: bool, t_req: float) -> dict:
@@ -423,7 +459,9 @@ class PlanService:
         subscribers; ``replan=True`` additionally re-searches every
         registered query against the new topology on a background thread,
         pushing one ``replan_push`` note per refreshed plan (the elastic
-        scale path the traffic-replay driver exercises)."""
+        scale path the traffic-replay driver exercises).  A no-op delta
+        (nothing changed, e.g. a remove cancelled by an add in the same
+        call) keeps the cache and warm states and pushes nothing."""
         removed = {str(t): int(n) for t, n in (removed or {}).items()}
         added = {str(t): int(n) for t, n in (added or {}).items()}
         with self._search_lock:
@@ -434,6 +472,17 @@ class PlanService:
                 new_cluster = grow_cluster(new_cluster, self.full_cluster,
                                            added)
             delta = ClusterDelta.between(self.cluster, new_cluster)
+            if delta.is_empty:
+                # nothing actually changed (e.g. a remove cancelled by an
+                # add in the same call): keep the plan cache and the warm
+                # search states — an empty delta must be cheap — and push
+                # nothing, so subscribers never see a phantom topology
+                # change
+                with self._note_cond:
+                    seq = self._note_seq
+                return {"invalidated": 0, "removed": {}, "added": {},
+                        "devices": new_cluster.total_devices, "seq": seq,
+                        "replanning": False}
             with self._lock:
                 self.cluster = new_cluster
                 self._states.clear()
@@ -486,7 +535,12 @@ class PlanService:
                     self._queries.pop(rec.key, None)
             new_fp = entry.get("plan_fingerprint")
             changed = new_fp != rec.plan_fingerprint
-            note = self._push_note({
+            with self._lock:
+                nrec = self._queries.get(new_key)
+            new_layout = nrec.plan_layout if nrec is not None else None
+            mig = self._migration_cost_ms(rec.model, rec.plan_layout,
+                                          new_layout)
+            payload = {
                 "kind": "replan_push",
                 "fingerprint": rec.plan_fingerprint,
                 "new_fingerprint": new_fp,
@@ -494,11 +548,18 @@ class PlanService:
                 "plan_changed": changed,
                 "new_best_cost_ms": entry.get("best_cost_ms"),
                 "reason": reason,
-            })
+            }
+            if mig is not None:
+                # one-time cost of resharding the old plan's live state
+                # onto the new plan, for subscribers weighing live
+                # migration against checkpoint-restore
+                payload["migration_cost_ms"] = mig
+            note = self._push_note(payload)
             self.events.emit(
                 "replan_push", fingerprint=rec.plan_fingerprint,
                 new_fingerprint=new_fp, reason=reason,
-                plan_changed=changed, seq=note["seq"])
+                plan_changed=changed, migration_cost_ms=mig,
+                seq=note["seq"])
             notes.append(note)
         return notes
 

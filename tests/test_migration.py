@@ -1,0 +1,133 @@
+"""Live plan migration units: layout pricing, the additive cost term's
+gating, reshard planning/verification, and the serve-cache fingerprint.
+The end-to-end supervisor and fleet legs live in tests/test_resilience.py;
+the ranking byte-identity invariants live in the search-regression gate
+(tools/check_search_regression.py, run by tests/test_parallel_search.py).
+"""
+import jax.numpy as jnp
+import pytest
+
+from metis_tpu.cluster import ClusterSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.errors import MigrationError
+from metis_tpu.cost.estimator import EstimatorOptions
+from metis_tpu.cost.volume import TransformerVolume
+from metis_tpu.execution.reshard import (
+    execute_reshard,
+    layout_moved_bytes,
+    plan_reshard,
+    price_migration_ms,
+)
+from metis_tpu.obs.ledger import query_fingerprint
+from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+from metis_tpu.resilience.faults import FaultInjector
+
+
+@pytest.fixture(scope="module")
+def volume():
+    model = tiny_test_model()
+    store = synthesize_profiles(model, ["A100"], tps=[1, 2],
+                                bss=[1, 2, 4, 8])
+    return TransformerVolume(model, store.model.params_per_layer_bytes)
+
+
+class TestLayoutPricing:
+    def test_identical_layout_moves_nothing(self, volume):
+        layout = ((1, 0, 5), (1, 5, 10))
+        assert layout_moved_bytes(layout, layout, volume) == 0
+        assert price_migration_ms(layout, layout, volume) == 0.0
+
+    def test_repartition_at_same_tp_is_resident(self, volume):
+        """A layer stays resident when some old stage held it at the same
+        tp — moving the stage boundary alone costs nothing."""
+        old = ((1, 0, 5), (1, 5, 10))
+        new = ((1, 0, 3), (1, 3, 10))
+        assert layout_moved_bytes(old, new, volume) == 0
+
+    def test_tp_change_moves_those_layers(self, volume):
+        old = ((1, 0, 5), (1, 5, 10))
+        new = ((2, 0, 5), (1, 5, 10))
+        expected = sum(volume.parameter_bytes_per_layer(2)[:5])
+        assert layout_moved_bytes(old, new, volume) == pytest.approx(
+            expected)
+
+    def test_price_scales_inversely_with_bandwidth(self, volume):
+        old = ((1, 0, 10),)
+        new = ((2, 0, 10),)
+        slow = price_migration_ms(old, new, volume, bw_gbps=50.0)
+        fast = price_migration_ms(old, new, volume, bw_gbps=100.0)
+        assert slow == pytest.approx(2.0 * fast)
+        assert fast > 0.0
+
+
+class TestCostTermGating:
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SearchConfig(gbs=8, migration_bw_gbps=0.0)
+        with pytest.raises(ValueError):
+            SearchConfig(gbs=8, migration_amortize_steps=0)
+
+    def test_migration_active_gates(self):
+        base = dict(gbs=8, migrate_from=((1, 0, 5), (1, 5, 10)))
+        on = EstimatorOptions.from_config(SearchConfig(**base))
+        assert on.migration_active
+        off = EstimatorOptions.from_config(
+            SearchConfig(**base, use_migration_model=False))
+        assert not off.migration_active
+        strict = EstimatorOptions.from_config(
+            SearchConfig(**base, strict_compat=True))
+        assert not strict.migration_active
+        fresh = EstimatorOptions.from_config(SearchConfig(gbs=8))
+        assert not fresh.migration_active
+
+    def test_migrate_from_changes_query_fingerprint(self):
+        """A replan that carries the incumbent layout must never hit the
+        fresh search's cache entry."""
+        model = tiny_test_model()
+        cluster = ClusterSpec.of(("A100", 2, 4))
+        fresh = query_fingerprint(model, cluster, SearchConfig(gbs=8))
+        moved = query_fingerprint(
+            model, cluster,
+            SearchConfig(gbs=8, migrate_from=((1, 0, 5), (1, 5, 10))))
+        assert fresh != moved
+
+
+class TestReshard:
+    def _state(self):
+        return {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+                "b": jnp.zeros((4,), dtype=jnp.float32)}
+
+    def test_plan_reshard_identical_state_is_resident(self):
+        state = self._state()
+        moved, leaves, moved_bytes = plan_reshard(state, self._state())
+        assert leaves == 2
+        assert moved == [] and moved_bytes == 0
+
+    def test_plan_reshard_rejects_structure_mismatch(self):
+        state = self._state()
+        with pytest.raises(MigrationError):
+            plan_reshard(state, {"w": state["w"]})
+        bad_shape = dict(state, w=jnp.zeros((4, 3), dtype=jnp.float32))
+        with pytest.raises(MigrationError):
+            plan_reshard(state, bad_shape)
+        bad_dtype = dict(state, b=jnp.zeros((4,), dtype=jnp.int32))
+        with pytest.raises(MigrationError):
+            plan_reshard(state, bad_dtype)
+
+    def test_execute_reshard_verifies_bit_identity(self):
+        state = self._state()
+        new_state, report = execute_reshard(state, self._state())
+        assert report.verified and report.leaves == 2
+        assert jnp.array_equal(new_state["w"], state["w"])
+
+    def test_injected_verify_fault_raises_migration_error(self):
+        """The ``reshard_verify`` injection point surfaces as the typed
+        error the supervisor's fallback path catches."""
+        state = self._state()
+        faults = FaultInjector("reshard_verify@3", seed=0)
+        with pytest.raises(MigrationError):
+            execute_reshard(state, self._state(), step=3, faults=faults)
+        # the budgeted fault is spent: the retry lands on step 4 clean
+        new_state, report = execute_reshard(
+            state, self._state(), step=3, faults=faults)
+        assert report.verified

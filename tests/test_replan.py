@@ -227,6 +227,81 @@ class TestReplan:
         assert report.delta.added == {"T4": 4}
 
 
+class TestMigrationEligibility:
+    """Device-set intersection edge cases for live plan migration
+    (``execution.reshard``): the gate the supervisor consults before
+    attempting an in-memory reshard instead of checkpoint-restore."""
+
+    def test_type_swap_is_disjoint(self):
+        """A wholesale fleet swap shares no devices — the delta is a full
+        remove + full add, and migration is ineligible."""
+        from metis_tpu.execution.reshard import (device_sets_intersect,
+                                                 migration_eligible)
+
+        old = ClusterSpec.of(("A100", 2, 4))
+        new = ClusterSpec.of(("T4", 2, 4))
+        d = ClusterDelta.between(old, new)
+        assert d.removed == {"A100": 8} and d.added == {"T4": 8}
+        assert not device_sets_intersect(old, new)
+        ok, reason = migration_eligible(
+            "gspmd", "gspmd", "", "", device_sets_intersect(old, new))
+        assert not ok
+        assert "disjoint" in reason
+
+    def test_superset_grow_intersects(self):
+        """Growing to a superset keeps every old device — intersection
+        holds and a same-shape gspmd switch is eligible."""
+        from metis_tpu.execution.reshard import (device_sets_intersect,
+                                                 migration_eligible)
+
+        old = ClusterSpec.of(("A100", 1, 4))
+        new = ClusterSpec.of(("A100", 2, 4), ("T4", 1, 4))
+        assert ClusterDelta.between(old, new).removed == {}
+        assert device_sets_intersect(old, new)
+        assert device_sets_intersect(new, old)
+        ok, reason = migration_eligible("gspmd", "gspmd", "", "", True)
+        assert ok and reason == "ok"
+
+    def test_same_set_different_plan(self):
+        """An unchanged topology (empty delta) still migrates only when
+        the state structure matches: same pipeline block layout is
+        eligible, a repartition is not."""
+        from metis_tpu.execution.reshard import (device_sets_intersect,
+                                                 migration_eligible)
+
+        c = ClusterSpec.of(("A100", 2, 4))
+        assert ClusterDelta.between(c, c).is_empty
+        assert device_sets_intersect(c, c)
+        ok, reason = migration_eligible(
+            "pipeline", "pipeline", "pp2:(0,2,4)", "pp2:(0,2,4)", True)
+        assert ok and reason == "ok"
+        ok, reason = migration_eligible(
+            "pipeline", "pipeline", "pp2:(0,2,4)", "pp4:(0,1,2,3,4)", True)
+        assert not ok
+        assert "block layouts differ" in reason
+        ok, reason = migration_eligible("pipeline", "gspmd", "", "", True)
+        assert not ok
+        assert "state shapes differ" in reason
+
+    def test_single_survivor_shrink(self):
+        """Shrinking to a single surviving device still intersects; the
+        hetero route stays ineligible regardless."""
+        from metis_tpu.execution.reshard import (device_sets_intersect,
+                                                 migration_eligible)
+        from metis_tpu.planner.replan import shrink_cluster
+
+        old = ClusterSpec.of(("A100", 2, 4))
+        new = shrink_cluster(old, {"A100": 7})
+        assert new.total_devices == 1
+        assert ClusterDelta.between(old, new).removed == {"A100": 7}
+        assert device_sets_intersect(old, new)
+        ok, reason = migration_eligible("gspmd", "gspmd", "", "", True)
+        assert ok
+        ok, reason = migration_eligible("hetero", "gspmd", "", "", True)
+        assert not ok
+        assert "hetero" in reason
+
+
 class TestEventLog:
     def test_planner_emits_events(self, setup, tmp_path):
         model, store = setup

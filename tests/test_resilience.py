@@ -322,6 +322,19 @@ class TestSupervisorDrills:
         assert [r["kind"] for r in rep["recoveries"]] == [
             "spot_preemption", "spot_return"]
 
+    def test_migration_drill_end_to_end(self, tmp_path):
+        """An eligible device loss is absorbed by a LIVE reshard (no
+        checkpoint rollback, bit-identical state, stall below the
+        filesystem round-trip) and a mid-flight verify fault degrades to
+        checkpoint-restore (asserts live in run_migration_drill)."""
+        from tools.chaos_drill import run_migration_drill
+
+        out = run_migration_drill(tmp_path, steps=8)
+        assert out["migrate"]["recoveries"][0]["migrated"]
+        assert not out["fallback"]["recoveries"][0]["migrated"]
+        t = out["timing"]
+        assert t["reshard_stall_ms"] < t["ckpt_restore_ms"]
+
 
 class TestFleetDrill:
     """The fleet simulation needs no training/jit — only plan searches
@@ -373,5 +386,7 @@ def test_resilience_events_registered_in_schema():
 
     for name in ("fault_injected", "retry_attempt", "retry_exhausted",
                  "anomaly_detected", "preempt_drain", "recovery_complete",
-                 "preemption", "spot_return", "fleet_tick", "recovery_cost"):
+                 "preemption", "spot_return", "fleet_tick", "recovery_cost",
+                 "reshard_plan", "reshard_step", "migration_fallback",
+                 "migration_complete"):
         assert name in EVENT_SCHEMA

@@ -336,6 +336,30 @@ class TestPlanService:
         assert shrunk["fingerprint"] != cold["fingerprint"]
         assert shrunk["plans"] != cold["plans"]
 
+    def test_empty_cluster_delta_is_a_cheap_noop(self, small_workload,
+                                                 service):
+        """A delta that changes nothing (no args, or a remove cancelled by
+        an add in the same call) must keep warm search state and the plan
+        cache, and push no note — regression for the path that used to
+        clear both on EMPTY deltas."""
+        _, _, model, config = small_workload
+        cold = service.plan_query(model, config, top_k=5)
+        before = service.stats()
+        service.apply_cluster_delta()
+        out = service.apply_cluster_delta(removed={"T4": 2},
+                                          added={"T4": 2}, replan=True)
+        assert out["invalidated"] == 0
+        assert out["removed"] == {} and out["added"] == {}
+        assert out["replanning"] is False
+        after = service.stats()
+        assert after["warm_states"] == before["warm_states"] == 1
+        assert after["cache"]["size"] == before["cache"]["size"] == 1
+        assert after["note_seq"] == before["note_seq"] == out["seq"]
+        assert service.notifications(since=0) == []
+        warm = service.plan_query(model, config, top_k=5)
+        assert warm["cached"] is True
+        assert warm["plans"] == cold["plans"]
+
     def test_cluster_delta_rejects_overdraw(self, service):
         from metis_tpu.core.errors import ClusterSpecError
 

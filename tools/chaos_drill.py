@@ -17,6 +17,14 @@ The CI-runnable proof that the whole recovery story works on CPU
    over the latest checkpoint's biggest array file and restore — the
    digest verification must reject it and fall back to the retained
    ``.prev`` generation.
+3. **The migration drill** (``run_migration_drill``): a device loss whose
+   survivor plan shares the old plan's state schema must be absorbed by a
+   LIVE reshard (``execution/reshard.py``) — no checkpoint rollback, the
+   run resumes at the step the fault hit, and the measured migration stall
+   beats a measured checkpoint save+restore round-trip of the same state.
+   A second leg injects a ``reshard_verify`` fault mid-migration and
+   proves the supervisor degrades to checkpoint-restore
+   (``migration_fallback``) instead of crashing or diverging.
 
 Run directly (``python tools/chaos_drill.py``) or via the tier-1 wrapper
 ``tests/test_resilience.py``.
@@ -209,6 +217,161 @@ def run_corruption_drill(tmp_dir: str | Path, steps: int = 4) -> dict:
     return {"fallback_step": got, "corrupted_file": victim.name}
 
 
+def migration_drill_setup():
+    """(cluster, profiles, model, search_config) for the migration drill:
+    2 nodes x 2 A100s — losing one node leaves a 2-device survivor whose
+    best plan keeps the old plan's pipeline state schema (pp=2, same block
+    layout), so the switch is live-reshard eligible."""
+    model = drill_model()
+    cluster = ClusterSpec.of(("A100", 2, 2))
+    profiles = synthesize_profiles(model, ["A100"], tps=[1, 2],
+                                   bss=[1, 2, 4, 8])
+    config = SearchConfig(gbs=8, max_profiled_tp=2, max_profiled_bs=8)
+    return cluster, profiles, model, config
+
+
+def _measure_ckpt_vs_reshard(tmp_dir: Path) -> dict:
+    """Time both state-movement primitives on the SAME trained state and
+    plan switch: the filesystem round-trip (save + digest-verified restore
+    onto the new plan) vs the live reshard.  Also asserts the migrated
+    state is bit-identical to the source (per-leaf sha256)."""
+    import time as _time
+
+    import jax
+
+    from metis_tpu.execution.builder import (
+        build_executable,
+        exec_state_to_train_state,
+        train_state_to_exec_state,
+    )
+    from metis_tpu.execution.checkpoint import (
+        _tree_digests,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from metis_tpu.execution.mesh import PlanArtifact
+    from metis_tpu.execution.reshard import execute_reshard
+    from metis_tpu.models import config_for_model_spec
+
+    model = drill_model()
+    cfg = config_for_model_spec(model)
+    old_art = PlanArtifact(
+        mesh_axes=("pp", "dp", "tp"), mesh_shape=(2, 2, 1),
+        layer_partition=(0, 2, 4), strategies=({"dp": 2, "tp": 1},),
+        gbs=8, microbatches=2)
+    new_art = PlanArtifact(
+        mesh_axes=("pp", "dp", "tp"), mesh_shape=(2, 1, 1),
+        layer_partition=(0, 2, 4), strategies=({"dp": 1, "tp": 1},),
+        gbs=8, microbatches=2)
+    old_exe = build_executable(cfg, old_art)
+    new_exe = build_executable(cfg, new_art)
+
+    from metis_tpu.data.pipeline import make_input_pipeline, \
+        synthetic_run_dataset
+
+    dataset = synthetic_run_dataset(model.vocab_size, old_art.gbs,
+                                    model.sequence_length)
+    batches = make_input_pipeline(dataset, old_art.gbs, epochs=None)
+    state = old_exe.init(jax.random.PRNGKey(0))
+    for _ in range(2):
+        tokens, targets = next(batches)
+        state, _loss = old_exe.step(state, tokens, targets)
+    src_digests = _tree_digests(state)
+    ref = new_exe.init(jax.random.PRNGKey(1))
+
+    # filesystem round-trip: save under the old plan, restore onto the new
+    ckpt = tmp_dir / "ckpt-baseline"
+    t0 = _time.perf_counter()
+    save_checkpoint(ckpt, exec_state_to_train_state(old_exe.kind, state, 2),
+                    old_art.build_mesh(), plan=old_art)
+    ts = restore_checkpoint(
+        ckpt, exec_state_to_train_state(new_exe.kind, ref, 2))
+    restored = train_state_to_exec_state(new_exe.kind, ts)
+    ckpt_ms = (_time.perf_counter() - t0) * 1000.0
+    assert _tree_digests(restored) == src_digests, \
+        "checkpoint round-trip altered state bytes"
+
+    # live reshard of the identical switch
+    migrated, rep = execute_reshard(state, ref, step=2)
+    assert rep.verified
+    assert _tree_digests(migrated) == src_digests, \
+        "live reshard altered state bytes"
+    return {"ckpt_restore_ms": round(ckpt_ms, 3),
+            "reshard_stall_ms": round(rep.stall_ms, 3),
+            "moved_bytes": rep.moved_bytes}
+
+
+def run_migration_drill(tmp_dir: str | Path, steps: int = 8) -> dict:
+    """The live-migration drill (module docstring item 3).  Returns a dict
+    with both legs' reports plus the measured stall comparison; raises
+    AssertionError when any migration guarantee is violated."""
+    tmp_dir = Path(tmp_dir)
+    tmp_dir.mkdir(parents=True, exist_ok=True)
+    cluster, profiles, model, config = migration_drill_setup()
+
+    def supervise(name: str, script: str):
+        path = tmp_dir / f"{name}.jsonl"
+        with EventLog(path) as events:
+            sup = TrainingSupervisor(
+                cluster, profiles, model, config,
+                checkpoint_dir=tmp_dir / f"ckpt-{name}", steps=steps,
+                resilience=ResilienceConfig(checkpoint_every=2),
+                faults=FaultInjector(script, seed=0, events=events),
+                events=events, sleep=_no_sleep)
+            report = sup.run()
+        evs = read_events(path)
+        problems = validate_events(evs)
+        assert not problems, \
+            "event schema problems:\n  " + "\n  ".join(problems)
+        return report, evs
+
+    # -- leg 1: the switch is absorbed live, no rollback ------------------
+    report, evs = supervise("migrate", "device_loss@4:A100=2")
+    assert report.outcome == "completed", \
+        f"migration leg did not complete: {report.detail}"
+    assert report.steps_done == steps
+    rec = report.recoveries[0]
+    assert rec.kind == "device_loss" and rec.migrated, \
+        f"device loss was not absorbed by live migration: {rec}"
+    assert rec.resumed_step == 4, \
+        f"migration rolled back to step {rec.resumed_step}, wanted 4"
+    names = [e["event"] for e in evs]
+    assert "migration_fallback" not in names, \
+        "migration leg unexpectedly fell back"
+    assert names.index("reshard_plan") < names.index("reshard_step") \
+        < names.index("migration_complete") \
+        < names.index("recovery_complete"), \
+        "reshard_plan -> reshard_step -> migration_complete -> " \
+        "recovery_complete out of causal order"
+    complete = next(e for e in evs if e["event"] == "migration_complete")
+    assert complete["stall_ms"] > 0 and complete["moved_bytes"] > 0
+
+    # -- leg 2: a mid-flight verify fault degrades, never crashes ---------
+    fb_report, fb_evs = supervise(
+        "fallback", "device_loss@4:A100=2,reshard_verify@4")
+    assert fb_report.outcome == "completed", \
+        f"fallback leg did not complete: {fb_report.detail}"
+    assert fb_report.steps_done == steps
+    fb_rec = fb_report.recoveries[0]
+    assert not fb_rec.migrated, "faulted migration still reported migrated"
+    fb_names = [e["event"] for e in fb_evs]
+    assert "migration_complete" not in fb_names
+    assert fb_names.index("fault_injected") < \
+        fb_names.index("migration_fallback") < \
+        fb_names.index("recovery_complete"), \
+        "fault -> migration_fallback -> recovery_complete out of order"
+
+    # -- the stall is measurably below the filesystem round-trip ----------
+    timing = _measure_ckpt_vs_reshard(tmp_dir)
+    assert timing["reshard_stall_ms"] < timing["ckpt_restore_ms"], \
+        f"live reshard ({timing['reshard_stall_ms']} ms) did not beat " \
+        f"checkpoint-restore ({timing['ckpt_restore_ms']} ms)"
+
+    return {"migrate": report.to_json_dict(),
+            "fallback": fb_report.to_json_dict(),
+            "timing": timing}
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=8)
@@ -218,6 +381,7 @@ def main(argv: list[str] | None = None) -> int:
                    help="run in DIR and keep the artifacts (default: a "
                         "temp dir, removed afterwards)")
     p.add_argument("--skip-corruption", action="store_true")
+    p.add_argument("--skip-migration", action="store_true")
     p.add_argument("--report", default=None, metavar="PATH",
                    help="also write the drill reports as JSON to PATH "
                         "(bench.py's resilience section consumes this)")
@@ -234,9 +398,17 @@ def main(argv: list[str] | None = None) -> int:
             out = run_corruption_drill(d)
             print(f"corruption drill OK: fell back to .prev at step "
                   f"{out['fallback_step']}")
+        mig = None
+        if not args.skip_migration:
+            mig = run_migration_drill(Path(d) / "migration")
+            t = mig["timing"]
+            print(f"migration drill OK: live reshard "
+                  f"{t['reshard_stall_ms']} ms vs checkpoint-restore "
+                  f"{t['ckpt_restore_ms']} ms")
         if args.report:
             Path(args.report).write_text(
-                json.dumps({"drill": rep, "corruption": out}))
+                json.dumps({"drill": rep, "corruption": out,
+                            "migration": mig}))
 
     if args.keep:
         Path(args.keep).mkdir(parents=True, exist_ok=True)

@@ -84,6 +84,16 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "spot_return": {"step", "returned"},
     "fleet_tick": {"tick", "devices", "goodput_frac"},
     "recovery_cost": {"tick", "recover_s"},
+    # live plan migration (execution/reshard.py, resilience/supervisor.py,
+    # tools/fleet_drill.py): one reshard_plan per migration attempt (the
+    # src->dst delta about to be transferred), one reshard_step per leaf
+    # moved, migration_complete on digest-verified success — or
+    # migration_fallback when a migration fault degrades the switch to
+    # the checkpoint-restore path (state is never lost, only slower)
+    "reshard_plan": {"leaves", "moved_bytes"},
+    "reshard_step": {"leaf"},
+    "migration_fallback": {"reason"},
+    "migration_complete": {"leaves", "stall_ms"},
 }
 
 

@@ -33,7 +33,15 @@ Four frozen invariants, any drift exits 1:
    the native reserved ranking, and spot-ON must stay batched==scalar
    byte-identical and match its checked-in golden
    (tools/search_spot_golden.json, recorded with ``--update-baseline``).
-7. **Inference-search golden.**  The serving-workload search
+7. **Migration invariants.**  With ``SearchConfig.migrate_from`` set to a
+   frozen source layout on the spot-tiered fixture, strict_compat must
+   reproduce the frozen reserved golden byte-for-byte (the migration
+   model is inert there), ``use_migration_model=False`` must match the
+   spot-ON ranking byte-for-byte (PR-10's pricing survives the flag), and
+   migration-ON must stay batched==scalar byte-identical and match its
+   checked-in golden (tools/search_migration_golden.json, recorded with
+   ``--update-baseline``).
+8. **Inference-search golden.**  The serving-workload search
    (``inference/planner.plan_inference`` on the parity topology with
    ``metis_tpu.testing.PARITY_INFERENCE``) must be run-to-run
    deterministic (two dumps byte-identical) and match its checked-in
@@ -85,6 +93,15 @@ INFERENCE_GOLDEN = Path(__file__).resolve().parent / (
 # native mode with the spot model ON.  Freezes the expected_recovery
 # pricing; recorded by ``--update-baseline``.
 SPOT_GOLDEN = Path(__file__).resolve().parent / "search_spot_golden.json"
+
+# Live-migration ranking golden: the spot-tiered fixture searched with
+# ``migrate_from`` pinned to MIGRATION_FROM (a pp2/tp1 even split of the
+# GPT-10L parity model — the layout a running job is migrating away from).
+# Freezes the additive ``migration`` pricing; recorded by
+# ``--update-baseline``.
+MIGRATION_GOLDEN = Path(__file__).resolve().parent / (
+    "search_migration_golden.json")
+MIGRATION_FROM = ((1, 0, 5), (1, 5, 10))
 
 # Throughput baseline: batched + scalar plans/sec recorded on one host by
 # ``--update-baseline``; the check compares host-normalized numbers, so the
@@ -285,6 +302,57 @@ def run_checks(workers: int = 2) -> list[str]:
                 f"spot golden missing: {SPOT_GOLDEN} "
                 "(record one with --update-baseline)")
 
+        # migration legs: the additive ``migration`` term with a pinned
+        # source layout.  (a) strict_compat keeps the migration model
+        # inert — the frozen reserved golden survives; (b) native mode
+        # with use_migration_model OFF must match the spot-ON ranking
+        # (PR-10's availability pricing is untouched by the flag); (c)
+        # migration ON must stay batched==scalar byte-identical and match
+        # its checked-in golden.
+        mig_strict = plan_hetero(
+            spot_cluster, spot_store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                         migrate_from=MIGRATION_FROM))
+        if dump_ranked_plans(serial.plans) != dump_ranked_plans(
+                mig_strict.plans):
+            problems.append(
+                "migrate_from under strict_compat drifted from the frozen "
+                "reserved golden (the migration model must be inert there)")
+        mig_off = plan_hetero(
+            spot_cluster, spot_store, model,
+            SearchConfig(gbs=PARITY_GBS, use_migration_model=False,
+                         migrate_from=MIGRATION_FROM))
+        if spot_dump != dump_ranked_plans(mig_off.plans):
+            problems.append(
+                "use_migration_model=False with migrate_from set is not "
+                "byte-identical to the spot-ON ranking")
+        mig_on = plan_hetero(
+            spot_cluster, spot_store, model,
+            SearchConfig(gbs=PARITY_GBS, migrate_from=MIGRATION_FROM))
+        mig_scalar = plan_hetero(
+            spot_cluster, spot_store, model,
+            SearchConfig(gbs=PARITY_GBS, migrate_from=MIGRATION_FROM,
+                         use_batch_eval=False))
+        mig_dump = dump_ranked_plans(mig_on.plans)
+        if mig_dump != dump_ranked_plans(mig_scalar.plans):
+            problems.append(
+                "migration pricing: batched ranking is not byte-identical "
+                "to the scalar oracle")
+        if MIGRATION_GOLDEN.exists():
+            golden = json.loads(MIGRATION_GOLDEN.read_text())
+            entry = _migration_fingerprint(mig_on, mig_dump)
+            for key in ("num_costed", "dump_sha256", "best_total_ms",
+                        "best_migration_ms"):
+                if golden.get(key) != entry[key]:
+                    problems.append(
+                        f"migration golden drift: {key} = {entry[key]}, "
+                        f"frozen golden is {golden.get(key)} "
+                        f"(re-record deliberately with --update-baseline)")
+        else:
+            problems.append(
+                f"migration golden missing: {MIGRATION_GOLDEN} "
+                "(record one with --update-baseline)")
+
         # inference leg: run-to-run determinism + frozen serving golden
         dump1, inf1 = _run_inference_search(cluster, store, model)
         dump2, _ = _run_inference_search(cluster, store, model)
@@ -452,6 +520,49 @@ def record_spot_golden() -> dict:
     return entry
 
 
+def _migration_fingerprint(result, dump: str | None = None) -> dict:
+    """Golden entry for the migration-on spot-parity run."""
+    import hashlib
+
+    from metis_tpu.core.types import dump_ranked_plans
+
+    if dump is None:
+        dump = dump_ranked_plans(result.plans)
+    best = result.plans[0] if result.plans else None
+    return {
+        "workload": "spot parity (8xA100 reserved + 8xT4 spot @0.05/hr, "
+                    "GPT-10L, gbs=128, native mode, "
+                    f"migrate_from={MIGRATION_FROM})",
+        "num_costed": result.num_costed,
+        "dump_sha256": hashlib.sha256(dump.encode()).hexdigest(),
+        "best_total_ms": (round(best.cost.total_ms, 4) if best else None),
+        "best_migration_ms": (
+            round(best.cost.migration_ms, 4) if best else None),
+    }
+
+
+def record_migration_golden() -> dict:
+    """Run the migration-on spot-parity search and write its golden."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import PARITY_GBS, write_spot_parity_fixture
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_spot_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        result = plan_hetero(cluster, store, tiny_test_model(),
+                             SearchConfig(gbs=PARITY_GBS,
+                                          migrate_from=MIGRATION_FROM))
+    entry = _migration_fingerprint(result)
+    MIGRATION_GOLDEN.write_text(json.dumps(entry, indent=2) + "\n")
+    return entry
+
+
 def measure_throughput(repeats: int = 3) -> dict:
     """Best-of-``repeats`` whole-search plans/sec on the parity workload for
     the batched (primary) and scalar (oracle) costing paths.  Best-of damps
@@ -538,6 +649,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"overlap golden written: {golden}")
         spot_golden = record_spot_golden()
         print(f"spot golden written: {spot_golden}")
+        mig_golden = record_migration_golden()
+        print(f"migration golden written: {mig_golden}")
         inf_golden = record_inference_golden()
         print(f"inference golden written: {inf_golden}")
         entry = measure_throughput()
@@ -556,7 +669,8 @@ def main(argv: list[str] | None = None) -> int:
           f"{GOLDEN_NUM_COSTED}, workers={args.workers} byte-identical, "
           f"batched == scalar oracle, time grid matches, overlap-off "
           f"inert + overlap golden matches, spot-off inert + spot golden "
-          f"matches, inference search deterministic + golden matches)")
+          f"matches, migration-off inert + migration golden matches, "
+          f"inference search deterministic + golden matches)")
     return 0
 
 

@@ -17,6 +17,22 @@ Two legs, both CPU-only and fully deterministic for a given ``--seed``:
    baseline.  The headline is ``fleet_goodput_frac`` — mean per-tick
    throughput relative to the full healthy fleet, discounted by
    recovery downtime (``SearchConfig.spot_recover_s`` per event).
+
+   With migration on (the default), eligible topology transitions take
+   the **live-migration path** instead of checkpoint-restore: the
+   reserved pool survives every delta (old and new device sets always
+   intersect), so when the priced transfer
+   (:func:`metis_tpu.execution.reshard.price_migration_ms` over the old
+   and new stage layouts — the supervisor's exact decision rule) beats
+   ``spot_recover_s``, the tick is charged the modeled migration stall
+   only.  Each migration reshards a synthetic per-layer state through a
+   serialized transfer and asserts the result bit-identical by sha256
+   digest; the first eligible migration absorbs an injected
+   ``reshard_verify`` fault and must fall back to the full
+   checkpoint-restore charge with a ``migration_fallback`` event.  (The
+   fleet plans are hetero — the *live jax* reshard adapter is exercised
+   by ``tools/chaos_drill.py``'s migration drill on homogeneous pipeline
+   state; this leg proves the fleet-scale *policy* and its pricing.)
 2. **The supervisor leg** (``run_supervisor_spot_drill``): a CPU-trainable
    model under ``TrainingSupervisor`` with a scripted
    ``spot_preemption`` -> ``spot_return`` pair — proves eviction is
@@ -32,6 +48,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import hashlib
 import json
 import math
 import os
@@ -39,6 +56,8 @@ import random
 import sys
 import tempfile
 from pathlib import Path
+
+import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
@@ -101,16 +120,73 @@ def _best_recovery_ms(resp: dict) -> float:
         return 0.0
 
 
+def _plan_layout(resp: dict) -> tuple | None:
+    """The ranked-best plan's per-stage ``(tp, layer_start, layer_end)``
+    triples from a daemon /plan response — the canonical layout shape
+    ``execution.reshard`` prices migrations over."""
+    try:
+        plans = json.loads(resp.get("plans") or "[]")
+        if not plans:
+            return None
+        bounds = list(plans[0]["layer_partition"])
+        tps = [int(s["tp"]) for s in plans[0]["strategies"]]
+        return tuple((tps[i], int(bounds[i]), int(bounds[i + 1]))
+                     for i in range(len(tps)))
+    except (KeyError, ValueError, IndexError, TypeError, AttributeError):
+        return None
+
+
+def _synthetic_state(num_layers: int, seed: int) -> list[np.ndarray]:
+    """One seeded array per layer — a fleet-scale stand-in for live
+    training state, small enough to digest every migration."""
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal(1024).astype(np.float32)
+            for _ in range(num_layers)]
+
+
+def _state_digest(state: list[np.ndarray]) -> str:
+    h = hashlib.sha256()
+    for a in state:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _simulate_reshard(state: list[np.ndarray], old_layout: tuple,
+                      new_layout: tuple) -> tuple[list[np.ndarray], int]:
+    """Round-trip every layer whose stage tp assignment changed through a
+    serialized transfer buffer (the same moved-layer rule as
+    ``reshard.layout_moved_bytes``); returns (new state, layers moved)."""
+    old_tp: dict[int, int] = {}
+    for tp, start, end in old_layout:
+        for layer in range(start, end):
+            old_tp[layer] = tp
+    out = list(state)
+    moved = 0
+    for tp, start, end in new_layout:
+        for layer in range(start, end):
+            if old_tp.get(layer) != tp and layer < len(state):
+                a = state[layer]
+                out[layer] = np.frombuffer(
+                    a.tobytes(), dtype=a.dtype).reshape(a.shape)
+                moved += 1
+    return out, moved
+
+
 def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
                     chips_per_node: int = 32, ticks: int = 24,
                     tick_seconds: float = 3600.0,
                     spot_rate_per_hr: float = 0.05,
                     return_rate_per_hr: float = 0.35,
                     spot_recover_s: float = 30.0, seed: int = 0,
+                    migrate: bool = True,
                     verbose: bool = False) -> dict:
     """Seeded Poisson preemption chaos against a live daemon.  Returns the
     fleet report dict; raises AssertionError when a recovery guarantee is
-    violated."""
+    violated.  ``migrate=False`` restores the checkpoint-restore-only
+    accounting (every delta charged ``spot_recover_s``)."""
+    from metis_tpu.cost.volume import TransformerVolume
+    from metis_tpu.execution.reshard import (layout_moved_bytes,
+                                             price_migration_ms)
     from metis_tpu.profiles.synthetic import synthesize_profiles
     from metis_tpu.serve.client import PlanServiceClient
     from metis_tpu.serve.daemon import PlanService, serve_in_thread
@@ -131,6 +207,17 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
     p_return = 1.0 - math.exp(-return_rate_per_hr * hours)
     n_spot_nodes = sum(1 for n in cluster.nodes if n.device_type == SPOT_TYPE)
 
+    # live-migration bookkeeping: price over the real model volume, carry
+    # a synthetic per-layer state that every migration must preserve
+    # bit-identically, and arm one injected verify fault for the first
+    # eligible migration (the fallback leg)
+    volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
+    state = _synthetic_state(model.num_layers, seed)
+    state_digest0 = _state_digest(state)
+    migrations = fallbacks = 0
+    migration_stall_ms_total = 0.0
+    fault_pending = migrate
+
     trajectory: list[dict] = []
     with EventLog(events_path) as events:
         service = PlanService(cluster, profiles, events=events)
@@ -143,6 +230,7 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
             base_recovery_ms = _best_recovery_ms(base)
             assert base_recovery_ms > 0.0, \
                 "spot-tiered fleet priced no expected_recovery"
+            prev_layout = _plan_layout(base)
 
             live_spot = n_spot_nodes   # mirror of the daemon's spot pool
             n_deltas = preemptions = returns = 0
@@ -184,7 +272,51 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
                 n_devices = (devices // 2) + live_spot * chips_per_node
                 n_events = (1 if lost_nodes else 0) \
                     + (1 if returned_nodes else 0)
+                new_layout = _plan_layout(resp)
+                # per eventful tick: live migration when the priced
+                # transfer over the layout transition beats the
+                # checkpoint-restore charge (the supervisor's decision
+                # rule; the reserved v6e pool survives every delta, so
+                # old and new device sets always intersect) — one modeled
+                # stall covers the tick's settled transition
                 recover_s = n_events * spot_recover_s
+                path = "ckpt" if n_events else "none"
+                if (migrate and n_events and prev_layout is not None
+                        and new_layout is not None):
+                    price_ms = price_migration_ms(
+                        prev_layout, new_layout, volume,
+                        config.migration_bw_gbps)
+                    if price_ms < spot_recover_s * 1000.0:
+                        if fault_pending:
+                            fault_pending = False
+                            fallbacks += 1
+                            path = "fallback"
+                            events.emit(
+                                "migration_fallback", step=tick,
+                                reason="MigrationError: injected "
+                                       "reshard_verify fault: post-transfer"
+                                       " digest mismatch")
+                        else:
+                            moved_bytes = layout_moved_bytes(
+                                prev_layout, new_layout, volume)
+                            events.emit("reshard_plan", step=tick,
+                                        leaves=len(state),
+                                        moved_bytes=moved_bytes)
+                            state, moved = _simulate_reshard(
+                                state, prev_layout, new_layout)
+                            events.emit("reshard_step", step=tick,
+                                        leaf=f"layers[{moved}]",
+                                        bytes=moved_bytes)
+                            assert _state_digest(state) == state_digest0, \
+                                f"tick {tick}: migrated state diverged " \
+                                "from the pre-chaos digest"
+                            events.emit("migration_complete", step=tick,
+                                        leaves=len(state), moved=moved,
+                                        stall_ms=round(price_ms, 3))
+                            recover_s = price_ms / 1000.0
+                            path = "migrate"
+                            migrations += 1
+                            migration_stall_ms_total += price_ms
                 downtime_frac = min(recover_s / tick_seconds, 1.0)
                 goodput = (c0 / cost) * (1.0 - downtime_frac)
                 recovery_ms = _best_recovery_ms(resp)
@@ -198,9 +330,10 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
                 trajectory.append({
                     "tick": tick, "devices": n_devices, "cost_ms": cost,
                     "expected_recovery_ms": recovery_ms,
-                    "recover_s": recover_s,
+                    "recover_s": recover_s, "path": path,
                     "goodput_frac": goodput,
                 })
+                prev_layout = new_layout
 
             # drain the background replan notifications: one replan_push
             # per registered query per delta
@@ -229,6 +362,14 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
         f"!= {c0}"
     assert pushes >= n_deltas, \
         f"daemon pushed {pushes} replans for {n_deltas} topology deltas"
+    if migrate and n_deltas > 1:
+        assert migrations > 0, \
+            "no eligible topology delta took the migration path"
+        assert fallbacks == 1, \
+            "the injected mid-migration fault did not fall back to " \
+            "checkpoint-restore"
+    assert _state_digest(state) == state_digest0, \
+        "state diverged across the drill's migrations"
 
     # -- schema-valid, causally ordered event stream ----------------------
     evs = read_events(events_path)
@@ -247,6 +388,21 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
         if e["event"] == "recovery_cost":
             assert i < tick_of[e["tick"]], \
                 "recovery_cost logged after its fleet_tick"
+    # migration events are causally ordered within their tick:
+    # reshard_plan -> reshard_step -> migration_complete, all before the
+    # fleet_tick that absorbed the transition; a fallback precedes its tick
+    mig_order = ("reshard_plan", "reshard_step", "migration_complete")
+    per_tick: dict[int, list[str]] = {}
+    for i, e in enumerate(evs):
+        if e["event"] in mig_order + ("migration_fallback",):
+            assert i < tick_of[e["step"]], \
+                f"{e['event']} at tick {e['step']} logged after its " \
+                "fleet_tick"
+            per_tick.setdefault(e["step"], []).append(e["event"])
+    for tick, names in per_tick.items():
+        if names != ["migration_fallback"]:
+            assert names == list(mig_order), \
+                f"tick {tick}: migration events out of order: {names}"
 
     goodputs = [t["goodput_frac"] for t in trajectory]
     report = {
@@ -259,12 +415,24 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
         "returned_nodes": returns,
         "cluster_deltas": n_deltas,
         "replan_pushes": pushes,
+        "migration_enabled": migrate,
+        "migrations": migrations,
+        "migration_fallbacks": fallbacks,
+        "migration_stall_ms_total": round(migration_stall_ms_total, 3),
         "baseline_cost_ms": c0,
         "baseline_expected_recovery_ms": base_recovery_ms,
         "fleet_goodput_frac": sum(goodputs) / len(goodputs),
         "min_goodput_frac": min(goodputs),
         "trajectory": trajectory,
     }
+    if (migrate and devices == 256 and ticks == 24 and seed == 0
+            and spot_rate_per_hr == 0.05 and return_rate_per_hr == 0.35
+            and spot_recover_s == 30.0 and tick_seconds == 3600.0):
+        # the headline target at default scale: live migration must beat
+        # the checkpoint-restore-only goodput of the same seeded chaos
+        assert report["fleet_goodput_frac"] > 0.869, \
+            f"default-scale goodput {report['fleet_goodput_frac']:.4f} " \
+            "did not beat the checkpoint-restore baseline 0.869"
     if verbose:
         print(json.dumps({k: v for k, v in report.items()
                           if k != "trajectory"}, indent=2))
@@ -331,6 +499,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--return-rate", type=float, default=0.35,
                    help="per-evicted-node return rate (events/hr)")
     p.add_argument("--spot-recover-s", type=float, default=30.0)
+    p.add_argument("--no-migrate", action="store_true",
+                   help="checkpoint-restore-only accounting (the PR-10 "
+                        "baseline; every delta charged --spot-recover-s)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--steps", type=int, default=8,
                    help="training steps for the supervisor leg")
@@ -352,10 +523,14 @@ def main(argv: list[str] | None = None) -> int:
             spot_rate_per_hr=args.spot_rate,
             return_rate_per_hr=args.return_rate,
             spot_recover_s=args.spot_recover_s, seed=args.seed,
-            verbose=True)
+            migrate=not args.no_migrate, verbose=True)
         print(f"fleet drill OK: {rep['preempted_nodes']} evictions, "
               f"{rep['returned_nodes']} returns, goodput "
               f"{rep['fleet_goodput_frac']:.4f}")
+        if rep["migration_enabled"]:
+            print(f"  live migration: {rep['migrations']} migrations "
+                  f"({rep['migration_stall_ms_total']:.1f} ms stalled), "
+                  f"{rep['migration_fallbacks']} fault-driven fallback(s)")
         sup = None
         if not args.skip_supervisor:
             sup = run_supervisor_spot_drill(d, steps=args.steps)
