@@ -1333,6 +1333,56 @@ def fleet_bench(record: dict) -> None:
     }
 
 
+def sched_bench(record: dict) -> None:
+    """Multi-tenant fleet scheduling under preemption chaos: the 3-tenant
+    drill (tools/fleet_drill.py --tenants 3 — steady training at two
+    priorities plus a diurnal inference service, seeded Poisson spot
+    evictions) in a CPU-pinned subprocess.  Headlines:
+    ``fleet_utilization_frac`` (mean share of live capacity held by
+    feasibly-planned tenants) and ``tenant_slo_attainment_min`` (the
+    worst tenant's share of ticks with a valid plan meeting its
+    demand)."""
+    args = [sys.executable,
+            str(Path(__file__).resolve().parent / "tools" / "fleet_drill.py"),
+            "--tenants", "3"]
+    with tempfile.TemporaryDirectory() as td:
+        rep_path = Path(td) / "report.json"
+        try:
+            proc = subprocess.run(
+                args + ["--report", str(rep_path)],
+                capture_output=True, text=True, timeout=300.0,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        except subprocess.TimeoutExpired:
+            record["sched"] = {
+                "skipped_reason": "tenant drill exceeded the 300 s budget"}
+            return
+        if proc.returncode != 0 or not rep_path.exists():
+            record["sched"] = {
+                "skipped_reason": f"rc={proc.returncode}: "
+                                  + proc.stderr.strip().splitlines()[-1][:160]
+                                  if proc.stderr.strip()
+                                  else f"rc={proc.returncode}"}
+            return
+        rep = json.loads(rep_path.read_text())["tenants"]
+    record["sched"] = {
+        "tenants": rep["tenants"],
+        "devices": rep["devices"],
+        "ticks": rep["ticks"],
+        "preempted_nodes": rep["preempted_nodes"],
+        "returned_nodes": rep["returned_nodes"],
+        "cluster_deltas": rep["cluster_deltas"],
+        "tenant_preempt_events": rep["tenant_preempt_events"],
+        "fleet_utilization_frac": round(rep["fleet_utilization_frac"], 4),
+        "min_utilization_frac": round(rep["min_utilization_frac"], 4),
+        "tenant_slo_attainment": {
+            k: round(v, 4) for k, v in rep["tenant_slo_attainment"].items()},
+        "tenant_slo_attainment_min":
+            round(rep["tenant_slo_attainment_min"], 4),
+        "closing_state_identical": rep["closing_state_identical"],
+        "trajectory": rep["trajectory"],
+    }
+
+
 def migration_bench(record: dict, timeout_s: float = 600.0) -> None:
     """Live migration vs checkpoint-restore: the chaos drill's migratable
     pipeline pair (tools/chaos_drill.run_migration_drill) in a CPU-pinned
@@ -1749,6 +1799,7 @@ def main() -> None:
     recorder.run("serve", serve_bench, record)
     recorder.run("inference", inference_bench, record)
     recorder.run("fleet", fleet_bench, record)
+    recorder.run("sched", sched_bench, record)
 
     # the migration drill jit-builds several pipeline programs; clamp its
     # subprocess to the remaining deadline so a slow host degrades to an
@@ -1873,6 +1924,12 @@ def _headline(record: dict) -> dict:
         .get("fleet_goodput_frac"),
         "fleet_replan_pushes": (record.get("fleet") or {})
         .get("replan_pushes"),
+        "fleet_utilization_frac": (record.get("sched") or {})
+        .get("fleet_utilization_frac"),
+        "tenant_slo_attainment_min": (record.get("sched") or {})
+        .get("tenant_slo_attainment_min"),
+        "sched_skipped": (record.get("sched") or {})
+        .get("skipped_reason"),
         "migration_stall_ms": (record.get("migration") or {})
         .get("migration_stall_ms"),
         "migration_vs_ckpt_speedup": (record.get("migration") or {})

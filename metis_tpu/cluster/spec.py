@@ -168,6 +168,36 @@ class ClusterSpec:
     def num_devices_by_type(self, device_type: str) -> int:
         return sum(n.num_devices for n in self.nodes if n.device_type == device_type)
 
+    def num_devices_by_tier(self, tier: str) -> int:
+        """Devices whose type sits on the given availability tier — the
+        spot-exposure accounting the fleet scheduler's price-aware
+        carve-up reports per tenant."""
+        if tier not in DEVICE_TIERS:
+            raise ClusterSpecError(
+                f"tier must be one of {DEVICE_TIERS}, got {tier!r}")
+        return sum(n.num_devices for n in self.nodes
+                   if self.devices[n.device_type].tier == tier)
+
+    def subset(self, node_indices) -> "ClusterSpec":
+        """The sub-cluster holding only the nodes at ``node_indices``
+        (any order; deduplicated), in the parent's node order so rank
+        mapping is preserved — the per-tenant carve the fleet scheduler
+        plans on.  The devices dict is narrowed to the surviving types;
+        a subset of every node reproduces the parent's node tuple exactly,
+        which is what keeps the single-tenant scheduling path
+        byte-identical to a direct planner call."""
+        indices = sorted(set(int(i) for i in node_indices))
+        if not indices:
+            raise ClusterSpecError("cannot build an empty sub-cluster")
+        if indices[0] < 0 or indices[-1] >= len(self.nodes):
+            raise ClusterSpecError(
+                f"node index out of range: {indices} vs "
+                f"{len(self.nodes)} nodes")
+        nodes = tuple(self.nodes[i] for i in indices)
+        types = {n.device_type for n in nodes}
+        return ClusterSpec(nodes=nodes,
+                           devices={t: self.devices[t] for t in types})
+
     def node_of_rank(self, rank: int) -> int:
         acc = 0
         for i, n in enumerate(self.nodes):

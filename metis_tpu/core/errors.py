@@ -87,6 +87,28 @@ class DeviceLossError(MetisError):
         self.step = step
 
 
+class TenantSpecError(MetisError):
+    """Malformed or unschedulable tenant description — an empty name, a
+    negative quota, a ceiling below the floor, or a zero-quota tenant
+    (``quota_ceiling=0``) that could never hold a single device.  Raised at
+    registration/admission time so a broken tenant never reaches the fleet
+    partitioner (``sched/tenant.py``)."""
+
+
+class FleetOverCommitError(MetisError):
+    """The fleet cannot honor every registered tenant's quota floor — the
+    floors sum past the surviving capacity (or node granularity makes them
+    unsatisfiable).  Raised by admission control and by shrink-time
+    preemption instead of silently starving a tenant below its guarantee
+    (``sched/fleet.py``)."""
+
+    def __init__(self, msg: str, *, required: int | None = None,
+                 available: int | None = None):
+        super().__init__(msg)
+        self.required = required
+        self.available = available
+
+
 class MigrationError(MetisError):
     """A live plan migration cannot proceed or failed verification — an
     incompatible src/dst state structure, a post-transfer digest mismatch,

@@ -37,6 +37,18 @@ class ClusterDelta:
     def is_empty(self) -> bool:
         return not self.added and not self.removed
 
+    @property
+    def num_added(self) -> int:
+        """Total devices gained — the capacity the fleet scheduler grants
+        back toward tenant shares on a grow delta."""
+        return sum(self.added.values())
+
+    @property
+    def num_removed(self) -> int:
+        """Total devices lost — the capacity the fleet scheduler must
+        reclaim from tenants (lowest priority first) on a shrink delta."""
+        return sum(self.removed.values())
+
     @staticmethod
     def between(old: ClusterSpec, new: ClusterSpec) -> "ClusterDelta":
         old_counts = Counter()

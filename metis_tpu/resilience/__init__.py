@@ -13,6 +13,7 @@ from metis_tpu.resilience.supervisor import (
     RetryingCheckpointWriter,
     SupervisorReport,
     TrainingSupervisor,
+    migration_decision,
 )
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "RetryingCheckpointWriter",
     "SupervisorReport",
     "TrainingSupervisor",
+    "migration_decision",
 ]

@@ -36,6 +36,12 @@ class RetryPolicy:
     max_delay_s: float = 2.0
     jitter: float = 0.25  # +/- fraction of the computed delay
     seed: int = 0
+    # total-elapsed budget across ALL attempts and backoff sleeps; None =
+    # attempt-capped only.  The attempt cap bounds how many times a flaky
+    # op runs, the deadline bounds how long a caller can be stalled — a
+    # recovery path needs both (waiting out 3 slow backoffs can cost more
+    # than the checkpoint-restore it guards).
+    deadline_s: float | None = None
     # OSError covers filesystem/network IO (and CheckpointWriteError, which
     # subclasses it); anything not listed transient is fatal by default —
     # an unknown error class is a bug until proven otherwise.
@@ -49,6 +55,8 @@ class RetryPolicy:
             raise ValueError("need 0 <= base_delay_s <= max_delay_s")
         if not 0.0 <= self.jitter < 1.0:
             raise ValueError("jitter must be in [0, 1)")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0 (or None)")
 
     def classify(self, exc: BaseException) -> str:
         """"transient" (retry) or "fatal" (re-raise immediately).  ``fatal``
@@ -74,10 +82,18 @@ class RetryPolicy:
              ) -> T:
         """Run ``fn`` under this policy.  ``on_retry(attempt, error)`` is
         called before each backoff sleep (supervisor bookkeeping); ``sleep``
-        is injectable so tests run at full speed."""
+        is injectable so tests run at full speed.
+
+        Exhaustion is whichever budget runs out first: the attempt cap, or
+        ``deadline_s`` of total elapsed time — a retry whose next backoff
+        would land past the deadline is not attempted (the sleep would
+        stall the caller past its budget for an attempt it may not get)."""
         rng = random.Random(self.seed)
+        t0 = time.monotonic()
         last: BaseException | None = None
+        attempts = 0
         for attempt in range(1, self.max_attempts + 1):
+            attempts = attempt
             try:
                 return fn()
             except Exception as e:  # noqa: BLE001 — classified below
@@ -87,12 +103,17 @@ class RetryPolicy:
                 if attempt == self.max_attempts:
                     break
                 delay = self.delay_s(attempt, rng)
+                if self.deadline_s is not None and \
+                        time.monotonic() - t0 + delay > self.deadline_s:
+                    break
                 events.emit("retry_attempt", op=op, attempt=attempt,
                             delay_s=round(delay, 4),
                             error=f"{type(e).__name__}: {e}")
                 if on_retry is not None:
                     on_retry(attempt, e)
                 sleep(delay)
-        events.emit("retry_exhausted", op=op, attempts=self.max_attempts,
+        events.emit("retry_exhausted", op=op, attempts=attempts,
+                    deadline_s=self.deadline_s,
+                    elapsed_s=round(time.monotonic() - t0, 4),
                     error=f"{type(last).__name__}: {last}")
-        raise RetryExhaustedError(op, self.max_attempts, last) from last
+        raise RetryExhaustedError(op, attempts, last) from last

@@ -88,6 +88,29 @@ from metis_tpu.resilience.faults import FaultInjector, NULL_INJECTOR
 from metis_tpu.resilience.retry import RetryPolicy
 
 
+def migration_decision(old_layout, new_layout, volume: TransformerVolume,
+                       bw_gbps: float,
+                       recover_s: float) -> tuple[str, float | None]:
+    """The migrate-vs-checkpoint-restore rule, shared verbatim between the
+    supervisor's ``_switch_state`` and the fleet scheduler's displaced-tenant
+    path: ``("migrate", price_ms)`` when both per-stage ``(tp, layer_start,
+    layer_end)`` layouts are known and the priced live transfer
+    (:func:`execution.reshard.price_migration_ms`) beats the
+    checkpoint-restore charge (``recover_s``); ``("ckpt", price_ms_or_None)``
+    otherwise.  Keeping the rule in one place means a tenant displaced by
+    the fleet partitioner and a job displaced by a device loss can never
+    disagree about which switch is cheaper."""
+    from metis_tpu.execution.reshard import price_migration_ms
+
+    if not old_layout or not new_layout:
+        return "ckpt", None
+    price_ms = price_migration_ms(tuple(old_layout), tuple(new_layout),
+                                  volume, bw_gbps)
+    if price_ms < recover_s * 1000.0:
+        return "migrate", price_ms
+    return "ckpt", price_ms
+
+
 class RetryingCheckpointWriter:
     """An :class:`AsyncCheckpointWriter` whose saves go through a
     :class:`RetryPolicy` — each attempt enqueues the async write and waits
@@ -351,15 +374,17 @@ class TrainingSupervisor:
                     raise MigrationError(reason)
                 volume = TransformerVolume(
                     self.model, self.profiles.model.params_per_layer_bytes)
-                price_ms = price_migration_ms(
+                path, price_ms = migration_decision(
                     stage_layout(old_art, self.model.num_layers),
                     stage_layout(art, self.model.num_layers),
-                    volume, self.search_config.migration_bw_gbps)
-                restore_ms = self.search_config.spot_recover_s * 1000.0
-                if price_ms >= restore_ms:
+                    volume, self.search_config.migration_bw_gbps,
+                    self.search_config.spot_recover_s)
+                if path != "migrate":
                     raise MigrationError(
                         f"priced transfer {price_ms:.1f} ms loses to "
-                        f"checkpoint-restore {restore_ms:.1f} ms")
+                        f"checkpoint-restore "
+                        f"{self.search_config.spot_recover_s * 1000.0:.1f}"
+                        " ms")
                 policy = RetryPolicy(max_attempts=res.retry_attempts,
                                      base_delay_s=res.retry_base_delay_s,
                                      max_delay_s=res.retry_max_delay_s)

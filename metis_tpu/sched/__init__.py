@@ -1,0 +1,21 @@
+"""Multi-tenant fleet scheduling: tenant registry + quota-safe fleet
+partitioner over the single-job training/inference planners."""
+from metis_tpu.sched.fleet import (
+    FleetPlan,
+    FleetScheduler,
+    TenantAllocation,
+)
+from metis_tpu.sched.tenant import (
+    TenantRegistry,
+    TenantSpec,
+    tenant_from_dict,
+)
+
+__all__ = [
+    "FleetPlan",
+    "FleetScheduler",
+    "TenantAllocation",
+    "TenantRegistry",
+    "TenantSpec",
+    "tenant_from_dict",
+]

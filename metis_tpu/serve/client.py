@@ -120,6 +120,24 @@ class PlanServiceClient:
                                    else dataclasses.asdict(workload))
         return self._request("POST", "/plan", payload)
 
+    def tenant_plan(self, name: str) -> dict:
+        """Tenant-routed plan query: the daemon answers from the fleet
+        scheduler's current carve for ``name`` (model/config/workload come
+        from the registered TenantSpec, not this call)."""
+        return self._request("POST", "/plan", {"tenant": name})
+
+    def tenant_register(self, spec) -> dict:
+        """Register a tenant (a ``sched.TenantSpec`` or its dict form)."""
+        payload = spec if isinstance(spec, dict) else dataclasses.asdict(spec)
+        return self._request("POST", "/tenant", payload)
+
+    def tenant_remove(self, name: str) -> dict:
+        return self._request("POST", "/tenant_remove", {"name": name})
+
+    def tenant_status(self, name: str | None = None) -> dict:
+        path = "/tenant" if name is None else f"/tenant?name={name}"
+        return self._request("GET", path)
+
     def accuracy_sample(self, fingerprint: str, measured_ms: float,
                         step: int | None = None, stage_ms=(),
                         predicted_ms: float | None = None) -> dict:

@@ -44,7 +44,7 @@ from urllib.parse import parse_qs, urlparse
 
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.core.config import ModelSpec, SearchConfig
-from metis_tpu.core.errors import MetisError
+from metis_tpu.core.errors import MetisError, TenantSpecError
 from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.trace import Counters, Tracer
 from metis_tpu.core.types import dump_ranked_plans
@@ -68,6 +68,8 @@ from metis_tpu.planner.replan import (
     shrink_cluster,
 )
 from metis_tpu.profiles.store import ProfileStore
+from metis_tpu.sched.fleet import FleetPlan, FleetScheduler
+from metis_tpu.sched.tenant import TenantSpec, tenant_from_dict
 from metis_tpu.serve.cache import PlanCache
 
 
@@ -146,6 +148,11 @@ class PlanService:
         self._notes: list[dict] = []
         self._note_seq = 0
         self._note_cond = threading.Condition()
+        self._closed = False
+        # multi-tenant mode: built lazily on the first tenant registration;
+        # None = classic single-job daemon, behavior byte-identical to
+        # before sched/ existed
+        self.sched: FleetScheduler | None = None
         self._t_start = time.monotonic()
 
     # -- cache keys ---------------------------------------------------------
@@ -483,11 +490,31 @@ class PlanService:
                 return {"invalidated": 0, "removed": {}, "added": {},
                         "devices": new_cluster.total_devices, "seq": seq,
                         "replanning": False}
+            # multi-tenant mode: re-partition the fleet FIRST (it raises
+            # FleetOverCommitError before mutating anything when the
+            # survivors cannot cover the quota floors, so a rejected
+            # shrink leaves daemon and scheduler state untouched), then
+            # swap the daemon topology in lockstep
+            old_fleet = fleet_plan = None
+            fleet_decisions: dict[str, dict] = {}
+            if self.sched is not None and len(self.sched.registry):
+                old_fleet = self.sched.last_plan
+                fleet_plan, fleet_decisions = self.sched.apply_delta(
+                    removed=delta.removed, added=delta.added)
             with self._lock:
                 self.cluster = new_cluster
                 self._states.clear()
                 self._state_order.clear()
-            invalidated = self.cache.invalidate_all()
+            if fleet_plan is not None:
+                # tenant-scoped invalidation: non-tenant entries always
+                # die with the topology; tenant entries survive unless
+                # their carve moved
+                invalidated = len(self.cache.invalidate_where(
+                    lambda _k, v: v.get("tenant") is None))
+                invalidated += len(self._invalidate_changed_tenants(
+                    old_fleet, fleet_plan))
+            else:
+                invalidated = self.cache.invalidate_all()
         note = self._push_note({
             "kind": "cluster_delta",
             "removed": delta.removed,
@@ -495,6 +522,20 @@ class PlanService:
             "invalidated": invalidated,
             "devices": new_cluster.total_devices,
         })
+        for name in sorted(fleet_decisions):
+            d = fleet_decisions[name]
+            if d.get("preempted"):
+                self._push_note({
+                    "kind": "tenant_preempt", "tenant": name,
+                    "from_devices": d["from_devices"],
+                    "to_devices": d["to_devices"],
+                })
+            self._push_note({
+                "kind": "tenant_replan", "tenant": name,
+                "devices": d["devices"], "path": d.get("path"),
+                "migration_ms": d.get("migration_ms"),
+                "feasible": d.get("feasible"),
+            })
         if replan:
             self.counters.inc("serve.delta_replans")
             threading.Thread(
@@ -503,7 +544,8 @@ class PlanService:
         return {"invalidated": invalidated, "removed": delta.removed,
                 "added": delta.added,
                 "devices": new_cluster.total_devices, "seq": note["seq"],
-                "replanning": replan}
+                "replanning": replan,
+                "tenants_changed": sorted(fleet_decisions)}
 
     def _replan_all(self, reason: str) -> list[dict]:
         """Re-search every registered query against the CURRENT topology
@@ -579,6 +621,180 @@ class PlanService:
                 self._state_order.clear()
         return {"invalidated": n}
 
+    # -- multi-tenant scheduling --------------------------------------------
+    def _ensure_sched(self) -> FleetScheduler:
+        with self._lock:
+            if self.sched is None:
+                sched = FleetScheduler(self.full_cluster, self.profiles,
+                                       events=self.events)
+                sched.cluster = self.cluster  # may already be shrunk
+                self.sched = sched
+            return self.sched
+
+    def _invalidate_changed_tenants(self, old_plan: FleetPlan | None,
+                                    new_plan: FleetPlan) -> list[str]:
+        """Tenant-scoped cache invalidation: drop exactly the entries of
+        tenants whose carve or ranked plans moved between two fleet plans
+        (plus tenants that vanished) — everyone else's cached answers
+        stay warm."""
+        changed = []
+        for a in new_plan.allocations:
+            old = old_plan.allocation(a.tenant) if old_plan else None
+            if old is None or old.node_indices != a.node_indices \
+                    or old.plan_json != a.plan_json:
+                changed.append(a.tenant)
+        if old_plan is not None:
+            for a in old_plan.allocations:
+                if new_plan.allocation(a.tenant) is None:
+                    changed.append(a.tenant)
+        if changed:
+            gone = set(changed)
+            self.cache.invalidate_where(
+                lambda _k, v: v.get("tenant") in gone)
+        return changed
+
+    def tenant_register(self, spec: TenantSpec) -> dict:
+        """Admit a tenant into the fleet (building the scheduler on first
+        use), re-partition, and push a ``tenant_admit`` note.  Admission
+        failures (bad spec, floors past capacity) raise typed errors the
+        HTTP layer maps to 400 without mutating fleet state.
+
+        Re-registering a byte-identical spec is idempotent: the client
+        retries POSTs on connection errors, so a register whose response
+        was dropped must not 400 on the retry — it answers from the
+        current fleet plan without re-partitioning.  A *different* spec
+        under the same name still raises (that is a conflict, not a
+        retry)."""
+        sched = self._ensure_sched()
+        with self._search_lock:
+            if spec.name in sched.registry \
+                    and sched.registry.get(spec.name) == spec:
+                plan = sched.last_plan or sched.schedule()
+                alloc = plan.allocation(spec.name)
+                with self._note_cond:
+                    seq = self._note_seq
+                return {
+                    "tenant": spec.name,
+                    "kind": spec.kind,
+                    "devices": alloc.devices if alloc else 0,
+                    "feasible": bool(alloc and alloc.feasible),
+                    "utilization_frac": plan.utilization_frac,
+                    "objective": plan.objective,
+                    "tenants_changed": [],
+                    "seq": seq,
+                }
+            old_plan = sched.last_plan
+            sched.admit(spec)
+            plan = sched.schedule()
+        changed = self._invalidate_changed_tenants(old_plan, plan)
+        alloc = plan.allocation(spec.name)
+        note = self._push_note({
+            "kind": "tenant_admit",
+            "tenant": spec.name,
+            "priority": spec.priority,
+            "devices": alloc.devices if alloc else 0,
+            "feasible": bool(alloc and alloc.feasible),
+        })
+        self.counters.inc("serve.tenants_admitted")
+        return {
+            "tenant": spec.name,
+            "kind": spec.kind,
+            "devices": alloc.devices if alloc else 0,
+            "feasible": bool(alloc and alloc.feasible),
+            "utilization_frac": plan.utilization_frac,
+            "objective": plan.objective,
+            "tenants_changed": changed,
+            "seq": note["seq"],
+        }
+
+    def tenant_remove(self, name: str) -> dict:
+        sched = self.sched
+        if sched is None:
+            raise TenantSpecError(f"no such tenant: {name!r}")
+        with self._search_lock:
+            old_plan = sched.last_plan
+            sched.remove(name)
+            plan = sched.schedule()
+        changed = self._invalidate_changed_tenants(old_plan, plan)
+        gone = {name}
+        self.cache.invalidate_where(lambda _k, v: v.get("tenant") in gone)
+        note = self._push_note({"kind": "tenant_remove", "tenant": name})
+        return {"tenant": name, "tenants_changed": changed,
+                "seq": note["seq"]}
+
+    def tenant_plan(self, name: str) -> dict:
+        """Per-tenant query routing: serve the tenant's slice of the
+        current fleet plan.  The ``plans`` field is the planner dump the
+        fleet scheduler produced on the tenant's sub-cluster — for a
+        single registered tenant that is byte-identical to a direct
+        ``/plan`` answer on the whole cluster.  Cached under a
+        tenant-tagged key so a cluster delta only evicts the tenants it
+        actually moved."""
+        t_req = time.perf_counter()
+        sched = self.sched
+        if sched is None:
+            raise TenantSpecError(f"no such tenant: {name!r}")
+        spec = sched.registry.get(name)
+        with self._search_lock:
+            plan = sched.last_plan or sched.schedule()
+            alloc = plan.allocation(name)
+            sub = (sched.cluster.subset(alloc.node_indices)
+                   if alloc and alloc.node_indices else sched.cluster)
+        qfp = query_fingerprint(spec.model, sub, spec.config,
+                                calibration=self.calibration,
+                                workload=spec.workload)
+        key = f"tenant/{name}/{qfp}"
+        self.counters.inc("serve.requests")
+        self.events.emit("plan_request", fingerprint=qfp,
+                         model=spec.model.name, gbs=spec.config.gbs,
+                         top_k=None, workload=spec.kind, tenant=name)
+        entry = self.cache.get(key)
+        if entry is not None:
+            self.events.emit("plan_cache_hit", fingerprint=qfp)
+            return self._respond(entry, cached=True, t_req=t_req)
+        self.events.emit("plan_cache_miss", fingerprint=qfp)
+        entry = {
+            "fingerprint": qfp,
+            "tenant": name,
+            "kind": spec.kind,
+            "devices": alloc.devices if alloc else 0,
+            "node_indices": list(alloc.node_indices) if alloc else [],
+            "feasible": bool(alloc and alloc.feasible),
+            "plans": alloc.plan_json if alloc else None,
+            "utility": round(alloc.utility, 9) if alloc else 0.0,
+            "utility_frac": round(alloc.utility_frac, 9) if alloc else 0.0,
+        }
+        self.cache.put(key, entry)
+        return self._respond(entry, cached=False, t_req=t_req)
+
+    def tenant_status(self, name: str | None = None) -> dict:
+        sched = self.sched
+        if sched is None:
+            if name is not None:
+                raise TenantSpecError(f"no such tenant: {name!r}")
+            return {"tenants": [], "objective": 0.0,
+                    "utilization_frac": 0.0}
+        with self._search_lock:
+            plan = sched.last_plan or sched.schedule()
+        if name is not None:
+            sched.registry.get(name)  # typed error for unknown names
+            alloc = plan.allocation(name)
+            return alloc.to_json_dict() if alloc else {"tenant": name}
+        return {
+            "tenants": list(sched.registry.names()),
+            "objective": round(plan.objective, 9),
+            "utilization_frac": round(plan.utilization_frac, 9),
+            "cluster_devices": plan.cluster_devices,
+            "allocations": [
+                {"tenant": a.tenant, "kind": a.kind,
+                 "priority": a.priority, "devices": a.devices,
+                 "reserved_devices": a.reserved_devices,
+                 "spot_devices": a.spot_devices,
+                 "feasible": a.feasible,
+                 "utility_frac": round(a.utility_frac, 9)}
+                for a in plan.allocations],
+        }
+
     # -- notifications ------------------------------------------------------
     def _push_note(self, note: dict) -> dict:
         with self._note_cond:
@@ -592,15 +808,27 @@ class PlanService:
     def notifications(self, since: int = 0,
                       timeout_s: float = 0.0) -> list[dict]:
         """Notes with seq > ``since``; blocks up to ``timeout_s`` for the
-        first new one (long-poll)."""
+        first new one (long-poll).  A :meth:`close` (daemon shutdown)
+        wakes every blocked poller immediately — it returns whatever is
+        already pending instead of holding the socket until timeout."""
         deadline = time.monotonic() + max(0.0, timeout_s)
         with self._note_cond:
             while True:
                 out = [n for n in self._notes if n["seq"] > since]
                 remaining = deadline - time.monotonic()
-                if out or remaining <= 0:
+                if out or remaining <= 0 or self._closed:
                     return out
                 self._note_cond.wait(remaining)
+
+    def close(self) -> None:
+        """Mark the service as shutting down and wake every long-polled
+        :meth:`notifications` reader.  Idempotent; the HTTP servers call
+        it from ``shutdown()`` before joining the serve loop, so no
+        handler thread is left blocked on ``_note_cond`` holding a socket
+        open past the daemon's death."""
+        with self._note_cond:
+            self._closed = True
+            self._note_cond.notify_all()
 
     # -- introspection ------------------------------------------------------
     def stats(self) -> dict:
@@ -614,6 +842,7 @@ class PlanService:
             "monitors": len(self._monitors),
             "queries": len(self._queries),
             "note_seq": self._note_seq,
+            "tenants": len(self.sched.registry) if self.sched else 0,
         }
 
 
@@ -668,6 +897,13 @@ class _Handler(BaseHTTPRequestHandler):
             notes = self.service.notifications(since=since,
                                                timeout_s=timeout_s)
             self._json(200, {"notifications": notes})
+        elif parsed.path == "/tenant":
+            q = parse_qs(parsed.query)
+            name = q.get("name", [None])[0]
+            try:
+                self._json(200, self.service.tenant_status(name=name))
+            except MetisError as e:
+                self._json(400, {"error": f"{type(e).__name__}: {e}"})
         else:
             self._json(404, {"error": f"no such endpoint: {parsed.path}"})
 
@@ -675,6 +911,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             body = self._body()
             if self.path == "/plan":
+                tenant = body.get("tenant")
+                if tenant is not None:
+                    # tenant routing: model/config/workload come from the
+                    # registered TenantSpec, not the request body
+                    self._json(200, self.service.tenant_plan(str(tenant)))
+                    return
                 model = model_spec_from_dict(body["model"])
                 config = search_config_from_dict(body["config"])
                 top_k = body.get("top_k")
@@ -683,6 +925,12 @@ class _Handler(BaseHTTPRequestHandler):
                     model, config,
                     top_k=int(top_k) if top_k is not None else None,
                     workload=workload_from_dict(wl) if wl else None)
+                self._json(200, out)
+            elif self.path == "/tenant":
+                out = self.service.tenant_register(tenant_from_dict(body))
+                self._json(200, out)
+            elif self.path == "/tenant_remove":
+                out = self.service.tenant_remove(str(body["name"]))
                 self._json(200, out)
             elif self.path == "/accuracy_sample":
                 out = self.service.post_accuracy_sample(
@@ -716,7 +964,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
 
-class _TCPServer(ThreadingHTTPServer):
+class _ServiceShutdownMixin:
+    """Close the PlanService BEFORE stopping the serve loop: ``shutdown()``
+    joins ``serve_forever``, which cannot finish while a handler thread
+    sits in a long-polled ``GET /notifications`` wait — ``service.close()``
+    wakes those waiters first, so shutdown never hangs behind a blocked
+    poller (and pollers get a prompt empty response instead of a dropped
+    socket)."""
+
+    def shutdown(self) -> None:
+        service = getattr(self, "service", None)
+        if service is not None:
+            service.close()
+        super().shutdown()
+
+
+class _TCPServer(_ServiceShutdownMixin, ThreadingHTTPServer):
     """Loopback TCP server tuned for bursty local clients: the default
     listen backlog of 5 resets connections the moment 64 threads connect
     at once, which the smoke tool's concurrency contract forbids."""
@@ -725,7 +988,7 @@ class _TCPServer(ThreadingHTTPServer):
     daemon_threads = True
 
 
-class _UnixHTTPServer(ThreadingHTTPServer):
+class _UnixHTTPServer(_ServiceShutdownMixin, ThreadingHTTPServer):
     """ThreadingHTTPServer over an AF_UNIX socket path."""
 
     address_family = socket.AF_UNIX

@@ -64,6 +64,16 @@ class TestClusterDelta:
         b = ClusterSpec.of(("A100", 4, 2))
         assert ClusterDelta.between(a, b).is_empty
 
+    def test_device_count_totals(self):
+        """num_added/num_removed aggregate across device types."""
+        old = ClusterSpec.of(("A100", 2, 4), ("T4", 1, 4))
+        new = ClusterSpec.of(("A100", 1, 4), ("T4", 3, 4))
+        d = ClusterDelta.between(old, new)
+        assert d.num_removed == 4
+        assert d.num_added == 8
+        empty = ClusterDelta.between(old, old)
+        assert empty.num_added == 0 and empty.num_removed == 0
+
 
 class TestShrinkCluster:
     def test_whole_node_removed_from_end(self):

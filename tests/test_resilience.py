@@ -156,6 +156,51 @@ class TestRetryPolicy:
             base = 0.1 * 2.0 ** (attempt - 1)
             assert base * 0.75 <= d <= base * 1.25
 
+    def test_deadline_cuts_retries_before_attempt_cap(self, tmp_path):
+        # backoff of 0.2s would blow the 10ms total budget, so the retry
+        # loop gives up after the first attempt even with 10 allowed
+        policy = RetryPolicy(max_attempts=10, base_delay_s=0.2,
+                             max_delay_s=2.0, jitter=0.0, deadline_s=0.01)
+        calls = {"n": 0}
+
+        def down():
+            calls["n"] += 1
+            raise OSError("still down")
+
+        path = tmp_path / "ev.jsonl"
+        with EventLog(path) as events:
+            with pytest.raises(RetryExhaustedError) as exc:
+                policy.call(down, op="write", events=events,
+                            sleep=lambda _s: None)
+        assert calls["n"] == 1 and exc.value.attempts == 1
+        from metis_tpu.core.events import read_events
+
+        exhausted = [e for e in read_events(path)
+                     if e["event"] == "retry_exhausted"]
+        assert len(exhausted) == 1
+        assert exhausted[0]["deadline_s"] == 0.01
+        assert exhausted[0]["elapsed_s"] >= 0.0
+
+    def test_deadline_none_keeps_attempt_cap_semantics(self, tmp_path):
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+        path = tmp_path / "ev.jsonl"
+        with EventLog(path) as events:
+            with pytest.raises(RetryExhaustedError) as exc:
+                policy.call(lambda: (_ for _ in ()).throw(OSError("nope")),
+                            op="write", events=events, sleep=lambda _s: None)
+        assert exc.value.attempts == 3
+        from metis_tpu.core.events import read_events
+
+        exhausted = [e for e in read_events(path)
+                     if e["event"] == "retry_exhausted"]
+        assert exhausted[0]["deadline_s"] is None
+
+    def test_deadline_validation(self):
+        with pytest.raises(ValueError, match="deadline_s"):
+            RetryPolicy(deadline_s=0)
+        with pytest.raises(ValueError, match="deadline_s"):
+            RetryPolicy(deadline_s=-1.0)
+
 
 class TestLossAnomalyDetector:
     def test_nan_and_inf_always_flag(self):

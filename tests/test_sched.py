@@ -1,0 +1,385 @@
+"""Multi-tenant fleet scheduler tests: registry, partitioner, preemption.
+
+Covers the sched-layer contracts:
+- TenantSpec/TenantRegistry: typed validation (zero-quota rejection,
+  floor/ceiling sanity), deterministic allocation + preemption orders.
+- FleetScheduler: a lone tenant's plan is byte-identical to the
+  single-job planner output (training AND inference), quota floors are
+  hard (FleetOverCommitError on admit and on shrink, before any state
+  mutation), equal-priority tie-breaks are name-deterministic, a
+  shrink->grow round trip restores the fleet plan byte-identically, and
+  preemption displaces the lowest priority first.
+- PlanService tenant integration: tenant-routed /plan byte-identity,
+  tenant-tagged cache keys surviving deltas that didn't move the tenant,
+  typed errors for unknown tenants.
+- tools/fleet_drill.py --tenants: the multi-tenant chaos drill as the
+  end-to-end gate (small smoke in tier-1, default scale marked slow).
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from metis_tpu.cluster import ClusterSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.errors import FleetOverCommitError, TenantSpecError
+from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+from metis_tpu.sched import FleetScheduler, TenantRegistry, TenantSpec
+from metis_tpu.testing import PARITY_INFERENCE
+
+
+@pytest.fixture(scope="module")
+def fleet_fixture():
+    """4 nodes of 2 devices (2xA100 + 2xT4): fine enough node granularity
+    that two 2-device quota floors survive a shrink to one type."""
+    model = tiny_test_model(num_layers=4)
+    profiles = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2],
+                                   bss=[1, 2, 4])
+    cluster = ClusterSpec.of(("A100", 2, 2), ("T4", 2, 2))
+    config = SearchConfig(gbs=16, max_profiled_tp=2, max_profiled_bs=4)
+    return cluster, profiles, model, config
+
+
+def _workload():
+    from metis_tpu.inference.workload import InferenceWorkload
+
+    return InferenceWorkload(**PARITY_INFERENCE)
+
+
+# ---------------------------------------------------------------------------
+# TenantSpec / TenantRegistry
+# ---------------------------------------------------------------------------
+
+
+class TestTenantSpec:
+    def test_zero_quota_ceiling_rejected_typed(self, fleet_fixture):
+        _, _, model, config = fleet_fixture
+        with pytest.raises(TenantSpecError, match="zero-quota"):
+            TenantSpec("t", model, config, quota_ceiling=0)
+
+    def test_bad_specs_rejected_typed(self, fleet_fixture):
+        _, _, model, config = fleet_fixture
+        with pytest.raises(TenantSpecError, match="non-empty"):
+            TenantSpec("", model, config)
+        with pytest.raises(TenantSpecError, match="quota_floor"):
+            TenantSpec("t", model, config, quota_floor=-1)
+        with pytest.raises(TenantSpecError, match="quota_ceiling"):
+            TenantSpec("t", model, config, quota_ceiling=-2)
+        with pytest.raises(TenantSpecError, match="< quota_floor"):
+            TenantSpec("t", model, config, quota_floor=8, quota_ceiling=4)
+
+    def test_kind_and_effective_ceiling(self, fleet_fixture):
+        _, _, model, config = fleet_fixture
+        train = TenantSpec("t", model, config, quota_ceiling=6)
+        serve = TenantSpec("s", model, config, workload=_workload())
+        assert train.kind == "training"
+        assert serve.kind == "inference"
+        assert train.ceiling_or(16) == 6
+        assert serve.ceiling_or(16) == 16  # unbounded -> fleet cap
+
+    def test_roundtrip_through_dict(self, fleet_fixture):
+        import dataclasses
+
+        from metis_tpu.sched import tenant_from_dict
+
+        _, _, model, config = fleet_fixture
+        spec = TenantSpec("t", model, config, priority=2, quota_floor=2,
+                          workload=_workload())
+        rebuilt = tenant_from_dict(dataclasses.asdict(spec))
+        assert rebuilt == spec
+
+
+class TestTenantRegistry:
+    def test_register_remove_and_typed_misses(self, fleet_fixture):
+        _, _, model, config = fleet_fixture
+        reg = TenantRegistry()
+        reg.register(TenantSpec("a", model, config))
+        with pytest.raises(TenantSpecError, match="already registered"):
+            reg.register(TenantSpec("a", model, config))
+        with pytest.raises(TenantSpecError, match="no such tenant"):
+            reg.get("b")
+        assert reg.remove("a").name == "a"
+        with pytest.raises(TenantSpecError, match="no such tenant"):
+            reg.remove("a")
+
+    def test_orders_are_deterministic_and_reversed(self, fleet_fixture):
+        _, _, model, config = fleet_fixture
+        reg = TenantRegistry()
+        # registration order scrambled on purpose: order must come from
+        # (-priority, name), never from dict insertion
+        reg.register(TenantSpec("zeta", model, config, priority=1))
+        reg.register(TenantSpec("beta", model, config, priority=0))
+        reg.register(TenantSpec("alpha", model, config, priority=1))
+        alloc = [t.name for t in reg.allocation_order()]
+        assert alloc == ["alpha", "zeta", "beta"]
+        assert [t.name for t in reg.preemption_order()] == alloc[::-1]
+        assert reg.total_quota_floor == 0
+        assert reg.names() == ("alpha", "beta", "zeta")
+
+
+# ---------------------------------------------------------------------------
+# FleetScheduler
+# ---------------------------------------------------------------------------
+
+
+class TestFleetScheduler:
+    def test_single_training_tenant_byte_identical(self, fleet_fixture):
+        from metis_tpu.core.types import dump_ranked_plans
+        from metis_tpu.planner import plan_hetero
+
+        cluster, profiles, model, config = fleet_fixture
+        offline = dump_ranked_plans(
+            plan_hetero(cluster, profiles, model, config).plans)
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("solo", model, config, quota_floor=2))
+        plan = sched.schedule()
+        alloc = plan.allocation("solo")
+        assert alloc.devices == cluster.total_devices
+        assert alloc.plan_json == offline
+
+    def test_single_inference_tenant_byte_identical(self, fleet_fixture):
+        from metis_tpu.inference.planner import (
+            dump_inference_plans,
+            plan_inference,
+        )
+
+        cluster, profiles, model, config = fleet_fixture
+        workload = _workload()
+        offline = dump_inference_plans(
+            plan_inference(cluster, profiles, model, config, workload),
+            workload)
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("solo", model, config, quota_floor=2,
+                               workload=workload))
+        alloc = sched.schedule().allocation("solo")
+        assert alloc.devices == cluster.total_devices
+        assert alloc.plan_json == offline
+
+    def test_admit_overcommit_typed_and_rolled_back(self, fleet_fixture):
+        cluster, profiles, model, config = fleet_fixture
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("a", model, config, quota_floor=6))
+        with pytest.raises(FleetOverCommitError) as ei:
+            sched.admit(TenantSpec("b", model, config, quota_floor=4))
+        assert ei.value.required == 10
+        assert ei.value.available == cluster.total_devices
+        # the rejected tenant must not linger in the registry
+        assert "b" not in sched.registry
+        assert len(sched.registry) == 1
+
+    def test_equal_priority_tie_break_is_name_deterministic(
+            self, fleet_fixture):
+        cluster, profiles, model, config = fleet_fixture
+
+        def carve(order):
+            sched = FleetScheduler(cluster, profiles)
+            for name in order:
+                sched.admit(TenantSpec(name, model, config, priority=1,
+                                       quota_floor=2))
+            return sched.schedule()
+
+        first = carve(["beta", "alpha"])
+        second = carve(["alpha", "beta"])
+        # registration order must not matter; repeated runs byte-identical
+        assert first.dump() == second.dump()
+        a, b = first.allocation("alpha"), first.allocation("beta")
+        # name ascending wins the tie: alpha draws first from the offer
+        assert a.node_indices < b.node_indices
+
+    def test_shrink_below_floors_raises_before_mutation(self, fleet_fixture):
+        cluster, profiles, model, config = fleet_fixture
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("a", model, config, priority=1,
+                               quota_floor=4))
+        sched.admit(TenantSpec("b", model, config, quota_floor=2))
+        before = sched.schedule().dump()
+        with pytest.raises(FleetOverCommitError):
+            sched.apply_delta(removed={"T4": 4, "A100": 2})
+        # failed delta left the fleet untouched
+        assert sched.cluster.total_devices == cluster.total_devices
+        assert sched.last_plan.dump() == before
+
+    def test_shrink_grow_round_trip_byte_identical(self, fleet_fixture):
+        cluster, profiles, model, config = fleet_fixture
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("hi", model, config, priority=1,
+                               quota_floor=2))
+        sched.admit(TenantSpec("lo", model, config, quota_floor=2))
+        baseline = sched.schedule().dump()
+        shrunk, _ = sched.apply_delta(removed={"T4": 2})
+        assert shrunk.dump() != baseline
+        healed, _ = sched.apply_delta(added={"T4": 2})
+        assert healed.dump() == baseline
+
+    def test_preemption_hits_lowest_priority_first(self, fleet_fixture):
+        cluster, profiles, model, config = fleet_fixture
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("hi", model, config, priority=1,
+                               quota_floor=2))
+        sched.admit(TenantSpec("lo", model, config, priority=0,
+                               quota_floor=2))
+        before = sched.schedule()
+        _, decisions = sched.apply_delta(removed={"T4": 2})
+        lo = decisions["lo"]
+        assert lo["preempted"] and lo["to_devices"] == 2
+        # the high-priority tenant kept (at least) its share
+        hi_before = before.allocation("hi").devices
+        assert sched.last_plan.allocation("hi").devices >= min(hi_before, 4)
+        # floors held for everyone
+        for alloc in sched.last_plan.allocations:
+            assert alloc.devices >= 2
+            assert alloc.feasible
+
+    def test_switch_decision_paths(self, fleet_fixture):
+        cluster, profiles, model, config = fleet_fixture
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("train", model, config, priority=1,
+                               quota_floor=2))
+        sched.admit(TenantSpec("serve", model, config, quota_floor=4,
+                               workload=_workload()))
+        sched.schedule()
+        _, decisions = sched.apply_delta(removed={"T4": 2})
+        for name, d in decisions.items():
+            kind = sched.registry.get(name).kind
+            if kind == "inference":
+                assert d["path"] == "reroute"
+            else:
+                assert d["path"] in ("migrate", "ckpt")
+                if d["path"] == "migrate":
+                    assert d["migration_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Serve-daemon tenant integration (in-process, no HTTP — transport is
+# covered by the tenant drill)
+# ---------------------------------------------------------------------------
+
+
+class TestServeTenants:
+    @pytest.fixture()
+    def service(self, fleet_fixture):
+        from metis_tpu.serve.daemon import PlanService
+
+        cluster, profiles, _, _ = fleet_fixture
+        return PlanService(cluster, profiles)
+
+    def test_tenant_plan_byte_identical_to_plan_query(self, fleet_fixture,
+                                                      service):
+        _, _, model, config = fleet_fixture
+        direct = service.plan_query(model, config)
+        service.tenant_register(TenantSpec("solo", model, config,
+                                           quota_floor=2))
+        routed = service.tenant_plan("solo")
+        assert routed["plans"] == direct["plans"]
+        assert routed["feasible"]
+        # second call answers from the tenant-tagged cache entry
+        assert service.tenant_plan("solo")["cached"]
+
+    def test_identical_reregister_is_idempotent(self, fleet_fixture,
+                                                service):
+        """The HTTP client retries POSTs on connection errors, so a
+        register whose response was dropped must answer the retry from
+        the current fleet plan instead of 400ing."""
+        _, _, model, config = fleet_fixture
+        spec = TenantSpec("solo", model, config, priority=1, quota_floor=2)
+        first = service.tenant_register(spec)
+        again = service.tenant_register(TenantSpec("solo", model, config,
+                                                   priority=1,
+                                                   quota_floor=2))
+        assert again["devices"] == first["devices"]
+        assert again["feasible"] == first["feasible"]
+        assert again["tenants_changed"] == []
+        # a DIFFERENT spec under the same name is a conflict, not a retry
+        with pytest.raises(TenantSpecError, match="already registered"):
+            service.tenant_register(TenantSpec("solo", model, config,
+                                               priority=2, quota_floor=2))
+
+    def test_unknown_tenant_typed_error(self, service):
+        with pytest.raises(TenantSpecError, match="no such tenant"):
+            service.tenant_plan("ghost")
+        with pytest.raises(TenantSpecError, match="no such tenant"):
+            service.tenant_status("ghost")
+
+    def test_delta_reports_and_invalidates_changed_tenants(
+            self, fleet_fixture, service):
+        _, _, model, config = fleet_fixture
+        service.tenant_register(TenantSpec("hi", model, config, priority=1,
+                                           quota_floor=2))
+        service.tenant_register(TenantSpec("lo", model, config,
+                                           quota_floor=2))
+        service.tenant_plan("hi")
+        service.tenant_plan("lo")
+        out = service.apply_cluster_delta(removed={"T4": 2})
+        assert out["tenants_changed"]
+        assert set(out["tenants_changed"]) <= {"hi", "lo"}
+        status = service.tenant_status()
+        assert status["cluster_devices"] == 6
+        for alloc in status["allocations"]:
+            assert alloc["feasible"] and alloc["devices"] >= 2
+
+    def test_overcommitting_delta_rejected_without_mutation(
+            self, fleet_fixture, service):
+        _, _, model, config = fleet_fixture
+        service.tenant_register(TenantSpec("a", model, config,
+                                           quota_floor=4))
+        service.tenant_register(TenantSpec("b", model, config,
+                                           quota_floor=2))
+        with pytest.raises(FleetOverCommitError):
+            service.apply_cluster_delta(removed={"T4": 4, "A100": 2})
+        # daemon cluster and fleet plan survived the rejected delta
+        assert service.cluster.total_devices == 8
+        assert service.tenant_status()["cluster_devices"] == 8
+
+
+# ---------------------------------------------------------------------------
+# The multi-tenant chaos drill
+# ---------------------------------------------------------------------------
+
+
+class TestTenantDrill:
+    def test_tenant_drill_smoke(self, tmp_path):
+        from tools.fleet_drill import run_tenant_drill
+
+        rep = run_tenant_drill(tmp_path, tenants=3, devices=16,
+                               chips_per_node=2, ticks=3,
+                               spot_rate_per_hr=0.9,
+                               return_rate_per_hr=0.9, seed=0)
+        assert rep["preempted_nodes"] > 0
+        assert rep["tenant_preempt_events"] > 0
+        assert rep["closing_state_identical"]
+        assert rep["tenant_slo_attainment_min"] > 0.0
+        assert 0.0 < rep["fleet_utilization_frac"] <= 1.0
+
+    def test_tenant_drill_deterministic(self, tmp_path):
+        from tools.fleet_drill import run_tenant_drill
+
+        kw = dict(tenants=3, devices=16, chips_per_node=2, ticks=3,
+                  spot_rate_per_hr=0.9, return_rate_per_hr=0.9, seed=0)
+        a = run_tenant_drill(tmp_path / "a", **kw)
+        b = run_tenant_drill(tmp_path / "b", **kw)
+        assert json.dumps(a, sort_keys=True) == json.dumps(b,
+                                                           sort_keys=True)
+
+    @pytest.mark.slow
+    def test_tenant_drill_default_scale(self, tmp_path):
+        from tools.fleet_drill import run_tenant_drill
+
+        rep = run_tenant_drill(tmp_path, tenants=3)
+        assert rep["closing_state_identical"]
+        assert rep["tenant_slo_attainment_min"] > 0.5
+
+
+def test_sched_events_registered_in_schema():
+    from tools.check_events_schema import EVENT_SCHEMA
+
+    assert EVENT_SCHEMA["tenant_admit"] == {"tenant", "priority", "kind",
+                                            "quota_floor"}
+    assert EVENT_SCHEMA["tenant_preempt"] == {"tenant", "from_devices",
+                                              "to_devices", "priority"}
+    assert EVENT_SCHEMA["tenant_replan"] == {"tenant", "devices", "path"}
+    assert "fleet_objective" in EVENT_SCHEMA

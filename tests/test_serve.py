@@ -366,6 +366,35 @@ class TestPlanService:
         with pytest.raises(ClusterSpecError):
             service.apply_cluster_delta({"T4": 99})
 
+    def test_close_wakes_blocked_long_poll(self, service):
+        """Regression: daemon shutdown must wake a client blocked in the
+        notifications long-poll immediately instead of holding it until
+        its timeout expires."""
+        import threading
+        import time
+
+        out = {}
+
+        def poll():
+            t0 = time.monotonic()
+            out["notes"] = service.notifications(since=0, timeout_s=30.0)
+            out["waited_s"] = time.monotonic() - t0
+
+        t = threading.Thread(target=poll)
+        t.start()
+        time.sleep(0.05)  # let the poller block on the condition
+        service.close()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "close() left the long-poll blocked"
+        assert out["notes"] == []
+        assert out["waited_s"] < 5.0
+        # closed service answers further polls immediately, and close()
+        # is idempotent
+        t0 = time.monotonic()
+        assert service.notifications(since=0, timeout_s=30.0) == []
+        assert time.monotonic() - t0 < 1.0
+        service.close()
+
     def test_stats_shape(self, small_workload, service):
         _, _, model, config = small_workload
         service.plan_query(model, config, top_k=5)

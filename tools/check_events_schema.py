@@ -94,6 +94,17 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "reshard_step": {"leaf"},
     "migration_fallback": {"reason"},
     "migration_complete": {"leaves", "stall_ms"},
+    # multi-tenant fleet scheduling (sched/fleet.py via serve/daemon.py,
+    # tools/fleet_drill.py --tenants): tenant_admit per admission,
+    # fleet_objective per re-partition (the scored winning carve),
+    # tenant_preempt when a capacity change shrinks a tenant's carve
+    # (never below its quota floor), tenant_replan for every carve
+    # change — carrying the migrate-vs-checkpoint-restore decision
+    "tenant_admit": {"tenant", "priority", "kind", "quota_floor"},
+    "fleet_objective": {"objective", "utilization_frac", "tenants",
+                        "shares_label", "cluster_devices"},
+    "tenant_preempt": {"tenant", "from_devices", "to_devices", "priority"},
+    "tenant_replan": {"tenant", "devices", "path"},
 }
 
 

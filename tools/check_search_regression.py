@@ -47,6 +47,13 @@ Four frozen invariants, any drift exits 1:
    deterministic (two dumps byte-identical) and match its checked-in
    golden (tools/search_inference_golden.json, recorded with
    ``--update-baseline``).
+9. **Multi-tenant placement golden.**  The fleet partition of a seeded
+   2-tenant fixture (a training tenant at priority 1 plus a serving
+   tenant at priority 0 on a 4-node mixed cluster, through
+   ``metis_tpu.sched.FleetScheduler``) must be run-to-run deterministic
+   (two ``FleetPlan.dump()`` byte-identical) and match its checked-in
+   golden (tools/search_sched_golden.json, recorded with
+   ``--update-baseline``).
 
 ``--throughput`` adds a performance gate: the batched whole-search
 plan-throughput on the parity workload, NORMALIZED by the scalar path's
@@ -102,6 +109,11 @@ SPOT_GOLDEN = Path(__file__).resolve().parent / "search_spot_golden.json"
 MIGRATION_GOLDEN = Path(__file__).resolve().parent / (
     "search_migration_golden.json")
 MIGRATION_FROM = ((1, 0, 5), (1, 5, 10))
+
+# Multi-tenant placement golden: the deterministic fleet partition of the
+# seeded 2-tenant fixture (FleetPlan.dump() sha + the headline carve),
+# recorded by ``--update-baseline``.
+SCHED_GOLDEN = Path(__file__).resolve().parent / "search_sched_golden.json"
 
 # Throughput baseline: batched + scalar plans/sec recorded on one host by
 # ``--update-baseline``; the check compares host-normalized numbers, so the
@@ -376,8 +388,80 @@ def run_checks(workers: int = 2) -> list[str]:
                 f"inference golden missing: {INFERENCE_GOLDEN} "
                 "(record one with --update-baseline)")
 
+        # sched leg: the 2-tenant fleet partition must be run-to-run
+        # deterministic and match its checked-in placement golden
+        sched_dump1, sched_plan = _run_sched_fixture()
+        sched_dump2, _ = _run_sched_fixture()
+        if sched_dump1 != sched_dump2:
+            problems.append(
+                "fleet partition is not run-to-run deterministic (two "
+                "FleetPlan dumps differ on the 2-tenant fixture)")
+        if SCHED_GOLDEN.exists():
+            golden = json.loads(SCHED_GOLDEN.read_text())
+            entry = _sched_fingerprint(sched_plan, sched_dump1)
+            for key in ("tenants", "shares_label", "objective",
+                        "utilization_frac", "devices", "dump_sha256"):
+                if golden.get(key) != entry[key]:
+                    problems.append(
+                        f"sched golden drift: {key} = {entry[key]}, "
+                        f"frozen golden is {golden.get(key)} "
+                        f"(re-record deliberately with --update-baseline)")
+        else:
+            problems.append(
+                f"sched golden missing: {SCHED_GOLDEN} "
+                "(record one with --update-baseline)")
+
         problems.extend(_check_grid_oracle(cluster, store))
     return problems
+
+
+def _run_sched_fixture():
+    """(dump, plan) of the seeded 2-tenant fleet partition: a priority-1
+    training tenant and a priority-0 serving tenant sharing a 4-node
+    mixed A100/T4 cluster through the fleet scheduler."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.inference.workload import InferenceWorkload
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+    from metis_tpu.sched import FleetScheduler, TenantSpec
+    from metis_tpu.testing import PARITY_INFERENCE
+
+    model = tiny_test_model()
+    cluster = ClusterSpec.of(("A100", 2, 2), ("T4", 2, 2))
+    profiles = synthesize_profiles(model, ["A100", "T4"],
+                                   tps=[1, 2], bss=[1, 2, 4])
+    cfg = SearchConfig(gbs=16, max_profiled_tp=2, max_profiled_bs=4)
+    sched = FleetScheduler(cluster, profiles)
+    sched.admit(TenantSpec("alpha", model, cfg, priority=1, quota_floor=2))
+    sched.admit(TenantSpec("beta", model, cfg, priority=0, quota_floor=4,
+                           workload=InferenceWorkload(**PARITY_INFERENCE)))
+    plan = sched.schedule()
+    return plan.dump(), plan
+
+
+def _sched_fingerprint(plan, dump: str) -> dict:
+    """Golden entry for the 2-tenant fleet partition."""
+    import hashlib
+
+    return {
+        "workload": "2-tenant fleet fixture (2xA100 + 2xT4 nodes of 2, "
+                    "tiny GPT; training 'alpha' prio 1 floor 2 + serving "
+                    "'beta' prio 0 floor 4)",
+        "tenants": [a.tenant for a in plan.allocations],
+        "shares_label": plan.shares_label,
+        "objective": round(plan.objective, 9),
+        "utilization_frac": round(plan.utilization_frac, 9),
+        "devices": {a.tenant: a.devices for a in plan.allocations},
+        "dump_sha256": hashlib.sha256(dump.encode()).hexdigest(),
+    }
+
+
+def record_sched_golden() -> dict:
+    """Run the 2-tenant fleet partition and write its placement golden."""
+    dump, plan = _run_sched_fixture()
+    entry = _sched_fingerprint(plan, dump)
+    SCHED_GOLDEN.write_text(json.dumps(entry, indent=2) + "\n")
+    return entry
 
 
 def _run_inference_search(cluster, store, model):
@@ -653,6 +737,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"migration golden written: {mig_golden}")
         inf_golden = record_inference_golden()
         print(f"inference golden written: {inf_golden}")
+        sched_golden = record_sched_golden()
+        print(f"sched golden written: {sched_golden}")
         entry = measure_throughput()
         THROUGHPUT_BASELINE.write_text(json.dumps(entry, indent=2) + "\n")
         print(f"throughput baseline written: {entry}")
@@ -670,7 +756,8 @@ def main(argv: list[str] | None = None) -> int:
           f"batched == scalar oracle, time grid matches, overlap-off "
           f"inert + overlap golden matches, spot-off inert + spot golden "
           f"matches, migration-off inert + migration golden matches, "
-          f"inference search deterministic + golden matches)")
+          f"inference search deterministic + golden matches, fleet "
+          f"partition deterministic + sched golden matches)")
     return 0
 
 

@@ -41,8 +41,23 @@ Two legs, both CPU-only and fully deterministic for a given ``--seed``:
    (``preemption`` before its ``recovery_complete``, ``spot_return``
    before the grow's).
 
+With ``--tenants N`` the drill runs the **multi-tenant leg**
+(``run_tenant_drill``) instead: N >= 3 tenants — steady training at two
+priorities plus a diurnal inference service — share one fleet through the
+``metis_tpu.sched`` fleet scheduler behind the same live daemon.  Seeded
+Poisson spot evictions and returns hit the shared capacity; every tick
+asserts that each surviving tenant holds a valid plan at or above its
+quota floor, the event stream is causally ordered (admits before chaos;
+each ``tenant_preempt`` between its capacity change's re-partition
+``fleet_objective`` and a ``tenant_replan`` for the same tenant), and the
+closing fleet state after the drain tick is byte-identical to the
+pre-chaos baseline.  Headlines: ``fleet_utilization_frac`` and per-tenant
+SLO attainment (training: planned every tick; inference: planned AND the
+carve's throughput covers the tick's diurnal demand).
+
 Run directly (``python tools/fleet_drill.py``), via the planner CLI
-(``metis-tpu chaos --fleet``), or through ``bench.py``'s fleet section.
+(``metis-tpu chaos --fleet``), or through ``bench.py``'s fleet/sched
+sections.
 """
 from __future__ import annotations
 
@@ -107,6 +122,14 @@ def fleet_cluster(devices: int = 256, chips_per_node: int = 32,
 def fleet_search_config(spot_recover_s: float = 30.0) -> SearchConfig:
     return SearchConfig(gbs=256, max_profiled_tp=4, max_profiled_bs=8,
                         use_spot_model=True, spot_recover_s=spot_recover_s)
+
+
+def tenant_model() -> ModelSpec:
+    """Per-tenant planner-scale model for the multi-tenant leg — smaller
+    than :func:`fleet_model` because every re-partition candidate costs
+    one planner search per tenant sub-cluster."""
+    return ModelSpec(name="gpt-tenant", num_layers=8, hidden_size=1024,
+                     sequence_length=512, vocab_size=32000, num_heads=8)
 
 
 def _best_recovery_ms(resp: dict) -> float:
@@ -439,6 +462,239 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
     return report
 
 
+def run_tenant_drill(tmp_dir: str | Path, *, tenants: int = 3,
+                     devices: int = 32, chips_per_node: int = 4,
+                     ticks: int = 8, tick_seconds: float = 3600.0,
+                     spot_rate_per_hr: float = 0.35,
+                     return_rate_per_hr: float = 0.5,
+                     spot_recover_s: float = 30.0, seed: int = 0,
+                     verbose: bool = False) -> dict:
+    """Multi-tenant preemption chaos against a live daemon's fleet
+    scheduler.  Returns the tenant report dict; raises AssertionError
+    when a quota or recovery guarantee is violated."""
+    from metis_tpu.inference.workload import InferenceWorkload
+    from metis_tpu.profiles.synthetic import synthesize_profiles
+    from metis_tpu.sched import TenantSpec
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+    assert tenants >= 3, "the multi-tenant drill needs >= 3 tenants"
+    tmp_dir = Path(tmp_dir)
+    tmp_dir.mkdir(parents=True, exist_ok=True)
+    events_path = tmp_dir / "tenant_events.jsonl"
+    cluster = fleet_cluster(devices, chips_per_node, spot_rate_per_hr)
+    n_reserved = sum(1 for n in cluster.nodes
+                     if n.device_type == RESERVED_TYPE)
+    n_spot = sum(1 for n in cluster.nodes if n.device_type == SPOT_TYPE)
+    # floors: one node per training tenant, two for the inference tenant
+    # (disaggregated serving needs separate prefill/decode pools); the
+    # reserved pool alone must cover the floors so no Poisson eviction
+    # pattern can over-commit them
+    floor_nodes = tenants + 1
+    assert floor_nodes <= n_reserved, \
+        f"{tenants} tenants need {floor_nodes} reserved nodes of quota " \
+        f"floor, fleet has {n_reserved} — raise --devices"
+    model = tenant_model()
+    profiles = synthesize_profiles(model, [RESERVED_TYPE, SPOT_TYPE],
+                                   tps=[1, 2, 4], bss=[1, 2, 4, 8])
+    floor = chips_per_node
+    base_cfg = SearchConfig(gbs=32, max_profiled_tp=4, max_profiled_bs=8,
+                            use_spot_model=True,
+                            spot_recover_s=spot_recover_s)
+    # the inference tenant is registered at its diurnal peak (the carve
+    # must handle the worst tick); attainment compares the carve's
+    # throughput against each tick's raised-cosine demand
+    peak_rps = 2.0
+    workload = InferenceWorkload(
+        arrival_rate_rps=peak_rps, prompt_len=256, output_len=64,
+        slo_ttft_p99_ms=4000.0, slo_tpot_p99_ms=200.0)
+    specs = [
+        TenantSpec("train-hi", model, base_cfg, priority=2,
+                   quota_floor=floor),
+        TenantSpec("serve-web", model, base_cfg, priority=1,
+                   quota_floor=2 * floor, workload=workload),
+        TenantSpec("train-lo", model,
+                   dataclasses.replace(base_cfg, gbs=16), priority=0,
+                   quota_floor=floor),
+    ]
+    for i in range(3, tenants):
+        specs.append(TenantSpec(
+            f"train-x{i}", model, dataclasses.replace(base_cfg, gbs=16),
+            priority=0, quota_floor=floor))
+    floors = {s.name: s.quota_floor for s in specs}
+
+    def _diurnal(tick: int) -> float:
+        phase = 2.0 * math.pi * tick / max(ticks, 1)
+        return peak_rps * (0.35 + 0.325 * (1.0 - math.cos(phase)))
+
+    def _strip(resp: dict) -> dict:
+        # drop the per-request fields (cached/serve_ms) so the closing
+        # byte-identity compares fleet state, not cache temperature
+        return {k: resp[k] for k in
+                ("fingerprint", "tenant", "kind", "devices",
+                 "node_indices", "feasible", "plans", "utility",
+                 "utility_frac")}
+
+    rng = random.Random(seed)
+    hours = tick_seconds / 3600.0
+    p_evict = 1.0 - math.exp(-spot_rate_per_hr * hours)
+    p_return = 1.0 - math.exp(-return_rate_per_hr * hours)
+
+    trajectory: list[dict] = []
+    attained = {s.name: 0 for s in specs}
+    utils: list[float] = []
+    with EventLog(events_path) as events:
+        service = PlanService(cluster, profiles, events=events)
+        server, thread, address = serve_in_thread(service)
+        try:
+            client = PlanServiceClient(address)
+            for s in specs:
+                resp = client.tenant_register(s)
+                assert resp["feasible"], \
+                    f"tenant {s.name} admitted infeasible on the " \
+                    "healthy fleet"
+
+            def _fleet_state() -> str:
+                status = client.tenant_status()
+                plans = {s.name: _strip(client.tenant_plan(s.name))
+                         for s in specs}
+                return json.dumps({"status": status, "plans": plans},
+                                  sort_keys=True)
+
+            baseline = _fleet_state()
+            live_spot = n_spot
+            n_deltas = preemptions = returns = 0
+            # final drain tick returns every evicted node: the closing
+            # fleet state must be byte-identical to the baseline
+            for tick in range(ticks + 1):
+                lost_nodes = returned_nodes = 0
+                if tick < ticks:
+                    for _ in range(live_spot):
+                        if rng.random() < p_evict:
+                            lost_nodes += 1
+                    for _ in range(n_spot - live_spot):
+                        if rng.random() < p_return:
+                            returned_nodes += 1
+                else:
+                    returned_nodes = n_spot - live_spot
+                if lost_nodes:
+                    lost = {SPOT_TYPE: lost_nodes * chips_per_node}
+                    events.emit("preemption", step=tick, tier="spot",
+                                lost=f"{SPOT_TYPE}={lost[SPOT_TYPE]}")
+                    client.cluster_delta(removed=lost)
+                    live_spot -= lost_nodes
+                    n_deltas += 1
+                    preemptions += lost_nodes
+                if returned_nodes:
+                    back = {SPOT_TYPE: returned_nodes * chips_per_node}
+                    events.emit("spot_return", step=tick,
+                                returned=f"{SPOT_TYPE}={back[SPOT_TYPE]}")
+                    client.cluster_delta(added=back)
+                    live_spot += returned_nodes
+                    n_deltas += 1
+                    returns += returned_nodes
+
+                status = client.tenant_status()
+                allocs = {a["tenant"]: a for a in status["allocations"]}
+                for s in specs:
+                    a = allocs.get(s.name)
+                    assert a is not None and a["feasible"], \
+                        f"tick {tick}: tenant {s.name} has no valid plan"
+                    assert a["devices"] >= floors[s.name], \
+                        f"tick {tick}: tenant {s.name} below quota " \
+                        f"floor ({a['devices']} < {floors[s.name]})"
+                demand = _diurnal(tick)
+                for s in specs:
+                    if s.workload is None:
+                        ok = allocs[s.name]["feasible"]
+                    else:
+                        served = client.tenant_plan(s.name)
+                        ok = (served["feasible"]
+                              and served["utility"] >= demand)
+                    attained[s.name] += 1 if ok else 0
+                util = status["utilization_frac"]
+                utils.append(util)
+                n_devices = status["cluster_devices"]
+                events.emit("fleet_tick", tick=tick, devices=n_devices,
+                            goodput_frac=round(util, 6))
+                trajectory.append({
+                    "tick": tick, "devices": n_devices,
+                    "utilization_frac": util,
+                    "demand_rps": round(demand, 4),
+                    "lost_nodes": lost_nodes,
+                    "returned_nodes": returned_nodes,
+                })
+            closing = _fleet_state()
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+
+    # -- the drill's guarantees -------------------------------------------
+    assert preemptions > 0, \
+        "seeded chaos produced no evictions — raise --ticks or --spot-rate"
+    assert trajectory[-1]["devices"] == devices, \
+        "fleet did not drain back to full capacity"
+    assert closing == baseline, \
+        "closing fleet state diverged from the pre-chaos baseline"
+
+    # -- schema-valid, causally ordered event stream ----------------------
+    evs = read_events(events_path)
+    problems = validate_events(evs)
+    assert not problems, "event schema problems:\n  " + "\n  ".join(problems)
+    names = [e["event"] for e in evs]
+    admits = [i for i, e in enumerate(evs) if e["event"] == "tenant_admit"]
+    assert len(admits) == tenants, \
+        f"expected {tenants} tenant_admit events, saw {len(admits)}"
+    first_cap = next((i for i, e in enumerate(evs)
+                      if e["event"] in ("preemption", "spot_return")),
+                     len(evs))
+    assert max(admits) < first_cap, "a tenant_admit logged after chaos began"
+    n_preempt_events = names.count("tenant_preempt")
+    assert n_preempt_events > 0, \
+        "spot evictions never preempted a tenant's carve"
+    for i, e in enumerate(evs):
+        if e["event"] != "tenant_preempt":
+            continue
+        prior_cap = [j for j in range(i) if evs[j]["event"]
+                     in ("preemption", "spot_return")]
+        assert prior_cap, "tenant_preempt with no prior capacity change"
+        assert any(evs[j]["event"] == "fleet_objective"
+                   for j in range(prior_cap[-1], i)), \
+            "tenant_preempt not preceded by its re-partition's " \
+            "fleet_objective"
+        assert any(evs[j]["event"] == "tenant_replan"
+                   and evs[j]["tenant"] == e["tenant"]
+                   for j in range(i + 1, len(evs))), \
+            f"preempted tenant {e['tenant']} was never replanned"
+        assert e["to_devices"] >= floors[e["tenant"]], \
+            f"tenant_preempt drove {e['tenant']} below its quota floor"
+
+    slo = {name: attained[name] / (ticks + 1) for name in attained}
+    report = {
+        "tenants": [s.name for s in specs],
+        "devices": devices,
+        "ticks": ticks,
+        "seed": seed,
+        "spot_rate_per_hr": spot_rate_per_hr,
+        "return_rate_per_hr": return_rate_per_hr,
+        "preempted_nodes": preemptions,
+        "returned_nodes": returns,
+        "cluster_deltas": n_deltas,
+        "tenant_preempt_events": n_preempt_events,
+        "fleet_utilization_frac": sum(utils) / len(utils),
+        "min_utilization_frac": min(utils),
+        "tenant_slo_attainment": slo,
+        "tenant_slo_attainment_min": min(slo.values()),
+        "closing_state_identical": True,
+        "trajectory": trajectory,
+    }
+    if verbose:
+        print(json.dumps({k: v for k, v in report.items()
+                          if k != "trajectory"}, indent=2))
+    return report
+
+
 def run_supervisor_spot_drill(tmp_dir: str | Path, steps: int = 8) -> dict:
     """Scripted spot eviction + return under the training supervisor:
     shrink -> replan -> restore, then grow -> replan, causally ordered."""
@@ -489,15 +745,23 @@ def run_supervisor_spot_drill(tmp_dir: str | Path, steps: int = 8) -> dict:
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--devices", type=int, default=256,
-                   help="fleet size (half reserved v6e, half spot v5e)")
-    p.add_argument("--chips-per-node", type=int, default=32)
-    p.add_argument("--ticks", type=int, default=24)
+    p.add_argument("--tenants", type=int, default=0, metavar="N",
+                   help="run the multi-tenant scheduler drill with N "
+                        "tenants instead of the single-job fleet legs")
+    p.add_argument("--devices", type=int, default=None,
+                   help="fleet size, half reserved v6e + half spot v5e "
+                        "(default: 256, or 32 with --tenants)")
+    p.add_argument("--chips-per-node", type=int, default=None,
+                   help="(default: 32, or 4 with --tenants)")
+    p.add_argument("--ticks", type=int, default=None,
+                   help="(default: 24, or 8 with --tenants)")
     p.add_argument("--tick-seconds", type=float, default=3600.0)
-    p.add_argument("--spot-rate", type=float, default=0.05,
-                   help="per-node spot preemption rate (events/hr)")
-    p.add_argument("--return-rate", type=float, default=0.35,
-                   help="per-evicted-node return rate (events/hr)")
+    p.add_argument("--spot-rate", type=float, default=None,
+                   help="per-node spot preemption rate (events/hr; "
+                        "default: 0.05, or 0.35 with --tenants)")
+    p.add_argument("--return-rate", type=float, default=None,
+                   help="per-evicted-node return rate (events/hr; "
+                        "default: 0.35, or 0.5 with --tenants)")
     p.add_argument("--spot-recover-s", type=float, default=30.0)
     p.add_argument("--no-migrate", action="store_true",
                    help="checkpoint-restore-only accounting (the PR-10 "
@@ -516,12 +780,45 @@ def main(argv: list[str] | None = None) -> int:
                         "(bench.py's fleet section consumes this)")
     args = p.parse_args(argv)
 
+    # the two legs run at different natural scales: the single-job fleet
+    # simulation is a 256-device pool, the multi-tenant leg pays one
+    # planner search per tenant sub-cluster per re-partition candidate
+    tenant_mode = args.tenants > 0
+    devices = args.devices if args.devices is not None \
+        else (32 if tenant_mode else 256)
+    chips_per_node = args.chips_per_node if args.chips_per_node is not None \
+        else (4 if tenant_mode else 32)
+    ticks = args.ticks if args.ticks is not None \
+        else (8 if tenant_mode else 24)
+    spot_rate = args.spot_rate if args.spot_rate is not None \
+        else (0.35 if tenant_mode else 0.05)
+    return_rate = args.return_rate if args.return_rate is not None \
+        else (0.5 if tenant_mode else 0.35)
+
+    def _run_tenants(d: str) -> None:
+        rep = run_tenant_drill(
+            d, tenants=args.tenants, devices=devices,
+            chips_per_node=chips_per_node, ticks=ticks,
+            tick_seconds=args.tick_seconds, spot_rate_per_hr=spot_rate,
+            return_rate_per_hr=return_rate,
+            spot_recover_s=args.spot_recover_s, seed=args.seed,
+            verbose=True)
+        print(f"tenant drill OK: {len(rep['tenants'])} tenants, "
+              f"{rep['preempted_nodes']} evictions, utilization "
+              f"{rep['fleet_utilization_frac']:.4f}, min SLO attainment "
+              f"{rep['tenant_slo_attainment_min']:.4f}")
+        if args.report:
+            Path(args.report).write_text(json.dumps({"tenants": rep}))
+
     def _run(d: str) -> None:
+        if tenant_mode:
+            _run_tenants(d)
+            return
         rep = run_fleet_drill(
-            d, devices=args.devices, chips_per_node=args.chips_per_node,
-            ticks=args.ticks, tick_seconds=args.tick_seconds,
-            spot_rate_per_hr=args.spot_rate,
-            return_rate_per_hr=args.return_rate,
+            d, devices=devices, chips_per_node=chips_per_node,
+            ticks=ticks, tick_seconds=args.tick_seconds,
+            spot_rate_per_hr=spot_rate,
+            return_rate_per_hr=return_rate,
             spot_recover_s=args.spot_recover_s, seed=args.seed,
             migrate=not args.no_migrate, verbose=True)
         print(f"fleet drill OK: {rep['preempted_nodes']} evictions, "
