@@ -197,6 +197,12 @@ class FleetScheduler:
         spec = self.registry.remove(name)
         self._stores.pop(name, None)
         self._baseline.pop(name, None)
+        # memo keys are (tenant, node shapes) with no model/config hash;
+        # remove + re-register is the supported way to change a tenant's
+        # spec, so the re-admitted tenant must never inherit plans
+        # memoized for the old one
+        for key in [k for k in self._memo if k[0] == name]:
+            del self._memo[key]
         return spec
 
     # -- partitioning (pure helpers) --------------------------------------
@@ -445,8 +451,10 @@ class FleetScheduler:
         (with the migrate-vs-checkpoint decision for training tenants)
         when its carve changed at all.  Returns the new fleet plan plus
         the per-tenant switch decisions.  Raises
-        :class:`FleetOverCommitError` — BEFORE mutating fleet state —
-        when the surviving capacity cannot cover the quota floors."""
+        :class:`FleetOverCommitError` — leaving fleet state untouched —
+        when the surviving capacity cannot cover the quota floors,
+        whether the floor sum fails upfront or node granularity defeats
+        a floor during assignment."""
         delta = ClusterDelta(added=dict(added or {}),
                              removed=dict(removed or {}))
         new_cluster = delta.apply(self.cluster, full=self.full_cluster)
@@ -458,8 +466,16 @@ class FleetScheduler:
                 required=floors, available=new_cluster.total_devices)
         old_plan = self.last_plan
         old_cluster = self.cluster
+        # the floor-sum pre-check above is necessary but not sufficient:
+        # node granularity can still defeat a floor inside _assign, so
+        # commit the new topology only once scheduling on it succeeds
         self.cluster = new_cluster
-        plan = self.schedule()
+        try:
+            plan = self.schedule()
+        except Exception:
+            self.cluster = old_cluster
+            self.last_plan = old_plan
+            raise
         decisions: dict[str, dict] = {}
         for t in self.registry.preemption_order():
             old_alloc = old_plan.allocation(t.name) if old_plan else None

@@ -685,7 +685,15 @@ class PlanService:
                 }
             old_plan = sched.last_plan
             sched.admit(spec)
-            plan = sched.schedule()
+            try:
+                plan = sched.schedule()
+            except Exception:
+                # admission is atomic: node granularity can defeat a
+                # floor the admit-time pre-check accepted, and a 400
+                # must not leave the tenant registered (every later
+                # schedule/delta would keep failing on it)
+                sched.remove(spec.name)
+                raise
         changed = self._invalidate_changed_tenants(old_plan, plan)
         alloc = plan.allocation(spec.name)
         note = self._push_note({
@@ -738,12 +746,17 @@ class PlanService:
         with self._search_lock:
             plan = sched.last_plan or sched.schedule()
             alloc = plan.allocation(name)
-            sub = (sched.cluster.subset(alloc.node_indices)
-                   if alloc and alloc.node_indices else sched.cluster)
+            node_ix = alloc.node_indices if alloc else ()
+            sub = (sched.cluster.subset(node_ix) if node_ix
+                   else sched.cluster)
         qfp = query_fingerprint(spec.model, sub, spec.config,
                                 calibration=self.calibration,
                                 workload=spec.workload)
-        key = f"tenant/{name}/{qfp}"
+        # the key names the actual carve: an empty/missing allocation
+        # fingerprints against the whole cluster above, and without the
+        # carve marker that key would collide with a full-cluster grant
+        carve = ",".join(map(str, node_ix)) if node_ix else "empty"
+        key = f"tenant/{name}/{carve}/{qfp}"
         self.counters.inc("serve.requests")
         self.events.emit("plan_request", fingerprint=qfp,
                          model=spec.model.name, gbs=spec.config.gbs,

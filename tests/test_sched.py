@@ -235,6 +235,50 @@ class TestFleetScheduler:
             assert alloc.devices >= 2
             assert alloc.feasible
 
+    def test_remove_purges_memo_for_reregistered_spec(self, fleet_fixture):
+        """remove + re-register is the supported way to change a tenant's
+        spec; the memo is keyed on (name, node shapes) only, so a stale
+        entry would silently serve the OLD spec's plans."""
+        cluster, profiles, model, config = fleet_fixture
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("solo", model, config, quota_floor=2))
+        training = sched.schedule().allocation("solo")
+        assert training.kind == "training" and training.feasible
+        sched.remove("solo")
+        workload = _workload()
+        sched.admit(TenantSpec("solo", model, config, quota_floor=2,
+                               workload=workload))
+        routed = sched.schedule().allocation("solo")
+        assert routed.kind == "inference" and routed.feasible
+        from metis_tpu.inference.planner import (
+            dump_inference_plans,
+            plan_inference,
+        )
+        offline = dump_inference_plans(
+            plan_inference(cluster, profiles, model, config, workload),
+            workload)
+        assert routed.plan_json == offline
+        assert routed.plan_json != training.plan_json
+
+    def test_granularity_rejected_delta_leaves_state_untouched(
+            self, fleet_fixture):
+        """Floors 3+3 on 2-device nodes: a shrink to 6 devices passes the
+        floor-sum pre-check but node granularity defeats tenant b's floor
+        inside _assign — the failed delta must not commit the shrunk
+        cluster (stale last_plan indices would then break every tenant
+        query)."""
+        cluster, profiles, model, config = fleet_fixture
+        sched = FleetScheduler(cluster, profiles)
+        sched.admit(TenantSpec("a", model, config, quota_floor=3))
+        sched.admit(TenantSpec("b", model, config, quota_floor=3))
+        before = sched.schedule().dump()
+        with pytest.raises(FleetOverCommitError):
+            sched.apply_delta(removed={"T4": 2})
+        assert sched.cluster.total_devices == cluster.total_devices
+        assert sched.last_plan.dump() == before
+        # the scheduler keeps working after the rejected delta
+        assert sched.schedule().dump() == before
+
     def test_switch_decision_paths(self, fleet_fixture):
         cluster, profiles, model, config = fleet_fixture
         sched = FleetScheduler(cluster, profiles)
@@ -334,6 +378,69 @@ class TestServeTenants:
         # daemon cluster and fleet plan survived the rejected delta
         assert service.cluster.total_devices == 8
         assert service.tenant_status()["cluster_devices"] == 8
+
+    def test_granularity_rejected_delta_keeps_tenants_serving(
+            self, fleet_fixture, service):
+        """The shrink passes the floor-sum pre-check but fails on node
+        granularity inside the scheduler: both the daemon cluster AND
+        the scheduler cluster must survive, so tenant queries keep
+        resolving against the topology their plan was carved from."""
+        _, _, model, config = fleet_fixture
+        service.tenant_register(TenantSpec("a", model, config,
+                                           quota_floor=3))
+        service.tenant_register(TenantSpec("b", model, config,
+                                           quota_floor=3))
+        before = service.tenant_plan("a")
+        with pytest.raises(FleetOverCommitError):
+            service.apply_cluster_delta(removed={"T4": 2})
+        assert service.cluster.total_devices == 8
+        assert service.sched.cluster.total_devices == 8
+        after = service.tenant_plan("a")
+        assert after["plans"] == before["plans"]
+        assert after["node_indices"] == before["node_indices"]
+
+    def test_register_rolled_back_when_granularity_defeats_floor(
+            self, fleet_fixture, service):
+        """Floors 3+5 sum to exactly the fleet's 8 devices, so admission
+        control accepts tenant b — but 2-device nodes leave b at 4.  The
+        400 must roll the admission back, or every later schedule and
+        delta would keep failing on the half-admitted tenant."""
+        _, _, model, config = fleet_fixture
+        service.tenant_register(TenantSpec("a", model, config,
+                                           quota_floor=3))
+        with pytest.raises(FleetOverCommitError):
+            service.tenant_register(TenantSpec("b", model, config,
+                                               quota_floor=5))
+        assert "b" not in service.sched.registry
+        status = service.tenant_status()
+        assert status["tenants"] == ["a"]
+        # the fleet keeps accepting satisfiable tenants afterwards
+        out = service.tenant_register(TenantSpec("b", model, config,
+                                                 quota_floor=4))
+        assert out["feasible"]
+
+    def test_empty_carve_cache_key_never_fingerprints_full_fleet(
+            self, fleet_fixture, service):
+        """A tenant whose allocation is empty used to fingerprint its
+        query against the WHOLE cluster, colliding with a hypothetical
+        full-cluster grant; the key now carries an explicit carve
+        marker."""
+        _, _, model, config = fleet_fixture
+        service.tenant_register(TenantSpec("big", model, config,
+                                           quota_floor=8, quota_ceiling=8))
+        service.tenant_register(TenantSpec("tiny", model, config))
+        starved = service.tenant_plan("tiny")
+        assert starved["devices"] == 0 and not starved["feasible"]
+        assert starved["plans"] is None
+        tiny_keys = [k for k in service.cache.keys()
+                     if k.startswith("tenant/tiny/")]
+        assert tiny_keys and all("/empty/" in k for k in tiny_keys)
+        # once the starving tenant leaves, tiny's full grant must not be
+        # served from the stale empty-carve entry
+        service.tenant_remove("big")
+        granted = service.tenant_plan("tiny")
+        assert granted["devices"] == 8 and granted["feasible"]
+        assert granted["plans"] is not None
 
 
 # ---------------------------------------------------------------------------
