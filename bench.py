@@ -510,6 +510,117 @@ def scale_search_256(record: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# scale points: 1024/4096 devices under symmetry collapse + warm replay
+# ---------------------------------------------------------------------------
+
+# PR-6 headline on its scale workload — the ">= 10x" yardstick the warm
+# plans_per_sec at 1024 devices is measured against
+PR6_PLANS_PER_SEC = 4340.0
+
+
+def _scale_sym_section(record: dict, key: str, devices: int,
+                       gbs: int) -> None:
+    """One symmetric-scale point: cold + warm search timings under
+    symmetry collapse, byte-identity vs the uncollapsed ranking, and the
+    serve daemon's incremental replan after a one-node delta (two tenants
+    split the fleet; the delta hits only the second tenant's carve)."""
+    import dataclasses as _dc
+    import time as _time
+
+    from metis_tpu.core.trace import Counters
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.planner.api import make_search_state, plan_hetero
+    from metis_tpu.sched.tenant import TenantSpec
+    from metis_tpu.search.inter_stage import sequence_symmetry_stats
+    from metis_tpu.serve.daemon import PlanService
+    from metis_tpu.testing import symmetric_scale_workload
+
+    cpus = os.cpu_count() or 1
+    if cpus < 2:
+        record[key] = {
+            "devices": devices, "cpus": cpus,
+            "skipped_reason": f"needs >= 2 cpus for a meaningful search "
+                              f"timing, have {cpus}"}
+        return
+    cluster, profiles, model, config = symmetric_scale_workload(
+        devices, gbs=gbs)
+    counters = Counters()
+    ctx = make_search_state(cluster, profiles, model, config,
+                            counters=counters)
+    t0 = _time.perf_counter()
+    res = plan_hetero(cluster, profiles, model, config,
+                      search_state=ctx, top_k=10)
+    cold_s = _time.perf_counter() - t0
+    hits0, misses0 = ctx.sym_hits, ctx.sym_misses
+    t0 = _time.perf_counter()
+    res = plan_hetero(cluster, profiles, model, config,
+                      search_state=ctx, top_k=10)
+    warm_s = _time.perf_counter() - t0
+    total_seqs, distinct_seqs = sequence_symmetry_stats(
+        cluster.device_types, ctx._symmetry or {})
+    cfg_off = _dc.replace(config, symmetry_collapse=False)
+    t0 = _time.perf_counter()
+    off = plan_hetero(cluster, profiles, model, cfg_off, top_k=10)
+    off_s = _time.perf_counter() - t0
+
+    # incremental replan through the daemon: alpha holds the AX/AY half,
+    # beta the BX/BY half; dropping the whole BY pool (a quarter of the
+    # nodes) re-costs only beta, which replans feasibly on BX alone —
+    # alpha's warm carve state survives and is reused
+    svc = PlanService(cluster, profiles)
+    half = devices // 2
+    svc.tenant_register(TenantSpec("alpha", model, config, priority=1,
+                                   quota_ceiling=half))
+    svc.tenant_register(TenantSpec("beta", model, config,
+                                   quota_ceiling=half))
+    by_devices = sum(n.num_devices for n in cluster.nodes
+                     if n.device_type == "BY")
+    t0 = _time.perf_counter()
+    svc.apply_cluster_delta(removed={"BY": by_devices})
+    replan_ms = (_time.perf_counter() - t0) * 1e3
+    reused = svc.counters.get("replan.incremental.reused")
+    recosted = svc.counters.get("replan.incremental.recosted")
+    replan_feasible = all(a.feasible
+                          for a in svc.sched.last_plan.allocations)
+    svc.close()
+
+    pps = res.num_costed / warm_s
+    record[key] = {
+        "devices": devices, "nodes": len(cluster.nodes), "types": 4,
+        "gbs": config.gbs, "cpus": cpus,
+        "plans_costed": res.num_costed,
+        "cold_search_s": round(cold_s, 3),
+        "sub_second_cold": cold_s < 1.0,
+        "warm_search_s": round(warm_s, 4),
+        "plans_per_sec": round(pps, 1),
+        "plans_per_sec_vs_pr6": round(pps / PR6_PLANS_PER_SEC, 2),
+        "symmetry_collapse_frac": (
+            round(1.0 - distinct_seqs / total_seqs, 4)
+            if total_seqs else 0.0),
+        "symmetry_replay_frac": (
+            round(hits0 / (hits0 + misses0), 4)
+            if hits0 + misses0 else 0.0),
+        "symmetry_speedup_cold": round(off_s / cold_s, 2),
+        "uncollapsed_byte_identical": (
+            dump_ranked_plans(off.plans) == dump_ranked_plans(res.plans)),
+        "incremental_replan_ms": round(replan_ms, 1),
+        "replan_feasible": replan_feasible,
+        "replan_reused_candidates": reused,
+        "replan_recosted_candidates": recosted,
+    }
+
+
+def scale_search_1024(record: dict) -> None:
+    from metis_tpu.testing import SCALE_GBS
+
+    _scale_sym_section(record, "scale_search_1024", 1024, SCALE_GBS)
+
+
+def scale_search_4096(record: dict) -> None:
+    _scale_sym_section(record, "scale_search_4096", 4096, 16384)
+
+
+# ---------------------------------------------------------------------------
 # north-star scenario: GPT-2.7B-class on v4-32 + v5e-16 (BASELINE.md)
 # ---------------------------------------------------------------------------
 
@@ -1792,6 +1903,8 @@ def main() -> None:
     recorder.run("scale_search", scale_search, record)
     recorder.run("parallel_search", parallel_search, record)
     recorder.run("scale_search_256", scale_search_256, record)
+    recorder.run("scale_search_1024", scale_search_1024, record)
+    recorder.run("scale_search_4096", scale_search_4096, record)
     recorder.run("northstar", northstar, record)
     recorder.run("validation", validation_error, record)
     recorder.run("resilience", resilience_bench, record)

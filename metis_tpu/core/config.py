@@ -187,6 +187,23 @@ class SearchConfig:
     migrate_from: tuple = ()
     migration_bw_gbps: float = 100.0
     migration_amortize_steps: int = 1000
+    # Cost-tensor backend for the batched costing path (cost/batch.py):
+    # "numpy" is the table-driven scalar-float path — the default and the
+    # parity oracle; "jax" routes the same gathered per-stage tables
+    # through a jit-compiled f64 kernel (cost/jax_backend.py) that mirrors
+    # the numpy expressions op-for-op, so rankings stay byte-identical
+    # (gated by tools/check_search_regression.py).  jax is lazy-imported;
+    # requesting "jax" on a host without it raises at estimator build.
+    cost_backend: str = "numpy"
+    # Symmetry-collapsed search (AMP-style, arXiv 2210.07297): placements
+    # that differ only by a permutation of cost-interchangeable device
+    # types (identical DeviceSpec cost fields, profiles, and type meta —
+    # search/device_groups.type_equivalence_classes) are costed once and
+    # the cached result stream replayed for the equivalent candidates
+    # (search/parallel.py).  Byte-identical rankings by construction —
+    # the replay re-runs every counter and pruner hook; clusters with no
+    # equivalent types skip the memo entirely.  False disables it.
+    symmetry_collapse: bool = True
 
     def __post_init__(self) -> None:
         if self.gbs < 1:
@@ -205,6 +222,10 @@ class SearchConfig:
             raise ValueError("progress_every must be >= 1")
         if self.workers < 1:
             raise ValueError("workers must be >= 1")
+        if self.cost_backend not in ("numpy", "jax"):
+            raise ValueError(
+                f"cost_backend must be 'numpy' or 'jax', "
+                f"got {self.cost_backend!r}")
 
 
 @dataclass(frozen=True)

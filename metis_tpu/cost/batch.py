@@ -102,6 +102,14 @@ class BatchCostEstimator:
         self._seq_meta: dict = {}   # (node_sequence, r0, r1) -> _StageMeta
         self._seq_dpfac: dict = {}  # (node_sequence, r0, r1, dp) -> factor
         self._seq_ppden: dict = {}  # (node_sequence, r0, end2) -> denominator
+        # optional jit backend (SearchConfig.cost_backend="jax"): shares
+        # every memo above through the host reference and stays
+        # byte-identical to the numpy loop (cost/jax_backend.py docstring);
+        # construction raises MetisError when jax is unavailable
+        self._jax = None
+        if getattr(scalar.options, "cost_backend", "numpy") == "jax":
+            from metis_tpu.cost.jax_backend import JaxCostBackend
+            self._jax = JaxCostBackend(self)
 
     # -- public API --------------------------------------------------------
     def cost_many(
@@ -117,6 +125,8 @@ class BatchCostEstimator:
         if not intras:
             return []
         P = self._placement(inter)
+        if self._jax is not None:
+            return self._jax.cost_many(P, inter, intras)
         return [self._cost_one(P, inter, intra) for intra in intras]
 
     def _cost_one(self, P, inter, intra):

@@ -109,6 +109,10 @@ class EstimatorOptions:
     migrate_from: tuple = ()
     migration_bw_gbps: float = 100.0
     migration_amortize_steps: int = 1000
+    # Batched cost-tensor backend (SearchConfig.cost_backend): "numpy" is
+    # the scalar-float oracle; "jax" jit-compiles the same per-stage table
+    # product (cost/jax_backend.py) with byte-identical results.
+    cost_backend: str = "numpy"
 
     @staticmethod
     def from_config(cfg: SearchConfig) -> "EstimatorOptions":
@@ -126,6 +130,7 @@ class EstimatorOptions:
                 tuple(int(x) for x in t) for t in cfg.migrate_from),
             migration_bw_gbps=cfg.migration_bw_gbps,
             migration_amortize_steps=cfg.migration_amortize_steps,
+            cost_backend=getattr(cfg, "cost_backend", "numpy"),
         )
 
     @property

@@ -1,0 +1,358 @@
+"""Jit-compiled cost tensor — the optional jax backend for batched costing.
+
+``JaxCostBackend`` prices the fast family (gpipe, virtual_stages=1,
+cp=ep=1, zero=0) of a candidate batch through one ``jax.jit``-compiled
+f64 kernel instead of ``BatchCostEstimator._fast``'s per-candidate Python
+loop.  The host side gathers exactly the same memoized tables ``_fast``
+reads (stage-time slice-sum matrices, activation volumes, dp ring
+factors, parameter bytes, optimizer rates, latency floors) into dense
+``[B, S]`` arrays; the kernel then replays the per-stage assembly with
+the same operations in the same association order, statically unrolled
+over the stage axis.
+
+Exactness contract (same as the numpy path's, extended): every float the
+kernel produces is either the result of the identical IEEE-754 double
+operation sequence ``_fast`` performs, or of an exact identity
+(``x + 0.0`` for ``x >= 0`` — how the per-candidate migration and
+step-overhead adds become unconditional).  Candidate selection, profile
+misses, and the non-fast-family scalar fallback are decided on the host
+with byte-for-byte the code ``_cost_one`` runs, so a batch returns the
+same ``PlanCost | None`` list in the same order — the regression gate
+(``tools/check_search_regression.py``) asserts ranked-dump byte-identity
+against the numpy backend on the parity workload.
+
+Specialization: the kernel re-traces per ``(num_stages, overlap,
+latency-floor, spot, migration, dp share, num_layers, padded batch)``
+combination; the batch axis is padded to the next power of two (pad rows
+are copies of row 0, sliced off after) so compile count stays
+logarithmic in batch size.  ``memo.jax_kernel.{hit,miss}`` counters
+report cache behavior.  f64 is forced per call via the scoped
+``jax.experimental.enable_x64`` context, so the process-global x64 flag
+is never touched.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from metis_tpu.core.errors import MetisError
+from metis_tpu.core.types import PlanCost
+from metis_tpu.cost.batch import _MISS
+
+try:  # lazy, optional: the numpy backend must work without jax installed
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import enable_x64
+except ImportError:  # pragma: no cover - exercised on jax-free hosts
+    jax = None
+    jnp = None
+    lax = None
+    enable_x64 = None
+
+
+def available() -> bool:
+    """Whether the jax cost backend can be constructed on this host."""
+    return jax is not None
+
+
+def _rounded(x):
+    """Force a product to round to f64 before it feeds an add or subtract.
+
+    XLA:CPU contracts ``a * b + c`` into a fused multiply-add during
+    codegen (the product keeps infinite precision), which breaks
+    bit-identity with the numpy path's separately-rounded multiply.
+    Neither ``--xla_cpu_enable_fast_math=false``,
+    ``--xla_allow_excess_precision=false``, nor
+    ``lax.optimization_barrier`` suppresses it, and a double bitcast gets
+    simplified away — all verified empirically.  ``abs`` does block it
+    (LLVM only contracts an fmul feeding an fadd/fsub directly) and is an
+    exact identity here: every guarded product is a product of
+    nonnegative factors (times, byte volumes, ring factors, hazard
+    scales), so ``abs(x) == x`` bit-for-bit.  The byte-identity
+    regression-gate leg re-verifies this on every run.
+    """
+    return jnp.abs(x)
+
+
+def _kernel(stage_ms, act, q, params, lat, o, span, pp_den, fb_sync,
+            batch_gen, migration, extra_once, extra_pb, batches_f,
+            spot_scale_f, layers_f, share_f, *, S, ov, has_lat, has_spot,
+            has_mig):
+    """The batched per-stage assembly, statically unrolled over stages.
+
+    Mirrors ``BatchCostEstimator._fast`` line for line: chained adds in
+    stage order (never a tree ``sum``), ``jnp.maximum`` chains seeded
+    from the first stage's value, and the left-associated component sum.
+    ``batches`` and ``num_layers`` travel as runtime scalars, not trace
+    constants: a compile-time divisor gets strength-reduced to a
+    multiply-by-reciprocal (verified: ``x / 10`` compiled to ``x * 0.1``),
+    which is inexact for non-power-of-two divisors.
+    """
+    sum_l = max_l = None
+    pp_cost = pp_exposed = None
+    max_dp = max_opt = max_dpe = None
+    for s in range(S):
+        t = stage_ms[:, s]
+        sum_l = t if sum_l is None else sum_l + t
+        max_l = t if max_l is None else jnp.maximum(max_l, t)
+        if s < S - 1:
+            t_pp = act[:, s] / pp_den[s]
+            pp_cost = t_pp if pp_cost is None else pp_cost + t_pp
+            if ov:
+                e = jnp.maximum(0.0, t_pp - t)
+                pp_exposed = e if pp_exposed is None else pp_exposed + e
+        dpv = _rounded((q[:, s] * params[:, s]) * share_f)
+        if has_lat:
+            dpv = dpv + lat[:, s]
+        max_dp = dpv if max_dp is None else jnp.maximum(max_dp, dpv)
+        opt = (o[:, s] * span[:, s]) / layers_f
+        max_opt = opt if max_opt is None else jnp.maximum(max_opt, opt)
+        if ov:
+            dpe = jnp.maximum(0.0, dpv - opt)
+            max_dpe = dpe if max_dpe is None else jnp.maximum(max_dpe, dpe)
+    execution = _rounded((batches_f - 1.0) * max_l) + sum_l
+    # step overhead: host pre-splits into a once-per-step and a
+    # per-microbatch term (exactly one is nonzero); adding both keeps the
+    # op unconditional and exact (x + 0.0 == x for x >= 0)
+    execution = (execution + extra_once) + _rounded(extra_pb * batches_f)
+    zero = jnp.zeros_like(execution)
+    dp_charge = max_dpe if ov else max_dp
+    pp_charge = pp_exposed if ov else pp_cost
+    if pp_charge is None:  # single-stage placement: no pp boundary at all
+        pp_charge = zero
+    total = (((((execution + fb_sync) + max_opt) + dp_charge)
+              + pp_charge) + batch_gen)
+    if has_spot:
+        recovery = _rounded(total * spot_scale_f)
+        total = total + recovery
+    else:
+        recovery = zero
+    if has_mig:
+        total = total + migration
+    return total, execution, max_opt, dp_charge, pp_charge, recovery
+
+
+_STATIC_ARGS = ("S", "ov", "has_lat", "has_spot", "has_mig")
+
+
+class JaxCostBackend:
+    """Batch-cost evaluation via the jit kernel, over a host
+    ``BatchCostEstimator`` that owns every table and memo."""
+
+    def __init__(self, host):
+        if jax is None:
+            raise MetisError(
+                "cost_backend='jax' requested but jax is not importable "
+                "on this host; use cost_backend='numpy'")
+        self.host = host
+        self._jit = jax.jit(_kernel, static_argnames=_STATIC_ARGS)
+        self._specs_seen: set = set()
+
+    # -- public API --------------------------------------------------------
+    def cost_many(self, P, inter, intras):
+        """Price one inter plan's intra batch; same contract as the host's
+        ``cost_many`` (entry per candidate, None on profile miss)."""
+        host = self.host
+        results: list = [None] * len(intras)
+        rows = []
+        rows_idx = []
+        for i, intra in enumerate(intras):
+            strategies = intra.strategies
+            if (intra.schedule != "gpipe" or intra.virtual_stages != 1
+                    or any(s.cp != 1 or s.ep != 1 or s.zero != 0
+                           for s in strategies)):
+                # non-fast family: scalar path, verbatim from _cost_one
+                try:
+                    results[i] = host.scalar.get_cost(
+                        inter, strategies, intra.layer_partition,
+                        schedule=intra.schedule,
+                        virtual_stages=intra.virtual_stages)
+                except KeyError:
+                    results[i] = None
+                continue
+            g = self._gather(P, inter, strategies, intra.layer_partition)
+            if g is None:
+                results[i] = None
+                continue
+            rows.append(g)
+            rows_idx.append(i)
+        if not rows:
+            return results
+        self._price(P, inter, rows, rows_idx, results)
+        return results
+
+    # -- host-side gather --------------------------------------------------
+    def _gather(self, P, inter, strategies, partition):
+        """One candidate's kernel inputs — the same memoized lookups, in
+        the same order, as ``_fast``; None at the same miss points."""
+        host = self.host
+        batches = inter.batches
+        g2 = inter.gbs // batches
+        stages = P.stages
+        S = P.num_stages
+        last = S - 1
+        dpfac = P.dpfac
+        lat_fn = P.lat_fn
+        actmap = host._actmap
+        pmap = host._pmap
+        omap = host._omap
+        stage_row = [0.0] * S
+        act_row = [0.0] * last
+        q_row = [0.0] * S
+        params_row = [0.0] * S
+        lat_row = [0.0] * S
+        o_row = [0.0] * S
+        span_row = [0.0] * S
+        fb_sync = 0.0
+        for s in range(S):
+            strat = strategies[s]
+            dp = strat.dp
+            tp = strat.tp
+            start = partition[s]
+            end = partition[s + 1]
+            meta = stages[s]
+            mbs = g2 // dp
+            if meta.homo:
+                E = meta.etabs.get((tp, mbs))
+                if E is None:
+                    E = host._build_etab(meta, tp, mbs)
+                if E is _MISS:
+                    return None
+                stage_row[s] = E[start][end]
+            else:
+                try:
+                    stage_row[s] = host.scalar._stage_execution_ms(
+                        inter, strat, meta.types, start, end)
+                except KeyError:
+                    return None
+            if s == last:
+                fb = meta.fbtabs.get((tp, mbs))
+                if fb is None:
+                    fb = host._build_fb(meta, tp, mbs)
+                if fb is _MISS:
+                    return None
+                fb_sync = fb * batches
+            else:
+                akey = (end, mbs, tp)
+                act = actmap.get(akey)
+                if act is None:
+                    act = host.scalar._activation(end, mbs, tp)
+                    actmap[akey] = act
+                if strat.sp:
+                    act = act / tp
+                act_row[s] = act
+            dkey = (s, dp)
+            q = dpfac.get(dkey)
+            if q is None:
+                q = host._build_dpfac(P, s, strat)
+                dpfac[dkey] = q
+            q_row[s] = q
+            pkey = (tp, start, end)
+            params = pmap.get(pkey)
+            if params is None:
+                params = host.volume.stage_parameter_bytes(tp, start, end)
+                pmap[pkey] = params
+            params_row[s] = params
+            if lat_fn is not None:
+                lat = P.latmap.get(dp)
+                if lat is None:
+                    lat = lat_fn("all_reduce", dp)
+                    P.latmap[dp] = lat
+                lat_row[s] = lat
+            okey = (meta.opt_type, tp)
+            o = omap.get(okey)
+            if o is None:
+                o = host.scalar._optimizer_ms(meta.opt_type) / tp
+                omap[okey] = o
+            o_row[s] = o
+            span_row[s] = float(end - start)
+        extra_once = extra_pb = 0.0
+        so = host._so
+        if so:
+            st0 = strategies[0]
+            d0, t0 = st0.dp, st0.tp
+            uniform = True
+            pairs = set()
+            for s in range(S):
+                strat = strategies[s]
+                if strat.dp != d0 or strat.tp != t0:
+                    uniform = False
+                stp = strat.tp
+                for t in stages[s].typeset:
+                    pairs.add((t, stp))
+            overhead = max((so.get(p, 0.0) for p in pairs), default=0.0)
+            if uniform and P.ranks_uniform:
+                extra_once = overhead
+            else:
+                extra_pb = max(overhead, 0.0)
+        if host.options.strict_compat or P.first_type is None:
+            batch_gen = host._bg_per * batches
+        else:
+            batch_gen = P.batch_gen
+        migration = 0.0
+        if host._mig_active:
+            migration = host.scalar._migration_ms(
+                tuple(s.tp for s in strategies), tuple(partition))
+        return (stage_row, act_row, q_row, params_row, lat_row, o_row,
+                span_row, fb_sync, batch_gen, migration, extra_once,
+                extra_pb)
+
+    # -- kernel dispatch ---------------------------------------------------
+    def _price(self, P, inter, rows, rows_idx, results):
+        host = self.host
+        S = P.num_stages
+        ov = host._overlap
+        has_lat = P.lat_fn is not None
+        spot_scale = P.spot_scale
+        has_spot = bool(spot_scale)
+        has_mig = host._mig_active
+        share = host._share
+        L = host._L
+        B = len(rows)
+        bpad = 1
+        while bpad < B:
+            bpad *= 2
+        spec = (S, ov, has_lat, has_spot, has_mig, share, L, bpad)
+        c = host.counters
+        if c is not None:
+            if spec in self._specs_seen:
+                c.inc("memo.jax_kernel.hit")
+            else:
+                self._specs_seen.add(spec)
+                c.inc("memo.jax_kernel.miss")
+        padded = rows + [rows[0]] * (bpad - B)
+
+        def mat(j, width):
+            return np.array([g[j] for g in padded],
+                            dtype=np.float64).reshape(bpad, width)
+
+        def vec(j):
+            return np.array([g[j] for g in padded], dtype=np.float64)
+
+        with enable_x64():
+            out = self._jit(
+                mat(0, S), mat(1, S - 1), mat(2, S), mat(3, S), mat(4, S),
+                mat(5, S), mat(6, S),
+                np.asarray(P.pp_den[:S - 1], dtype=np.float64),
+                vec(7), vec(8), vec(9), vec(10), vec(11),
+                np.float64(inter.batches), np.float64(spot_scale),
+                np.float64(L), np.float64(share),
+                S=S, ov=ov, has_lat=has_lat, has_spot=has_spot,
+                has_mig=has_mig)
+            total, execution, max_opt, dp_charge, pp_charge, recovery = (
+                np.asarray(a) for a in out)
+        for r, i in enumerate(rows_idx):
+            g = rows[r]
+            results[i] = PlanCost(
+                total_ms=float(total[r]),
+                execution_ms=float(execution[r]),
+                fb_sync_ms=g[7],
+                optimizer_ms=float(max_opt[r]),
+                dp_comm_ms=float(dp_charge[r]),
+                pp_comm_ms=float(pp_charge[r]),
+                batch_gen_ms=g[8],
+                cp_comm_ms=0.0,
+                ep_comm_ms=0.0,
+                expected_recovery_ms=float(recovery[r]),
+                migration_ms=g[9],
+            )

@@ -36,7 +36,10 @@ from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.cost.estimator import EstimatorOptions, UniformCostEstimator
 from metis_tpu.cost.ici import IciDcnBandwidth
 from metis_tpu.cost.volume import TransformerVolume
-from metis_tpu.search.inter_stage import inter_stage_plans
+from metis_tpu.search.inter_stage import (
+    inter_stage_plans,
+    sequence_symmetry_stats,
+)
 from metis_tpu.search.parallel import CandidateEvaluator
 from metis_tpu.search.prune import SearchPruner, pruned_inter_stage_plans
 from metis_tpu.search.uniform import uniform_plans
@@ -119,6 +122,7 @@ def make_search_state(
     config: SearchConfig,
     bandwidth_factory=None,
     counters=None,
+    node_ids=None,
 ) -> CandidateEvaluator:
     """Build the search state ``plan_hetero`` otherwise constructs in its
     setup span: the cost estimator, stage-performance model, layer
@@ -133,11 +137,17 @@ def make_search_state(
     ``(cluster, profiles, model, config, bandwidth_factory)`` it was built
     with (key on :func:`metis_tpu.obs.ledger.query_fingerprint`), and it is
     NOT reentrant — one search at a time per state.
+
+    ``node_ids``: the owner's stable identity for each cluster node, in
+    ``cluster.nodes`` order — the daemon passes fleet-level ids for a
+    tenant carve so the state's ``touched_nodes`` tags live in the fleet
+    namespace and a ``ClusterDelta`` can re-cost only intersecting states.
     """
     _check_profile_attn(profiles, model)
     return CandidateEvaluator(
         cluster, profiles, model, config,
-        bandwidth_factory=bandwidth_factory, counters=counters)
+        bandwidth_factory=bandwidth_factory, counters=counters,
+        node_ids=node_ids)
 
 
 def plan_hetero(
@@ -228,7 +238,11 @@ def plan_hetero(
 
     pruner = SearchPruner(config, cluster, profiles, model,
                           counters=tracer.counters if tracer.enabled
-                          else None)
+                          else None,
+                          symmetry_classes=ctx._symmetry)
+    # per-search symmetry accounting: the evaluator's hit/miss totals are
+    # lifetime (warm states span searches), so the event reports deltas
+    sym_h0, sym_m0 = ctx.sym_hits, ctx.sym_misses
     if pruner.active:
         # composition-level pruning: doom/bound filters run once per
         # (composition, batches) class and beam-dead classes skip
@@ -336,6 +350,22 @@ def plan_hetero(
                     components={k: round(v, 4)
                                 for k, v in bd.components.items()},
                     schedule=rp.intra.schedule)
+    if ctx._symmetry is not None:
+        total_seqs, distinct_seqs = sequence_symmetry_stats(
+            cluster.device_types, ctx._symmetry)
+        hits = ctx.sym_hits - sym_h0
+        misses = ctx.sym_misses - sym_m0
+        events.emit(
+            "symmetry_collapse",
+            classes={t: rep for t, rep in sorted(ctx._symmetry.items())},
+            total_sequences=total_seqs,
+            distinct_sequences=distinct_seqs,
+            collapse_frac=round(1.0 - distinct_seqs / total_seqs, 4)
+            if total_seqs else 0.0,
+            replayed=hits, costed_fresh=misses)
+    if getattr(config, "cost_backend", "numpy") != "numpy":
+        events.emit("cost_backend", backend=config.cost_backend,
+                    batch_fast=ctx._batch_fast)
     tracer.emit_counters(scope="plan_hetero")
     events.emit(
         "search_finished", mode="hetero", num_costed=num_costed,

@@ -146,12 +146,23 @@ class FleetScheduler:
 
     def __init__(self, full_cluster: ClusterSpec, profiles: ProfileStore,
                  *, events: EventLog = NULL_LOG,
-                 top_k: int | None = None):
+                 top_k: int | None = None,
+                 search_state_provider=None):
         self.full_cluster = full_cluster
         self.cluster = full_cluster
         self.profiles = profiles
         self.events = events
         self.top_k = top_k
+        # optional callable (spec, cluster, sub_cluster, node_indices) ->
+        # warm CandidateEvaluator or None: the serve daemon hands tenants'
+        # training searches their retained planner.api.make_search_state
+        # evaluators, so a re-partition that lands a tenant back on a
+        # carve it planned before starts with every memo table warm.
+        # ``cluster`` is the topology ``node_indices`` index into (the
+        # current fleet, or the reference topology for the baseline).
+        # Ranking is byte-identical either way (the state caches the same
+        # floats the cold path computes).
+        self.search_state_provider = search_state_provider
         self.registry = TenantRegistry()
         self._stores: dict[str, ProfileStore] = {}
         self._baseline: dict[str, float] = {}
@@ -354,8 +365,12 @@ class FleetScheduler:
             dump = dump_inference_plans(res, spec.workload) \
                 if feasible else None
         else:
+            state = None
+            if self.search_state_provider is not None:
+                state = self.search_state_provider(spec, cluster, sub,
+                                                   node_indices)
             res = plan_hetero(sub, store, spec.model, spec.config,
-                              top_k=self.top_k)
+                              top_k=self.top_k, search_state=state)
             best = res.best
             feasible = best is not None
             utility = (spec.config.gbs * 1000.0 / best.cost.total_ms

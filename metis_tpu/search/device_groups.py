@@ -24,6 +24,53 @@ from typing import Iterator, Sequence
 from metis_tpu.search.multiperm import multiset_permutations
 
 
+def type_equivalence_classes(cluster, profiles) -> dict[str, str]:
+    """Map each device type to its class representative under cost symmetry.
+
+    Two types are interchangeable for the planner (AMP-style placement
+    symmetry, arXiv 2210.07297) iff NOTHING the cost model reads can tell
+    them apart: identical ``DeviceSpec`` cost fields (everything but the
+    name), identical per-type node-width sequences (node order is rank
+    order, so widths must match position-for-position), identical profiled
+    configs with bit-equal ``LayerProfile`` data, and identical
+    ``type_meta`` timings.  Swapping two such types inside a
+    ``node_sequence`` then reprices to bit-identical floats, which is what
+    lets the evaluator cost one representative per class and replay the
+    result stream for the equivalent permutations (search/parallel.py).
+
+    The representative is the lexicographically smallest name in the
+    class, so the canonical form of a sequence is deterministic.  Clusters
+    with no equivalent pair map every type to itself.
+    """
+    sigs: dict[tuple, list[str]] = {}
+    for t in cluster.device_types:
+        spec = cluster.devices[t]
+        widths = tuple(n.num_devices for n in cluster.nodes
+                       if n.device_type == t)
+        meta = profiles.type_meta.get(t)
+        profile_sig = []
+        for (_, tp, bs) in sorted(profiles.configs(t)):
+            prof = profiles.get(t, tp, bs)
+            profile_sig.append((tp, bs, tuple(prof.layer_times_ms),
+                                tuple(prof.layer_memory_mb),
+                                prof.fb_sync_ms))
+        sig = (
+            spec.memory_gb, spec.intra_bw_gbps, spec.inter_bw_gbps,
+            spec.hbm_gbps, spec.tier, spec.preemption_rate_per_hr,
+            widths,
+            None if meta is None else (meta.optimizer_time_ms,
+                                       meta.batch_generator_ms),
+            tuple(profile_sig),
+        )
+        sigs.setdefault(sig, []).append(t)
+    out: dict[str, str] = {}
+    for members in sigs.values():
+        rep = min(members)
+        for t in members:
+            out[t] = rep
+    return out
+
+
 def power_of_two_shapes(num_devices: int) -> list[int]:
     """Allowed per-stage group sizes: 1, 2, 4, ... <= num_devices."""
     shapes = []

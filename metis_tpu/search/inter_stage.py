@@ -15,6 +15,25 @@ from metis_tpu.core.types import InterStagePlan, divisors
 from metis_tpu.search.device_groups import enumerate_device_groups
 
 
+def sequence_symmetry_stats(
+    device_types: Sequence[str], class_map: dict[str, str],
+) -> tuple[int, int]:
+    """(total, distinct) type-permutation counts under an equivalence map.
+
+    ``total`` is the number of node-sequence permutations the search walks;
+    ``distinct`` how many remain after canonicalizing each through
+    ``class_map`` (device_groups.type_equivalence_classes) — the
+    denominator/numerator of the ``symmetry_collapse`` event's
+    ``collapse_frac``."""
+    types = sorted(set(device_types))
+    total = 0
+    distinct: set[tuple] = set()
+    for perm in permutations(types):
+        total += 1
+        distinct.add(tuple(class_map.get(t, t) for t in perm))
+    return total, len(distinct)
+
+
 def inter_stage_plans(
     device_types: Sequence[str],
     num_devices: int,

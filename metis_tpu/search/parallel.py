@@ -44,6 +44,7 @@ two drivers.
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 import multiprocessing as mp
 import pickle
@@ -62,9 +63,18 @@ from metis_tpu.cost.estimator import EstimatorOptions, HeteroCostEstimator
 from metis_tpu.cost.expert_parallel import ep_candidates
 from metis_tpu.cost.volume import TransformerVolume
 from metis_tpu.cost.zero import zero_candidates
+from metis_tpu.search.device_groups import type_equivalence_classes
 from metis_tpu.search.inter_stage import inter_stage_plans
 from metis_tpu.search.intra_stage import intra_stage_plans, schedule_intra_plans
 from metis_tpu.search.prune import SearchPruner
+
+# Symmetry-class event memo: one entry per canonical (sequence class,
+# device_groups, batches) candidate.  Node-tag memo: one entry per
+# (node_sequence, device_groups) layout.  Both are bounded PR-6 style —
+# wholesale clear past the cap, traffic observable via
+# ``memo.{symmetry,node_tags}.{hit,miss,evict}``.
+_SYM_MEMO_MAX = 16384
+_NODE_TAG_MEMO_MAX = 8192
 
 
 class CandidateEvaluator:
@@ -88,11 +98,47 @@ class CandidateEvaluator:
     """
 
     def __init__(self, cluster, profiles, model, config,
-                 bandwidth_factory=None, counters=None):
+                 bandwidth_factory=None, counters=None, node_ids=None):
         self.cluster = cluster
         self.model = model
         self.config = config
         self.counters = counters
+        # Stable node identities for incremental replanning: position i of
+        # ``cluster.nodes`` is known to the OWNER of this evaluator (the
+        # serving daemon) as ``node_ids[i]`` in some enclosing topology —
+        # a tenant carve's nodes keep their full-fleet ids.  Every costed
+        # candidate gets tagged with the ids its placement touches
+        # (``touched_nodes``) so a ClusterDelta can re-cost only the
+        # intersecting warm state.
+        if node_ids is None:
+            node_ids = tuple(range(len(cluster.nodes)))
+        else:
+            node_ids = tuple(node_ids)
+            if len(node_ids) != len(cluster.nodes):
+                raise ValueError(
+                    f"node_ids has {len(node_ids)} entries for "
+                    f"{len(cluster.nodes)} cluster nodes")
+        self.node_ids = node_ids
+        self.touched_nodes: set = set()
+        self.tagged_candidates = 0
+        self._node_tags: dict[tuple, frozenset] = {}
+        # Symmetry collapse (AMP-style, arXiv 2210.07297): when two device
+        # types are cost-indistinguishable (see ``type_equivalence_classes``)
+        # every candidate whose node_sequence canonicalizes to an
+        # already-costed one is REPLAYED from the memo instead of re-priced —
+        # bit-identical by construction, since nothing the cost model reads
+        # differs.  Gated off when a bandwidth_factory is live (plan_tpu's
+        # ICI/DCN topology model reads link structure the DeviceSpec
+        # signature cannot see, so the collapse would be unsound there).
+        self._symmetry = None
+        if (getattr(config, "symmetry_collapse", True)
+                and bandwidth_factory is None):
+            cmap = type_equivalence_classes(cluster, profiles)
+            if any(rep != t for t, rep in cmap.items()):
+                self._symmetry = cmap
+        self._sym_memo: dict[tuple, list] = {}
+        self.sym_hits = 0
+        self.sym_misses = 0
         volume = TransformerVolume(model, profiles.model.params_per_layer_bytes)
         options = EstimatorOptions.from_config(config)
         self.estimator = HeteroCostEstimator(
@@ -257,52 +303,144 @@ class CandidateEvaluator:
         loop, and a family-level miss lands last — exactly where generation
         aborted.  An empty events list is a valid yield (admitted inter
         with no candidates).
+
+        When symmetry collapse is live, candidates whose canonicalized
+        ``node_sequence`` was already costed are replayed from the memo —
+        each stored event re-runs ``pruner.record`` and the counters, and
+        each plan is re-wrapped with THIS inter — so the pruner state,
+        counter totals, and the final stable-sort ranking are byte-identical
+        to pricing every permutation from scratch.
         """
-        if not self._batch_fast:
-            for inter in inters:
-                pruner.begin_candidate()
-                yield inter, list(self.evaluate(inter, pruner))
-                pruner.end_candidate(inter)
-            return
-        config = self.config
         for inter in inters:
             pruner.begin_candidate()
-            intras = []
-            fam_miss = False
-            try:
-                intra_gen = intra_stage_plans(
-                    inter, self.evaluator, self.balancer,
-                    max_tp=config.max_profiled_tp,
-                    max_bs=config.max_profiled_bs,
-                    cp_degrees=(1,), cp_eligible=None,
-                    ep_degrees=(1,), zero_stages=(0,),
-                    sp_variants=(False,), cp_modes=("ring",),
-                    num_heads=self.a2a_head_limit,
-                )
-                if self.intra_acc is not None:
-                    intra_gen = timed_iter(intra_gen, self.intra_acc)
-                for intra in intra_gen:
-                    intras.append(intra)
-            except KeyError:
-                fam_miss = True
-            with self.cost_acc:
-                costs = self.batch_estimator.cost_many(inter, intras)
-            events = []
-            for intra, cost in zip(intras, costs):
-                if cost is None:
-                    self._inc("pruned_profile_miss")
-                    events.append(("miss", True))
-                else:
-                    pruner.record(cost.total_ms)
-                    self._inc("costed")
-                    events.append(
-                        ("plan", RankedPlan(inter=inter, intra=intra,
-                                            cost=cost)))
-            if fam_miss:
-                self._inc("pruned_profile_miss")
-                events.append(("miss", False))
+            events = self._candidate_events(inter, pruner)
+            n_plans = sum(1 for kind, _ in events if kind == "plan")
+            if n_plans:
+                self.touched_nodes |= self._tag_nodes(inter)
+                self.tagged_candidates += n_plans
             yield inter, events
             pruner.end_candidate(inter)
+
+    def _candidate_events(self, inter, pruner):
+        """Events for one admitted inter plan: memo replay when its symmetry
+        class was already costed, fresh generation (then memoized) otherwise."""
+        sym = self._symmetry
+        if sym is None:
+            return self._generate_events(inter, pruner)
+        key = (tuple(sym[t] for t in inter.node_sequence),
+               inter.device_groups, inter.batches)
+        cached = self._sym_memo.get(key)
+        if cached is not None:
+            self.sym_hits += 1
+            self._inc("memo.symmetry.hit")
+            return self._replay(cached, inter, pruner)
+        self.sym_misses += 1
+        self._inc("memo.symmetry.miss")
+        events = self._generate_events(inter, pruner)
+        if len(self._sym_memo) > _SYM_MEMO_MAX:
+            self._sym_memo.clear()
+            self._inc("memo.symmetry.evict")
+        self._sym_memo[key] = events
+        return events
+
+    def _replay(self, cached, inter, pruner):
+        """Re-emit a memoized event stream for an equivalent inter plan.
+
+        Costs are reused verbatim (bit-identical across the class by
+        construction); the pruner heap and the ``costed``/
+        ``pruned_profile_miss`` counters are re-driven per event so every
+        observable downstream of the evaluator matches a from-scratch run.
+        """
+        events = []
+        for kind, item in cached:
+            if kind == "plan":
+                pruner.record(item.cost.total_ms)
+                self._inc("costed")
+                events.append(
+                    ("plan", dataclasses.replace(item, inter=inter)))
+            else:
+                self._inc("pruned_profile_miss")
+                events.append((kind, item))
+        return events
+
+    def _generate_events(self, inter, pruner):
+        if not self._batch_fast:
+            return list(self.evaluate(inter, pruner))
+        config = self.config
+        intras = []
+        fam_miss = False
+        try:
+            intra_gen = intra_stage_plans(
+                inter, self.evaluator, self.balancer,
+                max_tp=config.max_profiled_tp,
+                max_bs=config.max_profiled_bs,
+                cp_degrees=(1,), cp_eligible=None,
+                ep_degrees=(1,), zero_stages=(0,),
+                sp_variants=(False,), cp_modes=("ring",),
+                num_heads=self.a2a_head_limit,
+            )
+            if self.intra_acc is not None:
+                intra_gen = timed_iter(intra_gen, self.intra_acc)
+            for intra in intra_gen:
+                intras.append(intra)
+        except KeyError:
+            fam_miss = True
+        with self.cost_acc:
+            costs = self.batch_estimator.cost_many(inter, intras)
+        events = []
+        for intra, cost in zip(intras, costs):
+            if cost is None:
+                self._inc("pruned_profile_miss")
+                events.append(("miss", True))
+            else:
+                pruner.record(cost.total_ms)
+                self._inc("costed")
+                events.append(
+                    ("plan", RankedPlan(inter=inter, intra=intra,
+                                        cost=cost)))
+        if fam_miss:
+            self._inc("pruned_profile_miss")
+            events.append(("miss", False))
+        return events
+
+    def _tag_nodes(self, inter) -> frozenset:
+        """Node ids (in the owner's namespace) the placement touches.
+
+        Ranks are laid out over nodes in ``node_sequence`` type order;
+        every stage's rank range maps back to the nodes it spans.  Device
+        groups always sum to the cluster total, so for a single-job search
+        the union covers every node — the granularity that makes
+        incremental replanning selective comes from the daemon searching
+        per-tenant carves, each tagged with its own slice of fleet ids.
+        """
+        key = (inter.node_sequence, inter.device_groups)
+        cached = self._node_tags.get(key)
+        if cached is not None:
+            self._inc("memo.node_tags.hit")
+            return cached
+        self._inc("memo.node_tags.miss")
+        # rank spans per node, in sequence order
+        spans = []  # (start_rank, end_rank, node_id)
+        rank = 0
+        for t in inter.node_sequence:
+            for i, node in enumerate(self.cluster.nodes):
+                if node.device_type != t:
+                    continue
+                spans.append((rank, rank + node.num_devices,
+                              self.node_ids[i]))
+                rank += node.num_devices
+        touched = set()
+        for s in range(inter.num_stages):
+            lo, hi = inter.stage_rank_range(s)
+            for start, end, nid in spans:
+                if start < hi and lo < end:
+                    touched.add(nid)
+        out = frozenset(touched)
+        if len(self._node_tags) > _NODE_TAG_MEMO_MAX:
+            self._node_tags.clear()
+            self._inc("memo.node_tags.evict")
+        self._node_tags[key] = out
+        return out
 
 
 def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
@@ -324,7 +462,8 @@ def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
             cluster, profiles, model, config,
             bandwidth_factory=bandwidth_factory, counters=counters)
         pruner = SearchPruner(config, cluster, profiles, model,
-                              counters=counters)
+                              counters=counters,
+                              symmetry_classes=ctx._symmetry)
         plans: list[tuple] = []  # (total_ms, global_idx, seq, RankedPlan)
         pruned = 0
         ticks = 0

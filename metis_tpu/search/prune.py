@@ -100,12 +100,20 @@ class SearchPruner:
 
     def __init__(self, config: SearchConfig, cluster: ClusterSpec,
                  profiles: ProfileStore, model: ModelSpec,
-                 counters=None):
+                 counters=None, symmetry_classes=None):
         # optional core.trace.Counters: prune-family accounting for the
         # flight recorder (``prune.doom``/``prune.bound``/``prune.beam``
         # mirror num_doomed/num_bounded/num_beamed); None = tracing off,
         # not even a dict add in the hot filters
         self._counters = counters
+        # optional type->representative map (device_groups.
+        # type_equivalence_classes): beam patience is tracked per
+        # CANONICALIZED (node_sequence, stage-count) class, so equivalent
+        # placements — whose cost streams are bit-identical — share one
+        # patience budget instead of each re-earning the beam.  Sound
+        # because the beam is documented INEXACT anyway, and inert when the
+        # map is None or every class is a singleton.
+        self._sym = symmetry_classes
         self.max_bs = config.max_profiled_bs
         self.gbs = config.gbs
         self.top_k = (config.prune_to_top_k
@@ -219,14 +227,21 @@ class SearchPruner:
             out.append(batches)
         return out
 
+    def _class_key(self, node_sequence, num_stages: int) -> tuple:
+        if self._sym is not None:
+            node_sequence = tuple(
+                self._sym.get(t, t) for t in node_sequence)
+        return (node_sequence, num_stages)
+
     def class_dead(self, node_sequence, num_stages: int) -> bool:
         """Beam: whether a (placement, stage-count) class exhausted its
         patience (checked inside the pruned generator so dead classes skip
         arrangement expansion entirely)."""
         if self.beam_patience is None:
             return False
-        return (self._patience.get((node_sequence, num_stages), 0)
-                > self.beam_patience)
+        return (self._patience.get(
+            self._class_key(node_sequence, num_stages), 0)
+            > self.beam_patience)
 
     @property
     def active(self) -> bool:
@@ -265,7 +280,7 @@ class SearchPruner:
         # 3. anytime beam: stop a (placement, stage-count) class after
         #    beam_patience consecutive non-improving candidates
         if self.beam_patience is not None:
-            key = (inter.node_sequence, inter.num_stages)
+            key = self._class_key(inter.node_sequence, inter.num_stages)
             if self._patience.get(key, 0) > self.beam_patience:
                 self.num_beamed += 1
                 if self._counters is not None:
@@ -289,7 +304,7 @@ class SearchPruner:
     def end_candidate(self, inter) -> None:
         if self.beam_patience is None:
             return
-        key = (inter.node_sequence, inter.num_stages)
+        key = self._class_key(inter.node_sequence, inter.num_stages)
         if self._improved:
             self._patience[key] = 0
         else:

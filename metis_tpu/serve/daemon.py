@@ -99,6 +99,10 @@ class _QueryRecord:
     # the served best's per-stage (tp, layer_start, layer_end) triples —
     # what migration pricing compares when a replan displaces this plan
     plan_layout: tuple | None = None
+    # boot-topology node ids the served plans' placements touch — a
+    # ClusterDelta invalidates exactly the cache entries whose set
+    # intersects the changed nodes (None = unknown, always invalidated)
+    node_id_set: frozenset | None = None
 
 
 class PlanService:
@@ -160,6 +164,37 @@ class PlanService:
     def _cache_key(qfp: str, top_k: int | None) -> str:
         return f"{qfp}/k={top_k if top_k is not None else 'all'}"
 
+    # -- node identity ------------------------------------------------------
+    def _full_node_ids(self, cluster: ClusterSpec) -> tuple[int, ...]:
+        """Map each node of ``cluster`` (a shrink of the boot topology) to
+        its index in ``full_cluster`` — the stable id namespace every
+        warm-state tag and query record uses.  Shrinks peel from the END
+        of each type's node run (``planner.replan.shrink_cluster``) and
+        grows rebuild toward the reference order, so the k-th surviving
+        node of a type IS the k-th reference node of that type."""
+        by_type: dict[str, list[int]] = {}
+        for i, n in enumerate(self.full_cluster.nodes):
+            by_type.setdefault(n.device_type, []).append(i)
+        seen: dict[str, int] = {}
+        ids: list[int] = []
+        for n in cluster.nodes:
+            k = seen.get(n.device_type, 0)
+            ids.append(by_type[n.device_type][k])
+            seen[n.device_type] = k + 1
+        return tuple(ids)
+
+    def _changed_node_ids(self, old_cluster: ClusterSpec,
+                          new_cluster: ClusterSpec) -> frozenset:
+        """Boot-topology ids of nodes a delta touched: present on one side
+        only, or surviving with a different device count (partial loss
+        narrows the last matching node rather than dropping it)."""
+        old_w = {fid: n.num_devices for fid, n in
+                 zip(self._full_node_ids(old_cluster), old_cluster.nodes)}
+        new_w = {fid: n.num_devices for fid, n in
+                 zip(self._full_node_ids(new_cluster), new_cluster.nodes)}
+        return frozenset(fid for fid in old_w.keys() | new_w.keys()
+                         if old_w.get(fid) != new_w.get(fid))
+
     # -- warm search state --------------------------------------------------
     def _state_for(self, qfp: str, model: ModelSpec, config: SearchConfig):
         """Warm evaluator for this query shape, building (and LRU-bounding)
@@ -171,7 +206,8 @@ class PlanService:
                 self._state_order.append(qfp)
                 return state
         state = make_search_state(self.cluster, self.profiles, model,
-                                  config, counters=self.counters)
+                                  config, counters=self.counters,
+                                  node_ids=self._full_node_ids(self.cluster))
         with self._lock:
             self._states[qfp] = state
             self._state_order.append(qfp)
@@ -265,7 +301,8 @@ class PlanService:
             self._queries[key] = _QueryRecord(
                 model=model, config=config, top_k=top_k, key=key,
                 plan_fingerprint=plan_fp,
-                plan_layout=self._best_layout(best))
+                plan_layout=self._best_layout(best),
+                node_id_set=frozenset(self._full_node_ids(self.cluster)))
         if best is not None and plan_fp is not None:
             with self._accuracy_lock:
                 if plan_fp not in self.ledger.predictions:
@@ -308,7 +345,8 @@ class PlanService:
         with self._lock:
             self._queries[key] = _QueryRecord(
                 model=model, config=config, top_k=top_k, key=key,
-                plan_fingerprint=plan_fp, workload=workload)
+                plan_fingerprint=plan_fp, workload=workload,
+                node_id_set=frozenset(self._full_node_ids(self.cluster)))
         self.cache.put(key, entry)
         return entry
 
@@ -490,6 +528,12 @@ class PlanService:
                 return {"invalidated": 0, "removed": {}, "added": {},
                         "devices": new_cluster.total_devices, "seq": seq,
                         "replanning": False}
+            # which boot-topology nodes this delta actually touches —
+            # the incremental-replan keep/drop pivot for warm states and
+            # record-tagged cache entries alike
+            changed = self._changed_node_ids(self.cluster, new_cluster)
+            with self._lock:
+                pre_states = list(self._states.keys())
             # multi-tenant mode: re-partition the fleet FIRST (it raises
             # FleetOverCommitError before mutating anything when the
             # survivors cannot cover the quota floors, so a rejected
@@ -501,20 +545,58 @@ class PlanService:
                 old_fleet = self.sched.last_plan
                 fleet_plan, fleet_decisions = self.sched.apply_delta(
                     removed=delta.removed, added=delta.added)
+            # incremental replanning: keep every warm state whose tagged
+            # node set misses the changed nodes — its costed candidates
+            # stay bit-valid (fingerprint-keyed states can never serve a
+            # stale topology; at worst they idle until their carve
+            # recurs).  Only states that existed BEFORE the fleet
+            # re-partition are judged: states the re-partition itself
+            # just built are already on the new topology.
+            reused = recosted = kept = dropped = 0
             with self._lock:
                 self.cluster = new_cluster
-                self._states.clear()
-                self._state_order.clear()
+                for qfp in pre_states:
+                    state = self._states.get(qfp)
+                    if state is None:
+                        continue  # LRU-evicted during the re-partition
+                    if state.touched_nodes & changed:
+                        self._states.pop(qfp, None)
+                        if qfp in self._state_order:
+                            self._state_order.remove(qfp)
+                        recosted += state.tagged_candidates
+                        dropped += 1
+                    else:
+                        reused += state.tagged_candidates
+                        kept += 1
+            self.counters.inc("replan.incremental.reused", reused)
+            self.counters.inc("replan.incremental.recosted", recosted)
+            # cache entries whose recorded node set misses the changed
+            # nodes stay valid for the topology they were answered on
+            # (their keys re-materialize on an exact round-trip delta);
+            # untagged entries are invalidated conservatively
+            with self._lock:
+                keep_keys = {
+                    rec.key for rec in self._queries.values()
+                    if rec.node_id_set is not None
+                    and not (rec.node_id_set & changed)}
             if fleet_plan is not None:
-                # tenant-scoped invalidation: non-tenant entries always
-                # die with the topology; tenant entries survive unless
-                # their carve moved
+                # tenant-scoped invalidation: tenant entries survive
+                # unless their carve moved; non-tenant entries go through
+                # the record-tag filter
                 invalidated = len(self.cache.invalidate_where(
-                    lambda _k, v: v.get("tenant") is None))
+                    lambda k, v: v.get("tenant") is None
+                    and k not in keep_keys))
                 invalidated += len(self._invalidate_changed_tenants(
                     old_fleet, fleet_plan))
             else:
-                invalidated = self.cache.invalidate_all()
+                invalidated = len(self.cache.invalidate_where(
+                    lambda k, _v: k not in keep_keys))
+            self.events.emit(
+                "incremental_replan",
+                changed_nodes=sorted(changed),
+                states_kept=kept, states_dropped=dropped,
+                reused=reused, recosted=recosted,
+                invalidated=invalidated)
         note = self._push_note({
             "kind": "cluster_delta",
             "removed": delta.removed,
@@ -622,11 +704,49 @@ class PlanService:
         return {"invalidated": n}
 
     # -- multi-tenant scheduling --------------------------------------------
+    def _tenant_search_state(self, spec, cluster, sub, node_indices):
+        """Warm-state provider the fleet scheduler calls per training
+        search: retain one evaluator per (tenant, carve fingerprint),
+        tagged with the carve's boot-topology node ids so
+        ``apply_cluster_delta`` keeps it warm whenever the delta misses
+        the carve.  ``cluster`` is whatever topology the scheduler carved
+        ``node_indices`` from — the current fleet, or the reference
+        topology for the admission baseline (the daemon's own cluster may
+        lag the scheduler's mid-delta).  Runs under ``_search_lock``
+        (every scheduler invocation holds it), so the reuse is
+        race-free."""
+        if spec.workload is not None or spec.config.workers != 1:
+            return None  # inference searches carry no warm state
+        qfp = query_fingerprint(spec.model, sub, spec.config,
+                                calibration=self.calibration)
+        key = f"tenant/{spec.name}/{qfp}"
+        with self._lock:
+            state = self._states.get(key)
+            if state is not None:
+                self._state_order.remove(key)
+                self._state_order.append(key)
+                return state
+        fleet_ids = self._full_node_ids(cluster)
+        store = self.sched._stores.get(spec.name, self.profiles) \
+            if self.sched is not None else self.profiles
+        state = make_search_state(
+            sub, store, spec.model, spec.config, counters=self.counters,
+            node_ids=tuple(fleet_ids[i] for i in node_indices))
+        with self._lock:
+            self._states[key] = state
+            self._state_order.append(key)
+            while len(self._state_order) > self.state_capacity:
+                evicted = self._state_order.pop(0)
+                self._states.pop(evicted, None)
+                self.counters.inc("serve.state_evict")
+        return state
+
     def _ensure_sched(self) -> FleetScheduler:
         with self._lock:
             if self.sched is None:
-                sched = FleetScheduler(self.full_cluster, self.profiles,
-                                       events=self.events)
+                sched = FleetScheduler(
+                    self.full_cluster, self.profiles, events=self.events,
+                    search_state_provider=self._tenant_search_state)
                 sched.cluster = self.cluster  # may already be shrunk
                 self.sched = sched
             return self.sched

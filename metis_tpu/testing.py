@@ -30,6 +30,51 @@ PARITY_INFERENCE = dict(arrival_rate_rps=4.0, prompt_len=512, output_len=128,
 DEFAULT_REFERENCE_ROOT = Path("/root/reference")
 #: Spot-tier hazard used by the availability-aware parity variant.
 PARITY_SPOT_RATE = 0.05
+#: Device count of the frozen scale workload (symmetric_scale_workload).
+SCALE_DEVICES = 1024
+SCALE_GBS = 4096
+
+
+def symmetric_scale_workload(total_devices: int = SCALE_DEVICES,
+                             per_node: int = 8, gbs: int | None = None):
+    """(cluster, profiles, model, config) for the scale workload: four
+    device types forming two cost-equivalence pairs — AX/AY are A100
+    clones (same ChipPerf, same DeviceSpec fields) and BX/BY are T4
+    clones — split evenly across ``total_devices`` in nodes of
+    ``per_node``.  24 node-type sequences collapse to 6 under type
+    symmetry, so this is the golden workload for the symmetry-collapsed
+    search and the 1024/4096-device bench sections."""
+    from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+    from metis_tpu.profiles.synthetic import CHIP_PERF
+
+    types = ("AX", "AY", "BX", "BY")
+    nodes_per_type, rem = divmod(total_devices, per_node * len(types))
+    if rem or nodes_per_type < 1:
+        raise ValueError(
+            f"total_devices={total_devices} must be a positive multiple "
+            f"of {per_node * len(types)}")
+    model = tiny_test_model()
+    # the SAME ChipPerf instance per pair: synthesized layer times are
+    # bit-equal, which is what makes the pair cost-equivalent
+    perf = {"AX": CHIP_PERF["A100"], "AY": CHIP_PERF["A100"],
+            "BX": CHIP_PERF["T4"], "BY": CHIP_PERF["T4"]}
+    profiles = synthesize_profiles(model, list(types), tps=[1, 2, 4],
+                                   bss=[1, 2, 4, 8, 16], chip_perf=perf)
+
+    def spec(name: str, mem: float, intra: float) -> DeviceSpec:
+        return DeviceSpec(name, memory_gb=mem, intra_bw_gbps=intra,
+                          inter_bw_gbps=10)
+
+    overrides = {"AX": spec("AX", 80, 46), "AY": spec("AY", 80, 46),
+                 "BX": spec("BX", 15, 50), "BY": spec("BY", 15, 50)}
+    cluster = ClusterSpec.of(
+        *[(t, nodes_per_type, per_node) for t in types],
+        overrides=overrides)
+    config = SearchConfig(gbs=gbs if gbs is not None else SCALE_GBS,
+                          strict_compat=True)
+    return cluster, profiles, model, config
 
 
 def write_parity_fixture(target_dir: Path) -> None:

@@ -157,3 +157,208 @@ def test_throughput_gate_passes():
 
     assert THROUGHPUT_BASELINE.exists(), "baseline json must be checked in"
     assert run_throughput_check() == []
+
+
+# ---------------------------------------------------------------------------
+# symmetry-collapsed search (search/device_groups.type_equivalence_classes)
+# ---------------------------------------------------------------------------
+
+
+def _symmetric_pair_workload(gbs=16):
+    """Four device types forming two cost-equivalence pairs: AX/AY are
+    A100 clones (same ChipPerf instance, same DeviceSpec fields) and BX/BY
+    are T4 clones — the smallest cluster where node-type permutation
+    symmetry actually collapses anything (24 sequences -> 6).  Kept at
+    2 devices/node with a trimmed profile grid so the on/off
+    byte-identity comparison stays cheap enough for tier-1."""
+    from metis_tpu.cluster.spec import DeviceSpec
+    from metis_tpu.profiles.synthetic import CHIP_PERF, synthesize_profiles
+
+    model = tiny_test_model()
+    types = ["AX", "AY", "BX", "BY"]
+    perf = {"AX": CHIP_PERF["A100"], "AY": CHIP_PERF["A100"],
+            "BX": CHIP_PERF["T4"], "BY": CHIP_PERF["T4"]}
+    profiles = synthesize_profiles(model, types, tps=[1, 2],
+                                   bss=[1, 2, 4], chip_perf=perf)
+
+    def aspec(n):
+        return DeviceSpec(n, memory_gb=80, intra_bw_gbps=46,
+                          inter_bw_gbps=10)
+
+    def bspec(n):
+        return DeviceSpec(n, memory_gb=15, intra_bw_gbps=50,
+                          inter_bw_gbps=10)
+
+    cluster = ClusterSpec.of(
+        ("AX", 1, 2), ("AY", 1, 2), ("BX", 1, 2), ("BY", 1, 2),
+        overrides={"AX": aspec("AX"), "AY": aspec("AY"),
+                   "BX": bspec("BX"), "BY": bspec("BY")})
+    config = SearchConfig(gbs=gbs, strict_compat=True)
+    return cluster, profiles, model, config
+
+
+def test_type_equivalence_classes():
+    from metis_tpu.search.device_groups import type_equivalence_classes
+
+    cluster, profiles, _model, _config = _symmetric_pair_workload()
+    cmap = type_equivalence_classes(cluster, profiles)
+    assert cmap == {"AX": "AX", "AY": "AX", "BX": "BX", "BY": "BX"}
+
+
+def test_distinct_types_form_singleton_classes(workload):
+    """A100 vs T4 differ in every cost field — no collapse, and the
+    evaluator leaves symmetry off entirely (parity goldens unchanged)."""
+    from metis_tpu.planner.api import make_search_state
+    from metis_tpu.search.device_groups import type_equivalence_classes
+
+    cluster, store, model = workload
+    cmap = type_equivalence_classes(cluster, store)
+    assert cmap == {t: t for t in cluster.device_types}
+    ctx = make_search_state(cluster, store, model,
+                            SearchConfig(gbs=PARITY_GBS, strict_compat=True))
+    assert ctx._symmetry is None
+
+
+def test_symmetry_collapse_ranking_byte_identical():
+    """The tentpole invariant: collapsing node-type permutation symmetry
+    replays cached candidate events instead of re-costing, with the final
+    ranking, num_costed, and every semantic counter byte-identical to the
+    uncollapsed search."""
+    from metis_tpu.core.trace import Counters
+    from metis_tpu.planner.api import make_search_state
+
+    cluster, profiles, model, config = _symmetric_pair_workload()
+    dumps, costed, counters = {}, {}, {}
+    hits = misses = 0
+    for sym in (False, True):
+        import dataclasses as _dc
+        c = Counters()
+        cfg = _dc.replace(config, symmetry_collapse=sym)
+        ctx = make_search_state(cluster, profiles, model, cfg, counters=c)
+        res = plan_hetero(cluster, profiles, model, cfg, search_state=ctx)
+        dumps[sym] = dump_ranked_plans(res.plans)
+        costed[sym] = (res.num_costed, res.num_pruned)
+        counters[sym] = c.as_dict()
+        if sym:
+            hits, misses = ctx.sym_hits, ctx.sym_misses
+            assert ctx._symmetry is not None
+            assert c.get("memo.symmetry.hit") == hits
+            assert c.get("memo.symmetry.miss") == misses
+        else:
+            assert ctx._symmetry is None
+    assert dumps[False] == dumps[True]
+    assert costed[False] == costed[True]
+    assert hits > 0, "equivalent-pair cluster produced no symmetry replays"
+    for name in ("costed", "pruned_profile_miss", "prune.doom",
+                 "prune.bound", "prune.beam"):
+        assert counters[False].get(name) == counters[True].get(name), name
+
+
+def test_symmetry_disabled_under_bandwidth_factory():
+    """plan_tpu's topology-aware bandwidth model isn't captured by
+    DeviceSpec equality, so symmetry must stay off there."""
+    from metis_tpu.planner.api import make_search_state
+
+    cluster, profiles, model, config = _symmetric_pair_workload()
+    ctx = make_search_state(cluster, profiles, model, config,
+                            bandwidth_factory=lambda *_a: None)
+    assert ctx._symmetry is None
+
+
+def test_symmetry_event_emitted(tmp_path):
+    cluster, profiles, model, config = _symmetric_pair_workload()
+    path = tmp_path / "events.jsonl"
+    with EventLog(path) as log:
+        plan_hetero(cluster, profiles, model, config, events=log)
+    evs = [e for e in _events(path) if e["event"] == "symmetry_collapse"]
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["classes"] == {"AX": "AX", "AY": "AX", "BX": "BX", "BY": "BX"}
+    assert ev["total_sequences"] == 24
+    assert ev["distinct_sequences"] == 6
+    assert ev["collapse_frac"] == 0.75
+    assert ev["replayed"] > 0
+    assert ev["replayed"] + ev["costed_fresh"] > 0
+
+
+# ---------------------------------------------------------------------------
+# candidate node tagging (incremental replanning's keep/drop pivot)
+# ---------------------------------------------------------------------------
+
+
+def test_touched_nodes_cover_all_nodes_for_full_search(workload):
+    """A single-job search lays stages over every node, so its warm state
+    must be tagged with the whole node set (device_groups sum to the
+    cluster's device total)."""
+    from metis_tpu.planner.api import make_search_state
+
+    cluster, store, model = workload
+    cfg = SearchConfig(gbs=PARITY_GBS, strict_compat=True)
+    ctx = make_search_state(cluster, store, model, cfg)
+    assert ctx.touched_nodes == set() and ctx.tagged_candidates == 0
+    res = plan_hetero(cluster, store, model, cfg, search_state=ctx)
+    assert ctx.touched_nodes == set(range(len(cluster.nodes)))
+    assert ctx.tagged_candidates == res.num_costed
+
+
+def test_node_ids_namespace_is_respected(workload):
+    """An owner-supplied id namespace (the daemon's fleet ids for a tenant
+    carve) flows through to the tags verbatim."""
+    from metis_tpu.planner.api import make_search_state
+
+    cluster, store, model = workload
+    cfg = SearchConfig(gbs=PARITY_GBS, strict_compat=True)
+    ids = tuple(100 + i for i in range(len(cluster.nodes)))
+    ctx = make_search_state(cluster, store, model, cfg, node_ids=ids)
+    plan_hetero(cluster, store, model, cfg, search_state=ctx)
+    assert ctx.touched_nodes == set(ids)
+
+
+def test_node_ids_length_mismatch_rejected(workload):
+    from metis_tpu.planner.api import make_search_state
+
+    cluster, store, model = workload
+    with pytest.raises(ValueError):
+        make_search_state(cluster, store, model,
+                          SearchConfig(gbs=PARITY_GBS, strict_compat=True),
+                          node_ids=(0,))
+
+
+# ---------------------------------------------------------------------------
+# jax cost backend (cost/jax_backend.py) — numpy stays the parity oracle
+# ---------------------------------------------------------------------------
+
+
+def test_jax_backend_ranking_byte_identical(workload, serial_result):
+    """SearchConfig.cost_backend='jax' routes the batched candidate
+    pricing through the jit'd kernel; the ranking must be byte-identical
+    to the numpy default (same floats, not just same order)."""
+    pytest.importorskip("jax")
+    cluster, store, model = workload
+    res = plan_hetero(
+        cluster, store, model,
+        SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                     cost_backend="jax"))
+    assert dump_ranked_plans(res.plans) == dump_ranked_plans(
+        serial_result.plans)
+    assert res.num_costed == serial_result.num_costed
+
+
+def test_cost_backend_validated():
+    with pytest.raises(Exception):
+        SearchConfig(gbs=16, cost_backend="tensorflow")
+
+
+def test_cost_backend_event_emitted(workload, tmp_path):
+    pytest.importorskip("jax")
+    cluster, store, model = workload
+    path = tmp_path / "events.jsonl"
+    with EventLog(path) as log:
+        plan_hetero(cluster, store, model,
+                    SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                                 cost_backend="jax"),
+                    events=log)
+    evs = [e for e in _events(path) if e["event"] == "cost_backend"]
+    assert len(evs) == 1
+    assert evs[0]["backend"] == "jax"
+    assert evs[0]["batch_fast"] is True

@@ -407,6 +407,138 @@ class TestPlanService:
 
 
 # ---------------------------------------------------------------------------
+# incremental replanning (ClusterDelta keep/drop of warm state + cache)
+# ---------------------------------------------------------------------------
+
+
+class TestIncrementalReplan:
+    def test_changed_node_ids_partial_and_full(self, service):
+        """Shrinks peel from the END of a type's node run; a partial loss
+        narrows the last matching node — only that node id changes."""
+        from metis_tpu.planner.replan import grow_cluster, shrink_cluster
+
+        full = service.cluster  # A100 node 0, T4 node 1 (4 devices each)
+        half = shrink_cluster(full, {"T4": 2})
+        assert service._full_node_ids(half) == (0, 1)
+        assert service._changed_node_ids(full, half) == frozenset({1})
+        gone = shrink_cluster(full, {"T4": 4})
+        assert service._full_node_ids(gone) == (0,)
+        assert service._changed_node_ids(full, gone) == frozenset({1})
+        assert service._changed_node_ids(full, full) == frozenset()
+        back = grow_cluster(gone, full, {"T4": 4})
+        assert service._changed_node_ids(gone, back) == frozenset({1})
+
+    def test_full_cluster_queries_are_recosted_not_reused(
+            self, small_workload, service):
+        """A single-job search lays stages over every node, so a delta
+        touching any node drops its warm state — and the reused/recosted
+        counters reconcile exactly with the pre-delta candidate tags."""
+        _, _, model, config = small_workload
+        service.plan_query(model, config, top_k=5)
+        flipped = dataclasses.replace(config, use_overlap_model=False)
+        service.plan_query(model, flipped, top_k=5)
+        tagged = sum(s.tagged_candidates for s in service._states.values())
+        assert tagged > 0
+        out = service.apply_cluster_delta({"T4": 4})
+        assert service.stats()["warm_states"] == 0
+        c = service.counters
+        assert c.get("replan.incremental.reused") == 0
+        assert c.get("replan.incremental.recosted") == tagged
+        assert out["invalidated"] == 2
+
+    def test_shrink_grow_round_trip_byte_identical(self, small_workload,
+                                                   service):
+        """After a delta the daemon's answer must equal a cold full search
+        on the new topology, and the grow-back must reproduce the original
+        full-fleet ranking byte-identically under the original key."""
+        from metis_tpu.core.types import dump_ranked_plans
+        from metis_tpu.planner.api import plan_hetero
+
+        _, profiles, model, config = small_workload
+        cold = service.plan_query(model, config, top_k=5)
+        service.apply_cluster_delta(removed={"T4": 4})
+        shrunk = service.plan_query(model, config, top_k=5)
+        assert shrunk["cached"] is False
+        assert shrunk["fingerprint"] != cold["fingerprint"]
+        offline = dump_ranked_plans(
+            plan_hetero(service.cluster, profiles, model, config,
+                        top_k=5).plans)
+        assert shrunk["plans"] == offline
+        service.apply_cluster_delta(added={"T4": 4})
+        restored = service.plan_query(model, config, top_k=5)
+        assert restored["fingerprint"] == cold["fingerprint"]
+        assert restored["plans"] == cold["plans"]
+
+    def test_tenant_carve_untouched_by_delta_stays_warm(self,
+                                                        small_workload,
+                                                        service):
+        """The satellite-1 regression: a delta that misses a tenant's
+        carve must keep that tenant's warm search state AND its cached
+        answer; only intersecting states are re-costed."""
+        from metis_tpu.sched.tenant import TenantSpec
+
+        _, _, model, config = small_workload
+        service.tenant_register(
+            TenantSpec("a", model, config, priority=1, quota_ceiling=4))
+        service.tenant_register(
+            TenantSpec("b", model, config, quota_ceiling=4))
+        a0 = service.tenant_plan("a")
+        b0 = service.tenant_plan("b")
+        assert a0["node_indices"] == [0]  # A100 node: lowest hazard first
+        assert b0["node_indices"] == [1]
+        tagged = {k: s.tagged_candidates
+                  for k, s in service._states.items()}
+        assert tagged
+        service.apply_cluster_delta(removed={"T4": 4})
+        # tenant a's carve (node 0) missed the delta (node 1): cached
+        # answer survives, warm state survives
+        a1 = service.tenant_plan("a")
+        assert a1["cached"] is True
+        assert a1["plans"] == a0["plans"]
+        kept = [k for k in tagged if k in service._states]
+        assert kept, "delta dropped the untouched tenant's warm state"
+        assert all(service._states[k].touched_nodes == {0} for k in kept)
+        c = service.counters
+        reused = c.get("replan.incremental.reused")
+        recosted = c.get("replan.incremental.recosted")
+        assert reused == sum(tagged[k] for k in kept) > 0
+        assert recosted > 0
+        assert reused + recosted == sum(tagged.values())
+        # grow back: tenant b's carve recurs with a byte-identical ranking
+        service.apply_cluster_delta(added={"T4": 4})
+        b2 = service.tenant_plan("b")
+        assert b2["node_indices"] == [1]
+        assert b2["plans"] == b0["plans"]
+
+    def test_incremental_replan_event_schema(self, small_workload,
+                                             tmp_path):
+        from metis_tpu.core.events import EventLog
+        from metis_tpu.serve.daemon import PlanService
+        from tools.check_events_schema import validate_events
+
+        cluster, profiles, model, config = small_workload
+        path = tmp_path / "events.jsonl"
+        with EventLog(path) as log:
+            svc = PlanService(cluster, profiles, events=log)
+            svc.plan_query(model, config, top_k=5)
+            tagged = sum(s.tagged_candidates
+                         for s in svc._states.values())
+            svc.apply_cluster_delta(removed={"T4": 2})
+            svc.close()
+        evs = [json.loads(line)
+               for line in path.read_text().splitlines()]
+        assert validate_events(evs) == []
+        ir = [e for e in evs if e["event"] == "incremental_replan"]
+        assert len(ir) == 1
+        ev = ir[0]
+        assert ev["changed_nodes"] == [1]
+        assert ev["states_kept"] == 0 and ev["states_dropped"] == 1
+        assert ev["reused"] == 0
+        assert ev["recosted"] == tagged
+        assert ev["invalidated"] == 1
+
+
+# ---------------------------------------------------------------------------
 # end-to-end smoke (HTTP transport, concurrency, p50, event schema)
 # ---------------------------------------------------------------------------
 

@@ -105,6 +105,18 @@ EVENT_SCHEMA: dict[str, set[str]] = {
                         "shares_label", "cluster_devices"},
     "tenant_preempt": {"tenant", "from_devices", "to_devices", "priority"},
     "tenant_replan": {"tenant", "devices", "path"},
+    # sub-second replanning at scale (serve/daemon.py, planner/api.py):
+    # one incremental_replan per cluster delta — which reference node ids
+    # changed width, how many warm search states / cached candidates were
+    # kept vs dropped, and how many cache entries were invalidated; one
+    # symmetry_collapse per hetero search on a cluster with cost-equivalent
+    # device types (the class map plus replayed-vs-freshly-costed split);
+    # one cost_backend per search running a non-default cost backend
+    "incremental_replan": {"changed_nodes", "states_kept", "states_dropped",
+                           "reused", "recosted", "invalidated"},
+    "symmetry_collapse": {"classes", "total_sequences", "distinct_sequences",
+                          "collapse_frac", "replayed", "costed_fresh"},
+    "cost_backend": {"backend", "batch_fast"},
 }
 
 

@@ -54,6 +54,17 @@ Four frozen invariants, any drift exits 1:
    (two ``FleetPlan.dump()`` byte-identical) and match its checked-in
    golden (tools/search_sched_golden.json, recorded with
    ``--update-baseline``).
+10. **Symmetry-collapsed 1024-device golden.**  On the scale workload
+   (``metis_tpu.testing.symmetric_scale_workload``: 1024 devices, four
+   node types forming two cost-equivalence pairs), the symmetry-collapsed
+   search must reproduce the uncollapsed ranking byte-for-byte, actually
+   replay candidates (nonzero symmetry hits), and match its checked-in
+   golden (tools/search_1024_golden.json, recorded with
+   ``--update-baseline``).
+11. **Jax cost-backend byte-identity.**  When jax is importable,
+   ``SearchConfig.cost_backend="jax"`` must reproduce the numpy parity
+   rankings byte-for-byte in both strict-compat and native mode — numpy
+   stays the default-on parity oracle.
 
 ``--throughput`` adds a performance gate: the batched whole-search
 plan-throughput on the parity workload, NORMALIZED by the scalar path's
@@ -114,6 +125,11 @@ MIGRATION_FROM = ((1, 0, 5), (1, 5, 10))
 # seeded 2-tenant fixture (FleetPlan.dump() sha + the headline carve),
 # recorded by ``--update-baseline``.
 SCHED_GOLDEN = Path(__file__).resolve().parent / "search_sched_golden.json"
+
+# Scale golden: the symmetry-collapsed 1024-device hetero search
+# (testing.symmetric_scale_workload — two cost-equivalence type pairs),
+# sha-pinned ranking + replay split, recorded by ``--update-baseline``.
+SCALE_GOLDEN = Path(__file__).resolve().parent / "search_1024_golden.json"
 
 # Throughput baseline: batched + scalar plans/sec recorded on one host by
 # ``--update-baseline``; the check compares host-normalized numbers, so the
@@ -411,8 +427,130 @@ def run_checks(workers: int = 2) -> list[str]:
                 f"sched golden missing: {SCHED_GOLDEN} "
                 "(record one with --update-baseline)")
 
+        # jax backend legs: byte-identity against the numpy rankings
+        # already computed above (skipped cleanly when jax is absent)
+        problems.extend(_check_jax_backend(
+            cluster, store, model, dump_ranked_plans(serial.plans),
+            serial.num_costed, native_dump))
+
         problems.extend(_check_grid_oracle(cluster, store))
+
+    # scale leg: symmetry-collapsed 1024-device search vs the uncollapsed
+    # ranking and the checked-in golden
+    problems.extend(_check_scale_leg())
     return problems
+
+
+def _check_jax_backend(cluster, store, model, strict_dump: str,
+                       strict_costed: int, native_dump: str) -> list[str]:
+    """``cost_backend="jax"`` must reproduce the numpy rankings
+    byte-for-byte in strict-compat and native mode.  Hosts without jax
+    skip the leg (numpy is the only backend there by construction)."""
+    try:
+        import jax  # noqa: F401
+    except Exception:
+        return []
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.testing import PARITY_GBS
+
+    problems: list[str] = []
+    jax_strict = plan_hetero(
+        cluster, store, model,
+        SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                     cost_backend="jax"))
+    if dump_ranked_plans(jax_strict.plans) != strict_dump:
+        problems.append(
+            "cost_backend='jax' strict-compat ranking is not "
+            "byte-identical to the numpy oracle")
+    if jax_strict.num_costed != strict_costed:
+        problems.append(
+            f"cost_backend='jax' num_costed = {jax_strict.num_costed}, "
+            f"numpy oracle = {strict_costed}")
+    jax_native = plan_hetero(
+        cluster, store, model,
+        SearchConfig(gbs=PARITY_GBS, cost_backend="jax"))
+    if dump_ranked_plans(jax_native.plans) != native_dump:
+        problems.append(
+            "cost_backend='jax' native-mode ranking is not byte-identical "
+            "to the numpy oracle")
+    return problems
+
+
+def _run_scale_search(symmetry: bool):
+    """(dump, result, sym_hits) of the 1024-device scale search."""
+    import dataclasses
+
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.planner.api import make_search_state, plan_hetero
+    from metis_tpu.testing import symmetric_scale_workload
+
+    cluster, profiles, model, config = symmetric_scale_workload()
+    if not symmetry:
+        config = dataclasses.replace(config, symmetry_collapse=False)
+    ctx = make_search_state(cluster, profiles, model, config)
+    res = plan_hetero(cluster, profiles, model, config,
+                      search_state=ctx, top_k=10)
+    return dump_ranked_plans(res.plans), res, ctx.sym_hits
+
+
+def _check_scale_leg() -> list[str]:
+    problems: list[str] = []
+    sym_dump, sym_res, hits = _run_scale_search(symmetry=True)
+    plain_dump, plain_res, _ = _run_scale_search(symmetry=False)
+    if sym_dump != plain_dump:
+        problems.append(
+            "symmetry-collapsed 1024-device ranking is not byte-identical "
+            "to the uncollapsed search")
+    if sym_res.num_costed != plain_res.num_costed:
+        problems.append(
+            f"symmetry collapse changed num_costed: {sym_res.num_costed} "
+            f"vs {plain_res.num_costed} uncollapsed")
+    if hits == 0:
+        problems.append(
+            "scale workload produced no symmetry replays (the two "
+            "equivalence pairs should collapse 24 sequences to 6)")
+    if SCALE_GOLDEN.exists():
+        golden = json.loads(SCALE_GOLDEN.read_text())
+        entry = _scale_fingerprint(sym_res, sym_dump, hits)
+        for key in ("num_costed", "dump_sha256", "best_total_ms",
+                    "sym_replayed"):
+            if golden.get(key) != entry[key]:
+                problems.append(
+                    f"1024-device golden drift: {key} = {entry[key]}, "
+                    f"frozen golden is {golden.get(key)} "
+                    f"(re-record deliberately with --update-baseline)")
+    else:
+        problems.append(
+            f"1024-device golden missing: {SCALE_GOLDEN} "
+            "(record one with --update-baseline)")
+    return problems
+
+
+def _scale_fingerprint(result, dump: str, sym_hits: int) -> dict:
+    """Golden entry for the symmetry-collapsed 1024-device search."""
+    import hashlib
+
+    best = result.plans[0] if result.plans else None
+    return {
+        "workload": "scale (1024 devices: 32 nodes x 8 each of AX/AY "
+                    "A100-clones + BX/BY T4-clones, GPT-10L, gbs=4096, "
+                    "strict_compat, symmetry_collapse=True, top_k=10)",
+        "num_costed": result.num_costed,
+        "dump_sha256": hashlib.sha256(dump.encode()).hexdigest(),
+        "best_total_ms": (round(best.cost.total_ms, 4) if best else None),
+        "sym_replayed": sym_hits,
+    }
+
+
+def record_scale_golden() -> dict:
+    """Run the 1024-device symmetry-collapsed search and write its
+    golden."""
+    dump, res, hits = _run_scale_search(symmetry=True)
+    entry = _scale_fingerprint(res, dump, hits)
+    SCALE_GOLDEN.write_text(json.dumps(entry, indent=2) + "\n")
+    return entry
 
 
 def _run_sched_fixture():
@@ -739,6 +877,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"inference golden written: {inf_golden}")
         sched_golden = record_sched_golden()
         print(f"sched golden written: {sched_golden}")
+        scale_golden = record_scale_golden()
+        print(f"1024-device golden written: {scale_golden}")
         entry = measure_throughput()
         THROUGHPUT_BASELINE.write_text(json.dumps(entry, indent=2) + "\n")
         print(f"throughput baseline written: {entry}")
@@ -757,7 +897,9 @@ def main(argv: list[str] | None = None) -> int:
           f"inert + overlap golden matches, spot-off inert + spot golden "
           f"matches, migration-off inert + migration golden matches, "
           f"inference search deterministic + golden matches, fleet "
-          f"partition deterministic + sched golden matches)")
+          f"partition deterministic + sched golden matches, 1024-device "
+          f"symmetry collapse byte-identical + scale golden matches, jax "
+          f"backend byte-identical where available)")
     return 0
 
 
