@@ -1335,9 +1335,14 @@ def inference_bench(record: dict) -> None:
       TTFT under the PARITY_INFERENCE SLOs, plus TPOT/throughput and the
       search wall time;
     - ``replay_slo_attainment`` (headline): request-weighted SLO
-      attainment over one diurnal traffic cycle replayed against the
-      in-process serve daemon with elastic cluster deltas (replan pushes
-      counted).
+      attainment of the PREDICTIVE autoscaler over one diurnal traffic
+      cycle replayed against the in-process serve daemon with elastic
+      cluster deltas (replan pushes counted);
+    - ``replay_device_hours`` / ``autoscale_vs_hysteresis_ratio``
+      (headlines): provisioned device-hours of the predictive policy and
+      its ratio to the reactive hysteresis baseline on the IDENTICAL
+      4→40 rps trace — each policy replays against its own fresh daemon,
+      since cluster deltas mutate daemon topology.
 
     Socket setup can fail on locked-down hosts — the replay half skips
     with the honest reason while the offline search numbers survive."""
@@ -1368,33 +1373,46 @@ def inference_bench(record: dict) -> None:
             entry["prefill_devices"] = best.prefill.num_devices
             entry["decode_devices"] = best.decode.num_devices
 
-        try:
-            service = PlanService(cluster, profiles)
-            server, thread, address = serve_in_thread(service)
-        except OSError as e:
-            entry["replay_skipped_reason"] = f"socket setup failed: {e}"
-            record["inference"] = entry
-            return
-        try:
-            client = PlanServiceClient(address)
-            t0 = time.perf_counter()
-            report = replay_traffic(
-                client, cluster, model, config, workload,
-                base_rps=4.0, peak_rps=40.0, ticks_per_cycle=12, cycles=1)
-            entry["replay_wall_s"] = round(time.perf_counter() - t0, 2)
-            entry["replay_slo_attainment"] = round(
-                report.slo_attainment, 4)
-            entry["replay_ticks"] = len(report.ticks)
-            entry["replay_replan_pushes"] = report.replan_pushes
-            entry["replay_devices_min"] = min(report.device_trajectory)
-            entry["replay_devices_max"] = max(report.device_trajectory)
-        finally:
+        reports: dict = {}
+        replay_wall = 0.0
+        for policy in ("hysteresis", "predictive"):
             try:
-                client.shutdown()
-            except Exception:
-                server.shutdown()
-            thread.join(10)
-            server.server_close()
+                service = PlanService(cluster, profiles)
+                server, thread, address = serve_in_thread(service)
+            except OSError as e:
+                entry["replay_skipped_reason"] = f"socket setup failed: {e}"
+                record["inference"] = entry
+                return
+            try:
+                client = PlanServiceClient(address)
+                t0 = time.perf_counter()
+                reports[policy] = replay_traffic(
+                    client, cluster, model, config, workload,
+                    base_rps=4.0, peak_rps=40.0, ticks_per_cycle=12,
+                    cycles=1, policy=policy)
+                replay_wall += time.perf_counter() - t0
+            finally:
+                try:
+                    client.shutdown()
+                except Exception:
+                    server.shutdown()
+                thread.join(10)
+                server.server_close()
+        hyst, pred = reports["hysteresis"], reports["predictive"]
+        entry["replay_wall_s"] = round(replay_wall, 2)
+        entry["replay_slo_attainment"] = round(pred.slo_attainment, 4)
+        entry["replay_slo_attainment_hysteresis"] = round(
+            hyst.slo_attainment, 4)
+        entry["replay_ticks"] = len(pred.ticks)
+        entry["replay_replan_pushes"] = pred.replan_pushes
+        entry["replay_devices_min"] = min(pred.device_trajectory)
+        entry["replay_devices_max"] = max(pred.device_trajectory)
+        entry["replay_device_hours"] = round(pred.device_hours, 2)
+        entry["replay_device_hours_hysteresis"] = round(
+            hyst.device_hours, 2)
+        entry["autoscale_vs_hysteresis_ratio"] = (
+            round(pred.device_hours / hyst.device_hours, 4)
+            if hyst.device_hours else None)
     record["inference"] = entry
 
 
@@ -2029,6 +2047,10 @@ def _headline(record: dict) -> dict:
         .get("slo_p99_ttft_ms"),
         "replay_slo_attainment": (record.get("inference") or {})
         .get("replay_slo_attainment"),
+        "replay_device_hours": (record.get("inference") or {})
+        .get("replay_device_hours"),
+        "autoscale_vs_hysteresis_ratio": (record.get("inference") or {})
+        .get("autoscale_vs_hysteresis_ratio"),
         "inference_skipped": ((record.get("inference") or {})
                               .get("skipped")
                               or (record.get("inference") or {})

@@ -53,6 +53,7 @@ def max_kv_concurrency(
     kv_bytes_per_seq: float,
     *,
     stage: int = 0,
+    shared_bytes: float = 0.0,
 ) -> int:
     """Max sequences a stage can hold KV for after its weights are resident.
 
@@ -60,16 +61,24 @@ def max_kv_concurrency(
     matching ``DeviceSpec.memory_mb``).  Weights that already meet or exceed
     capacity raise :class:`KvCacheOomError` — the placement can never serve,
     and a silent 0 would be indistinguishable from "free memory fits no
-    sequence yet", which IS reported as 0 and prunes the candidate."""
+    sequence yet", which IS reported as 0 and prunes the candidate.
+
+    ``shared_bytes`` is the paged model's once-per-lane shared-prefix page
+    set (``cost.estimator.shared_prefix_stage_bytes``): it comes off the free
+    pool before per-sequence division, but a prefix that alone overflows the
+    headroom reports 0 (prune) rather than OOM — the weights still fit."""
     capacity_bytes = capacity_mb * 1024 * 1024
     free = capacity_bytes - weights_bytes
     if free <= 0:
         raise KvCacheOomError(stage, weights_bytes / (1024 * 1024),
                               capacity_mb)
+    free -= shared_bytes
     if kv_bytes_per_seq <= 0:
         # A stage holding only the embed/head pseudo-layers caches no KV —
         # concurrency is unbounded by THIS stage; callers min() across stages.
         return 1 << 30
+    if free <= 0:
+        return 0
     return int(free // kv_bytes_per_seq)
 
 

@@ -193,6 +193,71 @@ def kv_stage_bytes(
         model, kv_dtype_bytes=kv_dtype_bytes, tp=tp)
 
 
+def paged_tokens(tokens: int, page_tokens: int) -> int:
+    """Token count rounded UP to whole KV pages (``page_tokens`` tokens per
+    page per layer, vLLM-style block allocation).  ``page_tokens <= 0`` means
+    exact (unpaged) accounting — the PR-9 model."""
+    if page_tokens <= 0 or tokens <= 0:
+        return max(tokens, 0)
+    return -(-tokens // page_tokens) * page_tokens
+
+
+def paged_kv_seq_bytes(
+    model,
+    context_len: int,
+    start: int,
+    end: int,
+    kv_dtype_bytes: int = 2,
+    tp: int = 1,
+    *,
+    page_tokens: int = 0,
+    prefix_len: int = 0,
+    prefix_share_frac: float = 0.0,
+) -> float:
+    """Expected per-rank KV bytes ONE sequence uniquely holds on a stage
+    under paged prefix sharing.
+
+    ``prefix_share_frac`` of sequences share one common prompt prefix of
+    ``prefix_len`` tokens whose pages are stored once per lane (see
+    :func:`shared_prefix_stage_bytes`), so a sharing sequence only allocates
+    pages for its ``context_len - prefix_len`` unique tail.  The remaining
+    ``1 - prefix_share_frac`` carry their full context.  With sharing off and
+    paging off this is EXACTLY ``kv_stage_bytes(model, 1, context_len, ...)``
+    — the short-circuit keeps the frozen PR-9 golden byte-identical."""
+    if prefix_share_frac <= 0.0 or prefix_len <= 0:
+        return kv_stage_bytes(model, 1, paged_tokens(context_len, page_tokens),
+                              start, end, kv_dtype_bytes, tp)
+    pfx = min(prefix_len, context_len)
+    full = kv_stage_bytes(model, 1, paged_tokens(context_len, page_tokens),
+                          start, end, kv_dtype_bytes, tp)
+    uniq = kv_stage_bytes(model, 1,
+                          paged_tokens(context_len - pfx, page_tokens),
+                          start, end, kv_dtype_bytes, tp)
+    return prefix_share_frac * uniq + (1.0 - prefix_share_frac) * full
+
+
+def shared_prefix_stage_bytes(
+    model,
+    prefix_len: int,
+    context_len: int,
+    start: int,
+    end: int,
+    kv_dtype_bytes: int = 2,
+    tp: int = 1,
+    *,
+    page_tokens: int = 0,
+    prefix_share_frac: float = 0.0,
+) -> float:
+    """Per-rank bytes of the ONE shared-prefix page set a stage keeps
+    resident (counted once per lane, not once per sequence).  Zero when
+    sharing is off."""
+    if prefix_share_frac <= 0.0 or prefix_len <= 0:
+        return 0.0
+    pfx = min(prefix_len, context_len)
+    return kv_stage_bytes(model, 1, paged_tokens(pfx, page_tokens),
+                          start, end, kv_dtype_bytes, tp)
+
+
 # Memo bounds (entries) for the PR-4 costing caches: wholesale clear beyond
 # these, so a long-lived daemon sweeping many clusters cannot grow them
 # unboundedly.  Evictions are visible as ``memo.*.evict`` counters.

@@ -39,11 +39,16 @@ from metis_tpu.core.config import ModelSpec, SearchConfig
 from metis_tpu.core.errors import KvCacheOomError, MetisError, ProfileMissError
 from metis_tpu.core.events import NULL_LOG, EventLog
 from metis_tpu.core.types import InferenceCostBreakdown, divisors
-from metis_tpu.cost.estimator import kv_stage_bytes, uniform_layer_split
+from metis_tpu.cost.estimator import (
+    paged_kv_seq_bytes,
+    shared_prefix_stage_bytes,
+    uniform_layer_split,
+)
 from metis_tpu.inference.workload import (
     InferenceWorkload,
     decode_compute_stage_ms,
     hbm_read_ms,
+    measured_decode_stage_ms,
     prefill_stage_ms,
 )
 from metis_tpu.profiles.store import ProfileStore
@@ -72,9 +77,14 @@ class PoolPlan:
     max_rps: float
     latency_ms: float  # prefill: pipeline forward latency; decode: TPOT
     batch_per_lane: int = 0  # decode only: chosen concurrency per lane
+    # decode only: "measured" when TPOT came from the profile's decode table,
+    # "derived" when a table exists but lacked this pool's (type, tp) points
+    # and the forward-share derivation priced it.  "" (pre-decode-table
+    # stores) is omitted from the dump so the frozen PR-9 golden survives.
+    decode_source: str = ""
 
     def to_json_dict(self) -> dict:
-        return {
+        d = {
             "role": self.role,
             "node_counts": {t: self.node_counts[t]
                             for t in sorted(self.node_counts)},
@@ -88,6 +98,9 @@ class PoolPlan:
             "latency_ms": self.latency_ms,
             "batch_per_lane": self.batch_per_lane,
         }
+        if self.decode_source:
+            d["decode_source"] = self.decode_source
+        return d
 
 
 @dataclass(frozen=True)
@@ -284,35 +297,76 @@ def _price_prefill(pool, profiles, model, config, workload, inter, dp, tps):
 def _price_decode(pool, profiles, model, config, workload, inter, dp, tps):
     """Decode-side pricing of one candidate.
 
-    Returns ``(batch, tpot_ms, (compute_ms, kv_read_ms, comm_ms), rps)``
-    at the best KV-feasible batch inside the TPOT SLO, or raises
-    ProfileMissError / KvCacheOomError for the caller to prune on."""
+    Returns ``(batch, tpot_ms, (compute_ms, kv_read_ms, comm_ms), rps,
+    decode_source)`` at the best KV-feasible batch inside the TPOT SLO, or
+    raises ProfileMissError / KvCacheOomError for the caller to prune on.
+
+    Compute rates come from the profile's MEASURED decode table when every
+    (type, tp) this candidate touches carries one (``decode_source ==
+    "measured"``); a store with no decode table at all prices from the
+    training forward share exactly as PR 9 did (``decode_source == ""``,
+    omitted from dumps); a table with partial coverage falls back to the
+    derivation for the WHOLE candidate (``"derived"``) — mixing pricing
+    models across stages of one pipeline would make stage sums meaningless.
+
+    KV bytes use the paged prefix-sharing model: each lane keeps one copy of
+    the shared-prefix pages (``shared``) plus per-sequence unique pages
+    (``kv_per_seq``); the HBM roofline reads the shared pages once per step
+    (cascade attention) rather than once per sequence."""
     ranks = rank_device_types(pool, inter.node_sequence)
     offsets = _layer_offsets(model, inter.num_stages)
     context = workload.max_context_len
+    pfx = workload.shared_prefix_len
     params = profiles.model.params_per_layer_bytes
-    stage_info = []
+    stages = []  # (lo, hi, tp, types, weights_per_rank, kv_per_seq, shared, hbm)
     b_max = _B_CLAMP
     for s, (lo, hi) in enumerate(offsets):
         r0, r1 = inter.stage_rank_range(s)
         types = sorted(set(ranks[r0:r1]))
         tp = tps[s]
         weights_per_rank = sum(params[lo:hi]) / tp
-        kv_per_seq = kv_stage_bytes(model, 1, context, lo, hi,
-                                    workload.kv_dtype_bytes, tp)
+        kv_per_seq = paged_kv_seq_bytes(
+            model, context, lo, hi, workload.kv_dtype_bytes, tp,
+            page_tokens=workload.page_tokens, prefix_len=pfx,
+            prefix_share_frac=workload.prefix_share_frac)
+        shared = shared_prefix_stage_bytes(
+            model, pfx, context, lo, hi, workload.kv_dtype_bytes, tp,
+            page_tokens=workload.page_tokens,
+            prefix_share_frac=workload.prefix_share_frac)
         cap_mb = min(pool.memory_mb(t) for t in types)
         b_max = min(b_max, max_kv_concurrency(
-            cap_mb, weights_per_rank, kv_per_seq, stage=s))
-        comp_rate = max(
-            decode_compute_stage_ms(profiles, model, t, tp, lo, hi, 1,
-                                    config.max_profiled_bs)
-            for t in types)
-        hbm_bw = min(pool.devices[t].effective_hbm_gbps for t in types)
-        stage_info.append((comp_rate, weights_per_rank, kv_per_seq, hbm_bw))
+            cap_mb, weights_per_rank, kv_per_seq, stage=s,
+            shared_bytes=shared))
+        stages.append((lo, hi, tp, types, weights_per_rank, kv_per_seq,
+                       shared,
+                       min(pool.devices[t].effective_hbm_gbps
+                           for t in types)))
     if b_max < 1:
         # weights fit (max_kv_concurrency did not raise) but the headroom
         # holds no whole sequence — prune, distinct from the OOM case
         raise _PruneBatch("KV headroom below one sequence")
+    decode_source = ""
+    comp_rates = None
+    if profiles.has_decode():
+        measured = [
+            [measured_decode_stage_ms(profiles, t, tp, lo, hi, 1,
+                                      config.max_profiled_bs)
+             for t in types]
+            for lo, hi, tp, types, *_ in stages]
+        if all(m is not None for ms in measured for m in ms):
+            decode_source = "measured"
+            comp_rates = [max(ms) for ms in measured]
+        else:
+            decode_source = "derived"
+    if comp_rates is None:
+        comp_rates = [
+            max(decode_compute_stage_ms(profiles, model, t, tp, lo, hi, 1,
+                                        config.max_profiled_bs)
+                for t in types)
+            for lo, hi, tp, types, *_ in stages]
+    stage_info = [(rate, w, kvps, shared, hbm)
+                  for rate, (_, _, _, _, w, kvps, shared, hbm)
+                  in zip(comp_rates, stages)]
     send_per_seq = 0.0
     if inter.num_stages > 1:
         bw = pool.inter_bw_for_types(pool.device_types)
@@ -320,9 +374,9 @@ def _price_decode(pool, profiles, model, config, workload, inter, dp, tps):
 
     def step(batch):
         comp_sum = kv_excess = 0.0
-        for comp_rate, w, kvps, hbm in stage_info:
+        for comp_rate, w, kvps, shared, hbm in stage_info:
             comp = comp_rate * batch
-            mem = hbm_read_ms(w + kvps * batch, hbm)
+            mem = hbm_read_ms(w + shared + kvps * batch, hbm)
             comp_sum += comp
             kv_excess += max(0.0, mem - comp)
         comm = (inter.num_stages - 1) * send_per_seq * batch
@@ -345,7 +399,7 @@ def _price_decode(pool, profiles, model, config, workload, inter, dp, tps):
     tpot_ms, parts = step(best_b)
     tokens_per_s = dp * best_b * 1000.0 / tpot_ms
     rps = tokens_per_s / workload.output_len
-    return best_b, tpot_ms, parts, rps
+    return best_b, tpot_ms, parts, rps, decode_source
 
 
 class _PruneBatch(MetisError):
@@ -372,11 +426,18 @@ def plan_inference(
     decode candidate (max generation rps under the TPOT SLO).  Splits where
     a pool has no feasible candidate are dropped (counted in
     ``num_pruned``)."""
-    # prompt KV handoff crosses pools on the slowest inter-node link present
+    # prompt KV handoff crosses pools on the slowest inter-node link present;
+    # a shared prefix's pages are already resident on the decode pool
+    # (transferred once, amortized to ~0 per request), so the expected
+    # per-request transfer is the unique-page bytes under the paged model —
+    # identical to the full prompt when sharing is off
     handoff_bw = cluster.inter_bw_for_types(cluster.device_types)
     handoff_ms = hbm_read_ms(
-        kv_stage_bytes(model, 1, workload.tail_prompt_len, 0,
-                       model.num_layers, workload.kv_dtype_bytes, 1),
+        paged_kv_seq_bytes(model, workload.tail_prompt_len, 0,
+                           model.num_layers, workload.kv_dtype_bytes, 1,
+                           page_tokens=workload.page_tokens,
+                           prefix_len=workload.shared_prefix_len,
+                           prefix_share_frac=workload.prefix_share_frac),
         handoff_bw)
 
     num_costed = num_pruned = num_splits = 0
@@ -420,7 +481,7 @@ def plan_inference(
                       for t in dec_pool.device_types}
         for inter, dp, tps in _pool_candidates(dec_pool, model, config):
             try:
-                batch, tpot_ms, parts, rps = _price_decode(
+                batch, tpot_ms, parts, rps, decode_source = _price_decode(
                     dec_pool, profiles, model, config, workload,
                     inter, dp, tps)
             except (ProfileMissError, KvCacheOomError, _PruneBatch):
@@ -442,6 +503,7 @@ def plan_inference(
                     max_rps=rps,
                     latency_ms=tpot_ms,
                     batch_per_lane=batch,
+                    decode_source=decode_source,
                 ), parts)
 
         if best_pre is None or best_dec is None:
@@ -495,7 +557,9 @@ def plan_inference(
         events.emit("inference_plan", rank=i + 1,
                     ttft_p99_ms=p.cost.ttft_p99_ms,
                     tpot_p99_ms=p.cost.tpot_p99_ms,
-                    max_rps=p.cost.throughput_rps)
+                    max_rps=p.cost.throughput_rps,
+                    prefix_share_frac=workload.prefix_share_frac,
+                    kv_page_tokens=workload.page_tokens)
     best = result.best
     if best is not None and not best.cost.slo_ok:
         if best.cost.ttft_p99_ms > workload.slo_ttft_p99_ms:

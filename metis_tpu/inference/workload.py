@@ -34,7 +34,15 @@ class InferenceWorkload:
     Lengths are tokens; the ``*_p99`` fields describe the distribution tail
     the SLO is evaluated at (0 = deterministic lengths, tail == mean).
     ``kv_dtype_bytes`` prices the KV cache separately from activations —
-    int8 KV (1) halves the footprint of the bf16 default (2)."""
+    int8 KV (1) halves the footprint of the bf16 default (2).
+
+    The paged-sharing fields describe production prompt reuse:
+    ``prefix_share_frac`` of requests share ONE common prompt prefix of
+    ``prefix_len`` tokens (system prompt, few-shot preamble) whose KV pages
+    are stored once per lane instead of once per sequence;
+    ``page_tokens`` is the KV allocator's page granularity (0 = exact,
+    unpaged accounting — the PR-9 model).  All three default to off, which
+    is byte-identical to the pre-paging cost model."""
 
     arrival_rate_rps: float
     prompt_len: int
@@ -44,6 +52,9 @@ class InferenceWorkload:
     prompt_len_p99: int = 0
     output_len_p99: int = 0
     kv_dtype_bytes: int = 2
+    prefix_share_frac: float = 0.0
+    prefix_len: int = 0
+    page_tokens: int = 0
 
     def __post_init__(self) -> None:
         if self.arrival_rate_rps <= 0:
@@ -58,6 +69,10 @@ class InferenceWorkload:
             raise ValueError("output_len_p99 cannot undercut output_len")
         if self.kv_dtype_bytes < 1:
             raise ValueError("kv_dtype_bytes must be >= 1")
+        if not 0.0 <= self.prefix_share_frac <= 1.0:
+            raise ValueError("prefix_share_frac must be in [0, 1]")
+        if self.prefix_len < 0 or self.page_tokens < 0:
+            raise ValueError("prefix_len and page_tokens must be >= 0")
 
     @property
     def tail_prompt_len(self) -> int:
@@ -72,8 +87,22 @@ class InferenceWorkload:
         """Worst-case KV residency per sequence (end of tail generation)."""
         return self.tail_prompt_len + self.tail_output_len
 
+    @property
+    def shared_prefix_len(self) -> int:
+        """Shared-prefix tokens actually creditable: the prefix lives in the
+        prompt (generation always diverges), so it clamps to the tail prompt
+        length."""
+        return min(self.prefix_len, self.tail_prompt_len)
+
     def to_json_dict(self) -> dict:
-        return asdict(self)
+        d = asdict(self)
+        # Paged-sharing fields at their off defaults are omitted so default
+        # workloads serialize exactly as they did pre-paging — the frozen
+        # inference golden sha-pins these bytes.
+        for f in ("prefix_share_frac", "prefix_len", "page_tokens"):
+            if not d[f]:
+                del d[f]
+        return d
 
 
 def workload_from_dict(d: dict) -> InferenceWorkload:
@@ -138,6 +167,38 @@ def decode_compute_stage_ms(
     per_token_ms = fwd_fraction * prof.time_slice(start, end) / (
         bs * model.sequence_length)
     return per_token_ms * batch
+
+
+def largest_decode_bs(profiles: ProfileStore, device_type: str, tp: int,
+                      cap: int) -> int:
+    """Largest DECODE-profiled batch size <= ``cap`` for (device_type, tp),
+    or 0 when the store has no measured decode table there — callers fall
+    back to the forward-share derivation rather than raising."""
+    return max((bs for (t, p, bs) in profiles.decode_configs(device_type)
+                if p == tp and bs <= cap), default=0)
+
+
+def measured_decode_stage_ms(
+    profiles: ProfileStore,
+    device_type: str,
+    tp: int,
+    start: int,
+    end: int,
+    batch: int,
+    max_profiled_bs: int,
+) -> float | None:
+    """Decode step time for ``batch`` sequences across layers [start, end)
+    priced from the MEASURED decode table (KV-cache-resident single-token
+    microbenchmark), or None when (device_type, tp) has no decode entry —
+    the planner then derives from the training forward share instead.
+
+    Read at the largest decode-profiled batch (same amortization argument
+    as :func:`largest_profiled_bs`) and scaled linearly to ``batch``."""
+    bs = largest_decode_bs(profiles, device_type, tp, max_profiled_bs)
+    if not bs:
+        return None
+    prof = profiles.get(device_type, tp, bs)
+    return prof.decode_time_slice(start, end) / bs * batch
 
 
 def hbm_read_ms(bytes_read: float, hbm_gbps: float) -> float:

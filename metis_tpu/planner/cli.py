@@ -251,6 +251,16 @@ def _add_inference_args(p: argparse.ArgumentParser) -> None:
                    help="p99 generated length (0/omitted = deterministic)")
     g.add_argument("--kv-dtype-bytes", type=int, default=None,
                    help="KV-cache element bytes (2 = bf16 default, 1 = int8)")
+    g.add_argument("--prefix-share-frac", type=float, default=None,
+                   help="fraction of requests sharing one common prompt "
+                        "prefix whose KV pages are stored once per lane "
+                        "(0 = no sharing, the exact pre-paging model)")
+    g.add_argument("--prefix-len", type=int, default=None,
+                   help="shared prompt-prefix length, tokens (clamped to "
+                        "the tail prompt length)")
+    g.add_argument("--page-tokens", type=int, default=None,
+                   help="KV allocator page size, tokens per page per layer "
+                        "(0/omitted = exact unpaged accounting)")
 
 
 def _workload_from_args(args: argparse.Namespace,
@@ -274,6 +284,9 @@ def _workload_from_args(args: argparse.Namespace,
         "prompt_len_p99": args.prompt_len_p99,
         "output_len_p99": args.output_len_p99,
         "kv_dtype_bytes": args.kv_dtype_bytes,
+        "prefix_share_frac": args.prefix_share_frac,
+        "prefix_len": args.prefix_len,
+        "page_tokens": args.page_tokens,
     }
     for k, v in overrides.items():
         if v is not None:
@@ -352,6 +365,14 @@ def main(argv: list[str] | None = None) -> int:
                         help="comma-separated batch sizes to profile")
     p_prof.add_argument("--warmup", type=int, default=2)
     p_prof.add_argument("--iters", type=int, default=5)
+    p_prof.add_argument("--decode", action="store_true",
+                        help="also measure KV-cache-resident single-token "
+                             "decode steps per (tp, bs) — the measured TPOT "
+                             "table serving search prefers over the "
+                             "forward-share derivation")
+    p_prof.add_argument("--decode-context", type=int, default=None,
+                        help="resident KV tokens during decode profiling "
+                             "(default: the model's sequence length)")
     p_prof.add_argument("--events", default=None,
                         help="append structured JSONL measurement events "
                              "(profile_measured per (tp, bs)) to this file")
@@ -643,6 +664,12 @@ def main(argv: list[str] | None = None) -> int:
                        help="simulated seconds per tick (no wall sleeps)")
     g_rpl.add_argument("--min-nodes", type=int, default=2,
                        help="scale-down floor, nodes")
+    g_rpl.add_argument("--policy", choices=("hysteresis", "predictive"),
+                       default="hysteresis",
+                       help="elastic policy: reactive hysteresis (scale "
+                            "after a tick shows stress) or predictive "
+                            "(forecast the arrival trend and scale BEFORE "
+                            "the rate crosses the feasible ceiling)")
 
     args = parser.parse_args(argv)
 
@@ -831,16 +858,17 @@ def _cmd_replay(args: argparse.Namespace) -> int:
             base_rps=args.base_rps, peak_rps=args.peak_rps,
             ticks_per_cycle=args.ticks_per_cycle, cycles=args.cycles,
             tick_seconds=args.tick_seconds, min_nodes=args.min_nodes,
-            top_k=args.top_k, events=events)
+            top_k=args.top_k, policy=args.policy, events=events)
     finally:
         if server is not None:
             server.shutdown()
             server.server_close()
     _emit(args, json.dumps(report.to_json_dict(), indent=2))
-    print(f"slo attainment {report.slo_attainment:.3f} over "
-          f"{report.cycles} cycle(s), devices "
+    print(f"[{report.policy}] slo attainment {report.slo_attainment:.3f} "
+          f"over {report.cycles} cycle(s), devices "
           f"{min(report.device_trajectory, default=0)}-"
-          f"{max(report.device_trajectory, default=0)}, "
+          f"{max(report.device_trajectory, default=0)} "
+          f"({report.device_hours:.1f} device-hours), "
           f"{report.replan_pushes} replan push(es)", file=sys.stderr)
     events.close()
     return 0
@@ -994,6 +1022,29 @@ def _cmd_explain(args: argparse.Namespace, profiles, model, config,
     return 0
 
 
+def _kv_sharing_summary(model, workload) -> dict:
+    """Per-sequence decode-pool KV with and without paged prefix sharing
+    (full model depth, tp=1 — the hardware-independent contribution)."""
+    from metis_tpu.cost.estimator import kv_stage_bytes, paged_kv_seq_bytes
+
+    ctx = workload.max_context_len
+    full = kv_stage_bytes(model, 1, ctx, 0, model.num_layers,
+                          workload.kv_dtype_bytes, 1)
+    eff = paged_kv_seq_bytes(
+        model, ctx, 0, model.num_layers, workload.kv_dtype_bytes, 1,
+        page_tokens=workload.page_tokens,
+        prefix_len=workload.shared_prefix_len,
+        prefix_share_frac=workload.prefix_share_frac)
+    return {
+        "prefix_share_frac": workload.prefix_share_frac,
+        "shared_prefix_len": workload.shared_prefix_len,
+        "page_tokens": workload.page_tokens,
+        "kv_bytes_per_seq_full": round(full),
+        "kv_bytes_per_seq_effective": round(eff),
+        "kv_reduction_frac": (round(1.0 - eff / full, 4) if full else 0.0),
+    }
+
+
 def _cmd_explain_inference(args: argparse.Namespace, profiles, model,
                            config, events) -> int:
     """Serving counterpart of `explain`: per-component TTFT/TPOT delta
@@ -1032,6 +1083,8 @@ def _cmd_explain_inference(args: argparse.Namespace, profiles, model,
             name, d = bds[0].decisive_component(bds[1])
             payload["decisive"] = {"component": name,
                                    "delta_ms": round(d, 4)}
+        if workload.prefix_share_frac > 0.0:
+            payload["kv_sharing"] = _kv_sharing_summary(model, workload)
         _emit(args, json.dumps(payload, indent=2))
         return 0
 
@@ -1078,8 +1131,19 @@ def _cmd_explain_inference(args: argparse.Namespace, profiles, model,
             f"(max {pf.max_rps:.1f} rps) | decode "
             f"{dict(sorted(dc.node_counts.items()))} dp={dc.dp} "
             f"tp={list(dc.tp_per_stage)} batch/lane={dc.batch_per_lane} "
-            f"(max {dc.max_rps:.1f} rps); "
+            f"(max {dc.max_rps:.1f} rps, "
+            f"tpot {dc.decode_source or 'derived'}); "
             f"slo {'ok' if p.cost.slo_ok else 'VIOLATED'}")
+    if workload.prefix_share_frac > 0.0:
+        ks = _kv_sharing_summary(model, workload)
+        lines.append("")
+        lines.append(
+            f"prefix sharing: f={ks['prefix_share_frac']} over "
+            f"{ks['shared_prefix_len']} shared tokens (page="
+            f"{ks['page_tokens'] or 1}) — per-seq decode KV "
+            f"{ks['kv_bytes_per_seq_effective'] / 1e6:.1f} MB vs "
+            f"{ks['kv_bytes_per_seq_full'] / 1e6:.1f} MB unshared "
+            f"({ks['kv_reduction_frac']:.1%} smaller)")
     if len(bds) == 2:
         name, d = bds[0].decisive_component(bds[1])
         lines.append("")
@@ -1185,11 +1249,14 @@ def _cmd_profile(args: argparse.Namespace) -> int:
         tps=tuple(int(t) for t in args.tps.split(",")),
         bss=tuple(int(b) for b in args.bss.split(",")),
         config=ProfilerConfig(warmup=args.warmup, iters=args.iters),
-        events=events)
+        events=events,
+        decode=args.decode,
+        decode_context=args.decode_context)
     store.dump_to_dir(args.output_dir,
                       {"model_name": model.name, "attn": model.attn})
+    decode_note = " (+decode tables)" if store.has_decode() else ""
     print(f"profiled {model.name} -> {args.output_dir} "
-          f"({', '.join(store.device_types)})", file=sys.stderr)
+          f"({', '.join(store.device_types)}){decode_note}", file=sys.stderr)
     return 0
 
 

@@ -36,8 +36,9 @@ Design notes (TPU-first):
 """
 from __future__ import annotations
 
+import math
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from functools import partial
 from pathlib import Path
 from typing import Callable, Sequence
@@ -276,6 +277,109 @@ class LayerProfiler:
 
         return embed_fb, block_fb, head_fb, scan_fb
 
+    # -- decode mode ---------------------------------------------------------
+    def _make_decode_fns(self, cfg: GPTConfig):
+        """(embed_step, block_step, head_step) — forward-only SINGLE-TOKEN
+        closures, the serving decode regime: one new token per sequence
+        attending over a resident KV cache.  At q_len=1 the attention matmuls
+        are GEMVs and the step is memory-bound on cache+weight reads — the
+        physics ``inference.planner._price_decode`` races against compute,
+        now measured instead of derived from the training forward share."""
+        from metis_tpu.models.llama import LlamaConfig
+        from metis_tpu.models.gpt import _layer_norm
+
+        if isinstance(cfg, (MoEConfig, LlamaConfig)):
+            raise MetisError(
+                "decode profiling currently supports the GPT family only")
+        h, nh, hd, dt = cfg.hidden, cfg.num_heads, cfg.head_dim, cfg.dtype
+
+        def embed_step(embed_params, tokens):
+            tok = embed_params["tok"].astype(dt)[tokens]
+            # decode always runs at the END of the context window
+            pos = embed_params["pos"].astype(dt)[cfg.seq_len - 1]
+            return tok + pos[None, None, :]
+
+        def block_step(layer, x, k_cache, v_cache):
+            y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+            qkv = jnp.einsum("bsh,chk->cbsk", y, layer["qkv"].astype(dt),
+                             preferred_element_type=jnp.float32)
+            qkv = (qkv + layer["qkv_bias"][:, None, None, :]).astype(dt)
+
+            def heads(t):  # [b, 1, h] -> [b, nh, 1, hd]
+                b, s, _ = t.shape
+                return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+            q, k, v = heads(qkv[0]), heads(qkv[1]), heads(qkv[2])
+            ks = jnp.concatenate([k_cache, k], axis=2)
+            vs = jnp.concatenate([v_cache, v], axis=2)
+            # one query token sees the whole cache + itself: causal masking
+            # is vacuous at q_len=1
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, ks,
+                                preferred_element_type=jnp.float32)
+            weights = jax.nn.softmax(
+                scores / math.sqrt(hd), axis=-1).astype(dt)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", weights, vs)
+            b, _, s, _ = ctx.shape
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+            attn_out = jnp.einsum("bsh,hk->bsk", ctx,
+                                  layer["proj"].astype(dt),
+                                  preferred_element_type=jnp.float32)
+            x = x + (attn_out + layer["proj_bias"]).astype(dt)
+            y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+            z = jnp.einsum("bsh,hf->bsf", y, layer["mlp_in"].astype(dt),
+                           preferred_element_type=jnp.float32)
+            z = jax.nn.gelu(
+                (z + layer["mlp_in_bias"]).astype(jnp.float32)).astype(dt)
+            z = jnp.einsum("bsf,fh->bsh", z, layer["mlp_out"].astype(dt),
+                           preferred_element_type=jnp.float32)
+            return x + (z + layer["mlp_out_bias"]).astype(dt)
+
+        def head_step(head_params, x):
+            return head_logits({"head": head_params}, x, cfg)
+
+        return embed_step, block_step, head_step
+
+    def _profile_decode_one(self, tp: int, bs: int,
+                            context: int) -> tuple[float, ...]:
+        """Per-layer single-token decode step times at (tp, bs) with
+        ``context`` KV tokens resident — the measured ``decode`` table row.
+        No normalization pass: unlike the training decomposition (which only
+        trusts per-layer RATIOS inside one fused step), each decode closure
+        IS the deployed unit of work."""
+        cfg = self.cfg
+        if len(self.devices) < tp:
+            raise MetisError(
+                f"tp={tp} needs {tp} devices, have {len(self.devices)}")
+        mesh = Mesh(np.array(self.devices[:tp]).reshape(1, tp), (DP, TP))
+        specs = param_specs_for(cfg, ep_axis=None, tp_size=tp)
+        key = jax.random.PRNGKey(self.config.seed)
+        with mesh:
+            params = shard_params(init_params_for(key, cfg), mesh, specs)
+            repl = NamedSharding(mesh, P())
+            tokens = jax.device_put(
+                jax.random.randint(key, (bs, 1), 0, cfg.vocab_size), repl)
+            x = jax.device_put(
+                jax.random.normal(key, (bs, 1, cfg.hidden), cfg.dtype), repl)
+            kv_shape = (bs, cfg.num_heads, context, cfg.head_dim)
+            k_cache = jax.device_put(
+                jax.random.normal(key, kv_shape, cfg.dtype), repl)
+            v_cache = jax.device_put(
+                jax.random.normal(jax.random.fold_in(key, 1), kv_shape,
+                                  cfg.dtype), repl)
+            layer0 = jax.tree.map(lambda a: a[0], params["blocks"])
+            embed_step, block_step, head_step = self._make_decode_fns(cfg)
+
+            embed_p, head_p = params["embed"], params["head"]
+            j_embed = _aot_compile(embed_step, (embed_p, tokens))
+            j_block = _aot_compile(block_step, (layer0, x, k_cache, v_cache))
+            j_head = _aot_compile(head_step, (head_p, x))
+            w, it = self.config.warmup, self.config.iters
+            embed_ms = _median_ms(j_embed, (embed_p, tokens), w, it)
+            block_ms = _median_ms(j_block, (layer0, x, k_cache, v_cache),
+                                  w, it)
+            head_ms = _median_ms(j_head, (head_p, x), w, it)
+        return tuple([embed_ms] + [block_ms] * cfg.num_blocks + [head_ms])
+
     def _profile_one(self, tp: int, bs: int) -> LayerProfile:
         cfg, model = self.cfg, self.model
         if len(self.devices) < tp:
@@ -429,7 +533,8 @@ class LayerProfiler:
 
     # -- public API ---------------------------------------------------------
     def run(
-        self, tps: Sequence[int] = (1,), bss: Sequence[int] = (1,)
+        self, tps: Sequence[int] = (1,), bss: Sequence[int] = (1,),
+        *, decode: bool = False, decode_context: int | None = None,
     ) -> ProfileStore:
         """Profile every available (tp, bs) combination into a ProfileStore.
 
@@ -437,6 +542,12 @@ class LayerProfiler:
         head count) are skipped — profile what the hardware can measure, plan
         with what was profiled (the reference's ``max_profiled_tp_degree``
         contract, ``arguments.py:44``).
+
+        ``decode=True`` additionally measures the KV-cache-resident
+        single-token decode step per (tp, bs) (``decode_context`` resident
+        tokens, default the model's sequence length) — the serving planner
+        then prices TPOT from the measurement (``decode_source="measured"``)
+        instead of the training forward-share derivation.
         """
         self.events.emit(
             "profile_started", device_type=self.device_type,
@@ -456,6 +567,17 @@ class LayerProfiler:
             for bs in bss:
                 t_cfg = time.perf_counter()
                 prof = self._profile_one(tp, bs)
+                if decode:
+                    ctx = decode_context or self.model.sequence_length
+                    t_dec = time.perf_counter()
+                    dec_times = self._profile_decode_one(tp, bs, ctx)
+                    prof = dc_replace(prof, decode_layer_times_ms=dec_times,
+                                      decode_context_len=ctx)
+                    self.events.emit(
+                        "decode_profile", device_type=self.device_type,
+                        tp=tp, bs=bs, context_len=ctx,
+                        step_ms=round(sum(dec_times), 4),
+                        wall_s=round(time.perf_counter() - t_dec, 3))
                 entries[(self.device_type, tp, bs)] = prof
                 self.events.emit(
                     "profile_measured", device_type=self.device_type,
@@ -495,10 +617,13 @@ def profile_model(
     devices: Sequence | None = None,
     config: ProfilerConfig = ProfilerConfig(),
     events: EventLog = NULL_LOG,
+    decode: bool = False,
+    decode_context: int | None = None,
 ) -> ProfileStore:
     """One-call measured profiling (see :class:`LayerProfiler`)."""
     return LayerProfiler(model, device_type, devices, config,
-                         events=events).run(tps, bss)
+                         events=events).run(tps, bss, decode=decode,
+                                            decode_context=decode_context)
 
 
 def measure_remat_fraction(
@@ -596,9 +721,12 @@ def profile_to_dir(
     bss: Sequence[int] = (1,),
     device_type: str | None = None,
     config: ProfilerConfig = ProfilerConfig(),
+    decode: bool = False,
+    decode_context: int | None = None,
 ) -> list[Path]:
     """Profile and write reference-schema JSON files (the end-to-end path:
     profile on this host -> plan anywhere)."""
-    store = profile_model(model, tps, bss, device_type, config=config)
+    store = profile_model(model, tps, bss, device_type, config=config,
+                          decode=decode, decode_context=decode_context)
     return store.dump_to_dir(
         out_dir, {"model_name": model.name, "attn": model.attn})

@@ -34,18 +34,36 @@ _FNAME_RE = re.compile(r"(?:DeviceType\.)?(?P<type>\w+?)_tp(?P<tp>\d+)_bs(?P<bs>
 
 @dataclass(frozen=True)
 class LayerProfile:
-    """Measured behavior of one (device_type, tp, bs) configuration."""
+    """Measured behavior of one (device_type, tp, bs) configuration.
+
+    The decode fields are optional: a KV-cache-resident single-token decode
+    step measured per layer at this (tp, bs), with ``decode_context_len``
+    tokens resident during the measurement.  ``None`` means this entry was
+    profiled without decode mode — serving falls back to the forward-share
+    derivation (``inference.workload.decode_compute_stage_ms``)."""
 
     layer_times_ms: tuple[float, ...]   # per-layer fwd+bwd
     layer_memory_mb: tuple[float, ...]  # per-layer peak memory
     fb_sync_ms: float                   # fwd/bwd total minus per-layer sum
+    decode_layer_times_ms: tuple[float, ...] | None = None
+    decode_context_len: int = 0
 
     @property
     def num_layers(self) -> int:
         return len(self.layer_times_ms)
 
+    @property
+    def has_decode(self) -> bool:
+        return self.decode_layer_times_ms is not None
+
     def time_slice(self, start: int, end: int) -> float:
         return sum(self.layer_times_ms[start:end])
+
+    def decode_time_slice(self, start: int, end: int) -> float:
+        """Single-token decode step time across layers [start, end) — callers
+        check :attr:`has_decode` first."""
+        assert self.decode_layer_times_ms is not None
+        return sum(self.decode_layer_times_ms[start:end])
 
     def memory_slice(self, start: int, end: int) -> float:
         return sum(self.layer_memory_mb[start:end])
@@ -138,6 +156,16 @@ class ProfileStore:
     def configs(self, device_type: str | None = None) -> list[tuple[str, int, int]]:
         return [k for k in self._entries if device_type is None or k[0] == device_type]
 
+    def has_decode(self) -> bool:
+        """True when ANY entry carries a measured decode table — the gate the
+        serving planner uses to decide whether ``decode_source`` is in play."""
+        return any(p.has_decode for p in self._entries.values())
+
+    def decode_configs(self, device_type: str | None = None) -> list[tuple[str, int, int]]:
+        """(device_type, tp, bs) keys that carry a measured decode table."""
+        return [k for k, p in self._entries.items()
+                if p.has_decode and (device_type is None or k[0] == device_type)]
+
     def max_tp(self, device_type: str) -> int:
         return max((tp for (t, tp, _) in self._entries if t == device_type), default=0)
 
@@ -197,6 +225,10 @@ class ProfileStore:
                     layer_times_ms=tuple(b_i * bs for b_i in slopes),
                     layer_memory_mb=prof.layer_memory_mb,
                     fb_sync_ms=prof.fb_sync_ms,
+                    # decode steps are read raw (largest profiled bs), never
+                    # bs-smoothed — pass the table through untouched
+                    decode_layer_times_ms=prof.decode_layer_times_ms,
+                    decode_context_len=prof.decode_context_len,
                 )
             overhead[(t, tp)] = a_total
         smoothed = ProfileStore(entries, self.model, self.type_meta)
@@ -298,6 +330,13 @@ class ProfileStore:
                     "layer_memory_total_mb": list(prof.layer_memory_mb),
                 },
             }
+            if prof.has_decode:
+                # extension section (absent from the reference schema, which
+                # has no serving story): per-layer single-token decode step
+                raw["decode"] = {
+                    "context_len": prof.decode_context_len,
+                    "layer_step_ms": list(prof.decode_layer_times_ms),
+                }
             path = out / f"DeviceType.{dtype}_tp{tp}_bs{bs}.json"
             path.write_text(json.dumps(raw, indent=2))
             written.append(path)
@@ -308,10 +347,14 @@ def _layer_profile_from_raw(raw: dict) -> LayerProfile:
     times = tuple(float(t) for t in raw["execution_time"]["layer_compute_total_ms"])
     fb_total = float(raw["execution_time"]["forward_backward_time_ms"])
     mem = tuple(float(m) for m in raw["execution_memory"]["layer_memory_total_mb"])
+    decode = raw.get("decode")
     return LayerProfile(
         layer_times_ms=times,
         layer_memory_mb=mem,
         fb_sync_ms=fb_total - sum(times),
+        decode_layer_times_ms=(tuple(float(t) for t in decode["layer_step_ms"])
+                               if decode else None),
+        decode_context_len=int(decode["context_len"]) if decode else 0,
     )
 
 

@@ -98,8 +98,15 @@ def synthesize_profiles(
     tps: list[int] | None = None,
     bss: list[int] | None = None,
     chip_perf: dict[str, ChipPerf] | None = None,
+    decode_context: int = 0,
 ) -> ProfileStore:
-    """Build a ProfileStore covering ``device_types`` × ``tps`` × ``bss``."""
+    """Build a ProfileStore covering ``device_types`` × ``tps`` × ``bss``.
+
+    ``decode_context > 0`` additionally synthesizes a measured-style decode
+    table per entry (single-token step with that many KV tokens resident,
+    roofline max of GEMV compute vs weight+cache reads) — the zero-TPU
+    stand-in for ``metis-tpu profile --decode``.  Off by default so training
+    fixtures keep their exact historical bytes."""
     tps = tps or [1, 2, 4]
     bss = bss or [1, 2, 4, 8]
     perf_map = chip_perf or CHIP_PERF
@@ -110,8 +117,17 @@ def synthesize_profiles(
         perf = perf_map[dtype]
         for tp in tps:
             for bs in bss:
-                entries[(dtype, tp, bs)] = _synth_layer_profile(
-                    model, perf, tp, bs, params)
+                prof = _synth_layer_profile(model, perf, tp, bs, params)
+                if decode_context > 0:
+                    prof = LayerProfile(
+                        layer_times_ms=prof.layer_times_ms,
+                        layer_memory_mb=prof.layer_memory_mb,
+                        fb_sync_ms=prof.fb_sync_ms,
+                        decode_layer_times_ms=_synth_decode_times(
+                            model, perf, tp, bs, params, decode_context),
+                        decode_context_len=decode_context,
+                    )
+                entries[(dtype, tp, bs)] = prof
 
     # Optimizer reads/writes all Adam state at each chip type's HBM bandwidth.
     opt_bytes = sum(params) * (1 + _ADAM_STATE_FACTOR)
@@ -171,6 +187,35 @@ def _synth_layer_profile(
         layer_memory_mb=tuple(mems),
         fb_sync_ms=fb_sync,
     )
+
+
+def _synth_decode_times(
+    model: ModelSpec, perf: ChipPerf, tp: int, bs: int,
+    params: tuple[int, ...], context: int,
+) -> tuple[float, ...]:
+    """Per-layer single-token decode step times: roofline max of the GEMV
+    compute (forward only, one token per sequence) and the HBM reads the
+    step cannot avoid (stage weights once + the batch's KV cache)."""
+    h, v = model.hidden_size, model.vocab_size
+    f = h * model.ffn_multiplier
+    eff_flops = perf.bf16_tflops * 1e12 * perf.mfu(bs, tp)
+    hbm_bps = perf.hbm_bw_gbps * 1e9
+    kv_heads = model.num_kv_heads or model.num_heads
+    head_dim = h // model.num_heads
+    kv_bytes = bs * context * 2 * kv_heads * head_dim * model.dtype_bytes / tp
+
+    def step_ms(flops: float, read_bytes: float) -> float:
+        return max(flops / tp / eff_flops, read_bytes / hbm_bps) * 1e3
+
+    # embed: one-row gathers, negligible compute, reads bs embedding rows
+    embed_ms = step_ms(0.0, bs * h * model.dtype_bytes)
+    # block: qkv/proj/FFN GEMVs + attention over the resident cache
+    block_flops = (8 * bs * h * h + 4 * bs * h * f
+                   + 4 * bs * context * kv_heads * head_dim)
+    block_ms = step_ms(block_flops, params[1] / tp + kv_bytes)
+    # head: one-token logits GEMV against the full vocab projection
+    head_ms = step_ms(2 * bs * h * v, params[-1] / tp)
+    return tuple([embed_ms] + [block_ms] * model.num_blocks + [head_ms])
 
 
 def tiny_test_model(num_layers: int = 10) -> ModelSpec:

@@ -27,6 +27,13 @@ PARITY_MAX_BS = 16
 # everything-violates degenerate case
 PARITY_INFERENCE = dict(arrival_rate_rps=4.0, prompt_len=512, output_len=128,
                         slo_ttft_p99_ms=2000.0, slo_tpot_p99_ms=100.0)
+#: Serving parity workload with paged prefix sharing on — the decode+prefix
+#: golden's workload (tools/search_inference_decode_golden.json).
+PARITY_INFERENCE_PREFIX = dict(PARITY_INFERENCE, prefix_share_frac=0.6,
+                               prefix_len=256, page_tokens=16)
+#: Resident KV tokens of the parity decode tables (= PARITY_INFERENCE's
+#: worst-case context: prompt 512 + output 128).
+PARITY_DECODE_CONTEXT = 640
 DEFAULT_REFERENCE_ROOT = Path("/root/reference")
 #: Spot-tier hazard used by the availability-aware parity variant.
 PARITY_SPOT_RATE = 0.05
@@ -93,6 +100,21 @@ def write_parity_fixture(target_dir: Path) -> None:
         for ip, t, bw, mem in [
             ("0.0.0.3", "T4", 50, 15), ("0.0.0.5", "T4", 50, 15),
             ("0.0.0.4", "A100", 46, 80), ("0.0.0.6", "A100", 46, 80)]}))
+
+
+def write_decode_parity_fixture(target_dir: Path) -> None:
+    """The parity workload with synthetic DECODE tables on every profile
+    entry (``PARITY_DECODE_CONTEXT`` resident tokens): the golden fixture
+    for measured-decode TPOT pricing (``decode_source="measured"``).
+    Training slices are byte-identical to ``write_parity_fixture``; only the
+    ``decode`` profile section is added."""
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+    write_parity_fixture(target_dir)
+    profiles = synthesize_profiles(
+        tiny_test_model(), ["A100", "T4"], tps=[1, 2, 4],
+        bss=[1, 2, 4, 8, 16], decode_context=PARITY_DECODE_CONTEXT)
+    profiles.dump_to_dir(target_dir / "profiles")
 
 
 def write_spot_parity_fixture(target_dir: Path) -> None:

@@ -704,3 +704,49 @@ def test_replay_subcommand(fixture_dir, tmp_path):
     assert report["cycles"] == 1
     assert len(report["ticks"]) == 4
     assert 0.0 <= report["slo_attainment"] <= 1.0
+
+
+def test_replay_predictive_policy(fixture_dir, tmp_path):
+    out = tmp_path / "replay.json"
+    rc = main(["replay", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-tp", "2", "--max-bs", "4",
+               "--prompt-len", "16", "--output-len", "8",
+               "--slo-ttft", "10000", "--slo-tpot", "1000",
+               "--base-rps", "1", "--peak-rps", "4",
+               "--ticks-per-cycle", "4", "--cycles", "1",
+               "--policy", "predictive",
+               "--output", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["policy"] == "predictive"
+    assert report["device_hours"] > 0
+
+
+def test_explain_inference_prefix_sharing(fixture_dir, tmp_path):
+    """--prefix-share-frac surfaces the KV-sharing contribution in both
+    render modes: a kv_sharing block in JSON and a prefix-sharing line with
+    the per-plan decode tpot source tag in the table."""
+    share = ["--prefix-share-frac", "0.5", "--prefix-len", "8",
+             "--page-tokens", "4"]
+    out = tmp_path / "explain.json"
+    rc = main(["explain", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-tp", "2", "--max-bs", "4",
+               *INFER_ARGS, *share, "--json", "--output", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    ks = payload["kv_sharing"]
+    assert ks["prefix_share_frac"] == 0.5
+    assert 0.0 < ks["kv_reduction_frac"] < 1.0
+    assert ks["kv_bytes_per_seq_effective"] < ks["kv_bytes_per_seq_full"]
+
+    txt = tmp_path / "explain.txt"
+    rc = main(["explain", *_cluster_args(fixture_dir),
+               "--profile-dir", str(fixture_dir / "profiles"),
+               *MODEL_ARGS, "--gbs", "8", "--max-tp", "2", "--max-bs", "4",
+               *INFER_ARGS, *share, "--output", str(txt)])
+    assert rc == 0
+    text = txt.read_text()
+    assert "prefix sharing" in text
+    assert "tpot derived" in text  # synthetic fixture has no decode table

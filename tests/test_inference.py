@@ -17,7 +17,13 @@ from metis_tpu.cluster import ClusterSpec
 from metis_tpu.cluster.spec import DeviceSpec
 from metis_tpu.core.config import SearchConfig
 from metis_tpu.core.errors import KvCacheOomError
-from metis_tpu.cost.estimator import kv_bytes_per_token, kv_stage_bytes
+from metis_tpu.cost.estimator import (
+    kv_bytes_per_token,
+    kv_stage_bytes,
+    paged_kv_seq_bytes,
+    paged_tokens,
+    shared_prefix_stage_bytes,
+)
 from metis_tpu.inference.planner import dump_inference_plans, plan_inference
 from metis_tpu.inference.workload import InferenceWorkload, workload_from_dict
 from metis_tpu.profiles import ProfileStore, synthesize_profiles, tiny_test_model
@@ -130,6 +136,105 @@ class TestKvMemoryModel:
         assert max_kv_concurrency(
             10.0, 2.0 * 1024 * 1024, 1.0 * 1024 * 1024) == 8
 
+    def test_paged_tokens_rounds_up_to_page(self):
+        assert paged_tokens(33, 16) == 48
+        assert paged_tokens(32, 16) == 32
+        assert paged_tokens(0, 16) == 0
+        assert paged_tokens(640, 0) == 640  # paging off passes through
+        # a page larger than the whole sequence still costs one full page
+        assert paged_tokens(5, 4096) == 4096
+
+    def test_zero_sharing_is_byte_identical_to_unshared(self):
+        m = tiny_test_model()
+        plain = kv_stage_bytes(m, 1, 640, 0, m.num_layers)
+        assert paged_kv_seq_bytes(m, 640, 0, m.num_layers) == plain
+        assert paged_kv_seq_bytes(m, 640, 0, m.num_layers, prefix_len=256,
+                                  prefix_share_frac=0.0) == plain
+        assert shared_prefix_stage_bytes(m, 256, 640, 0, m.num_layers) == 0.0
+
+    def test_full_sharing_leaves_only_the_unique_tail(self):
+        m = tiny_test_model()
+        # f=1: every sequence shares the prefix, so per-seq bytes are the
+        # tail beyond it ...
+        assert paged_kv_seq_bytes(
+            m, 640, 0, m.num_layers, prefix_len=256,
+            prefix_share_frac=1.0) \
+            == kv_stage_bytes(m, 1, 640 - 256, 0, m.num_layers)
+        # ... and a prefix covering the whole context costs nothing per seq
+        assert paged_kv_seq_bytes(
+            m, 640, 0, m.num_layers, prefix_len=10_000,
+            prefix_share_frac=1.0) == 0.0
+
+    def test_prefix_longer_than_prompt_clamps(self):
+        wl = _parity_workload(prefix_share_frac=0.5, prefix_len=10_000)
+        assert wl.shared_prefix_len == wl.tail_prompt_len
+
+    def test_page_larger_than_per_seq_kv_rounds_to_one_page(self):
+        m = tiny_test_model()
+        assert paged_kv_seq_bytes(m, 5, 0, m.num_layers, page_tokens=4096) \
+            == kv_stage_bytes(m, 1, 4096, 0, m.num_layers)
+
+    def test_partial_sharing_mixes_paged_full_and_unique(self):
+        m = tiny_test_model()
+        full = kv_stage_bytes(m, 1, paged_tokens(640, 16), 0, m.num_layers)
+        uniq = kv_stage_bytes(m, 1, paged_tokens(640 - 256, 16), 0,
+                              m.num_layers)
+        got = paged_kv_seq_bytes(m, 640, 0, m.num_layers, page_tokens=16,
+                                 prefix_len=256, prefix_share_frac=0.6)
+        assert got == pytest.approx(0.6 * uniq + 0.4 * full)
+        assert uniq < got < full
+
+    def test_gqa_int8_and_sharing_compose(self):
+        m = tiny_test_model()
+        gqa = dataclasses.replace(m, num_kv_heads=8)
+        kw = dict(page_tokens=16, prefix_len=256, prefix_share_frac=0.5)
+        base = paged_kv_seq_bytes(m, 640, 0, m.num_layers, 2, 1, **kw)
+        # GQA scales the shared model by the kv-head ratio, int8 halves it
+        assert paged_kv_seq_bytes(gqa, 640, 0, m.num_layers, 2, 1, **kw) \
+            == pytest.approx(base * 8 / m.num_heads)
+        assert paged_kv_seq_bytes(m, 640, 0, m.num_layers, 1, 1, **kw) \
+            == pytest.approx(base / 2)
+        skw = dict(page_tokens=16, prefix_share_frac=0.5)
+        shared = shared_prefix_stage_bytes(m, 256, 640, 0, m.num_layers, 2,
+                                           1, **skw)
+        assert shared_prefix_stage_bytes(gqa, 256, 640, 0, m.num_layers, 2,
+                                         1, **skw) \
+            == pytest.approx(shared * 8 / m.num_heads)
+
+    def test_shared_bytes_charge_against_concurrency(self):
+        mb = 1024 * 1024
+        # 10 MB capacity, 2 MB weights, 1 MB/seq: 8 lanes unshared ...
+        assert max_kv_concurrency(10.0, 2.0 * mb, 1.0 * mb) == 8
+        # ... the shared prefix pages are a one-off charge on the pool
+        assert max_kv_concurrency(10.0, 2.0 * mb, 1.0 * mb,
+                                  shared_bytes=3.0 * mb) == 5
+        # a prefix that alone overflows the headroom prunes (0), only
+        # weights overflowing is the raise
+        assert max_kv_concurrency(10.0, 2.0 * mb, 1.0 * mb,
+                                  shared_bytes=9.0 * mb) == 0
+        with pytest.raises(KvCacheOomError):
+            max_kv_concurrency(1.0, 2.0 * mb, 1.0 * mb, shared_bytes=0.0)
+
+    def test_workload_rejects_bad_sharing_fields(self):
+        with pytest.raises(ValueError):
+            _parity_workload(prefix_share_frac=1.5)
+        with pytest.raises(ValueError):
+            _parity_workload(prefix_share_frac=-0.1)
+        with pytest.raises(ValueError):
+            _parity_workload(prefix_len=-1)
+        with pytest.raises(ValueError):
+            _parity_workload(page_tokens=-1)
+
+    def test_workload_dump_omits_default_sharing_fields(self):
+        plain = _parity_workload().to_json_dict()
+        for key in ("prefix_share_frac", "prefix_len", "page_tokens"):
+            assert key not in plain
+        shared = _parity_workload(prefix_share_frac=0.6, prefix_len=256,
+                                  page_tokens=16).to_json_dict()
+        assert shared["prefix_share_frac"] == 0.6
+        assert shared["prefix_len"] == 256
+        assert shared["page_tokens"] == 16
+
     def test_planner_survives_oom_topology(self, parity_inputs):
         # shrink every device to 32 MB: weights alone overflow, every decode
         # candidate OOM-prunes, and the search reports that rather than
@@ -241,6 +346,9 @@ class TestQueryFingerprintWorkloads:
         dict(prompt_len_p99=1024),
         dict(output_len_p99=256),
         dict(kv_dtype_bytes=1),
+        dict(prefix_share_frac=0.5),
+        dict(prefix_len=128),
+        dict(page_tokens=16),
     ])
     def test_every_workload_field_flips_the_key(self, flip):
         assert self._fp(_parity_workload()) != self._fp(
@@ -395,3 +503,162 @@ class TestTrafficReplay:
         # restoring the node replans back toward the full topology
         out = service.apply_cluster_delta(added={"T4": 4}, replan=True)
         assert out["devices"] == 16
+
+
+# ---------------------------------------------------------------------------
+# measured decode profiles -> TPOT pricing (decode_source plumbing)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def decode_parity_inputs(tmp_path_factory):
+    from metis_tpu.testing import write_decode_parity_fixture
+
+    d = tmp_path_factory.mktemp("inf_decode")
+    write_decode_parity_fixture(d)
+    cluster = ClusterSpec.from_files(d / "hostfile", d / "clusterfile.json")
+    store = ProfileStore.from_dir(d / "profiles")
+    return cluster, store, tiny_test_model()
+
+
+class TestMeasuredDecode:
+    def test_decode_table_roundtrips_through_dump(self, decode_parity_inputs,
+                                                  tmp_path):
+        _, store, _ = decode_parity_inputs
+        assert store.has_decode()
+        prof = store.get("A100", 1, 1)
+        assert prof.has_decode
+        assert prof.decode_context_len == 640
+        store.dump_to_dir(tmp_path / "again")
+        back = ProfileStore.from_dir(tmp_path / "again")
+        assert back.get("A100", 1, 1).decode_layer_times_ms \
+            == prof.decode_layer_times_ms
+
+    def test_measured_table_changes_tpot_and_tags_the_source(
+            self, parity_inputs, decode_parity_inputs):
+        cluster, plain_store, model = parity_inputs
+        _, decode_store, _ = decode_parity_inputs
+        wl = _parity_workload()
+        derived = plan_inference(cluster, plain_store, model,
+                                 _parity_config(), wl)
+        measured = plan_inference(cluster, decode_store, model,
+                                  _parity_config(), wl)
+        assert derived.best.decode.decode_source == ""
+        assert "decode_source" not in dump_inference_plans(derived, wl)
+        assert measured.best.decode.decode_source == "measured"
+        assert '"decode_source": "measured"' in \
+            dump_inference_plans(measured, wl)
+        assert measured.best.cost.tpot_p99_ms \
+            != pytest.approx(derived.best.cost.tpot_p99_ms)
+
+    def test_partial_coverage_falls_back_to_derived(self,
+                                                    decode_parity_inputs):
+        # strip the decode tables from every T4 entry: candidates whose
+        # decode pool touches a T4 must fall back WHOLE-candidate, while
+        # all-A100 decode pools keep the measured pricing
+        cluster, store, model = decode_parity_inputs
+        entries = {k: (dataclasses.replace(p, decode_layer_times_ms=None,
+                                           decode_context_len=0)
+                       if k[0] == "T4" else p)
+                   for k, p in ((k, store.get(*k)) for k in store.configs())}
+        partial = ProfileStore(entries, store.model, store.type_meta)
+        assert partial.has_decode()
+        result = plan_inference(cluster, partial, model, _parity_config(),
+                                _parity_workload())
+        sources = {p.decode.decode_source: p for p in result.plans}
+        assert set(sources) <= {"measured", "derived"}
+        assert "derived" in sources
+        for p in result.plans:
+            if "T4" in p.decode.node_counts:
+                assert p.decode.decode_source == "derived"
+            else:
+                assert p.decode.decode_source == "measured"
+
+    def test_batched_and_scalar_parity_with_paged_kv(
+            self, decode_parity_inputs):
+        from metis_tpu.testing import PARITY_INFERENCE_PREFIX
+
+        cluster, store, model = decode_parity_inputs
+        wl = InferenceWorkload(**PARITY_INFERENCE_PREFIX)
+        batched = plan_inference(cluster, store, model, _parity_config(), wl)
+        scalar = plan_inference(
+            cluster, store, model,
+            dataclasses.replace(_parity_config(), use_batch_eval=False), wl)
+        assert dump_inference_plans(batched, wl) \
+            == dump_inference_plans(scalar, wl)
+        assert batched.best.decode.decode_source == "measured"
+
+
+# ---------------------------------------------------------------------------
+# predictive autoscaling (forecaster + policy comparison on one spike)
+# ---------------------------------------------------------------------------
+
+
+class TestPredictiveAutoscaling:
+    def test_forecast_extrapolates_a_linear_trend_exactly(self):
+        from metis_tpu.inference.replay import forecast_rate
+
+        # slope 1 through [1..4]: two ticks ahead of x=3 is 6
+        assert forecast_rate([1.0, 2.0, 3.0, 4.0], window=4, horizon=2) \
+            == pytest.approx(6.0)
+        # a falling trend forecasts below the last observation, floored at 0
+        assert forecast_rate([9.0, 6.0, 3.0], window=4, horizon=2) == 0.0
+        assert forecast_rate([5.0], window=4, horizon=2) == 5.0
+        assert forecast_rate([], window=4, horizon=2) == 0.0
+
+    def test_unknown_policy_rejected(self, parity_inputs):
+        from metis_tpu.inference.replay import replay_traffic
+
+        cluster, _, model = parity_inputs
+        with pytest.raises(ValueError, match="unknown replay policy"):
+            replay_traffic(None, cluster, model, _parity_config(),
+                           _parity_workload(), base_rps=4.0, peak_rps=40.0,
+                           policy="psychic")
+
+    def _replay(self, parity_inputs, log, policy: str):
+        from metis_tpu.inference.replay import replay_traffic
+        from metis_tpu.serve.client import PlanServiceClient
+        from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+        cluster, store, model = parity_inputs
+        # a FRESH daemon per policy: cluster deltas mutate the daemon's
+        # topology, so sharing one would leak state across policies
+        service = PlanService(cluster, store, events=log)
+        server, _thread, address = serve_in_thread(service)
+        try:
+            return replay_traffic(
+                PlanServiceClient(address), cluster, model,
+                _parity_config(), _parity_workload(),
+                base_rps=4.0, peak_rps=40.0, ticks_per_cycle=12, cycles=1,
+                policy=policy, events=log)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_predictive_matches_attainment_at_fewer_device_hours(
+            self, parity_inputs, tmp_path):
+        from tools.check_events_schema import validate_events
+
+        from metis_tpu.core.events import EventLog, read_events
+
+        path = tmp_path / "policy_events.jsonl"
+        log = EventLog(path)
+        hyst = self._replay(parity_inputs, log, "hysteresis")
+        pred = self._replay(parity_inputs, log, "predictive")
+        log.close()
+
+        assert hyst.policy == "hysteresis" and pred.policy == "predictive"
+        # the acceptance spike: 4 -> 40 rps over 12 ticks — predictive must
+        # hold the SLO line while provisioning less
+        assert pred.slo_attainment >= 0.999
+        assert pred.slo_attainment >= hyst.slo_attainment
+        assert pred.device_hours < hyst.device_hours
+        d = pred.to_json_dict()
+        assert d["policy"] == "predictive"
+        assert d["device_hours"] == pytest.approx(pred.device_hours)
+
+        events = read_events(path)
+        forecasts = [e for e in events if e["event"] == "autoscale_forecast"]
+        assert len(forecasts) == 12  # one per predictive tick
+        assert any(e["action"] == "down" for e in forecasts)
+        assert validate_events(events) == []

@@ -181,6 +181,35 @@ def test_profiler_honors_attn_flash():
     assert all(t > 0 for t in p.layer_times_ms)
 
 
+def test_decode_mode_measures_and_roundtrips(tmp_path):
+    """profile --decode: every (tp, bs) entry gains a KV-resident
+    single-token step table at the requested context, the store reports
+    has_decode, and the table survives a dump/load round trip."""
+    store = profile_model(TINY, tps=(1,), bss=(1, 2), config=FAST,
+                          decode=True, decode_context=16)
+    assert store.has_decode()
+    dtype = store.device_types[0]
+    for bs in (1, 2):
+        p = store.get(dtype, 1, bs)
+        assert p.has_decode
+        assert p.decode_context_len == 16
+        assert len(p.decode_layer_times_ms) == TINY.num_layers
+        assert all(t > 0 for t in p.decode_layer_times_ms)
+    store.dump_to_dir(tmp_path, {"model_name": TINY.name})
+    back = ProfileStore.from_dir(tmp_path)
+    assert back.get(dtype, 1, 2).decode_layer_times_ms \
+        == pytest.approx(store.get(dtype, 1, 2).decode_layer_times_ms)
+    assert back.get(dtype, 1, 2).decode_context_len == 16
+
+
+def test_decode_defaults_off_and_context_defaults_to_seq_len():
+    plain = profile_model(TINY, tps=(1,), bss=(1,), config=FAST)
+    assert not plain.has_decode()
+    dec = profile_model(TINY, tps=(1,), bss=(1,), config=FAST, decode=True)
+    p = dec.get(dec.device_types[0], 1, 1)
+    assert p.decode_context_len == TINY.sequence_length
+
+
 def test_profile_dir_records_attn(tmp_path):
     """profile_to_dir stamps the attention impl into the profile JSON meta so
     a plan consumer can tell which execution the numbers describe."""

@@ -61,13 +61,21 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "plan_cache_hit": {"fingerprint"},
     "plan_cache_miss": {"fingerprint"},
     "replan_push": {"fingerprint", "new_fingerprint", "reason"},
-    # serving-workload planning (inference/planner.py, inference/replay.py):
-    # one inference_plan per ranked serving plan; slo_violation when the
-    # best plan misses a p99 target (metric names which); replay_tick per
-    # simulated tick of the traffic-replay bench
-    "inference_plan": {"rank", "ttft_p99_ms", "tpot_p99_ms", "max_rps"},
+    # serving-workload planning (inference/planner.py, inference/replay.py,
+    # profiles/profiler.py): one inference_plan per ranked serving plan
+    # (prefix_share_frac/kv_page_tokens record the paged-sharing model the
+    # KV math used); slo_violation when the best plan misses a p99 target
+    # (metric names which); replay_tick per simulated tick of the
+    # traffic-replay bench; decode_profile per measured (tp, bs)
+    # KV-resident single-token step (metis-tpu profile --decode);
+    # autoscale_forecast per predictive-policy tick — the forecasted
+    # demand, the ceiling it was judged against, and the action taken
+    "inference_plan": {"rank", "ttft_p99_ms", "tpot_p99_ms", "max_rps",
+                       "prefix_share_frac", "kv_page_tokens"},
     "slo_violation": {"metric", "value", "slo"},
     "replay_tick": {"t_s", "arrival_rps", "devices", "slo_ok"},
+    "decode_profile": {"device_type", "tp", "bs", "context_len", "step_ms"},
+    "autoscale_forecast": {"t_s", "forecast_rps", "ceiling_rps", "action"},
     # fault tolerance (resilience/ — faults.py, retry.py, supervisor.py)
     "fault_injected": {"point"},
     "retry_attempt": {"op", "attempt"},

@@ -65,6 +65,18 @@ Four frozen invariants, any drift exits 1:
    ``SearchConfig.cost_backend="jax"`` must reproduce the numpy parity
    rankings byte-for-byte in both strict-compat and native mode — numpy
    stays the default-on parity oracle.
+12. **Decode+prefix inference golden.**  The serving search on the
+   decode-profiled parity fixture
+   (``metis_tpu.testing.write_decode_parity_fixture`` — synthetic decode
+   tables at ``PARITY_DECODE_CONTEXT`` resident tokens) with the
+   prefix-sharing workload (``PARITY_INFERENCE_PREFIX``: f=0.6 over 256
+   tokens, 16-token pages) must price TPOT from the measured table
+   (``decode_source == "measured"``), stay batched==scalar
+   byte-identical, and match its checked-in golden
+   (tools/search_inference_decode_golden.json, recorded with
+   ``--update-baseline``).  Leg 8 above keeps running on the decode-free
+   fixture at sharing defaults, pinning that the new pricing is inert
+   there.
 
 ``--throughput`` adds a performance gate: the batched whole-search
 plan-throughput on the parity workload, NORMALIZED by the scalar path's
@@ -105,6 +117,13 @@ OVERLAP_GOLDEN = Path(__file__).resolve().parent / (
 # latencies/throughput, recorded by ``--update-baseline``.
 INFERENCE_GOLDEN = Path(__file__).resolve().parent / (
     "search_inference_golden.json")
+
+# Measured-decode + prefix-sharing serving golden: the decode-profiled
+# parity fixture searched with PARITY_INFERENCE_PREFIX.  Freezes the
+# measured-TPOT pricing and the paged KV-sharing model; recorded by
+# ``--update-baseline``.
+INFERENCE_DECODE_GOLDEN = Path(__file__).resolve().parent / (
+    "search_inference_decode_golden.json")
 
 # Availability-aware ranking golden: the spot-tiered parity fixture
 # (testing.write_spot_parity_fixture — T4 pool marked spot) searched in
@@ -404,6 +423,11 @@ def run_checks(workers: int = 2) -> list[str]:
                 f"inference golden missing: {INFERENCE_GOLDEN} "
                 "(record one with --update-baseline)")
 
+        # decode+prefix leg: measured-TPOT pricing + paged KV sharing on
+        # the decode-profiled fixture must be deterministic, priced from
+        # the table, batched==scalar byte-identical, and match its golden
+        problems.extend(_check_decode_inference_leg())
+
         # sched leg: the 2-tenant fleet partition must be run-to-run
         # deterministic and match its checked-in placement golden
         sched_dump1, sched_plan = _run_sched_fixture()
@@ -621,6 +645,122 @@ def _run_inference_search(cluster, store, model):
                      max_profiled_bs=PARITY_MAX_BS),
         workload)
     return dump_inference_plans(result, workload), result
+
+
+def _run_decode_inference_search(cluster, store, model, *,
+                                 use_batch_eval: bool = True):
+    """(dump, result) of the decode-profiled prefix-sharing serving
+    search."""
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.inference.planner import dump_inference_plans, plan_inference
+    from metis_tpu.inference.workload import InferenceWorkload
+    from metis_tpu.testing import (
+        PARITY_GBS,
+        PARITY_INFERENCE_PREFIX,
+        PARITY_MAX_BS,
+        PARITY_MAX_TP,
+    )
+
+    workload = InferenceWorkload(**PARITY_INFERENCE_PREFIX)
+    result = plan_inference(
+        cluster, store, model,
+        SearchConfig(gbs=PARITY_GBS, max_profiled_tp=PARITY_MAX_TP,
+                     max_profiled_bs=PARITY_MAX_BS,
+                     use_batch_eval=use_batch_eval),
+        workload)
+    return dump_inference_plans(result, workload), result
+
+
+def _check_decode_inference_leg() -> list[str]:
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import write_decode_parity_fixture
+
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_decode_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        model = tiny_test_model()
+        dump1, res1 = _run_decode_inference_search(cluster, store, model)
+        dump2, _ = _run_decode_inference_search(cluster, store, model)
+        scalar_dump, _ = _run_decode_inference_search(
+            cluster, store, model, use_batch_eval=False)
+    if dump1 != dump2:
+        problems.append(
+            "decode+prefix inference search is not run-to-run deterministic")
+    if dump1 != scalar_dump:
+        problems.append(
+            "decode+prefix inference search: batched ranking is not "
+            "byte-identical to the scalar oracle")
+    best = res1.best
+    if best is None or best.decode.decode_source != "measured":
+        src = best.decode.decode_source if best else None
+        problems.append(
+            f"decode-profiled fixture priced TPOT from {src!r}, expected "
+            "'measured' (the decode table covers every (type, tp) point)")
+    if INFERENCE_DECODE_GOLDEN.exists():
+        golden = json.loads(INFERENCE_DECODE_GOLDEN.read_text())
+        entry = _decode_inference_fingerprint(res1, dump1)
+        for key in ("num_costed", "num_splits", "dump_sha256",
+                    "best_ttft_p99_ms", "best_tpot_p99_ms", "best_max_rps",
+                    "best_decode_source"):
+            if golden.get(key) != entry[key]:
+                problems.append(
+                    f"decode inference golden drift: {key} = {entry[key]}, "
+                    f"frozen golden is {golden.get(key)} "
+                    f"(re-record deliberately with --update-baseline)")
+    else:
+        problems.append(
+            f"decode inference golden missing: {INFERENCE_DECODE_GOLDEN} "
+            "(record one with --update-baseline)")
+    return problems
+
+
+def _decode_inference_fingerprint(result, dump: str) -> dict:
+    """Golden entry for the decode-profiled prefix-sharing serving
+    search."""
+    import hashlib
+
+    best = result.best
+    return {
+        "workload": "decode parity serving (8xA100+8xT4, GPT-10L, 4 rps, "
+                    "prompt 512 / output 128, SLO ttft 2000ms tpot 100ms, "
+                    "decode tables @640 tokens, prefix f=0.6 len 256 "
+                    "pages 16)",
+        "num_costed": result.num_costed,
+        "num_splits": result.num_splits,
+        "dump_sha256": hashlib.sha256(dump.encode()).hexdigest(),
+        "best_ttft_p99_ms": (round(best.cost.ttft_p99_ms, 4)
+                             if best else None),
+        "best_tpot_p99_ms": (round(best.cost.tpot_p99_ms, 4)
+                             if best else None),
+        "best_max_rps": (round(best.cost.throughput_rps, 4)
+                         if best else None),
+        "best_decode_source": (best.decode.decode_source if best else None),
+    }
+
+
+def record_decode_inference_golden() -> dict:
+    """Run the decode-profiled prefix-sharing serving search and write its
+    golden."""
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import write_decode_parity_fixture
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_decode_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        dump, result = _run_decode_inference_search(cluster, store,
+                                                    tiny_test_model())
+    entry = _decode_inference_fingerprint(result, dump)
+    INFERENCE_DECODE_GOLDEN.write_text(json.dumps(entry, indent=2) + "\n")
+    return entry
 
 
 def _inference_fingerprint(result, dump: str) -> dict:
@@ -875,6 +1015,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"migration golden written: {mig_golden}")
         inf_golden = record_inference_golden()
         print(f"inference golden written: {inf_golden}")
+        dec_golden = record_decode_inference_golden()
+        print(f"decode inference golden written: {dec_golden}")
         sched_golden = record_sched_golden()
         print(f"sched golden written: {sched_golden}")
         scale_golden = record_scale_golden()
@@ -896,7 +1038,8 @@ def main(argv: list[str] | None = None) -> int:
           f"batched == scalar oracle, time grid matches, overlap-off "
           f"inert + overlap golden matches, spot-off inert + spot golden "
           f"matches, migration-off inert + migration golden matches, "
-          f"inference search deterministic + golden matches, fleet "
+          f"inference search deterministic + golden matches, decode+prefix "
+          f"serving measured + golden matches, fleet "
           f"partition deterministic + sched golden matches, 1024-device "
           f"symmetry collapse byte-identical + scale golden matches, jax "
           f"backend byte-identical where available)")
