@@ -621,6 +621,91 @@ def scale_search_4096(record: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# exact branch-and-bound: certificates + the tightened default-beam bound
+# ---------------------------------------------------------------------------
+
+
+def exact_search_bench(record: dict, remaining_s: float | None) -> None:
+    """Exact backend vs the beam on the 1024-device scale workload.
+
+    Headlines:
+    - ``optimality_gap_frac``: the beam best's certified gap against the
+      exact backend's proven lower bound (0.0 = the beam is provably
+      optimal on this workload, not just unbeaten).
+    - ``bound_prune_frac``: extra candidate classes the exact backend's
+      relaxation bound lets the DEFAULT beam skip (tight vs stock
+      num_bound_pruned delta over classes considered) while the ranking
+      stays byte-identical — the "certificates also make the default
+      search faster" half of the claim.
+    """
+    import dataclasses as _dc
+    import time as _time
+
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.planner.api import plan_hetero
+    from metis_tpu.testing import symmetric_scale_workload
+
+    if remaining_s is not None and remaining_s < 90.0:
+        record["exact_search"] = {
+            "skipped_reason": f"needs >= 90 s of bench budget for the "
+                              f"exact + stock/tight runs, have "
+                              f"{remaining_s:.0f} s"}
+        return
+    cluster, profiles, model, config = symmetric_scale_workload()
+    entry: dict = {"devices": 1024, "gbs": config.gbs}
+
+    beam = plan_hetero(cluster, profiles, model, config, top_k=10)
+    deadline = 60.0 if remaining_s is None else min(60.0, remaining_s / 2)
+    t0 = _time.perf_counter()
+    exact = plan_hetero(
+        cluster, profiles, model,
+        _dc.replace(config, backend="exact", exact_deadline_s=deadline),
+        top_k=10)
+    exact_s = _time.perf_counter() - t0
+    cert = exact.certificate
+    if cert is None:
+        entry["skipped_reason"] = (
+            f"exact backend produced no certificate within its "
+            f"{deadline:.0f} s deadline")
+        record["exact_search"] = entry
+        return
+    beam_best = beam.best.cost.total_ms
+    entry.update({
+        "exact_wall_s": round(exact_s, 2),
+        "exact_complete": cert.complete,
+        "certified_best_ms": round(cert.best_ms, 4),
+        "proven_lower_bound_ms": round(cert.lower_bound_ms, 4),
+        "nodes_explored": cert.nodes_explored,
+        "nodes_bounded": cert.nodes_bounded,
+        "exact_num_costed": exact.num_costed,
+        "beam_num_costed": beam.num_costed,
+        "beam_best_ms": round(beam_best, 4),
+        # the beam's gap against the PROVEN bound, not just the exact best
+        "optimality_gap_frac": round(
+            max(0.0, (beam_best - cert.lower_bound_ms) / beam_best), 6),
+    })
+
+    # tightened-bound beam: native mode (the stock bound prune is inert
+    # under strict_compat), stock vs tight at byte-identical top-10
+    native = _dc.replace(config, strict_compat=False, prune_to_top_k=10)
+    stock = plan_hetero(cluster, profiles, model,
+                        _dc.replace(native, tight_bound=False), top_k=10)
+    tight = plan_hetero(cluster, profiles, model, native, top_k=10)
+    considered = stock.num_costed + stock.num_bound_pruned
+    entry.update({
+        "bound_pruned_stock": stock.num_bound_pruned,
+        "bound_pruned_tight": tight.num_bound_pruned,
+        "bound_prune_frac": round(
+            (tight.num_bound_pruned - stock.num_bound_pruned)
+            / max(1, considered), 6),
+        "tight_ranking_byte_identical": (
+            dump_ranked_plans(tight.plans) == dump_ranked_plans(
+                stock.plans)),
+    })
+    record["exact_search"] = entry
+
+
+# ---------------------------------------------------------------------------
 # north-star scenario: GPT-2.7B-class on v4-32 + v5e-16 (BASELINE.md)
 # ---------------------------------------------------------------------------
 
@@ -1923,6 +2008,11 @@ def main() -> None:
     recorder.run("scale_search_256", scale_search_256, record)
     recorder.run("scale_search_1024", scale_search_1024, record)
     recorder.run("scale_search_4096", scale_search_4096, record)
+
+    def _exact_section(rec: dict) -> None:
+        exact_search_bench(rec, recorder.remaining_s())
+
+    recorder.run("exact_search", _exact_section, record)
     recorder.run("northstar", northstar, record)
     recorder.run("validation", validation_error, record)
     recorder.run("resilience", resilience_bench, record)
@@ -2073,6 +2163,14 @@ def _headline(record: dict) -> dict:
         .get("skipped_reason"),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),
+        "optimality_gap_frac": (record.get("exact_search") or {})
+        .get("optimality_gap_frac"),
+        "bound_prune_frac": (record.get("exact_search") or {})
+        .get("bound_prune_frac"),
+        "exact_complete": (record.get("exact_search") or {})
+        .get("exact_complete"),
+        "exact_skipped": (record.get("exact_search") or {})
+        .get("skipped_reason"),
         "tpu_step": _tpu_brief(record, "tpu_step"),
         "tpu_validation": _tpu_brief(record, "tpu_validation"),
         "tpu_sweep_mean_err_pct": ((record.get("tpu_deep") or {})

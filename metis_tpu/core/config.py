@@ -204,6 +204,27 @@ class SearchConfig:
     # the replay re-runs every counter and pruner hook; clusters with no
     # equivalent types skip the memo entirely.  False disables it.
     symmetry_collapse: bool = True
+    # Search backend (planner/api.plan_hetero dispatch): "beam" is the
+    # prune/beam walk above — fast, anytime, INEXACT once beam_patience is
+    # set; "exact" is the branch-and-bound backend (search/exact.py) that
+    # explores the same candidate space under admissible relaxation bounds
+    # and terminates with an optimality Certificate (proven lower bound +
+    # gap) attached to the PlannerResult and emitted as a ``certificate``
+    # event.  Exact runs serially (workers is ignored).
+    backend: str = "beam"
+    # Consult the exact backend's tighter relaxation bound (stage-time
+    # floors + per-term minima from the estimator's own tables,
+    # search/exact.RelaxationBound) as an ADDITIONAL admit-time filter in
+    # the default beam search (prune.bound.tight counter).  Admissible by
+    # construction, so the returned top-K ranking stays byte-identical to
+    # the stock bound — gated by tools/check_search_regression.py the same
+    # way symmetry collapse is.  Inert unless prune_to_top_k is set.
+    tight_bound: bool = True
+    # Wall-clock budget for the exact backend's branch-and-bound loop in
+    # seconds (None = run to proven optimality).  On expiry the search
+    # keeps its incumbent and certifies the REMAINING gap — the
+    # Certificate reports complete=False and the proven bound at stop.
+    exact_deadline_s: float | None = None
 
     def __post_init__(self) -> None:
         if self.gbs < 1:
@@ -226,6 +247,11 @@ class SearchConfig:
             raise ValueError(
                 f"cost_backend must be 'numpy' or 'jax', "
                 f"got {self.cost_backend!r}")
+        if self.backend not in ("beam", "exact"):
+            raise ValueError(
+                f"backend must be 'beam' or 'exact', got {self.backend!r}")
+        if self.exact_deadline_s is not None and self.exact_deadline_s < 0:
+            raise ValueError("exact_deadline_s must be >= 0")
 
 
 @dataclass(frozen=True)

@@ -375,6 +375,52 @@ class RankedPlan:
         return d
 
 
+@dataclass(frozen=True)
+class Certificate:
+    """Optimality certificate of one exact (branch-and-bound) search.
+
+    ``lower_bound_ms`` is a PROVEN lower bound on every candidate in the
+    searched space (the same inter x intra space the beam backend walks,
+    under the same cost model and config); ``best_ms`` is the incumbent's
+    cost, so ``gap_frac = (best - bound) / best`` bounds how far the
+    returned plan can be from the true optimum.  ``complete`` means the
+    branch-and-bound ran to exhaustion (every node expanded or provably
+    bounded) — then the bound equals the best cost and the gap is 0.0;
+    a deadline stop (``SearchConfig.exact_deadline_s``) keeps the
+    incumbent and certifies the remaining gap instead."""
+
+    best_ms: float
+    lower_bound_ms: float
+    gap_frac: float
+    nodes_explored: int
+    nodes_bounded: int
+    wall_s: float
+    complete: bool = True
+
+    def to_json_dict(self) -> dict:
+        return {
+            "best_ms": self.best_ms,
+            "lower_bound_ms": self.lower_bound_ms,
+            "gap_frac": self.gap_frac,
+            "nodes_explored": self.nodes_explored,
+            "nodes_bounded": self.nodes_bounded,
+            "wall_s": self.wall_s,
+            "complete": self.complete,
+        }
+
+    @staticmethod
+    def from_json_dict(d: dict) -> "Certificate":
+        return Certificate(
+            best_ms=d["best_ms"],
+            lower_bound_ms=d["lower_bound_ms"],
+            gap_frac=d["gap_frac"],
+            nodes_explored=int(d["nodes_explored"]),
+            nodes_bounded=int(d["nodes_bounded"]),
+            wall_s=d["wall_s"],
+            complete=bool(d.get("complete", True)),
+        )
+
+
 def dump_ranked_plans(plans: Sequence[RankedPlan], limit: int | None = None) -> str:
     """Serialize a ranked plan list to JSON (the machine-readable analogue of
     the reference's stdout ranking, ``cost_het_cluster.py:73-77``)."""

@@ -146,6 +146,11 @@ class BatchCostEstimator:
         return self._fast(P, inter, strategies, intra.layer_partition)
 
     # -- fast path ---------------------------------------------------------
+    # Term structure (execution + pp/dp exposure + overhead + fb-sync +
+    # optimizer + spot/migration) is mirrored by the admissible per-class
+    # floors in search/exact.RelaxationBound — a new additive term here
+    # needs a matching floor there (or 0, which stays admissible) or the
+    # exact backend's certificates go stale.
     def _fast(self, P, inter, strategies, partition):
         batches = inter.batches
         # gbs // dp // batches == (gbs // batches) // dp for positive ints

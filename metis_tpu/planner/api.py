@@ -23,6 +23,7 @@ from metis_tpu.core.errors import MetisError
 from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.trace import Heartbeat, Tracer, timed_iter
 from metis_tpu.core.types import (
+    Certificate,
     CostBreakdown,
     PlanCost,
     RankedPlan,
@@ -55,6 +56,11 @@ class PlannerResult:
     (observably identical results) plus, when ``SearchConfig.prune_to_top_k``
     / ``beam_patience`` are set, the lower-bound and beam filters (top-K
     ranking exact under the bound's monotonicity assumption; beam inexact).
+
+    ``certificate`` is attached only by the exact branch-and-bound backend
+    (``SearchConfig.backend="exact"``, search/exact.py): the proven lower
+    bound and optimality gap of this search's best plan.  None from the
+    beam backend.
     """
 
     plans: tuple[RankedPlan, ...]  # sorted by total cost, best first
@@ -62,6 +68,7 @@ class PlannerResult:
     num_pruned: int
     search_seconds: float
     num_bound_pruned: int = 0
+    certificate: "Certificate | None" = None
 
     @property
     def best(self) -> RankedPlan | None:
@@ -188,6 +195,16 @@ def plan_hetero(
     memo tables cache the same floats the cold path computes.  Ignored by
     the ``workers > 1`` parallel path (workers build their own shards)."""
     _check_profile_attn(profiles, model)
+    if getattr(config, "backend", "beam") == "exact":
+        # branch-and-bound backend (search/exact.py): same candidate space
+        # and cost path, plus an optimality certificate; runs serially
+        from metis_tpu.search.exact import exact_plan_hetero
+
+        return exact_plan_hetero(
+            cluster, profiles, model, config,
+            bandwidth_factory=bandwidth_factory, top_k=top_k,
+            events=events, inter_filter=inter_filter,
+            search_state=search_state)
     if config.workers > 1:
         from metis_tpu.search.parallel import try_parallel_plan_hetero
 
@@ -236,10 +253,23 @@ def plan_hetero(
             heartbeat.tick(best_cost_ms=_finite(best_ms),
                            num_costed=len(results), num_pruned=pruned)
 
+    # Tight relaxation bound (search/exact.RelaxationBound): the exact
+    # backend's admissible per-class lower bound, consulted by the pruner
+    # after its stock execution floor passes.  Admissible means the top-K
+    # ranking stays byte-identical — it only skips candidates that provably
+    # cannot enter the top K (prune.bound.tight counter; gated by
+    # tools/check_search_regression.py).
+    bound_fn = None
+    if (getattr(config, "tight_bound", True)
+            and config.prune_to_top_k is not None
+            and not config.strict_compat):
+        from metis_tpu.search.exact import RelaxationBound
+
+        bound_fn = RelaxationBound.from_evaluator(ctx)
     pruner = SearchPruner(config, cluster, profiles, model,
                           counters=tracer.counters if tracer.enabled
                           else None,
-                          symmetry_classes=ctx._symmetry)
+                          bound_fn=bound_fn)
     # per-search symmetry accounting: the evaluator's hit/miss totals are
     # lifetime (warm states span searches), so the event reports deltas
     sym_h0, sym_m0 = ctx.sym_hits, ctx.sym_misses

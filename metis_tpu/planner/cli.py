@@ -163,6 +163,17 @@ def _add_search_args(p: argparse.ArgumentParser) -> None:
                         "byte-identical to serial, and the planner falls "
                         "back to the serial loop when multiprocessing is "
                         "unavailable")
+    g.add_argument("--backend", choices=("beam", "exact"), default="beam",
+                   help="search backend: the default beam/prune walk, or "
+                        "the branch-and-bound backend (search/exact.py) "
+                        "that attaches an optimality certificate — proven "
+                        "lower bound, gap fraction, nodes explored/bounded "
+                        "— to the result and the 'certificate' event")
+    g.add_argument("--exact-deadline-s", type=float, default=None,
+                   help="anytime stop for --backend exact: return the "
+                        "incumbent after this many seconds with an honest "
+                        "certificate (complete=false, remaining gap from "
+                        "the best unexplored node's bound)")
     g.add_argument("--top-k", type=int, default=20)
     g.add_argument("--output", default="-", help="output path ('-' = stdout)")
     g.add_argument("--events", default=None,
@@ -222,6 +233,8 @@ def _config_from_args(args: argparse.Namespace) -> SearchConfig:
         use_overlap_model=not getattr(args, "no_overlap_model", False),
         use_spot_model=not getattr(args, "no_spot_model", False),
         spot_recover_s=getattr(args, "spot_recover_s", 30.0),
+        backend=getattr(args, "backend", "beam"),
+        exact_deadline_s=getattr(args, "exact_deadline_s", None),
     )
 
 
@@ -825,6 +838,16 @@ def _cmd_plan(args: argparse.Namespace) -> int:
         _emit(args, dump_ranked_plans(result.plans))
         print(f"costed {result.num_costed} plans ({result.num_pruned} "
               f"pruned) in {result.search_seconds:.2f}s", file=sys.stderr)
+        cert = result.certificate
+        if cert is not None:
+            status = ("optimal" if cert.complete and cert.gap_frac == 0.0
+                      else f"gap <= {cert.gap_frac:.2%}"
+                      + ("" if cert.complete else " (deadline)"))
+            print(f"certificate: {status} — best {cert.best_ms:.2f}ms, "
+                  f"proven lower bound {cert.lower_bound_ms:.2f}ms, "
+                  f"{cert.nodes_explored} nodes explored / "
+                  f"{cert.nodes_bounded} bounded in {cert.wall_s:.2f}s",
+                  file=sys.stderr)
     events.close()
     return 0
 
@@ -957,6 +980,8 @@ def _cmd_explain(args: argparse.Namespace, profiles, model, config,
             name, d = chosen[0].breakdown.decisive_component(
                 chosen[1].breakdown)
             payload["decisive"] = {"component": name, "delta_ms": round(d, 4)}
+        if result.certificate is not None:
+            payload["certificate"] = result.certificate.to_json_dict()
         _emit(args, json.dumps(payload, indent=2))
         return 0
 
@@ -1016,6 +1041,17 @@ def _cmd_explain(args: argparse.Namespace, profiles, model, config,
             lines.append(
                 f"decisive: {name} ({d:+.3f} ms against a {gap:+.3f} ms gap) "
                 f"— #{ranks[1]} wins {name} but loses elsewhere")
+    cert = result.certificate
+    if cert is not None:
+        status = ("proven optimal" if cert.complete and cert.gap_frac == 0.0
+                  else f"gap <= {cert.gap_frac:.2%}"
+                  + ("" if cert.complete else ", deadline stop"))
+        lines.append("")
+        lines.append(
+            f"certificate: #1 is {status} over this config's plan space "
+            f"(lower bound {cert.lower_bound_ms:.3f} ms; "
+            f"{cert.nodes_explored} nodes explored, "
+            f"{cert.nodes_bounded} bounded, {cert.wall_s:.2f}s)")
     _emit(args, "\n".join(lines))
     print(f"costed {result.num_costed} plans ({result.num_pruned} pruned) "
           f"in {result.search_seconds:.2f}s", file=sys.stderr)

@@ -34,6 +34,36 @@ def sequence_symmetry_stats(
     return total, len(distinct)
 
 
+def stage_compositions(
+    num_devices: int,
+    num_layers: int,
+    variance: float = 1.0,
+    max_stages: int | None = None,
+) -> Iterator[tuple[int, tuple[int, ...]]]:
+    """Yield every (stage count, non-decreasing composition) class of the
+    search space — the branch nodes shared by the composition-level pruned
+    walk (``search/prune.pruned_inter_stage_plans``) and the exact
+    branch-and-bound backend (``search/exact.py``).  One definition, so the
+    spaces the two backends cover cannot drift: a composition appears here
+    iff some arrangement of it appears in the flat walk."""
+    from metis_tpu.search.device_groups import (
+        nondecreasing_compositions,
+        power_of_two_shapes,
+    )
+
+    cap = min(num_devices, num_layers)
+    if max_stages is not None:
+        cap = min(cap, max_stages)
+    all_shapes = power_of_two_shapes(num_devices)
+    for num_stage in range(1, cap + 1):
+        min_group = max(num_devices // num_stage,
+                        num_stage // num_devices) * variance
+        eligible = [s for s in all_shapes if s >= min_group]
+        for comp in nondecreasing_compositions(
+                num_stage, num_devices, eligible):
+            yield num_stage, comp
+
+
 def inter_stage_plans(
     device_types: Sequence[str],
     num_devices: int,

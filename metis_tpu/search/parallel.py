@@ -461,9 +461,19 @@ def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
         ctx = CandidateEvaluator(
             cluster, profiles, model, config,
             bandwidth_factory=bandwidth_factory, counters=counters)
+        bound_fn = None
+        if (getattr(config, "tight_bound", True)
+                and config.prune_to_top_k is not None
+                and not config.strict_compat):
+            # same tight relaxation bound the serial driver installs — each
+            # worker builds its own from its own evaluator tables, so the
+            # bound floats match the serial run's exactly (pure functions
+            # of the shared profiles/config)
+            from metis_tpu.search.exact import RelaxationBound
+
+            bound_fn = RelaxationBound.from_evaluator(ctx)
         pruner = SearchPruner(config, cluster, profiles, model,
-                              counters=counters,
-                              symmetry_classes=ctx._symmetry)
+                              counters=counters, bound_fn=bound_fn)
         plans: list[tuple] = []  # (total_ms, global_idx, seq, RankedPlan)
         pruned = 0
         ticks = 0

@@ -2,7 +2,7 @@
 
 VERDICT r2 next-step 7: enumeration at 256 devices with small-group
 variance grows to tens of millions of (placement x groups x batches)
-candidates; costing each takes minutes-to-hours.  Three prunes, layered:
+candidates; costing each takes minutes-to-hours.  Four prunes, layered:
 
 1. **Doom fast-path (always on, exact).**  A stage's microbatch size only
    GROWS under dp->tp escalation (``mbs = gbs/(dp*B)``, dp only halves), so
@@ -23,10 +23,23 @@ candidates; costing each takes minutes-to-hours.  Three prunes, layered:
    real measurements and the synthesizer; the returned TOP-K ranking then
    matches exhaustive search, only the tail beyond K is dropped.
 
-3. **Beam patience (opt-in via ``SearchConfig.beam_patience``, INEXACT).**
+3. **Tightened relaxation bound (default on via
+   ``SearchConfig.tight_bound``, exact).**  After the stock bound (2)
+   passes, the pruner consults the exact backend's admissible
+   ``RelaxationBound`` (search/exact.py) — the execution floor plus
+   step-overhead / fb-sync / optimizer floors and the mbs-feasibility
+   cap — through the ``bound_fn`` hook.  Admissibility gives the same
+   top-K guarantee as (2) while skipping strictly more classes
+   (``prune.bound.tight`` counter); disabled under ``strict_compat``
+   like the stock bound.
+
+4. **Beam patience (opt-in via ``SearchConfig.beam_patience``, INEXACT).**
    Each (node_sequence, stage_count) class stops after N consecutive
    candidates that failed to enter the running top K — an anytime beam
-   for scales where even the bounded walk is too slow.
+   for scales where even the bounded walk is too slow.  Patience is
+   keyed on the RAW (node_sequence, stage_count) pair even under
+   symmetry collapse, so collapsed and uncollapsed searches stay
+   byte-identical.
 """
 from __future__ import annotations
 
@@ -88,47 +101,20 @@ def fastest_full_model_by_bs(
     return {bs: sum(v) / max(cp_divisor, 1) for bs, v in by_bs.items()}
 
 
-class SearchPruner:
-    """Running top-K tracker + the candidate filters.
+class ExecutionFloor:
+    """W tables + the all-schedules execution lower bound, factored out of
+    ``SearchPruner`` so the exact backend's relaxation bound
+    (search/exact.RelaxationBound) provably shares the same floor
+    arithmetic — bound math and prune math can never drift.
 
-    ``admit(inter)`` is called per inter-stage candidate BEFORE the (much
-    more expensive) intra expansion; ``record(total_ms)`` after each costed
-    plan; ``composition_batches``/``class_dead`` let the pruned generator
-    (``pruned_inter_stage_plans``) filter whole (composition, batches)
-    classes before arrangements are even expanded.  The doom fast-path runs
-    unconditionally; the bound and beam filters only when configured."""
+    ``profiles`` decides which view the tables read: SearchPruner passes
+    the raw store it was built with (its historical behavior);
+    RelaxationBound passes the estimator's post-affine view so the floor
+    matches what candidates are actually priced with."""
 
     def __init__(self, config: SearchConfig, cluster: ClusterSpec,
-                 profiles: ProfileStore, model: ModelSpec,
-                 counters=None, symmetry_classes=None):
-        # optional core.trace.Counters: prune-family accounting for the
-        # flight recorder (``prune.doom``/``prune.bound``/``prune.beam``
-        # mirror num_doomed/num_bounded/num_beamed); None = tracing off,
-        # not even a dict add in the hot filters
-        self._counters = counters
-        # optional type->representative map (device_groups.
-        # type_equivalence_classes): beam patience is tracked per
-        # CANONICALIZED (node_sequence, stage-count) class, so equivalent
-        # placements — whose cost streams are bit-identical — share one
-        # patience budget instead of each re-earning the beam.  Sound
-        # because the beam is documented INEXACT anyway, and inert when the
-        # map is None or every class is a singleton.
-        self._sym = symmetry_classes
-        self.max_bs = config.max_profiled_bs
+                 profiles: ProfileStore, model: ModelSpec):
         self.gbs = config.gbs
-        self.top_k = (config.prune_to_top_k
-                      if not config.strict_compat else None)
-        self.beam_patience = (config.beam_patience
-                              if self.top_k is not None else None)
-        self.num_doomed = 0
-        self.num_bounded = 0
-        self.num_beamed = 0
-        self._heap: list[float] = []  # negated costs; [0] = -(kth best)
-        self._patience: dict[tuple, int] = {}
-        self._improved = False
-        self.w_min = 0.0
-        self._w_by_bs: dict[int, float] = {}
-        self._w_bs_sorted: list[int] = []
         # schedule search admits interleaved plans whose execution can
         # undercut the gpipe fill-drain — the bound must floor at the
         # interleaved schedule's own minimum or it would prune true top-K
@@ -144,18 +130,16 @@ class SearchPruner:
         self._remat = (config.remat_fwd_fraction
                        if config.remat_fwd_fraction is not None
                        else REMAT_FWD_FRACTION)
-        if self.top_k is not None:
-            cp_div = (config.max_cp_degree
-                      if config.enable_cp and model.num_experts == 0 else 1)
-            self.w_min = fastest_full_model_ms(
-                profiles, cluster.device_types, config.max_profiled_tp,
-                cp_div)
-            self._w_by_bs = fastest_full_model_by_bs(
-                profiles, cluster.device_types, config.max_profiled_tp,
-                cp_div)
-            self._w_bs_sorted = sorted(self._w_by_bs)
+        cp_div = (config.max_cp_degree
+                  if (config.enable_cp and not config.strict_compat
+                      and model.num_experts == 0) else 1)
+        self.w_min = fastest_full_model_ms(
+            profiles, cluster.device_types, config.max_profiled_tp, cp_div)
+        self._w_by_bs = fastest_full_model_by_bs(
+            profiles, cluster.device_types, config.max_profiled_tp, cp_div)
+        self._w_bs_sorted = sorted(self._w_by_bs)
 
-    def _w_at(self, mbs: int) -> float:
+    def w_at(self, mbs: int) -> float:
         """W at the largest profiled bs <= mbs (monotone-time assumption).
 
         Below the sweep, W[smallest] would be an OVER-estimate (time is
@@ -174,8 +158,7 @@ class SearchPruner:
         i = bisect.bisect_right(self._w_bs_sorted, mbs) - 1
         return self._w_by_bs[self._w_bs_sorted[i]]
 
-    def _exec_lower_bound(self, g_max: int, num_stages: int,
-                          batches: int) -> float:
+    def bound(self, g_max: int, num_stages: int, batches: int) -> float:
         """Execution >= (B-1)*max_lens + sum_lens; every stage's microbatch
         is >= gbs/(group*B) (dp only shrinks under escalation), so the
         full-model pass costs >= W[mbs_floor] where mbs_floor comes from
@@ -187,16 +170,68 @@ class SearchPruner:
         vs*S per group, each >= max_lens/vs), so the all-schedules bound
         is the minimum of the two."""
         mbs_floor = max(1, (self.gbs // g_max) // batches)
-        # _w_at covers every case: w_min when the by-bs table is empty,
+        # w_at covers every case: w_min when the by-bs table is empty,
         # the scaled-down bound below the sweep, the table lookup above it
         # (w_min <= W[bs] for all bs, so a separate max() floor is dead).
-        w = self._w_at(mbs_floor)
+        w = self.w_at(mbs_floor)
         gpipe_lb = (batches - 1) * w / num_stages + w
         if not self._schedule_search:
             return gpipe_lb
         interleaved_floor = (
             (1 + self._remat) * batches * w / num_stages)
         return min(gpipe_lb, interleaved_floor)
+
+
+class SearchPruner:
+    """Running top-K tracker + the candidate filters.
+
+    ``admit(inter)`` is called per inter-stage candidate BEFORE the (much
+    more expensive) intra expansion; ``record(total_ms)`` after each costed
+    plan; ``composition_batches``/``class_dead`` let the pruned generator
+    (``pruned_inter_stage_plans``) filter whole (composition, batches)
+    classes before arrangements are even expanded.  The doom fast-path runs
+    unconditionally; the bound and beam filters only when configured."""
+
+    def __init__(self, config: SearchConfig, cluster: ClusterSpec,
+                 profiles: ProfileStore, model: ModelSpec,
+                 counters=None, bound_fn=None):
+        # optional core.trace.Counters: prune-family accounting for the
+        # flight recorder (``prune.doom``/``prune.bound``/``prune.beam``
+        # mirror num_doomed/num_bounded/num_beamed; ``prune.bound.tight``
+        # counts the bound_fn's extra catches within num_bounded); None =
+        # tracing off, not even a dict add in the hot filters
+        self._counters = counters
+        # optional tighter admissible lower bound ``(g_max, num_stages,
+        # batches) -> ms`` (search/exact.RelaxationBound): consulted AFTER
+        # the stock execution bound passes, so it only ever prunes more.
+        # Must be admissible — a true lower bound on every plan in the
+        # (composition ceiling, stage count, batches) class — or the
+        # prune_to_top_k exactness guarantee breaks.
+        self._bound_fn = bound_fn
+        self.max_bs = config.max_profiled_bs
+        self.gbs = config.gbs
+        self.top_k = (config.prune_to_top_k
+                      if not config.strict_compat else None)
+        self.beam_patience = (config.beam_patience
+                              if self.top_k is not None else None)
+        self.num_doomed = 0
+        self.num_bounded = 0
+        self.num_beamed = 0
+        self._heap: list[float] = []  # negated costs; [0] = -(kth best)
+        self._patience: dict[tuple, int] = {}
+        self._improved = False
+        self._floor: ExecutionFloor | None = None
+        self.w_min = 0.0
+        if self.top_k is not None:
+            self._floor = ExecutionFloor(config, cluster, profiles, model)
+            self.w_min = self._floor.w_min
+
+    def _w_at(self, mbs: int) -> float:
+        return self._floor.w_at(mbs) if self._floor is not None else 0.0
+
+    def _exec_lower_bound(self, g_max: int, num_stages: int,
+                          batches: int) -> float:
+        return self._floor.bound(g_max, num_stages, batches)
 
     def composition_batches(
         self, composition: Sequence[int], num_stages: int,
@@ -217,20 +252,29 @@ class SearchPruner:
                 if self._counters is not None:
                     self._counters.inc("prune.doom")
                 continue
-            if (self.top_k is not None and kth != float("inf")
-                    and self._exec_lower_bound(
-                        g_max, num_stages, batches) > kth):
-                self.num_bounded += 1  # counts (composition, B) classes
-                if self._counters is not None:
-                    self._counters.inc("prune.bound")
-                continue
+            if self.top_k is not None and kth != float("inf"):
+                if self._exec_lower_bound(
+                        g_max, num_stages, batches) > kth:
+                    self.num_bounded += 1  # counts (composition, B) classes
+                    if self._counters is not None:
+                        self._counters.inc("prune.bound")
+                    continue
+                if (self._bound_fn is not None
+                        and self._bound_fn(
+                            g_max, num_stages, batches) > kth):
+                    self.num_bounded += 1
+                    if self._counters is not None:
+                        self._counters.inc("prune.bound.tight")
+                    continue
             out.append(batches)
         return out
 
     def _class_key(self, node_sequence, num_stages: int) -> tuple:
-        if self._sym is not None:
-            node_sequence = tuple(
-                self._sym.get(t, t) for t in node_sequence)
+        # keyed on the RAW sequence: symmetry replay drives record() with
+        # bit-identical costs per permutation, so per-sequence budgets make
+        # the collapsed beam walk byte-identical to the uncollapsed one.
+        # (A canonicalized shared budget — tried first — kills classes
+        # earlier under collapse and changes the ranking.)
         return (node_sequence, num_stages)
 
     def class_dead(self, node_sequence, num_stages: int) -> bool:
@@ -268,15 +312,24 @@ class SearchPruner:
             return False
         if self.top_k is None or self.w_min <= 0:
             return True
-        # 2. execution lower bound vs the running kth best
+        # 2. execution lower bound vs the running kth best, then the
+        #    optional tighter relaxation bound (only when the cheap stock
+        #    bound failed to prune — it strictly adds catches)
         kth = self._kth_best()
-        if (kth != float("inf")
-                and self._exec_lower_bound(
-                    g_max, inter.num_stages, inter.batches) > kth):
-            self.num_bounded += 1
-            if self._counters is not None:
-                self._counters.inc("prune.bound")
-            return False
+        if kth != float("inf"):
+            if self._exec_lower_bound(
+                    g_max, inter.num_stages, inter.batches) > kth:
+                self.num_bounded += 1
+                if self._counters is not None:
+                    self._counters.inc("prune.bound")
+                return False
+            if (self._bound_fn is not None
+                    and self._bound_fn(
+                        g_max, inter.num_stages, inter.batches) > kth):
+                self.num_bounded += 1
+                if self._counters is not None:
+                    self._counters.inc("prune.bound.tight")
+                return False
         # 3. anytime beam: stop a (placement, stage-count) class after
         #    beam_patience consecutive non-improving candidates
         if self.beam_patience is not None:
@@ -337,40 +390,31 @@ def pruned_inter_stage_plans(
     from itertools import permutations as _perms
 
     from metis_tpu.core.types import InterStagePlan, divisors
-    from metis_tpu.search.device_groups import (
-        arrangements_of_composition,
-        nondecreasing_compositions,
-        power_of_two_shapes,
-    )
+    from metis_tpu.search.device_groups import arrangements_of_composition
+    from metis_tpu.search.inter_stage import stage_compositions
 
-    cap = min(num_devices, num_layers)
     batch_options = list(divisors(gbs))  # ascending: low-bubble plans first
     type_perms = list(_perms(sorted(set(device_types))))
-    all_shapes = power_of_two_shapes(num_devices)
-    for num_stage in range(1, cap + 1):
-        min_group = max(num_devices // num_stage,
-                        num_stage // num_devices) * variance
-        eligible = [s for s in all_shapes if s >= min_group]
-        for comp in nondecreasing_compositions(
-                num_stage, num_devices, eligible):
-            feasible = pruner.composition_batches(
-                comp, num_stage, batch_options)
-            if not feasible:
+    for num_stage, comp in stage_compositions(
+            num_devices, num_layers, variance=variance):
+        feasible = pruner.composition_batches(
+            comp, num_stage, batch_options)
+        if not feasible:
+            continue
+        arrangements = None  # expand lazily, reuse across type perms
+        for node_sequence in type_perms:
+            if pruner.class_dead(node_sequence, num_stage):
                 continue
-            arrangements = None  # expand lazily, reuse across type perms
-            for node_sequence in type_perms:
-                if pruner.class_dead(node_sequence, num_stage):
-                    continue
-                if arrangements is None:
-                    arrangements = list(
-                        arrangements_of_composition(comp, max_permute_len))
-                for groups in arrangements:
-                    for batches in feasible:
-                        if counters is not None:
-                            counters.inc("inter_enumerated")
-                        yield InterStagePlan(
-                            node_sequence=node_sequence,
-                            device_groups=groups,
-                            batches=batches,
-                            gbs=gbs,
-                        )
+            if arrangements is None:
+                arrangements = list(
+                    arrangements_of_composition(comp, max_permute_len))
+            for groups in arrangements:
+                for batches in feasible:
+                    if counters is not None:
+                        counters.inc("inter_enumerated")
+                    yield InterStagePlan(
+                        node_sequence=node_sequence,
+                        device_groups=groups,
+                        batches=batches,
+                        gbs=gbs,
+                    )

@@ -297,6 +297,10 @@ class PlanService:
             "num_bound_pruned": result.num_bound_pruned,
             "search_seconds": round(result.search_seconds, 6),
         }
+        if result.certificate is not None:
+            # exact-backend cold search: the optimality certificate rides
+            # the /plan response (and the cached entry) verbatim
+            entry["certificate"] = result.certificate.to_json_dict()
         with self._lock:
             self._queries[key] = _QueryRecord(
                 model=model, config=config, top_k=top_k, key=key,

@@ -1,0 +1,178 @@
+"""Exact branch-and-bound backend (search/exact.py, backend="exact").
+
+Property tests: the exact backend's certified best must never be worse
+than the beam's on any workload (it explores a superset of the beam's
+candidate space through an admissible relaxation); a complete certificate
+must have gap 0 and prove the beam optimal whenever the beam found the
+same cost; the tightened relaxation bound handed to the default beam must
+leave its ranking byte-identical (serial AND parallel); and the backend
+must compose with the spot/migration pricing models and symmetry
+collapse.
+"""
+import dataclasses
+import io
+import json
+
+import pytest
+
+from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.events import EventLog
+from metis_tpu.core.types import dump_ranked_plans
+from metis_tpu.planner import plan_hetero
+from metis_tpu.profiles import synthesize_profiles
+
+
+def _make_workload(num_layers, hidden, types, nodes_per_type, per_node):
+    model = ModelSpec(name=f"exact-wl-{num_layers}-{hidden}",
+                      num_layers=num_layers, hidden_size=hidden,
+                      sequence_length=256, vocab_size=8192, num_heads=8)
+    store = synthesize_profiles(model, types, tps=[1, 2, 4],
+                                bss=[1, 2, 4, 8, 16, 32])
+    specs = {"A100": DeviceSpec("A100", 80, 100, 25),
+             "T4": DeviceSpec("T4", 15, 50, 10)}
+    node_list = []
+    for t in types:
+        node_list.extend(NodeSpec(t, per_node) for _ in range(nodes_per_type))
+    cluster = ClusterSpec(nodes=tuple(node_list),
+                          devices={t: specs[t] for t in types})
+    return model, store, cluster
+
+
+# uniform and hetero shapes with varied model/batch geometry — the
+# property (exact <= beam, certified gap 0 on completion) must hold on
+# all of them, not just the frozen parity fixture
+WORKLOADS = [
+    pytest.param((6, 256, ["A100"], 2, 4), 64, id="uniform-6L"),
+    pytest.param((10, 512, ["A100"], 2, 4), 128, id="uniform-10L"),
+    pytest.param((8, 256, ["A100", "T4"], 1, 4), 64, id="hetero-8L"),
+    pytest.param((10, 512, ["A100", "T4"], 2, 4), 128, id="hetero-10L"),
+]
+
+
+@pytest.mark.parametrize("shape,gbs", WORKLOADS)
+def test_exact_never_worse_than_beam(shape, gbs):
+    model, store, cluster = _make_workload(*shape)
+    beam = plan_hetero(cluster, store, model,
+                       SearchConfig(gbs=gbs, prune_to_top_k=10), top_k=5)
+    exact = plan_hetero(cluster, store, model,
+                        SearchConfig(gbs=gbs, backend="exact"), top_k=5)
+    cert = exact.certificate
+    assert cert is not None
+    assert exact.best is not None
+    assert exact.best.cost.total_ms <= beam.best.cost.total_ms + 1e-9
+    # a certificate's bound must never exceed the cost it certifies
+    assert cert.lower_bound_ms <= cert.best_ms + 1e-9
+    assert cert.best_ms == pytest.approx(exact.best.cost.total_ms)
+
+
+@pytest.mark.parametrize("shape,gbs", WORKLOADS)
+def test_complete_certificate_has_zero_gap(shape, gbs):
+    """No deadline => the branch-and-bound runs to completion, and a
+    complete certificate is by definition gap 0: the incumbent IS the
+    proven optimum.  When the beam lands on the same cost, the
+    certificate proves the beam optimal on that workload."""
+    model, store, cluster = _make_workload(*shape)
+    exact = plan_hetero(cluster, store, model,
+                        SearchConfig(gbs=gbs, backend="exact"))
+    cert = exact.certificate
+    assert cert.complete
+    assert cert.gap_frac == 0.0
+    assert cert.lower_bound_ms == pytest.approx(cert.best_ms)
+    beam = plan_hetero(cluster, store, model,
+                       SearchConfig(gbs=gbs, prune_to_top_k=10))
+    if beam.best.cost.total_ms == pytest.approx(cert.best_ms):
+        # beam found the certified optimum: gap between them is exactly 0
+        assert abs(beam.best.cost.total_ms - cert.lower_bound_ms) < 1e-6
+
+
+@pytest.mark.parametrize("workers", [1, 2])
+def test_tight_bound_keeps_beam_ranking_byte_identical(workers):
+    """The exact backend's relaxation bound rides the default beam as an
+    extra admit filter (SearchConfig.tight_bound) — admissibility means
+    it may only drop candidates that provably cannot reach the top-K, so
+    the ranking must stay byte-for-byte what the stock bound produced,
+    serial and parallel alike."""
+    model, store, cluster = _make_workload(10, 512, ["A100", "T4"], 2, 4)
+    base = SearchConfig(gbs=128, prune_to_top_k=10, workers=workers)
+    stock = plan_hetero(cluster, store, model,
+                        dataclasses.replace(base, tight_bound=False),
+                        top_k=10)
+    tight = plan_hetero(cluster, store, model, base, top_k=10)
+    assert dump_ranked_plans(tight.plans) == dump_ranked_plans(stock.plans)
+    # the tight bound only ever ADDS prunes on top of the stock bound
+    assert tight.num_bound_pruned >= stock.num_bound_pruned
+
+
+def test_exact_composes_with_spot_and_migration_models():
+    model, store, cluster = _make_workload(8, 256, ["A100", "T4"], 1, 4)
+    for extra in ({"use_spot_model": True},
+                  {"migrate_from": ((1, 0, 4),)}):
+        beam = plan_hetero(cluster, store, model,
+                           SearchConfig(gbs=64, **extra))
+        exact = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=64, backend="exact", **extra))
+        cert = exact.certificate
+        assert cert is not None and cert.complete
+        # availability/migration pricing is part of the objective the
+        # certificate covers — the certified best must match exhaustive
+        assert exact.best.cost.total_ms == pytest.approx(
+            beam.best.cost.total_ms)
+
+
+def test_exact_composes_with_symmetry_collapse():
+    """symmetry_collapse touches the BEAM's candidate replay, not the
+    exact enumeration — backend="exact" must return the same certificate
+    either way."""
+    model, store, cluster = _make_workload(8, 256, ["A100", "T4"], 1, 4)
+    on = plan_hetero(cluster, store, model,
+                     SearchConfig(gbs=64, backend="exact",
+                                  symmetry_collapse=True))
+    off = plan_hetero(cluster, store, model,
+                      SearchConfig(gbs=64, backend="exact",
+                                   symmetry_collapse=False))
+    assert on.certificate.best_ms == pytest.approx(off.certificate.best_ms)
+    assert on.certificate.complete and off.certificate.complete
+
+
+def test_exact_emits_certificate_event():
+    model, store, cluster = _make_workload(6, 256, ["A100"], 2, 4)
+    stream = io.StringIO()
+    res = plan_hetero(cluster, store, model,
+                      SearchConfig(gbs=64, backend="exact"),
+                      events=EventLog(stream=stream))
+    events = [json.loads(l) for l in stream.getvalue().splitlines()]
+    certs = [e for e in events if e["event"] == "certificate"]
+    assert len(certs) == 1
+    assert certs[0]["best_ms"] == pytest.approx(res.certificate.best_ms)
+    assert certs[0]["gap_frac"] == res.certificate.gap_frac
+    assert any(e["event"] == "bnb_progress" for e in events)
+
+
+def test_deadline_stop_is_honest():
+    """An exhausted deadline must yield complete=False with a gap bound
+    derived from the unexplored frontier — never a fake gap-0 claim."""
+    model, store, cluster = _make_workload(10, 512, ["A100", "T4"], 2, 4)
+    res = plan_hetero(cluster, store, model,
+                      SearchConfig(gbs=128, backend="exact",
+                                   exact_deadline_s=0.0))
+    cert = res.certificate
+    if cert is None:
+        # zero budget can stop before the first node is costed: no
+        # incumbent means no certificate — and no plans, not a fake one
+        assert res.best is None
+        return
+    if not cert.complete:
+        assert cert.gap_frac >= 0.0
+        assert cert.lower_bound_ms <= cert.best_ms + 1e-9
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(ValueError, match="backend"):
+        SearchConfig(gbs=64, backend="bogus")
+
+
+def test_negative_deadline_raises():
+    with pytest.raises(ValueError, match="exact_deadline_s"):
+        SearchConfig(gbs=64, backend="exact", exact_deadline_s=-1.0)

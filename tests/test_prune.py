@@ -121,6 +121,44 @@ def test_strict_compat_disables_bound_prune(workload):
     assert res.num_costed == full.num_costed
 
 
+def test_beam_symmetry_byte_identity_and_counter_reconciliation():
+    """Beam patience under symmetry_collapse must key its patience
+    counters on the RAW class key, so the collapsed search stays
+    byte-identical to the uncollapsed one — and the prune counters must
+    reconcile exactly: every bound-pruned class is attributed to exactly
+    one of doom / stock bound / tight bound / beam patience."""
+    import dataclasses
+    import io
+    import json
+
+    from metis_tpu.core.events import EventLog
+    from metis_tpu.core.types import dump_ranked_plans
+    from metis_tpu.testing import symmetric_scale_workload
+
+    cluster, profiles, model, config = symmetric_scale_workload(
+        total_devices=128, gbs=512)
+    config = dataclasses.replace(config, strict_compat=False,
+                                 prune_to_top_k=10, beam_patience=2)
+    from metis_tpu.planner import plan_hetero
+
+    stream = io.StringIO()
+    sym = plan_hetero(cluster, profiles, model, config, top_k=10,
+                      events=EventLog(stream=stream))
+    plain = plan_hetero(
+        cluster, profiles, model,
+        dataclasses.replace(config, symmetry_collapse=False), top_k=10)
+    assert dump_ranked_plans(sym.plans) == dump_ranked_plans(plain.plans)
+
+    counters = [json.loads(l) for l in stream.getvalue().splitlines()
+                if json.loads(l)["event"] == "counters"][-1]["counters"]
+    attributed = (counters.get("prune.doom", 0)
+                  + counters.get("prune.bound", 0)
+                  + counters.get("prune.bound.tight", 0)
+                  + counters.get("prune.beam", 0))
+    assert attributed == sym.num_bound_pruned
+    assert sym.num_bound_pruned > 0
+
+
 def test_fastest_full_model_ms_is_lower_bound(workload):
     """W_min must lower-bound every costed plan's execution sum."""
     from metis_tpu.search.prune import fastest_full_model_ms

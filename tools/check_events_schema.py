@@ -125,6 +125,14 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "symmetry_collapse": {"classes", "total_sequences", "distinct_sequences",
                           "collapse_frac", "replayed", "costed_fresh"},
     "cost_backend": {"backend", "batch_fast"},
+    # exact branch-and-bound backend (search/exact.py, backend="exact"):
+    # one bnb_progress per node expansion (frontier state for live gap
+    # tracking), one certificate per search — the proven lower bound, gap
+    # fraction, and node accounting attached to the PlannerResult
+    "bnb_progress": {"nodes_explored", "nodes_bounded", "best_ms",
+                     "bound_ms"},
+    "certificate": {"best_ms", "lower_bound_ms", "gap_frac",
+                    "nodes_explored", "nodes_bounded", "wall_s"},
 }
 
 

@@ -77,6 +77,15 @@ Four frozen invariants, any drift exits 1:
    ``--update-baseline``).  Leg 8 above keeps running on the decode-free
    fixture at sharing defaults, pinning that the new pricing is inert
    there.
+13. **Exact branch-and-bound certificates.**  ``backend="exact"`` on the
+   parity (strict), spot, migration, and 1024-device workloads must
+   certify each frozen beam golden's best cost optimal (gap 0 on the
+   parity-class legs; gap <= 2% under a 45 s anytime deadline at 1024
+   devices).  An exact best BELOW a beam golden means the frozen beam
+   golden is provably suboptimal — correct the beam golden; an exact
+   best ABOVE it means the exact backend lost part of the plan space.
+   Certified costs are frozen in tools/search_exact_golden.json
+   (recorded with ``--update-baseline``).
 
 ``--throughput`` adds a performance gate: the batched whole-search
 plan-throughput on the parity workload, NORMALIZED by the scalar path's
@@ -149,6 +158,20 @@ SCHED_GOLDEN = Path(__file__).resolve().parent / "search_sched_golden.json"
 # (testing.symmetric_scale_workload — two cost-equivalence type pairs),
 # sha-pinned ranking + replay split, recorded by ``--update-baseline``.
 SCALE_GOLDEN = Path(__file__).resolve().parent / "search_1024_golden.json"
+
+# Exact branch-and-bound certificates golden (search/exact.py,
+# backend="exact"): the certified best cost + proven gap on the parity,
+# spot, migration, and 1024-device workloads, recorded by
+# ``--update-baseline``.  The leg FAILS if any frozen beam golden's best
+# is provably suboptimal (the exact backend certifies a strictly better
+# plan) — that means the beam golden must be corrected, not the exact one.
+EXACT_GOLDEN = Path(__file__).resolve().parent / "search_exact_golden.json"
+
+# The 1024-device exact run must certify within this gap under this
+# anytime deadline (ISSUE acceptance: gap <= 2% in < 60 s on a 1-core
+# host; the margin below 60 covers fixture setup and CI noise).
+SCALE_EXACT_DEADLINE_S = 45.0
+SCALE_EXACT_MAX_GAP = 0.02
 
 # Throughput baseline: batched + scalar plans/sec recorded on one host by
 # ``--update-baseline``; the check compares host-normalized numbers, so the
@@ -462,7 +485,150 @@ def run_checks(workers: int = 2) -> list[str]:
     # scale leg: symmetry-collapsed 1024-device search vs the uncollapsed
     # ranking and the checked-in golden
     problems.extend(_check_scale_leg())
+
+    # exact leg: branch-and-bound certificates on the parity, spot,
+    # migration, and 1024-device workloads — fails when a frozen beam
+    # golden's best is provably suboptimal
+    problems.extend(_check_exact_leg())
     return problems
+
+
+def _run_exact_legs() -> dict:
+    """Certificates of the exact backend on the four golden workloads:
+    ``{leg: (Certificate, beam_best_ms_or_None)}``.  The parity leg also
+    reruns the strict-compat beam search so its best is compared live (the
+    frozen parity golden pins num_costed, not a cost)."""
+    import dataclasses
+
+    from metis_tpu.cluster import ClusterSpec
+    from metis_tpu.core.config import SearchConfig
+    from metis_tpu.planner import plan_hetero
+    from metis_tpu.profiles import ProfileStore, tiny_test_model
+    from metis_tpu.testing import (
+        PARITY_GBS,
+        symmetric_scale_workload,
+        write_parity_fixture,
+        write_spot_parity_fixture,
+    )
+
+    model = tiny_test_model()
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        beam = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True), top_k=10)
+        exact = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, strict_compat=True,
+                         backend="exact"), top_k=10)
+        out["parity"] = (exact.certificate,
+                         beam.plans[0].cost.total_ms if beam.plans else None)
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        write_spot_parity_fixture(tmp)
+        cluster = ClusterSpec.from_files(
+            tmp / "hostfile", tmp / "clusterfile.json")
+        store = ProfileStore.from_dir(tmp / "profiles")
+        spot_exact = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, backend="exact"), top_k=10)
+        spot_beam_best = (json.loads(SPOT_GOLDEN.read_text())
+                          .get("best_total_ms")
+                          if SPOT_GOLDEN.exists() else None)
+        out["spot"] = (spot_exact.certificate, spot_beam_best)
+        mig_exact = plan_hetero(
+            cluster, store, model,
+            SearchConfig(gbs=PARITY_GBS, migrate_from=MIGRATION_FROM,
+                         backend="exact"), top_k=10)
+        mig_beam_best = (json.loads(MIGRATION_GOLDEN.read_text())
+                         .get("best_total_ms")
+                         if MIGRATION_GOLDEN.exists() else None)
+        out["migration"] = (mig_exact.certificate, mig_beam_best)
+    cluster, profiles, model, config = symmetric_scale_workload()
+    scale_exact = plan_hetero(
+        cluster, profiles, model,
+        dataclasses.replace(config, backend="exact",
+                            exact_deadline_s=SCALE_EXACT_DEADLINE_S),
+        top_k=10)
+    scale_beam_best = (json.loads(SCALE_GOLDEN.read_text())
+                       .get("best_total_ms")
+                       if SCALE_GOLDEN.exists() else None)
+    out["scale"] = (scale_exact.certificate, scale_beam_best)
+    return out
+
+
+def _exact_fingerprint(legs: dict) -> dict:
+    """Golden entry: the certified best cost + proven gap per workload."""
+    entry: dict = {
+        "workloads": "parity strict / spot native / migration native "
+                     "(gbs=128) + 1024-device scale (strict, deadline "
+                     f"{SCALE_EXACT_DEADLINE_S}s), backend=exact, top_k=10",
+    }
+    for leg, (cert, _) in legs.items():
+        entry[f"{leg}_best_ms"] = (round(cert.best_ms, 4)
+                                   if cert is not None else None)
+        entry[f"{leg}_gap_frac"] = (round(cert.gap_frac, 6)
+                                    if cert is not None else None)
+        entry[f"{leg}_complete"] = (cert.complete
+                                    if cert is not None else None)
+    return entry
+
+
+def _check_exact_leg() -> list[str]:
+    problems: list[str] = []
+    legs = _run_exact_legs()
+    for leg, (cert, beam_best) in legs.items():
+        if cert is None:
+            problems.append(f"exact backend produced no certificate on the "
+                            f"{leg} workload")
+            continue
+        max_gap = SCALE_EXACT_MAX_GAP if leg == "scale" else 0.0
+        if cert.gap_frac > max_gap:
+            problems.append(
+                f"exact {leg} certificate gap {cert.gap_frac:.4f} exceeds "
+                f"the {max_gap:.0%} ceiling (complete={cert.complete}, "
+                f"wall {cert.wall_s:.1f}s)")
+        if beam_best is None:
+            continue
+        exact_best = round(cert.best_ms, 4)
+        beam_best = round(beam_best, 4)
+        if exact_best < beam_best:
+            problems.append(
+                f"frozen {leg} beam golden is PROVABLY SUBOPTIMAL: exact "
+                f"certifies {exact_best} ms < beam best {beam_best} ms — "
+                f"correct the beam golden, do not relax the exact one")
+        elif exact_best > beam_best:
+            problems.append(
+                f"exact {leg} best {exact_best} ms is WORSE than the beam "
+                f"best {beam_best} ms — the exact backend is missing part "
+                f"of the candidate space (bound or enumeration bug)")
+    if EXACT_GOLDEN.exists():
+        golden = json.loads(EXACT_GOLDEN.read_text())
+        entry = _exact_fingerprint(legs)
+        for key in sorted(k for k in entry if k != "workloads"):
+            if golden.get(key) != entry[key]:
+                problems.append(
+                    f"exact golden drift: {key} = {entry[key]}, frozen "
+                    f"golden is {golden.get(key)} "
+                    f"(re-record deliberately with --update-baseline)")
+    else:
+        problems.append(
+            f"exact golden missing: {EXACT_GOLDEN} "
+            "(record one with --update-baseline)")
+    return problems
+
+
+def record_exact_golden() -> dict:
+    """Run the exact backend on the four golden workloads and write the
+    certified-cost golden."""
+    entry = _exact_fingerprint(_run_exact_legs())
+    EXACT_GOLDEN.write_text(json.dumps(entry, indent=2) + "\n")
+    return entry
 
 
 def _check_jax_backend(cluster, store, model, strict_dump: str,
@@ -1021,6 +1187,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"sched golden written: {sched_golden}")
         scale_golden = record_scale_golden()
         print(f"1024-device golden written: {scale_golden}")
+        exact_golden = record_exact_golden()
+        print(f"exact certificates golden written: {exact_golden}")
         entry = measure_throughput()
         THROUGHPUT_BASELINE.write_text(json.dumps(entry, indent=2) + "\n")
         print(f"throughput baseline written: {entry}")
@@ -1042,7 +1210,8 @@ def main(argv: list[str] | None = None) -> int:
           f"serving measured + golden matches, fleet "
           f"partition deterministic + sched golden matches, 1024-device "
           f"symmetry collapse byte-identical + scale golden matches, jax "
-          f"backend byte-identical where available)")
+          f"backend byte-identical where available, exact backend "
+          f"certifies every frozen beam golden optimal)")
     return 0
 
 
