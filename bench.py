@@ -49,6 +49,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from pathlib import Path
 
@@ -1412,6 +1413,107 @@ def serve_bench(record: dict) -> None:
     record["serve"] = entry
 
 
+def telemetry_bench(record: dict) -> None:
+    """Cost of the telemetry plane (metis_tpu/obs): the cached-hit p50
+    with the metrics registry on vs off — the instrumentation rides the
+    hottest serve path, so its overhead must be provably small
+    (``metrics_overhead_frac`` headline, budget ≤ 5%) — plus /metrics
+    scrape latency while 64 client threads hammer the cached path, with
+    the scraped text lint-checked as valid Prometheus exposition.
+
+    Both daemons are booted up front and the measurement rounds alternate
+    between them, so machine drift lands on both sides equally;
+    min-of-medians keeps a GC pause in one round from deciding the
+    comparison."""
+    import statistics
+    from concurrent.futures import ThreadPoolExecutor
+
+    from metis_tpu.obs.metrics import NULL_METRICS
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from tools.check_metrics_names import validate_exposition
+    from tools.serve_smoke import SMOKE_TOP_K, parity_inputs
+
+    entry: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        cluster, profiles, model, config = parity_inputs(tmp)
+
+        try:
+            svc_off = PlanService(cluster, profiles, metrics=NULL_METRICS)
+            srv_off, thr_off, addr_off = serve_in_thread(svc_off)
+            svc_on = PlanService(cluster, profiles)
+            srv_on, thr_on, addr_on = serve_in_thread(svc_on)
+        except OSError as e:
+            record["telemetry"] = {
+                "skipped_reason": f"socket setup failed: {e}"}
+            return
+        try:
+            cli_off = PlanServiceClient(addr_off)
+            cli_on = PlanServiceClient(addr_on)
+            cli_off.plan(model, config, top_k=SMOKE_TOP_K)  # warm caches
+            cli_on.plan(model, config, top_k=SMOKE_TOP_K)
+
+            def round_p50(client, n=70):
+                lat = []
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    client.plan(model, config, top_k=SMOKE_TOP_K)
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                return statistics.median(lat)
+
+            meds_off, meds_on = [], []
+            for _round in range(3):
+                meds_off.append(round_p50(cli_off))
+                meds_on.append(round_p50(cli_on))
+            p50_off = min(meds_off)
+            p50_on = min(meds_on)
+            entry["cached_hit_p50_metrics_off_ms"] = round(p50_off, 3)
+            entry["cached_hit_p50_metrics_on_ms"] = round(p50_on, 3)
+            entry["metrics_overhead_frac"] = round(
+                (p50_on - p50_off) / max(p50_off, 1e-9), 4)
+
+            # /metrics under fire: 64 threads of cached queries while the
+            # scrape loop runs — a dashboard must not stall the daemon
+            stop = threading.Event()
+
+            def hammer():
+                while not stop.is_set():
+                    cli_on.plan(model, config, top_k=SMOKE_TOP_K)
+
+            scrape_ms = []
+            text = ""
+            with ThreadPoolExecutor(max_workers=64) as pool:
+                for _ in range(64):
+                    pool.submit(hammer)
+                try:
+                    for _ in range(20):
+                        t0 = time.perf_counter()
+                        text = cli_on.metrics(timeout=30.0)
+                        scrape_ms.append((time.perf_counter() - t0) * 1e3)
+                finally:
+                    stop.set()
+            entry["metrics_scrape_p50_ms"] = round(
+                statistics.median(scrape_ms), 3)
+            entry["metrics_scrape_p95_ms"] = round(
+                sorted(scrape_ms)[int(0.95 * (len(scrape_ms) - 1))], 3)
+            entry["scrape_concurrent_threads"] = 64
+            problems = validate_exposition(text)
+            entry["scrape_valid_exposition"] = not problems
+            if problems:
+                entry["scrape_problems"] = problems[:5]
+        finally:
+            for client, server, thread in ((cli_off, srv_off, thr_off),
+                                           (cli_on, srv_on, thr_on)):
+                try:
+                    client.shutdown()
+                except Exception:
+                    server.shutdown()
+                thread.join(10)
+                server.server_close()
+    record["telemetry"] = entry
+
+
 def inference_bench(record: dict) -> None:
     """Latency-SLO serving planner (metis_tpu/inference) on the parity
     workload:
@@ -2018,6 +2120,7 @@ def main() -> None:
     recorder.run("resilience", resilience_bench, record)
     recorder.run("overlap", overlap_bench, record)
     recorder.run("serve", serve_bench, record)
+    recorder.run("telemetry", telemetry_bench, record)
     recorder.run("inference", inference_bench, record)
     recorder.run("fleet", fleet_bench, record)
     recorder.run("sched", sched_bench, record)
@@ -2132,6 +2235,12 @@ def _headline(record: dict) -> dict:
         "serve_byte_identical": (record.get("serve") or {})
         .get("byte_identical"),
         "serve_skipped": (record.get("serve") or {})
+        .get("skipped_reason"),
+        "metrics_overhead_frac": (record.get("telemetry") or {})
+        .get("metrics_overhead_frac"),
+        "metrics_scrape_p95_ms": (record.get("telemetry") or {})
+        .get("metrics_scrape_p95_ms"),
+        "telemetry_skipped": (record.get("telemetry") or {})
         .get("skipped_reason"),
         "slo_p99_ttft_ms": (record.get("inference") or {})
         .get("slo_p99_ttft_ms"),

@@ -145,6 +145,7 @@ def replay_traffic(
     forecast_window: int = 4,
     forecast_horizon: int = 2,
     events: EventLog = NULL_LOG,
+    metrics=None,
 ) -> ReplayReport:
     """Run ``cycles`` diurnal cycles against a live daemon (``client`` is a
     ``serve.client.PlanServiceClient``; ``cluster`` mirrors the daemon's
@@ -154,7 +155,13 @@ def replay_traffic(
     ``"hysteresis"`` or proactive ``"predictive"``.  Every elastic action
     goes through ``client.cluster_delta(..., replan=True)`` so the daemon
     re-searches and pushes ``replan_push`` notifications, which the report
-    counts."""
+    counts.
+
+    ``metrics`` (an ``obs.metrics.MetricsRegistry``) gets per-tick
+    telemetry labeled by ``policy``: the running request-weighted SLO
+    attainment gauge, a device-hours counter (fractional — counters are
+    float-valued), and a tick counter — so a dashboard watching /metrics
+    follows a live replay without waiting for the final report."""
     if policy not in ("hysteresis", "predictive"):
         raise ValueError(f"unknown replay policy: {policy!r}")
     # local mirror of the daemon's node list: deltas remove from the END
@@ -240,6 +247,13 @@ def replay_traffic(
         report.ticks.append(ReplayTick(
             t_s=t_s, arrival_rps=rate, devices=devices, slo_ok=slo_ok,
             throughput_rps=throughput, scaled=scaled))
+        if metrics is not None:
+            metrics.gauge("metis_replay_slo_attainment",
+                          policy=policy).set(report.slo_attainment)
+            metrics.counter("metis_replay_device_hours_total",
+                            policy=policy).inc(
+                devices * tick_seconds / 3600.0)
+            metrics.counter("metis_replay_ticks_total", policy=policy).inc()
         events.emit("replay_tick", t_s=t_s, arrival_rps=rate,
                     devices=devices, slo_ok=slo_ok)
         if not slo_ok:

@@ -167,6 +167,7 @@ def plan_hetero(
     events: EventLog = NULL_LOG,
     inter_filter=None,
     search_state: CandidateEvaluator | None = None,
+    metrics=None,
 ) -> PlannerResult:
     """Full heterogeneous search: inter-stage × intra-stage candidates,
     costed and ranked (≅ ``cost_het_cluster``).
@@ -193,7 +194,13 @@ def plan_hetero(
     built for this exact (cluster, profiles, model, config,
     bandwidth_factory); ranking is byte-identical either way because the
     memo tables cache the same floats the cold path computes.  Ignored by
-    the ``workers > 1`` parallel path (workers build their own shards)."""
+    the ``workers > 1`` parallel path (workers build their own shards).
+
+    ``metrics``: an optional ``obs.metrics.MetricsRegistry`` — the serve
+    daemon passes its own so every search feeds the
+    ``metis_search_phase_seconds{phase}`` histograms /metrics exposes
+    (phase timings come from the tracer's accum spans, so they require an
+    enabled ``events`` log; setup and ranking are timed directly)."""
     _check_profile_attn(profiles, model)
     if getattr(config, "backend", "beam") == "exact":
         # branch-and-bound backend (search/exact.py): same candidate space
@@ -234,6 +241,7 @@ def plan_hetero(
             bandwidth_factory=bandwidth_factory,
             counters=tracer.counters if tracer.enabled else None)
     setup_span.__exit__(None, None, None)
+    setup_s = time.perf_counter() - t0
     events.emit(
         "search_started", mode="hetero", devices=cluster.total_devices,
         device_types=list(cluster.device_types), gbs=config.gbs,
@@ -347,8 +355,20 @@ def plan_hetero(
     enum_acc.close()
     intra_acc.close()
     cost_acc.close()
+    t_rank = time.perf_counter()
     with tracer.span("ranking", num_plans=len(results)):
         results.sort(key=lambda r: r.cost.total_ms)
+    if metrics is not None:
+        phase_obs = [("setup", setup_s),
+                     ("ranking", time.perf_counter() - t_rank)]
+        if tracer.enabled:
+            # accum spans are NULL_SPAN (no totals) without a tracer
+            phase_obs += [("enumeration", enum_acc.total_s),
+                          ("intra_stage", intra_acc.total_s),
+                          ("costing", cost_acc.total_s)]
+        for phase, secs in phase_obs:
+            metrics.histogram("metis_search_phase_seconds",
+                              phase=phase).observe(secs)
     num_costed = len(results)
     best_cost = results[0].cost.total_ms if results else None
     if top_k is not None:

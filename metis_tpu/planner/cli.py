@@ -20,6 +20,7 @@ import argparse
 import dataclasses
 import json
 import sys
+import time
 
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.cluster.tpu import TpuClusterSpec, slice_from_name
@@ -553,6 +554,12 @@ def main(argv: list[str] | None = None) -> int:
                           help="keep only the N most expensive spans by "
                                "self-time (ancestors kept for context, "
                                "crashed-open spans always shown)")
+    p_report.add_argument("--trace", default=None, metavar="ID",
+                          help="keep only events stamped with this "
+                               "trace_id (the id a serve client minted "
+                               "and the /plan response echoed) — "
+                               "reconstructs one request's span tree "
+                               "out of a shared daemon event log")
     p_report.add_argument("--output", default="-",
                           help="output path ('-' = stdout)")
 
@@ -631,6 +638,29 @@ def main(argv: list[str] | None = None) -> int:
                             "must stay inside before a replan fires")
     p_srv.add_argument("--events", default=None,
                        help="append structured JSONL daemon events here")
+    p_srv.add_argument("--events-max-bytes", type=int, default=None,
+                       metavar="N",
+                       help="rotate the events file to <name>.1 when it "
+                            "would exceed N bytes (core/events.EventLog "
+                            "max_bytes) — bounds a long-lived daemon's "
+                            "log; default: never rotate")
+
+    p_top = sub.add_parser(
+        "top", help="live terminal dashboard over a running daemon's "
+                    "GET /metrics: qps, per-endpoint p50/p99 latency, "
+                    "cache hit rate, fleet utilization, per-tenant SLO "
+                    "(plain ANSI poll loop, Ctrl-C to exit)")
+    p_top.add_argument("address",
+                       help="daemon address: http://HOST:PORT or "
+                            "unix:/path/to.sock")
+    p_top.add_argument("--interval", type=float, default=2.0,
+                       help="seconds between /metrics polls")
+    p_top.add_argument("--iterations", type=int, default=0, metavar="N",
+                       help="render N frames then exit (0 = run until "
+                            "Ctrl-C; >0 is the scriptable/test mode)")
+    p_top.add_argument("--no-clear", action="store_true",
+                       help="append frames instead of clearing the "
+                            "screen (for logs/pipes)")
 
     p_plan = sub.add_parser(
         "plan", help="plan query: against a running daemon (--remote) or "
@@ -695,6 +725,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_replay(args)
     if args.command == "report":
         return _cmd_report(args)
+    if args.command == "top":
+        return _cmd_top(args)
     if args.command == "accuracy":
         return _cmd_accuracy(args)
     if args.command == "calibrate":
@@ -769,7 +801,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
     cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
     profiles = ProfileStore.from_dir(args.profile_dir)
-    events = EventLog(args.events) if args.events else NULL_LOG
+    events = (EventLog(args.events, max_bytes=args.events_max_bytes)
+              if args.events else NULL_LOG)
     service = PlanService(
         cluster, profiles, cache_capacity=args.cache_size,
         state_capacity=args.state_cache_size, events=events,
@@ -808,7 +841,8 @@ def _cmd_plan(args: argparse.Namespace) -> int:
             f"costed {resp.get('num_costed')} plans "
             f"({resp.get('num_pruned')} pruned) in "
             f"{resp.get('search_seconds', 0):.2f}s "
-            f"(served in {resp.get('serve_ms', 0):.1f}ms)",
+            f"(served in {resp.get('serve_ms', 0):.1f}ms) "
+            f"trace={resp.get('trace_id')}",
             file=sys.stderr)
         return 0
 
@@ -911,6 +945,13 @@ def _cmd_report(args: argparse.Namespace) -> int:
     except OSError as e:
         print(f"cannot read {args.events_file}: {e}", file=sys.stderr)
         return 1
+    if args.trace is not None:
+        total = len(events)
+        events = [e for e in events if e.get("trace_id") == args.trace]
+        print(f"trace {args.trace}: {len(events)} of {total} events",
+              file=sys.stderr)
+        if not events:
+            return 1
     roots, counters = build_span_tree(events)
     if not roots and not counters:
         print(f"{args.events_file}: no span/counter events "
@@ -925,6 +966,106 @@ def _cmd_report(args: argparse.Namespace) -> int:
         payload = render_span_table(roots, counters)
     _emit(args, payload)
     return 0
+
+
+def _top_frame(text: str, address: str) -> str:
+    """One rendered dashboard frame from a /metrics exposition scrape.
+    Pure text-in/text-out so tests drive it without a terminal."""
+    from metis_tpu.obs.metrics import parse_exposition, quantile_from_buckets
+
+    fams = parse_exposition(text)
+
+    def gauge(name: str, **want) -> float | None:
+        fam = fams.get(name)
+        if fam is None:
+            return None
+        for n, lab, v in fam["samples"]:
+            if n == name and all(lab.get(k) == w for k, w in want.items()):
+                return v
+        return None
+
+    def labeled(name: str, label: str) -> dict[str, float]:
+        fam = fams.get(name)
+        if fam is None:
+            return {}
+        return {lab[label]: v for n, lab, v in fam["samples"]
+                if n == name and label in lab}
+
+    lines = [f"metis-tpu top — {address} — "
+             f"up {gauge('metis_serve_uptime_seconds') or 0:.0f}s"]
+    qps = gauge("metis_serve_qps") or 0.0
+    hit = gauge("metis_serve_cache_hit_ratio")
+    inflight = gauge("metis_serve_inflight_requests") or 0
+    lines.append(
+        f"qps {qps:7.1f}   in-flight {inflight:3.0f}   cache hit "
+        + (f"{hit:6.1%}" if hit is not None else "   n/a")
+        + f"   entries {gauge('metis_serve_cache_entries') or 0:.0f}"
+          f"/{gauge('metis_serve_cache_capacity') or 0:.0f}")
+    lat = fams.get("metis_serve_request_latency_ms")
+    if lat is not None:
+        # per-endpoint cumulative buckets -> p50/p99 via the same
+        # nearest-rank rule the registry uses
+        per_ep: dict[str, list[tuple[float, float]]] = {}
+        counts: dict[str, float] = {}
+        for n, lab, v in lat["samples"]:
+            ep = lab.get("endpoint", "")
+            if n.endswith("_bucket"):
+                le = lab.get("le", "")
+                bound = float("inf") if le == "+Inf" else float(le)
+                per_ep.setdefault(ep, []).append((bound, v))
+            elif n.endswith("_count"):
+                counts[ep] = v
+        lines.append(f"{'endpoint':<16}{'reqs':>8}{'p50 ms':>10}"
+                     f"{'p99 ms':>10}")
+        for ep in sorted(per_ep):
+            p50 = quantile_from_buckets(per_ep[ep], 0.5)
+            p99 = quantile_from_buckets(per_ep[ep], 0.99)
+            lines.append(
+                f"{ep:<16}{counts.get(ep, 0):>8.0f}"
+                + (f"{p50:>10.2f}" if p50 is not None else f"{'-':>10}")
+                + (f"{p99:>10.2f}" if p99 is not None else f"{'-':>10}"))
+    util = gauge("metis_fleet_utilization_frac")
+    if util is not None:
+        lines.append(f"fleet utilization {util:6.1%}   objective "
+                     f"{gauge('metis_fleet_objective') or 0:.3f}")
+        devices = labeled("metis_fleet_tenant_devices", "tenant")
+        tenant_util = labeled("metis_fleet_tenant_utilization_frac",
+                              "tenant")
+        for tname in sorted(devices):
+            lines.append(f"  tenant {tname:<14}{devices[tname]:>5.0f} dev"
+                         f"   util {tenant_util.get(tname, 0.0):6.1%}")
+    slo = labeled("metis_replay_slo_attainment", "policy")
+    for policy in sorted(slo):
+        lines.append(f"replay[{policy}] slo attainment {slo[policy]:6.1%}")
+    return "\n".join(lines)
+
+
+def _cmd_top(args: argparse.Namespace) -> int:
+    """Live dashboard: poll GET /metrics and render qps / latency
+    quantiles / cache / fleet / SLO until Ctrl-C (or --iterations)."""
+    from metis_tpu.serve.client import PlanServiceClient, ServeClientError
+
+    client = PlanServiceClient(args.address,
+                               timeout=max(args.interval, 5.0))
+    n = 0
+    try:
+        while True:
+            try:
+                text = client.metrics(timeout=max(args.interval, 5.0))
+                frame = _top_frame(text, args.address)
+            except ServeClientError as e:
+                frame = f"metis-tpu top — {args.address} — {e}"
+            if args.no_clear:
+                print(frame, flush=True)
+            else:
+                # ANSI clear + home: plain escapes, no curses dependency
+                print(f"\x1b[2J\x1b[H{frame}", flush=True)
+            n += 1
+            if args.iterations and n >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def _parse_ranks(args: argparse.Namespace) -> list[int] | None:

@@ -147,12 +147,19 @@ class FleetScheduler:
     def __init__(self, full_cluster: ClusterSpec, profiles: ProfileStore,
                  *, events: EventLog = NULL_LOG,
                  top_k: int | None = None,
-                 search_state_provider=None):
+                 search_state_provider=None,
+                 metrics=None):
         self.full_cluster = full_cluster
         self.cluster = full_cluster
         self.profiles = profiles
         self.events = events
         self.top_k = top_k
+        # obs.metrics.MetricsRegistry (the serve daemon passes its own):
+        # fleet utilization/objective + per-tenant gauges refresh on every
+        # schedule(); preemption counters tick in apply_delta().  None
+        # (library use) records nothing.
+        from metis_tpu.obs.metrics import NULL_METRICS
+        self.metrics = metrics if metrics is not None else NULL_METRICS
         # optional callable (spec, cluster, sub_cluster, node_indices) ->
         # warm CandidateEvaluator or None: the serve daemon hands tenants'
         # training searches their retained planner.api.make_search_state
@@ -450,6 +457,17 @@ class FleetScheduler:
             utilization_frac=round(best.utilization_frac, 9),
             tenants=len(order), shares_label=best.shares_label,
             cluster_devices=cap)
+        m = self.metrics
+        m.gauge("metis_fleet_utilization_frac").set(best.utilization_frac)
+        m.gauge("metis_fleet_objective").set(best.objective)
+        for a in best.allocations:
+            # gauges for removed tenants go stale rather than vanish —
+            # Prometheus has no unregister; dashboards filter on the
+            # current tenant set from /stats
+            m.gauge("metis_fleet_tenant_utilization_frac",
+                    tenant=a.tenant).set(a.utility_frac)
+            m.gauge("metis_fleet_tenant_devices",
+                    tenant=a.tenant).set(a.devices)
         self.last_plan = best
         return best
 
@@ -505,6 +523,8 @@ class FleetScheduler:
                     "tenant_preempt", tenant=t.name,
                     from_devices=old_alloc.devices,
                     to_devices=new_alloc.devices, priority=t.priority)
+                self.metrics.counter("metis_fleet_preemptions_total",
+                                     tenant=t.name).inc()
             if changed:
                 decision = self._switch_decision(t, old_alloc, new_alloc,
                                                  old_cluster)

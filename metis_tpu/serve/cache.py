@@ -20,23 +20,37 @@ from collections import OrderedDict
 from typing import Any
 
 from metis_tpu.core.trace import Counters
+from metis_tpu.obs.metrics import NULL_METRICS, MetricsRegistry
+
+# serve.cache.* counter suffix -> exported Prometheus counter name
+_METRIC_NAMES = {
+    "hit": "metis_serve_cache_hits_total",
+    "miss": "metis_serve_cache_misses_total",
+    "evict": "metis_serve_cache_evictions_total",
+    "invalidate": "metis_serve_cache_invalidations_total",
+}
 
 
 class PlanCache:
     """Bounded LRU mapping query fingerprint -> response payload."""
 
     def __init__(self, capacity: int = 128,
-                 counters: Counters | None = None):
+                 counters: Counters | None = None,
+                 metrics: MetricsRegistry = NULL_METRICS):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self.counters = counters
+        self.metrics = metrics
+        self.metrics.gauge("metis_serve_cache_capacity").set(capacity)
+        self._occupancy = self.metrics.gauge("metis_serve_cache_entries")
         self._lock = threading.Lock()
         self._entries: OrderedDict[str, dict] = OrderedDict()
 
     def _inc(self, name: str) -> None:
         if self.counters is not None:
             self.counters.inc(f"serve.cache.{name}")
+        self.metrics.counter(_METRIC_NAMES[name]).inc()
 
     def get(self, key: str) -> dict | None:
         """Payload for ``key`` (refreshing its recency), or None."""
@@ -58,6 +72,7 @@ class PlanCache:
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
                 evicted += 1
+            self._occupancy.set(len(self._entries))
         for _ in range(evicted):
             self._inc("evict")
 
@@ -65,6 +80,7 @@ class PlanCache:
         """Drop one entry; True when it existed."""
         with self._lock:
             existed = self._entries.pop(key, None) is not None
+            self._occupancy.set(len(self._entries))
         if existed:
             self._inc("invalidate")
         return existed
@@ -77,6 +93,7 @@ class PlanCache:
             doomed = [k for k, v in self._entries.items() if predicate(k, v)]
             for k in doomed:
                 del self._entries[k]
+            self._occupancy.set(len(self._entries))
         for _ in doomed:
             self._inc("invalidate")
         return doomed
@@ -86,6 +103,7 @@ class PlanCache:
         with self._lock:
             n = len(self._entries)
             self._entries.clear()
+            self._occupancy.set(0)
         for _ in range(n):
             self._inc("invalidate")
         return n

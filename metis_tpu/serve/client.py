@@ -5,6 +5,12 @@ TCP address (``http://127.0.0.1:8642`` or bare ``127.0.0.1:8642``) or a
 unix socket (``unix:/run/metis-plan.sock``).  One connection per request —
 thread-safe by construction, which is what the ≥64-thread concurrency
 contract of ``tools/serve_smoke.py`` leans on.
+
+Every request that causes daemon-side work mints a ``trace_id`` (or
+forwards the caller's) so the daemon can stamp every span, event, and
+background thread the request triggers — the handle
+``metis-tpu report --trace ID`` reconstructs one request's story from.
+The response echoes it back as ``trace_id``.
 """
 from __future__ import annotations
 
@@ -13,6 +19,7 @@ import http.client
 import json
 import socket
 import time
+import uuid
 from typing import Any
 
 from metis_tpu.core.config import ModelSpec, SearchConfig
@@ -21,6 +28,12 @@ from metis_tpu.core.errors import MetisError
 
 class ServeClientError(MetisError):
     """Daemon unreachable, or it answered with an error status."""
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char request id (collision odds are irrelevant at
+    daemon-lifetime event volumes; short enough to read in a log line)."""
+    return uuid.uuid4().hex[:16]
 
 
 class _UnixHTTPConnection(http.client.HTTPConnection):
@@ -57,15 +70,25 @@ class PlanServiceClient:
                     "http://HOST:PORT or unix:/path/to.sock")
             self._host, self._port = host, int(port)
 
-    def _connection(self) -> http.client.HTTPConnection:
+    def _connection(self, timeout: float | None = None
+                    ) -> http.client.HTTPConnection:
+        t = timeout if timeout is not None else self.timeout
         if self._unix_path is not None:
-            return _UnixHTTPConnection(self._unix_path, self.timeout)
+            return _UnixHTTPConnection(self._unix_path, t)
         return http.client.HTTPConnection(self._host, self._port,
-                                          timeout=self.timeout)
+                                          timeout=t)
 
     def _request(self, method: str, path: str,
-                 payload: dict | None = None, _retries: int = 3) -> dict:
-        conn = self._connection()
+                 payload: dict | None = None, _retries: int = 3,
+                 timeout: float | None = None, raw: bool = False,
+                 error_ok: bool = False) -> Any:
+        """One round-trip.  ``timeout`` overrides the client default for
+        this call (the monitoring GETs want seconds, not the 300 s plan
+        budget).  ``raw=True`` returns the decoded body text instead of
+        parsed JSON (/metrics is text exposition, not JSON).
+        ``error_ok=True`` returns error-status bodies instead of raising
+        (/healthz answers 503 by design when not ready)."""
+        conn = self._connection(timeout=timeout)
         try:
             body = json.dumps(payload).encode() if payload is not None \
                 else None
@@ -83,7 +106,9 @@ class PlanServiceClient:
                     conn.close()
                     time.sleep(0.05)
                     return self._request(method, path, payload,
-                                         _retries=_retries - 1)
+                                         _retries=_retries - 1,
+                                         timeout=timeout, raw=raw,
+                                         error_ok=error_ok)
                 raise ServeClientError(
                     f"plan daemon at {self.address} unreachable: {e}") \
                     from e
@@ -91,12 +116,17 @@ class PlanServiceClient:
                 raise ServeClientError(
                     f"plan daemon at {self.address} unreachable: {e}") \
                     from e
+            if raw:
+                if status >= 400 and not error_ok:
+                    raise ServeClientError(
+                        f"daemon error {status}: {data!r}")
+                return data.decode("utf-8", errors="replace")
             try:
                 out = json.loads(data) if data else {}
             except json.JSONDecodeError as e:
                 raise ServeClientError(
                     f"daemon sent invalid JSON ({e.msg})") from e
-            if status >= 400:
+            if status >= 400 and not error_ok:
                 detail = out.get("error") if isinstance(out, dict) else None
                 raise ServeClientError(
                     f"daemon error {status}: {detail or data!r}")
@@ -106,7 +136,8 @@ class PlanServiceClient:
 
     # -- endpoints ----------------------------------------------------------
     def plan(self, model: ModelSpec, config: SearchConfig,
-             top_k: int | None = None, workload=None) -> dict:
+             top_k: int | None = None, workload=None,
+             trace_id: str | None = None) -> dict:
         """Plan query; the response's ``plans`` field is the exact
         ``dump_ranked_plans`` (training) or ``dump_inference_plans``
         (``workload`` set) JSON string the offline CLI prints."""
@@ -114,17 +145,20 @@ class PlanServiceClient:
             "model": dataclasses.asdict(model),
             "config": dataclasses.asdict(config),
             "top_k": top_k,
+            "trace_id": trace_id or mint_trace_id(),
         }
         if workload is not None:
             payload["workload"] = (workload if isinstance(workload, dict)
                                    else dataclasses.asdict(workload))
         return self._request("POST", "/plan", payload)
 
-    def tenant_plan(self, name: str) -> dict:
+    def tenant_plan(self, name: str,
+                    trace_id: str | None = None) -> dict:
         """Tenant-routed plan query: the daemon answers from the fleet
         scheduler's current carve for ``name`` (model/config/workload come
         from the registered TenantSpec, not this call)."""
-        return self._request("POST", "/plan", {"tenant": name})
+        return self._request("POST", "/plan", {
+            "tenant": name, "trace_id": trace_id or mint_trace_id()})
 
     def tenant_register(self, spec) -> dict:
         """Register a tenant (a ``sched.TenantSpec`` or its dict form)."""
@@ -140,10 +174,12 @@ class PlanServiceClient:
 
     def accuracy_sample(self, fingerprint: str, measured_ms: float,
                         step: int | None = None, stage_ms=(),
-                        predicted_ms: float | None = None) -> dict:
+                        predicted_ms: float | None = None,
+                        trace_id: str | None = None) -> dict:
         payload: dict[str, Any] = {
             "fingerprint": fingerprint, "measured_ms": measured_ms,
             "step": step, "stage_ms": list(stage_ms),
+            "trace_id": trace_id or mint_trace_id(),
         }
         if predicted_ms is not None:
             payload["predicted_ms"] = predicted_ms
@@ -151,10 +187,11 @@ class PlanServiceClient:
 
     def cluster_delta(self, removed: dict[str, int] | None = None,
                       added: dict[str, int] | None = None,
-                      replan: bool = False) -> dict:
+                      replan: bool = False,
+                      trace_id: str | None = None) -> dict:
         return self._request("POST", "/cluster_delta", {
             "removed": removed or {}, "added": added or {},
-            "replan": replan})
+            "replan": replan, "trace_id": trace_id or mint_trace_id()})
 
     def invalidate(self, fingerprint: str | None = None,
                    drop_states: bool = False) -> dict:
@@ -169,6 +206,19 @@ class PlanServiceClient:
 
     def stats(self) -> dict:
         return self._request("GET", "/stats")
+
+    def metrics(self, timeout: float | None = None) -> str:
+        """Raw Prometheus text exposition from ``GET /metrics``.  Pass a
+        short ``timeout`` when scraping on a schedule — the endpoint
+        never searches, so a slow answer means a sick daemon."""
+        return self._request("GET", "/metrics", timeout=timeout, raw=True)
+
+    def healthz(self, timeout: float | None = None) -> dict:
+        """Liveness/readiness from ``GET /healthz``.  Returns the health
+        document even on 503 (not-ready IS the answer, not an error);
+        raises only when the daemon is unreachable."""
+        return self._request("GET", "/healthz", timeout=timeout,
+                             error_ok=True)
 
     def shutdown(self) -> dict:
         return self._request("POST", "/shutdown", {})

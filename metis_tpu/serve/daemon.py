@@ -60,6 +60,7 @@ from metis_tpu.inference.planner import (
     plan_inference,
 )
 from metis_tpu.inference.workload import InferenceWorkload, workload_from_dict
+from metis_tpu.obs.metrics import MetricsRegistry
 from metis_tpu.planner.api import make_search_state, plan_hetero
 from metis_tpu.planner.replan import (
     ClusterDelta,
@@ -121,6 +122,7 @@ class PlanService:
         drift_band_pct: float = 20.0,
         drift_min_samples: int = 5,
         search_wait_s: float = 300.0,
+        metrics: MetricsRegistry | None = None,
     ):
         self.cluster = cluster
         # boot topology: the elastic ceiling scale-up deltas grow back toward
@@ -133,7 +135,12 @@ class PlanService:
         self.drift_min_samples = drift_min_samples
         self.search_wait_s = search_wait_s
         self.counters = Counters()
-        self.cache = PlanCache(cache_capacity, counters=self.counters)
+        # metrics=None builds a live registry (the daemon's /metrics
+        # surface); pass obs.metrics.NULL_METRICS to measure the
+        # uninstrumented baseline (bench telemetry section)
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.cache = PlanCache(cache_capacity, counters=self.counters,
+                               metrics=self.metrics)
         self.state_capacity = state_capacity
         self.ledger = AccuracyLedger(None)  # in-memory: daemon-lifetime
         # _lock: registry/state-table mutations.  _search_lock: serializes
@@ -220,70 +227,101 @@ class PlanService:
     # -- plan queries -------------------------------------------------------
     def plan_query(self, model: ModelSpec, config: SearchConfig,
                    top_k: int | None = None,
-                   workload: InferenceWorkload | None = None) -> dict:
+                   workload: InferenceWorkload | None = None,
+                   trace_id: str | None = None) -> dict:
         """Answer one plan query: cache hit, coalesced wait, or cold
         search with warm state.  Byte-identical to the offline path.
 
         ``workload`` switches the query to the serving planner
         (``inference.planner.plan_inference``); the fingerprint hashes the
         workload kind + SLO fields, so training and inference queries for
-        the same model/cluster never share a cache entry."""
+        the same model/cluster never share a cache entry.  ``trace_id``
+        (client-minted) stamps every event, span, and worker heartbeat
+        this query causes — the handle ``metis-tpu report --trace``
+        reconstructs one request's span tree from."""
         t_req = time.perf_counter()
         qfp = query_fingerprint(model, self.cluster, config,
                                 calibration=self.calibration,
                                 workload=workload)
         key = self._cache_key(qfp, top_k)
         self.counters.inc("serve.requests")
-        tracer = Tracer(self.events)
+        ev = (self.events.with_fields(trace_id=trace_id)
+              if trace_id else self.events)
+        tracer = Tracer(ev)
         kind = "inference" if workload is not None else "training"
         with tracer.span("serve_request", fingerprint=qfp,
                          model=model.name, gbs=config.gbs) as span:
-            self.events.emit("plan_request", fingerprint=qfp,
-                             model=model.name, gbs=config.gbs, top_k=top_k,
-                             workload=kind)
+            ev.emit("plan_request", fingerprint=qfp,
+                    model=model.name, gbs=config.gbs, top_k=top_k,
+                    workload=kind)
             entry = self.cache.get(key)
             if entry is not None:
-                self.events.emit("plan_cache_hit", fingerprint=qfp)
+                ev.emit("plan_cache_hit", fingerprint=qfp)
                 span.set(cached=True)
-                return self._respond(entry, cached=True, t_req=t_req)
-            self.events.emit("plan_cache_miss", fingerprint=qfp)
+                return self._respond(entry, cached=True, t_req=t_req,
+                                     trace_id=trace_id)
+            ev.emit("plan_cache_miss", fingerprint=qfp)
             span.set(cached=False)
             # single-flight: identical concurrent misses wait for the
             # leader's search to land in the cache instead of repeating it
+            waited_since = None
             while True:
                 with self._lock:
                     waiter = self._inflight.get(key)
                     if waiter is None:
                         self._inflight[key] = threading.Event()
                         break
+                if waited_since is None:
+                    waited_since = time.perf_counter()
+                    self.metrics.counter(
+                        "metis_serve_coalesced_waits_total").inc()
                 waiter.wait(timeout=self.search_wait_s)
                 entry = self.cache.get(key)
                 if entry is not None:
-                    return self._respond(entry, cached=True, t_req=t_req)
+                    self.metrics.histogram(
+                        "metis_serve_coalesced_wait_ms").observe(
+                        (time.perf_counter() - waited_since) * 1000)
+                    return self._respond(entry, cached=True, t_req=t_req,
+                                         trace_id=trace_id)
                 # leader failed or timed out — loop to become the leader
             try:
                 if workload is not None:
                     entry = self._search_inference(qfp, key, model, config,
-                                                   workload, top_k)
+                                                   workload, top_k,
+                                                   events=ev)
                 else:
-                    entry = self._search(qfp, key, model, config, top_k)
+                    entry = self._search(qfp, key, model, config, top_k,
+                                         events=ev)
             finally:
                 with self._lock:
                     done = self._inflight.pop(key, None)
                 if done is not None:
                     done.set()
-            return self._respond(entry, cached=False, t_req=t_req)
+            return self._respond(entry, cached=False, t_req=t_req,
+                                 trace_id=trace_id)
 
     def _search(self, qfp: str, key: str, model: ModelSpec,
-                config: SearchConfig, top_k: int | None) -> dict:
-        with self._search_lock:
-            # warm state only helps the serial path; workers>1 queries go
-            # through search/parallel.py's own per-worker shards
-            state = (self._state_for(qfp, model, config)
-                     if config.workers == 1 else None)
-            result = plan_hetero(self.cluster, self.profiles, model, config,
-                                 top_k=top_k, events=self.events,
-                                 search_state=state)
+                config: SearchConfig, top_k: int | None,
+                events: EventLog | None = None) -> dict:
+        ev = events if events is not None else self.events
+        queue_depth = self.metrics.gauge("metis_serve_queue_depth")
+        queue_depth.inc()
+        try:
+            with self._search_lock:
+                t0 = time.perf_counter()
+                # warm state only helps the serial path; workers>1 queries
+                # go through search/parallel.py's own per-worker shards
+                state = (self._state_for(qfp, model, config)
+                         if config.workers == 1 else None)
+                result = plan_hetero(self.cluster, self.profiles, model,
+                                     config, top_k=top_k, events=ev,
+                                     search_state=state,
+                                     metrics=self.metrics)
+                self.metrics.histogram(
+                    "metis_search_duration_seconds",
+                    kind="training").observe(time.perf_counter() - t0)
+        finally:
+            queue_depth.dec()
         best = result.best
         plan_fp = fingerprint_ranked_plan(best) if best is not None else None
         entry = {
@@ -318,18 +356,27 @@ class PlanService:
     def _search_inference(self, qfp: str, key: str, model: ModelSpec,
                           config: SearchConfig,
                           workload: InferenceWorkload,
-                          top_k: int | None) -> dict:
+                          top_k: int | None,
+                          events: EventLog | None = None) -> dict:
         """Cold inference search.  No warm state — the pool search is
         orders of magnitude smaller than a training search — but it still
         serializes behind ``_search_lock`` so the cluster it reads cannot
         be swapped mid-enumeration by a concurrent ``cluster_delta``."""
-        with self._search_lock:
-            t0 = time.perf_counter()
-            result = plan_inference(self.cluster, self.profiles, model,
-                                    config, workload,
-                                    top_k=top_k if top_k is not None else 20,
-                                    events=self.events)
-            elapsed = time.perf_counter() - t0
+        ev = events if events is not None else self.events
+        queue_depth = self.metrics.gauge("metis_serve_queue_depth")
+        queue_depth.inc()
+        try:
+            with self._search_lock:
+                t0 = time.perf_counter()
+                result = plan_inference(
+                    self.cluster, self.profiles, model, config, workload,
+                    top_k=top_k if top_k is not None else 20, events=ev)
+                elapsed = time.perf_counter() - t0
+                self.metrics.histogram(
+                    "metis_search_duration_seconds",
+                    kind="inference").observe(elapsed)
+        finally:
+            queue_depth.dec()
         best = result.best
         plan_fp = fingerprint_inference_plan(best) if best else None
         entry = {
@@ -387,16 +434,22 @@ class PlanService:
         return round(price_migration_ms(old_layout, new_layout, volume), 6)
 
     @staticmethod
-    def _respond(entry: dict, *, cached: bool, t_req: float) -> dict:
+    def _respond(entry: dict, *, cached: bool, t_req: float,
+                 trace_id: str | None = None) -> dict:
         out = dict(entry)
         out["cached"] = cached
         out["serve_ms"] = round((time.perf_counter() - t_req) * 1000, 3)
+        if trace_id is not None:
+            # echo the client-minted id so the caller can hand it straight
+            # to `metis-tpu report --trace`
+            out["trace_id"] = trace_id
         return out
 
     # -- accuracy + drift ---------------------------------------------------
     def post_accuracy_sample(self, fingerprint: str, measured_ms: float,
                              step: int | None = None,
-                             stage_ms=(), predicted_ms=None) -> dict:
+                             stage_ms=(), predicted_ms=None,
+                             trace_id: str | None = None) -> dict:
         """Feed one measured step for a served plan; on a drift alarm a
         background thread replans every query whose cached best is that
         plan and pushes ``replan_push`` notifications."""
@@ -423,8 +476,13 @@ class PlanService:
                 self._handled_alarms[fingerprint] = status.alarms
         if fire:
             self.counters.inc("serve.drift_replans")
+            # bind the triggering sample's trace_id onto everything the
+            # background replan emits — the thread outlives this request,
+            # but the telemetry stays attributable to it
+            ev = (self.events.with_fields(trace_id=trace_id)
+                  if trace_id else self.events)
             threading.Thread(
-                target=self._replan_for, args=(fingerprint, status),
+                target=self._replan_for, args=(fingerprint, status, ev),
                 name="metis-serve-replan", daemon=True).start()
         return {
             "fingerprint": fingerprint,
@@ -435,9 +493,11 @@ class PlanService:
             "replanning": fire,
         }
 
-    def _replan_for(self, plan_fp: str, status) -> list[dict]:
+    def _replan_for(self, plan_fp: str, status,
+                    events: EventLog | None = None) -> list[dict]:
         """Drift-alarm fallout: re-search every registered query whose
         best plan is ``plan_fp``, refresh the cache, notify trainers."""
+        ev = events if events is not None else self.events
         with self._lock:
             targets = [rec for rec in self._queries.values()
                        if rec.plan_fingerprint == plan_fp]
@@ -454,7 +514,7 @@ class PlanService:
                          if rec.config.workers == 1 else None)
                 report = replan_on_drift(
                     status, self.cluster, self.profiles, rec.model,
-                    rec.config, top_k=rec.top_k, events=self.events,
+                    rec.config, top_k=rec.top_k, events=ev,
                     search_state=state)
             if report is None or report.result.best is None:
                 continue
@@ -491,7 +551,7 @@ class PlanService:
                 "new_best_cost_ms": best.cost.total_ms,
                 "reason": "drift_alarm",
             })
-            self.events.emit(
+            ev.emit(
                 "replan_push", fingerprint=plan_fp, new_fingerprint=new_fp,
                 reason="drift_alarm", plan_changed=changed,
                 seq=note["seq"])
@@ -501,7 +561,8 @@ class PlanService:
     # -- topology change ----------------------------------------------------
     def apply_cluster_delta(self, removed: dict[str, int] | None = None,
                             added: dict[str, int] | None = None,
-                            replan: bool = False) -> dict:
+                            replan: bool = False,
+                            trace_id: str | None = None) -> dict:
         """Elastic topology change: lose ``removed`` devices and/or restore
         ``added`` (type -> count, capped by the boot topology).  Swaps in
         the new cluster, drops every cache entry and warm state, notifies
@@ -513,6 +574,8 @@ class PlanService:
         call) keeps the cache and warm states and pushes nothing."""
         removed = {str(t): int(n) for t, n in (removed or {}).items()}
         added = {str(t): int(n) for t, n in (added or {}).items()}
+        ev = (self.events.with_fields(trace_id=trace_id)
+              if trace_id else self.events)
         with self._search_lock:
             new_cluster = self.cluster
             if removed:
@@ -595,7 +658,7 @@ class PlanService:
             else:
                 invalidated = len(self.cache.invalidate_where(
                     lambda k, _v: k not in keep_keys))
-            self.events.emit(
+            ev.emit(
                 "incremental_replan",
                 changed_nodes=sorted(changed),
                 states_kept=kept, states_dropped=dropped,
@@ -625,7 +688,7 @@ class PlanService:
         if replan:
             self.counters.inc("serve.delta_replans")
             threading.Thread(
-                target=self._replan_all, args=("cluster_delta",),
+                target=self._replan_all, args=("cluster_delta", ev),
                 name="metis-serve-delta-replan", daemon=True).start()
         return {"invalidated": invalidated, "removed": delta.removed,
                 "added": delta.added,
@@ -633,10 +696,12 @@ class PlanService:
                 "replanning": replan,
                 "tenants_changed": sorted(fleet_decisions)}
 
-    def _replan_all(self, reason: str) -> list[dict]:
+    def _replan_all(self, reason: str,
+                    events: EventLog | None = None) -> list[dict]:
         """Re-search every registered query against the CURRENT topology
         and push a ``replan_push`` note per query — the cluster-delta
         counterpart of the drift path's ``_replan_for``."""
+        ev = events if events is not None else self.events
         with self._lock:
             targets = list(self._queries.values())
         notes: list[dict] = []
@@ -650,10 +715,10 @@ class PlanService:
                 if rec.workload is not None:
                     entry = self._search_inference(
                         qfp, new_key, rec.model, rec.config, rec.workload,
-                        rec.top_k)
+                        rec.top_k, events=ev)
                 else:
                     entry = self._search(qfp, new_key, rec.model,
-                                         rec.config, rec.top_k)
+                                         rec.config, rec.top_k, events=ev)
             except MetisError:
                 # the shrunken topology may not fit this query at all —
                 # subscribers learn from the absence of a push
@@ -683,7 +748,7 @@ class PlanService:
                 # migration against checkpoint-restore
                 payload["migration_cost_ms"] = mig
             note = self._push_note(payload)
-            self.events.emit(
+            ev.emit(
                 "replan_push", fingerprint=rec.plan_fingerprint,
                 new_fingerprint=new_fp, reason=reason,
                 plan_changed=changed, migration_cost_ms=mig,
@@ -750,7 +815,8 @@ class PlanService:
             if self.sched is None:
                 sched = FleetScheduler(
                     self.full_cluster, self.profiles, events=self.events,
-                    search_state_provider=self._tenant_search_state)
+                    search_state_provider=self._tenant_search_state,
+                    metrics=self.metrics)
                 sched.cluster = self.cluster  # may already be shrunk
                 self.sched = sched
             return self.sched
@@ -854,7 +920,7 @@ class PlanService:
         return {"tenant": name, "tenants_changed": changed,
                 "seq": note["seq"]}
 
-    def tenant_plan(self, name: str) -> dict:
+    def tenant_plan(self, name: str, trace_id: str | None = None) -> dict:
         """Per-tenant query routing: serve the tenant's slice of the
         current fleet plan.  The ``plans`` field is the planner dump the
         fleet scheduler produced on the tenant's sub-cluster — for a
@@ -882,14 +948,17 @@ class PlanService:
         carve = ",".join(map(str, node_ix)) if node_ix else "empty"
         key = f"tenant/{name}/{carve}/{qfp}"
         self.counters.inc("serve.requests")
-        self.events.emit("plan_request", fingerprint=qfp,
-                         model=spec.model.name, gbs=spec.config.gbs,
-                         top_k=None, workload=spec.kind, tenant=name)
+        ev = (self.events.with_fields(trace_id=trace_id)
+              if trace_id else self.events)
+        ev.emit("plan_request", fingerprint=qfp,
+                model=spec.model.name, gbs=spec.config.gbs,
+                top_k=None, workload=spec.kind, tenant=name)
         entry = self.cache.get(key)
         if entry is not None:
-            self.events.emit("plan_cache_hit", fingerprint=qfp)
-            return self._respond(entry, cached=True, t_req=t_req)
-        self.events.emit("plan_cache_miss", fingerprint=qfp)
+            ev.emit("plan_cache_hit", fingerprint=qfp)
+            return self._respond(entry, cached=True, t_req=t_req,
+                                 trace_id=trace_id)
+        ev.emit("plan_cache_miss", fingerprint=qfp)
         entry = {
             "fingerprint": qfp,
             "tenant": name,
@@ -902,7 +971,8 @@ class PlanService:
             "utility_frac": round(alloc.utility_frac, 9) if alloc else 0.0,
         }
         self.cache.put(key, entry)
-        return self._respond(entry, cached=False, t_req=t_req)
+        return self._respond(entry, cached=False, t_req=t_req,
+                             trace_id=trace_id)
 
     def tenant_status(self, name: str | None = None) -> dict:
         sched = self.sched
@@ -968,6 +1038,56 @@ class PlanService:
             self._note_cond.notify_all()
 
     # -- introspection ------------------------------------------------------
+    def healthz(self) -> dict:
+        """Liveness + readiness.  Live = not shut down.  Ready = live and
+        every check passes: no search currently holds the lock (a stuck
+        search would starve cold queries), the plan cache holds at least
+        one answer (a cold daemon serves its first query at search speed,
+        not cache speed), and the last fleet plan — when multi-tenant mode
+        is on — left every tenant feasible.  /healthz answers 200 when
+        ready, 503 otherwise, so a load balancer can drain a daemon that
+        is alive but not yet (or no longer) fit to serve."""
+        live = not self._closed
+        fleet_ok = True
+        sched = self.sched
+        if sched is not None and sched.last_plan is not None:
+            fleet_ok = all(a.feasible for a in sched.last_plan.allocations)
+        checks = {
+            "search_lock_free": not self._search_lock.locked(),
+            "cache_warm": len(self.cache) > 0,
+            "fleet_feasible": fleet_ok,
+        }
+        return {
+            "live": live,
+            "ready": live and all(checks.values()),
+            "checks": checks,
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+        }
+
+    def render_metrics(self) -> str:
+        """Prometheus text exposition of the whole registry, refreshing
+        the derived gauges (ratios, occupancy, uptime) at scrape time —
+        the cheap pull-model alternative to updating them on every
+        request."""
+        m = self.metrics
+        counters = self.counters.as_dict()
+        hits = counters.get("serve.cache.hit", 0)
+        misses = counters.get("serve.cache.miss", 0)
+        if hits + misses:
+            m.gauge("metis_serve_cache_hit_ratio").set(
+                hits / (hits + misses))
+        m.gauge("metis_serve_cache_entries").set(len(self.cache))
+        m.gauge("metis_serve_cache_capacity").set(self.cache.capacity)
+        with self._lock:
+            m.gauge("metis_serve_warm_states").set(len(self._states))
+        with self._note_cond:
+            m.gauge("metis_serve_notes_backlog").set(len(self._notes))
+        m.gauge("metis_serve_uptime_seconds").set(
+            time.monotonic() - self._t_start)
+        m.gauge("metis_serve_tenants").set(
+            len(self.sched.registry) if self.sched else 0)
+        return m.render()
+
     def stats(self) -> dict:
         return {
             "uptime_s": round(time.monotonic() - self._t_start, 3),
@@ -986,6 +1106,16 @@ class PlanService:
 # ---------------------------------------------------------------------------
 # HTTP transport (stdlib http.server; TCP or AF_UNIX)
 # ---------------------------------------------------------------------------
+
+
+# endpoints that get their own label on the per-endpoint metrics;
+# anything else (404s, typos) lands under "other" so an attacker probing
+# paths cannot mint unbounded label cardinality
+_KNOWN_ENDPOINTS = {
+    "/plan", "/tenant", "/tenant_remove", "/accuracy_sample",
+    "/cluster_delta", "/invalidate", "/shutdown",
+    "/stats", "/healthz", "/metrics", "/notifications",
+}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -1007,8 +1137,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _json(self, code: int, payload: dict) -> None:
         body = json.dumps(payload).encode()
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, code: int, text: str,
+              content_type: str = "text/plain; version=0.0.4; "
+                                  "charset=utf-8") -> None:
+        body = text.encode()
+        self._status = code
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -1023,10 +1165,46 @@ class _Handler(BaseHTTPRequestHandler):
             raise ValueError("request body must be a JSON object")
         return loaded
 
+    def _instrumented(self, inner) -> None:
+        """Per-endpoint SLIs, recorded at the single point every request
+        passes through so ``metis_serve_requests_total{endpoint=e}`` and
+        the latency histogram's count reconcile exactly by construction."""
+        m = self.service.metrics
+        path = urlparse(self.path).path
+        endpoint = (path.lstrip("/") if path in _KNOWN_ENDPOINTS
+                    else "other")
+        self._status = 200
+        m.gauge("metis_serve_inflight_requests").inc()
+        t0 = time.perf_counter()
+        try:
+            inner()
+        finally:
+            dur_ms = (time.perf_counter() - t0) * 1000
+            m.gauge("metis_serve_inflight_requests").dec()
+            m.counter("metis_serve_requests_total",
+                      endpoint=endpoint).inc()
+            m.histogram("metis_serve_request_latency_ms",
+                        endpoint=endpoint).observe(dur_ms)
+            m.rate("metis_serve_qps").mark()
+            if self._status >= 400:
+                m.counter("metis_serve_errors_total",
+                          endpoint=endpoint).inc()
+
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        self._instrumented(self._do_get)
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        self._instrumented(self._do_post)
+
+    def _do_get(self) -> None:
         parsed = urlparse(self.path)
-        if parsed.path in ("/stats", "/healthz"):
+        if parsed.path == "/stats":
             self._json(200, self.service.stats())
+        elif parsed.path == "/healthz":
+            health = self.service.healthz()
+            self._json(200 if health["ready"] else 503, health)
+        elif parsed.path == "/metrics":
+            self._text(200, self.service.render_metrics())
         elif parsed.path == "/notifications":
             q = parse_qs(parsed.query)
             since = int(q.get("since", ["0"])[0])
@@ -1044,15 +1222,18 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._json(404, {"error": f"no such endpoint: {parsed.path}"})
 
-    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+    def _do_post(self) -> None:
         try:
             body = self._body()
+            trace_id = body.get("trace_id")
+            trace_id = str(trace_id) if trace_id is not None else None
             if self.path == "/plan":
                 tenant = body.get("tenant")
                 if tenant is not None:
                     # tenant routing: model/config/workload come from the
                     # registered TenantSpec, not the request body
-                    self._json(200, self.service.tenant_plan(str(tenant)))
+                    self._json(200, self.service.tenant_plan(
+                        str(tenant), trace_id=trace_id))
                     return
                 model = model_spec_from_dict(body["model"])
                 config = search_config_from_dict(body["config"])
@@ -1061,7 +1242,8 @@ class _Handler(BaseHTTPRequestHandler):
                 out = self.service.plan_query(
                     model, config,
                     top_k=int(top_k) if top_k is not None else None,
-                    workload=workload_from_dict(wl) if wl else None)
+                    workload=workload_from_dict(wl) if wl else None,
+                    trace_id=trace_id)
                 self._json(200, out)
             elif self.path == "/tenant":
                 out = self.service.tenant_register(tenant_from_dict(body))
@@ -1074,13 +1256,15 @@ class _Handler(BaseHTTPRequestHandler):
                     str(body["fingerprint"]), float(body["measured_ms"]),
                     step=body.get("step"),
                     stage_ms=body.get("stage_ms", ()),
-                    predicted_ms=body.get("predicted_ms"))
+                    predicted_ms=body.get("predicted_ms"),
+                    trace_id=trace_id)
                 self._json(200, out)
             elif self.path == "/cluster_delta":
                 out = self.service.apply_cluster_delta(
                     removed=body.get("removed"),
                     added=body.get("added"),
-                    replan=bool(body.get("replan", False)))
+                    replan=bool(body.get("replan", False)),
+                    trace_id=trace_id)
                 self._json(200, out)
             elif self.path == "/invalidate":
                 out = self.service.invalidate(

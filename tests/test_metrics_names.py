@@ -1,0 +1,65 @@
+"""Metrics-contract validation (tools/check_metrics_names.py) wired into
+tier-1: a live daemon's /metrics scrape must parse as valid Prometheus
+text exposition, export only cataloged names, and the README "Metrics"
+table must match obs.metrics.METRIC_CATALOG exactly in both directions —
+so name drift between code, scrape, and docs breaks the build."""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_metrics_names  # noqa: E402
+
+
+def test_live_scrape_and_readme_contract(capsys):
+    """One daemon boot covers both the library check and the CLI wrapper
+    (main() is run_check + formatting) — the suite sits near its wall-clock
+    budget, so no second boot just for the exit-code path."""
+    rc = check_metrics_names.main(["-q"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "OK" in out
+
+
+def test_validate_exposition_catches_malformations():
+    v = check_metrics_names.validate_exposition
+    assert v("# TYPE m counter\nm 1\n") == []
+    # sample with no TYPE
+    assert v("orphan 1\n")
+    # non-cumulative buckets
+    bad_hist = ("# TYPE h histogram\n"
+                'h_bucket{le="1"} 5\n'
+                'h_bucket{le="+Inf"} 3\n'
+                "h_sum 1\nh_count 3\n")
+    assert any("cumulative" in p for p in v(bad_hist))
+    # missing +Inf terminator
+    no_inf = ("# TYPE h histogram\n"
+              'h_bucket{le="1"} 5\n'
+              "h_sum 1\nh_count 5\n")
+    assert any("+Inf" in p for p in v(no_inf))
+    # _count disagreeing with the +Inf bucket
+    bad_count = ("# TYPE h histogram\n"
+                 'h_bucket{le="+Inf"} 5\n'
+                 "h_sum 1\nh_count 7\n")
+    assert any("_count" in p for p in v(bad_count))
+    # garbage line
+    assert any("malformed" in p for p in v("not a metric line at all\n"))
+
+
+def test_readme_table_parses_nonempty():
+    names = check_metrics_names.readme_metric_names()
+    assert "metis_serve_requests_total" in names
+    assert len(names) == len(check_metrics_names.METRIC_CATALOG)
+
+
+def test_catalog_entries_well_formed():
+    for name, (kind, help_text, labels) in \
+            check_metrics_names.METRIC_CATALOG.items():
+        assert name.startswith("metis_")
+        assert kind in ("counter", "gauge", "histogram")
+        assert help_text
+        assert isinstance(labels, tuple)
+        if kind == "counter":
+            assert name.endswith("_total"), name

@@ -1,0 +1,381 @@
+"""Telemetry plane integration: /metrics + /healthz on a live daemon,
+counter↔histogram reconciliation, end-to-end trace_id propagation
+(client → daemon → search → `metis-tpu report --trace`), EventLog
+size-based rotation under concurrent emit, and the `metis-tpu top`
+dashboard."""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+from metis_tpu.cluster import ClusterSpec
+from metis_tpu.core.config import SearchConfig
+from metis_tpu.core.events import EventLog, read_events
+from metis_tpu.obs.metrics import parse_exposition
+
+
+@pytest.fixture(scope="module")
+def small_workload():
+    from metis_tpu.profiles import synthesize_profiles, tiny_test_model
+
+    model = tiny_test_model(num_layers=4)
+    profiles = synthesize_profiles(model, ["A100", "T4"], tps=[1, 2],
+                                   bss=[1, 2, 4])
+    cluster = ClusterSpec.of(("A100", 1, 4), ("T4", 1, 4))
+    config = SearchConfig(gbs=16, max_profiled_tp=2, max_profiled_bs=4)
+    return cluster, profiles, model, config
+
+
+@pytest.fixture(scope="module")
+def live_daemon(small_workload, tmp_path_factory):
+    """One HTTP daemon, driven through the real client: a cold /healthz
+    probe, then a traced cold plan + cached repeats, then a settle pause
+    (the handler records its metrics after writing the response, so an
+    immediate scrape can trail the last request by microseconds)."""
+    from metis_tpu.serve.client import PlanServiceClient, mint_trace_id
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+    cluster, profiles, model, config = small_workload
+    events_path = tmp_path_factory.mktemp("telemetry") / "daemon.jsonl"
+    events = EventLog(events_path)
+    service = PlanService(cluster, profiles, events=events)
+    server, _thread, address = serve_in_thread(service)
+    client = PlanServiceClient(address, timeout=300.0)
+
+    cold_health = client.healthz(timeout=10.0)
+    trace_id = mint_trace_id()
+    cold_resp = client.plan(model, config, top_k=5, trace_id=trace_id)
+    for _ in range(3):
+        cached_resp = client.plan(model, config, top_k=5)
+    client.stats()
+    time.sleep(0.3)  # let the last handler's finally-block accounting land
+
+    yield {
+        "client": client,
+        "service": service,
+        "address": address,
+        "events_path": events_path,
+        "cold_health": cold_health,
+        "trace_id": trace_id,
+        "cold_resp": cold_resp,
+        "cached_resp": cached_resp,
+    }
+    server.shutdown()
+    server.server_close()
+    events.close()
+
+
+# ---------------------------------------------------------------------------
+# /healthz
+# ---------------------------------------------------------------------------
+
+
+class TestHealthz:
+    def test_cold_daemon_live_but_not_ready(self, live_daemon):
+        health = live_daemon["cold_health"]
+        assert health["live"] is True
+        assert health["ready"] is False
+        assert health["checks"]["cache_warm"] is False
+        assert health["checks"]["search_lock_free"] is True
+
+    def test_ready_after_first_served_plan(self, live_daemon):
+        health = live_daemon["client"].healthz(timeout=10.0)
+        assert health["live"] is True
+        assert health["ready"] is True
+        assert all(health["checks"].values())
+        assert health["uptime_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# /metrics
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsEndpoint:
+    def test_scrape_is_valid_exposition(self, live_daemon):
+        import check_metrics_names
+
+        text = live_daemon["client"].metrics(timeout=10.0)
+        assert check_metrics_names.validate_exposition(text) == []
+
+    def test_counters_reconcile_with_histograms(self, live_daemon):
+        """Per endpoint: requests_total == latency histogram _count.  Both
+        are recorded at the single instrumentation site in the HTTP
+        handler, so they can never drift."""
+        text = live_daemon["client"].metrics(timeout=10.0)
+        fam = parse_exposition(text)
+        requests = {dict(labels)["endpoint"]: v for _, labels, v
+                    in fam["metis_serve_requests_total"]["samples"]}
+        hist_counts = {
+            dict(labels)["endpoint"]: v
+            for name, labels, v
+            in fam["metis_serve_request_latency_ms"]["samples"]
+            if name.endswith("_count")}
+        assert requests == hist_counts
+        # the fixture drove 4 /plan requests (1 cold + 3 cached)
+        assert requests["plan"] >= 4.0
+
+    def test_cache_metrics_track_the_load(self, live_daemon):
+        fam = parse_exposition(live_daemon["client"].metrics(timeout=10.0))
+
+        def value(family):
+            (_, _, v), = fam[family]["samples"]
+            return v
+
+        assert value("metis_serve_cache_hits_total") >= 3.0
+        assert value("metis_serve_cache_misses_total") >= 1.0
+        assert 0.0 < value("metis_serve_cache_hit_ratio") < 1.0
+        assert value("metis_serve_cache_entries") >= 1.0
+        assert value("metis_serve_uptime_seconds") > 0.0
+
+    def test_search_durations_exported(self, live_daemon):
+        fam = parse_exposition(live_daemon["client"].metrics(timeout=10.0))
+        counts = {dict(labels).get("kind"): v for name, labels, v
+                  in fam["metis_search_duration_seconds"]["samples"]
+                  if name.endswith("_count")}
+        assert counts.get("training", 0.0) >= 1.0
+
+    def test_in_process_render_matches_http(self, live_daemon):
+        names_http = set(parse_exposition(
+            live_daemon["client"].metrics(timeout=10.0)))
+        names_local = set(parse_exposition(
+            live_daemon["service"].render_metrics()))
+        assert names_local == names_http
+
+
+# ---------------------------------------------------------------------------
+# end-to-end tracing
+# ---------------------------------------------------------------------------
+
+
+class TestTracePropagation:
+    def test_response_echoes_trace_id(self, live_daemon):
+        assert live_daemon["cold_resp"]["trace_id"] \
+            == live_daemon["trace_id"]
+        # untraced... no: the client mints when the caller doesn't
+        assert live_daemon["cached_resp"]["trace_id"]
+        assert live_daemon["cached_resp"]["trace_id"] \
+            != live_daemon["trace_id"]
+
+    def test_trace_id_on_every_caused_event(self, live_daemon):
+        tid = live_daemon["trace_id"]
+        events = read_events(live_daemon["events_path"])
+        traced = [e for e in events if e.get("trace_id") == tid]
+        names = {e["event"] for e in traced}
+        # the cold query: request record, cache miss, the search it ran,
+        # and the tracer spans around it
+        assert {"plan_request", "plan_cache_miss", "search_started",
+                "search_finished", "span_begin", "span_end"} <= names
+        # nothing from OTHER requests bled into this trace: exactly one
+        # plan_request carries this id
+        assert sum(1 for e in traced if e["event"] == "plan_request") == 1
+
+    def test_request_scoped_events_all_traced(self, live_daemon):
+        """The schema checker's contract: in a traced log, every
+        request-scoped event carries a trace_id."""
+        import check_events_schema
+
+        events = read_events(live_daemon["events_path"])
+        assert check_events_schema.validate_events(events) == []
+        scoped = [e for e in events
+                  if e["event"] in check_events_schema.REQUEST_SCOPED_EVENTS]
+        assert scoped
+        assert all(e.get("trace_id") for e in scoped)
+
+    def test_report_trace_reconstructs_span_tree(self, live_daemon, capsys):
+        from metis_tpu.planner.cli import main
+
+        rc = main(["report", str(live_daemon["events_path"]),
+                   "--trace", live_daemon["trace_id"]])
+        out = capsys.readouterr()
+        assert rc == 0
+        assert "plan_hetero" in out.out          # the root span survived
+        assert live_daemon["trace_id"] in out.err  # "trace <id>: N of M"
+
+    def test_report_unknown_trace_fails(self, live_daemon, capsys):
+        from metis_tpu.planner.cli import main
+
+        rc = main(["report", str(live_daemon["events_path"]),
+                   "--trace", "deadbeefdeadbeef"])
+        capsys.readouterr()
+        assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# metis-tpu top
+# ---------------------------------------------------------------------------
+
+
+class TestTopDashboard:
+    def test_one_frame_against_live_daemon(self, live_daemon, capsys):
+        from metis_tpu.planner.cli import main
+
+        rc = main(["top", live_daemon["address"], "--iterations", "1",
+                   "--no-clear"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert live_daemon["address"] in out
+        assert "qps" in out
+        assert "plan" in out            # the endpoint table has a plan row
+        assert "p99" in out
+
+    def test_frame_renders_from_exposition_text(self, live_daemon):
+        from metis_tpu.planner.cli import _top_frame
+
+        frame = _top_frame(live_daemon["client"].metrics(timeout=10.0),
+                           live_daemon["address"])
+        assert "cache" in frame
+        assert "endpoint" in frame
+
+    def test_unreachable_daemon_renders_error_frame(self, capsys):
+        from metis_tpu.planner.cli import main
+
+        rc = main(["top", "127.0.0.1:1", "--iterations", "1",
+                   "--no-clear", "--interval", "0.1"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "unreachable" in out
+
+
+# ---------------------------------------------------------------------------
+# EventLog rotation
+# ---------------------------------------------------------------------------
+
+
+class TestEventLogRotation:
+    def test_rotation_under_concurrent_emit(self, tmp_path):
+        """8 writers race the roll threshold: no line is torn or lost, the
+        predecessor lands at .1, and every fresh file opens with
+        event_log_rotated — all schema-valid."""
+        import check_events_schema
+
+        path = tmp_path / "rot.jsonl"
+        per_thread, threads = 400, 8
+        with EventLog(path, max_bytes=16 * 1024) as log:
+            def work(wid):
+                for i in range(per_thread):
+                    log.emit("train_step", step=i, worker=wid)
+
+            ts = [threading.Thread(target=work, args=(w,))
+                  for w in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        rolled = path.with_name(path.name + ".1")
+        assert rolled.exists()
+        live_events = read_events(path)
+        rolled_events = read_events(rolled)   # every line parses
+        assert check_events_schema.validate_events(live_events) == []
+        # the live file begins with the rotation marker pointing at .1
+        assert live_events[0]["event"] == "event_log_rotated"
+        assert live_events[0]["rotated_to"] == str(rolled)
+        assert live_events[0]["size_bytes"] <= 16 * 1024
+        # rotation keeps only one generation; the surviving records are a
+        # subset of what was emitted, each intact
+        for ev in live_events + rolled_events:
+            if ev["event"] == "train_step":
+                assert 0 <= ev["step"] < per_thread
+                assert 0 <= ev["worker"] < threads
+
+    def test_rotated_file_stays_under_threshold(self, tmp_path):
+        path = tmp_path / "cap.jsonl"
+        limit = 4096
+        with EventLog(path, max_bytes=limit) as log:
+            for i in range(500):
+                log.emit("train_step", step=i)
+        assert path.stat().st_size <= limit + 512   # one record of slack
+        assert path.with_name(path.name + ".1").stat().st_size <= limit + 512
+
+    def test_no_rotation_without_max_bytes(self, tmp_path):
+        path = tmp_path / "plain.jsonl"
+        with EventLog(path) as log:
+            for i in range(200):
+                log.emit("train_step", step=i)
+        assert not path.with_name(path.name + ".1").exists()
+        assert len(read_events(path)) == 200
+
+    def test_bad_max_bytes_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            EventLog(tmp_path / "x.jsonl", max_bytes=0)
+
+    def test_with_fields_binding_survives_rotation(self, tmp_path):
+        """A bound view shares the parent's rotation; its records carry
+        the bound fields on whichever file they land in."""
+        path = tmp_path / "bound.jsonl"
+        with EventLog(path, max_bytes=2048) as log:
+            bound = log.with_fields(trace_id="t" * 16)
+            for i in range(100):
+                bound.emit("train_step", step=i)
+        all_events = read_events(path) \
+            + read_events(path.with_name(path.name + ".1"))
+        steps = [e for e in all_events if e["event"] == "train_step"]
+        assert steps
+        assert all(e["trace_id"] == "t" * 16 for e in steps)
+
+
+# ---------------------------------------------------------------------------
+# client surface
+# ---------------------------------------------------------------------------
+
+
+class TestClientSurface:
+    def test_metrics_returns_raw_exposition(self, live_daemon):
+        text = live_daemon["client"].metrics(timeout=10.0)
+        assert isinstance(text, str)
+        assert "# TYPE metis_serve_requests_total counter" in text
+
+    def test_healthz_never_raises_on_503(self, small_workload):
+        """A cold daemon answers /healthz 503; the client returns the body
+        instead of raising (the probe must work when the probe target is
+        the thing that's broken)."""
+        from metis_tpu.serve.client import PlanServiceClient
+        from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+        cluster, profiles, _model, _config = small_workload
+        service = PlanService(cluster, profiles)
+        server, _thread, address = serve_in_thread(service)
+        try:
+            health = PlanServiceClient(address).healthz(timeout=10.0)
+            assert health["ready"] is False
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_mint_trace_id_shape(self):
+        from metis_tpu.serve.client import mint_trace_id
+
+        ids = {mint_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(len(t) == 16 and all(c in "0123456789abcdef" for c in t)
+                   for t in ids)
+
+    def test_stats_unchanged_by_instrumentation(self, live_daemon):
+        stats = live_daemon["client"].stats()
+        assert stats["cache"]["size"] >= 1
+        assert "counters" in stats
+
+
+def test_events_file_is_schema_clean_end_to_end(live_daemon):
+    """The whole daemon session's event file — traced and untraced
+    requests interleaved — validates against the documented schema."""
+    import check_events_schema
+
+    n, problems = check_events_schema.validate_file(
+        live_daemon["events_path"])
+    assert problems == []
+    assert n > 0
+
+
+def test_json_lines_are_single_objects(live_daemon):
+    for line in Path(live_daemon["events_path"]).read_text().splitlines():
+        assert isinstance(json.loads(line), dict)

@@ -133,12 +133,27 @@ EVENT_SCHEMA: dict[str, set[str]] = {
                      "bound_ms"},
     "certificate": {"best_ms", "lower_bound_ms", "gap_frac",
                     "nodes_explored", "nodes_bounded", "wall_s"},
+    # size-based log rotation (core/events.EventLog max_bytes): the first
+    # record of every fresh file after a roll — where the predecessor
+    # went and how large it was when it rolled
+    "event_log_rotated": {"rotated_to", "size_bytes"},
 }
+
+# Events the serve daemon emits once per client request.  When a client
+# mints trace_ids (serve/client.py does, always), the daemon stamps them
+# onto every event a request causes — so in a daemon log where ANY event
+# carries a trace_id, every request-scoped event must.  A partial stamp
+# means a code path lost the binding (exactly the regression the
+# end-to-end tracing contract exists to catch).
+REQUEST_SCOPED_EVENTS = {"plan_request", "plan_cache_hit",
+                         "plan_cache_miss", "replan_push"}
 
 
 def validate_events(events: list[dict]) -> list[str]:
     """Problems (empty = valid) for already-parsed event dicts."""
     problems: list[str] = []
+    traced = any(isinstance(ev, dict) and ev.get("trace_id")
+                 for ev in events)
     for i, ev in enumerate(events, 1):
         where = f"event {i}"
         if not isinstance(ev, dict):
@@ -157,6 +172,15 @@ def validate_events(events: list[dict]) -> list[str]:
         missing = sorted(required - set(ev))
         if missing:
             problems.append(f"{where} ({name}): missing fields {missing}")
+        if "trace_id" in ev and not (isinstance(ev["trace_id"], str)
+                                     and ev["trace_id"]):
+            problems.append(
+                f"{where} ({name}): trace_id must be a non-empty string")
+        elif traced and name in REQUEST_SCOPED_EVENTS \
+                and not ev.get("trace_id"):
+            problems.append(
+                f"{where} ({name}): request-scoped event missing trace_id "
+                "in a traced log")
     return problems
 
 

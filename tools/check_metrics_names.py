@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Validate the daemon's /metrics surface against the documented contract.
+
+Three checks, mirroring what check_events_schema.py does for events:
+
+1. **Exposition syntax** — ``validate_exposition(text)`` lints Prometheus
+   text format v0.0.4: line grammar, metric/label name charsets, numeric
+   sample values, TYPE declared before samples, histogram buckets
+   cumulative and terminated by ``+Inf``, ``_count`` equal to the +Inf
+   bucket.
+2. **Catalog coverage** — every name a live daemon exports must be
+   registered in ``obs.metrics.METRIC_CATALOG`` (the code-side contract).
+3. **README table** — the README "Metrics" section's table and
+   METRIC_CATALOG must match exactly, both directions, so the docs can
+   never silently drift from the exported surface.
+
+``main()`` boots a real daemon on the parity fixture, drives one plan
+query through the client (so request/search/cache metrics exist), scrapes
+/metrics and /healthz over HTTP, and runs all three checks — the tier-1
+wiring lives in tests/test_metrics_names.py.
+"""
+from __future__ import annotations
+
+import math
+import re
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+from metis_tpu.obs.metrics import METRIC_CATALOG, parse_exposition  # noqa: E402
+
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+    r" (-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|\+Inf|-Inf|NaN))$")
+_README_METRIC_RE = re.compile(r"^\|\s*`(metis_[a-z0-9_]+)`")
+
+
+def validate_exposition(text: str) -> list[str]:
+    """Problems (empty = valid) for one /metrics scrape."""
+    problems: list[str] = []
+    typed: dict[str, str] = {}
+    # family -> {labelkey-without-le: {le_bound: cumulative}}
+    hist: dict[str, dict[tuple, dict[float, float]]] = {}
+    counts: dict[str, dict[tuple, float]] = {}
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        where = f"line {lineno}"
+        if not line.strip():
+            continue
+        if line.startswith("# HELP"):
+            if not _HELP_RE.match(line):
+                problems.append(f"{where}: malformed HELP: {line!r}")
+            continue
+        if line.startswith("# TYPE"):
+            m = _TYPE_RE.match(line)
+            if not m:
+                problems.append(f"{where}: malformed TYPE: {line!r}")
+                continue
+            typed[m.group(1)] = m.group(2)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            problems.append(f"{where}: malformed sample: {line!r}")
+            continue
+        name = m.group(1)
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in typed:
+                family = name[:-len(suffix)]
+        if family not in typed:
+            problems.append(f"{where}: sample {name!r} has no TYPE "
+                            "declaration")
+            continue
+        if typed[family] == "histogram":
+            labels = dict(
+                re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                           m.group(2) or ""))
+            le = labels.pop("le", None)
+            lkey = tuple(sorted(labels.items()))
+            value = float(m.group(3).replace("+Inf", "inf")
+                          .replace("-Inf", "-inf"))
+            if name.endswith("_bucket"):
+                if le is None:
+                    problems.append(f"{where}: histogram bucket without "
+                                    "an le label")
+                    continue
+                bound = math.inf if le == "+Inf" else float(le)
+                hist.setdefault(family, {}).setdefault(lkey, {})[bound] = \
+                    value
+            elif name.endswith("_count"):
+                counts.setdefault(family, {})[lkey] = value
+
+    for family, series in hist.items():
+        for lkey, buckets in series.items():
+            label = f"{family}{dict(lkey)}"
+            bounds = sorted(buckets)
+            if not bounds or bounds[-1] != math.inf:
+                problems.append(f"{label}: buckets missing +Inf terminator")
+                continue
+            cums = [buckets[b] for b in bounds]
+            if any(b > a for a, b in zip(cums[1:], cums)):
+                problems.append(f"{label}: bucket counts not cumulative")
+            total = counts.get(family, {}).get(lkey)
+            if total is None:
+                problems.append(f"{label}: histogram without a _count "
+                                "sample")
+            elif total != buckets[math.inf]:
+                problems.append(
+                    f"{label}: _count {total} != +Inf bucket "
+                    f"{buckets[math.inf]}")
+    return problems
+
+
+def readme_metric_names(readme: Path = REPO / "README.md") -> set[str]:
+    """Backticked ``metis_*`` names from the README Metrics table."""
+    names: set[str] = set()
+    in_metrics = False
+    for line in readme.read_text().splitlines():
+        if line.startswith("#"):
+            in_metrics = "metrics" in line.lower()
+            continue
+        if in_metrics:
+            m = _README_METRIC_RE.match(line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def run_check(verbose: bool = False) -> list[str]:
+    """Boot a daemon, scrape it, run every check; problems (empty = ok)."""
+    from serve_smoke import parity_inputs
+
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+    problems: list[str] = []
+
+    # docs vs code first — cheap, and meaningful even if the boot fails
+    documented = readme_metric_names()
+    catalog = set(METRIC_CATALOG)
+    for name in sorted(catalog - documented):
+        problems.append(f"README Metrics table missing {name!r} "
+                        "(in METRIC_CATALOG)")
+    for name in sorted(documented - catalog):
+        problems.append(f"README documents unknown metric {name!r} "
+                        "(not in METRIC_CATALOG)")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cluster, profiles, model, config = parity_inputs(tmp)
+        service = PlanService(cluster, profiles)
+        server, _thread, address = serve_in_thread(service)
+        try:
+            client = PlanServiceClient(address, timeout=300.0)
+            health = client.healthz(timeout=10.0)
+            if not health.get("live"):
+                problems.append(f"healthz reports not live: {health}")
+            client.plan(model, config, top_k=10)   # cold search
+            client.plan(model, config, top_k=10)   # cached hit
+            health = client.healthz(timeout=10.0)
+            if not health.get("ready"):
+                problems.append(
+                    f"healthz not ready after a served query: {health}")
+            text = client.metrics(timeout=10.0)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    problems.extend(validate_exposition(text))
+    try:
+        exported = {name for name in parse_exposition(text)
+                    if name.startswith("metis_")}
+    except ValueError as e:
+        problems.append(f"parse_exposition failed: {e}")
+        exported = set()
+    for name in sorted(exported - catalog):
+        problems.append(f"daemon exports undocumented metric {name!r} "
+                        "(not in METRIC_CATALOG)")
+    # a minimal boot cannot export fleet/replay metrics, so the scrape
+    # check is one-directional (exported ⊆ catalog); the serve-core
+    # names below must always be present after a query
+    for name in ("metis_serve_requests_total",
+                 "metis_serve_request_latency_ms",
+                 "metis_serve_cache_hits_total",
+                 "metis_search_duration_seconds"):
+        if name not in exported:
+            problems.append(f"daemon did not export {name!r} after a "
+                            "plan query")
+    if verbose and not problems:
+        print(f"{len(exported)} exported metric families, "
+              f"{len(catalog)} cataloged, README in sync")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    verbose = "-q" not in (argv or sys.argv[1:])
+    problems = run_check(verbose=verbose)
+    if problems:
+        print(f"{len(problems)} problem(s)")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("metrics names OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
