@@ -1514,6 +1514,92 @@ def telemetry_bench(record: dict) -> None:
     record["telemetry"] = entry
 
 
+def provenance_bench(record: dict) -> None:
+    """Cost of the decision log (metis_tpu/obs/provenance): cached-hit
+    p50 with the log durably on disk vs the in-memory default — every
+    cached serve appends one JSONL decision record, so the write must be
+    provably cheap (``provenance_overhead_frac`` headline, budget ≤ 2%)
+    — plus the read side: causal-chain reconstruction latency over the
+    recorded log, and the log passing the decision-schema invariants.
+
+    Same drift-cancelling shape as ``telemetry_bench``: both daemons
+    booted up front, alternating rounds, min-of-medians."""
+    import statistics
+
+    from metis_tpu.obs.provenance import DecisionLog
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from tools.check_decisions_schema import validate_file as validate_dlog
+    from tools.serve_smoke import SMOKE_TOP_K, parity_inputs
+
+    entry: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        cluster, profiles, model, config = parity_inputs(tmp)
+        dlog_path = tmp / "decisions.jsonl"
+
+        try:
+            svc_mem = PlanService(cluster, profiles)  # in-memory log
+            srv_mem, thr_mem, addr_mem = serve_in_thread(svc_mem)
+            svc_disk = PlanService(cluster, profiles,
+                                   decisions=DecisionLog(dlog_path))
+            srv_disk, thr_disk, addr_disk = serve_in_thread(svc_disk)
+        except OSError as e:
+            record["provenance"] = {
+                "skipped_reason": f"socket setup failed: {e}"}
+            return
+        try:
+            cli_mem = PlanServiceClient(addr_mem)
+            cli_disk = PlanServiceClient(addr_disk)
+            cli_mem.plan(model, config, top_k=SMOKE_TOP_K)  # warm caches
+            cli_disk.plan(model, config, top_k=SMOKE_TOP_K)
+
+            def round_p50(client, n=70):
+                lat = []
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    client.plan(model, config, top_k=SMOKE_TOP_K)
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                return statistics.median(lat)
+
+            meds_mem, meds_disk = [], []
+            for _round in range(3):
+                meds_mem.append(round_p50(cli_mem))
+                meds_disk.append(round_p50(cli_disk))
+            p50_mem = min(meds_mem)
+            p50_disk = min(meds_disk)
+            entry["cached_hit_p50_log_memory_ms"] = round(p50_mem, 3)
+            entry["cached_hit_p50_log_disk_ms"] = round(p50_disk, 3)
+            entry["provenance_overhead_frac"] = round(
+                (p50_disk - p50_mem) / max(p50_mem, 1e-9), 4)
+
+            # read side: walk the causal chain of the latest decision —
+            # the `metis-tpu why` hot loop — over the whole recorded log
+            stats = cli_disk.stats()
+            entry["decision_records"] = stats.get("decisions")
+            last = stats.get("decision_seq")
+            t0 = time.perf_counter()
+            chain = svc_disk.decisions.chain(last) if last else []
+            entry["chain_walk_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3)
+            entry["chain_depth"] = len(chain)
+        finally:
+            for client, server, thread in ((cli_mem, srv_mem, thr_mem),
+                                           (cli_disk, srv_disk, thr_disk)):
+                try:
+                    client.shutdown()
+                except Exception:
+                    server.shutdown()
+                thread.join(10)
+                server.server_close()
+        n_recs, problems = validate_dlog(dlog_path)
+        entry["log_schema_valid"] = not problems
+        entry["log_records_on_disk"] = n_recs
+        if problems:
+            entry["log_problems"] = problems[:5]
+    record["provenance"] = entry
+
+
 def inference_bench(record: dict) -> None:
     """Latency-SLO serving planner (metis_tpu/inference) on the parity
     workload:
@@ -2121,6 +2207,7 @@ def main() -> None:
     recorder.run("overlap", overlap_bench, record)
     recorder.run("serve", serve_bench, record)
     recorder.run("telemetry", telemetry_bench, record)
+    recorder.run("provenance", provenance_bench, record)
     recorder.run("inference", inference_bench, record)
     recorder.run("fleet", fleet_bench, record)
     recorder.run("sched", sched_bench, record)
@@ -2241,6 +2328,12 @@ def _headline(record: dict) -> dict:
         "metrics_scrape_p95_ms": (record.get("telemetry") or {})
         .get("metrics_scrape_p95_ms"),
         "telemetry_skipped": (record.get("telemetry") or {})
+        .get("skipped_reason"),
+        "provenance_overhead_frac": (record.get("provenance") or {})
+        .get("provenance_overhead_frac"),
+        "provenance_log_valid": (record.get("provenance") or {})
+        .get("log_schema_valid"),
+        "provenance_skipped": (record.get("provenance") or {})
         .get("skipped_reason"),
         "slo_p99_ttft_ms": (record.get("inference") or {})
         .get("slo_p99_ttft_ms"),

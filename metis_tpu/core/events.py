@@ -154,3 +154,19 @@ def read_events(path: str | Path) -> list[dict]:
         if line.strip():
             out.append(json.loads(line))
     return out
+
+
+def read_events_rotated(path: str | Path) -> list[dict]:
+    """Like :func:`read_events`, but prepends the ``<name>.1`` roll when
+    size-based rotation (``EventLog(max_bytes=...)``) displaced earlier
+    records there — so trace and causal-chain reconstruction over a
+    long-lived daemon's log sees the full history, not just the live
+    file.  The rolled file's records come first (they are strictly older);
+    a missing roll degrades to a plain read."""
+    p = Path(path)
+    rolled = p.with_name(p.name + ".1")
+    out: list[dict] = []
+    if rolled.exists():
+        out.extend(read_events(rolled))
+    out.extend(read_events(p))
+    return out

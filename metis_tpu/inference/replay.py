@@ -177,14 +177,18 @@ def replay_traffic(
 
     def add_node() -> None:
         delta = released.pop()
-        client.cluster_delta(added=delta, replan=True)
+        # cause labels the delta's decision-log root "autoscale": the
+        # daemon records it as an autoscale_delta, distinguishing elastic
+        # policy actions from operator deltas in `metis-tpu why`
+        client.cluster_delta(added=delta, replan=True, cause="autoscale")
         t = next(iter(delta))
         live_nodes.append(NodeSpec(t, delta[t]))
 
     def shed_node() -> None:
         node = live_nodes.pop()
         delta = {node.device_type: node.num_devices}
-        client.cluster_delta(removed=delta, replan=True)
+        client.cluster_delta(removed=delta, replan=True,
+                             cause="autoscale")
         released.append(delta)
 
     for tick in range(total_ticks):

@@ -232,7 +232,13 @@ def query_fingerprint(model, cluster, config, *, calibration=None,
 
 @dataclass(frozen=True)
 class AccuracySample:
-    """One measured step joined against its plan's prediction (if any)."""
+    """One measured step joined against its plan's prediction (if any).
+
+    ``components`` holds measured per-``CostBreakdown``-component times
+    when the measurement was component-resolved (empty otherwise — the
+    residual decomposition then falls back to proportional attribution);
+    ``device_type`` labels which hardware measured the step, so residual
+    distributions can be grouped per device type."""
 
     fingerprint: str
     measured_ms: float
@@ -240,6 +246,8 @@ class AccuracySample:
     step: int | None = None
     source: str = "train"
     stage_ms: tuple[float, ...] = ()
+    components: dict[str, float] = dataclasses.field(default_factory=dict)
+    device_type: str = ""
 
     @property
     def error_pct(self) -> float | None:
@@ -326,6 +334,8 @@ class AccuracyLedger:
             step=rec.get("step"),
             source=rec.get("source", "train"),
             stage_ms=tuple(rec.get("stage_ms", ())),
+            components=dict(rec.get("components") or {}),
+            device_type=rec.get("device_type", ""),
         )
 
     def _append(self, rec: dict) -> None:
@@ -456,6 +466,70 @@ class AccuracyLedger:
              "mape_pct": round(sum(abs(e) for e in errs) / len(errs), 3)}
             for i, errs in sorted(acc.items())
         )
+
+    def component_residuals(
+            self, fingerprint: str | None = None,
+            by_device: bool = False) -> dict[str, dict]:
+        """Per-``CostBreakdown``-component residual distributions in ms.
+
+        For every matched sample whose prediction carries ``components``:
+        a component-resolved measurement (``record_measurement(...,
+        components={...})``) yields the exact residual ``predicted_c -
+        measured_c`` per component both sides carry (a component absent
+        from the measurement is skipped for that sample — e.g.
+        ``migration`` appears only on migrated plans); an unresolved
+        measurement attributes the total residual proportionally to the
+        predicted component shares, so the per-component residuals still
+        sum to the total residual by additivity.
+
+        Returns ``{component: {n, mean_ms, var_ms, p50_abs_ms,
+        p95_abs_ms}}`` — or, with ``by_device=True``, the same keyed by
+        device type first (samples without a ``device_type`` group under
+        ``""``).  Empty dict when nothing is component-attributable.
+        This is the model-confidence context ``DecisionRecord.confidence``
+        carries for the ranking margin (``metis-tpu accuracy
+        --components`` renders it)."""
+        acc: dict[tuple[str, str], list[float]] = {}
+        for s in self.samples:
+            if fingerprint is not None and s.fingerprint != fingerprint:
+                continue
+            pred = self.predictions.get(s.fingerprint)
+            if not pred or not pred.get("components"):
+                continue
+            pcomps = pred["components"]
+            ptotal = pred.get("predicted_ms") or sum(pcomps.values())
+            dev = s.device_type or pred.get("device_type", "") or ""
+            for comp, pv in pcomps.items():
+                if s.components:
+                    if comp not in s.components:
+                        continue
+                    r = pv - s.components[comp]
+                elif ptotal > 0 and s.measured_ms > 0:
+                    r = pv / ptotal * (ptotal - s.measured_ms)
+                else:
+                    continue
+                acc.setdefault((dev, comp), []).append(r)
+
+        def stats(residuals: list[float]) -> dict:
+            n = len(residuals)
+            mean = sum(residuals) / n
+            var = max(sum(r * r for r in residuals) / n - mean * mean, 0.0)
+            abs_sorted = sorted(abs(r) for r in residuals)
+            return {"n": n, "mean_ms": round(mean, 4),
+                    "var_ms": round(var, 4),
+                    "p50_abs_ms": round(_percentile(abs_sorted, 0.5), 4),
+                    "p95_abs_ms": round(_percentile(abs_sorted, 0.95), 4)}
+
+        if by_device:
+            out: dict[str, dict] = {}
+            for (dev, comp), residuals in sorted(acc.items()):
+                out.setdefault(dev, {})[comp] = stats(residuals)
+            return out
+        merged: dict[str, list[float]] = {}
+        for (_dev, comp), residuals in acc.items():
+            merged.setdefault(comp, []).extend(residuals)
+        return {comp: stats(residuals)
+                for comp, residuals in sorted(merged.items())}
 
 
 # ---------------------------------------------------------------------------

@@ -168,6 +168,8 @@ def plan_hetero(
     inter_filter=None,
     search_state: CandidateEvaluator | None = None,
     metrics=None,
+    decisions=None,
+    decision_meta: dict | None = None,
 ) -> PlannerResult:
     """Full heterogeneous search: inter-stage × intra-stage candidates,
     costed and ranked (≅ ``cost_het_cluster``).
@@ -200,18 +202,36 @@ def plan_hetero(
     daemon passes its own so every search feeds the
     ``metis_search_phase_seconds{phase}`` histograms /metrics exposes
     (phase timings come from the tracer's accum spans, so they require an
-    enabled ``events`` log; setup and ranking are timed directly)."""
+    enabled ``events`` log; setup and ranking are timed directly).
+
+    ``decisions``: an optional ``obs.provenance.DecisionLog`` — the search
+    outcome is appended as one decision record (kind ``cold_search``
+    unless ``decision_meta`` overrides it; the serve daemon records at its
+    own layer instead, with cache context this function cannot see).
+    ``decision_meta``: extra DecisionRecord fields (``kind``, ``cause``,
+    ``parent_seq``, ``trace_id``, ``query_fingerprint``, ...)."""
     _check_profile_attn(profiles, model)
+
+    def _record(result: PlannerResult) -> PlannerResult:
+        if decisions is not None:
+            from metis_tpu.obs.provenance import record_planner_decision
+
+            meta = dict(decision_meta or {})
+            record_planner_decision(
+                decisions, result, kind=meta.pop("kind", "cold_search"),
+                **meta)
+        return result
+
     if getattr(config, "backend", "beam") == "exact":
         # branch-and-bound backend (search/exact.py): same candidate space
         # and cost path, plus an optimality certificate; runs serially
         from metis_tpu.search.exact import exact_plan_hetero
 
-        return exact_plan_hetero(
+        return _record(exact_plan_hetero(
             cluster, profiles, model, config,
             bandwidth_factory=bandwidth_factory, top_k=top_k,
             events=events, inter_filter=inter_filter,
-            search_state=search_state)
+            search_state=search_state))
     if config.workers > 1:
         from metis_tpu.search.parallel import try_parallel_plan_hetero
 
@@ -220,7 +240,7 @@ def plan_hetero(
             bandwidth_factory=bandwidth_factory, top_k=top_k,
             events=events, inter_filter=inter_filter)
         if parallel_result is not None:
-            return parallel_result
+            return _record(parallel_result)
     tracer = Tracer(events)
     heartbeat = Heartbeat(events, every=config.progress_every)
     root = tracer.span("plan_hetero", mode="hetero", model=model.name,
@@ -422,13 +442,13 @@ def plan_hetero(
         num_pruned=pruned, seconds=round(elapsed, 4),
         best_cost_ms=best_cost, num_bound_pruned=pruner.num_pruned)
     root.__exit__(None, None, None)
-    return PlannerResult(
+    return _record(PlannerResult(
         plans=tuple(results),
         num_costed=num_costed,
         num_pruned=pruned,
         search_seconds=elapsed,
         num_bound_pruned=pruner.num_pruned,
-    )
+    ))
 
 
 def plan_uniform(

@@ -592,6 +592,13 @@ def main(argv: list[str] | None = None) -> int:
                        help="restrict to one plan fingerprint")
     p_acc.add_argument("--top", type=int, default=5,
                        help="worst samples to list")
+    p_acc.add_argument("--components", action="store_true",
+                       help="per-component residual distributions (n, "
+                            "mean, p50/p95 |residual| ms) — the "
+                            "model-confidence stats decision records "
+                            "carry")
+    p_acc.add_argument("--by-device", action="store_true",
+                       help="split --components stats per device type")
     p_acc.add_argument("--json", action="store_true", dest="as_json")
     p_acc.add_argument("--output", default="-",
                        help="output path ('-' = stdout)")
@@ -644,6 +651,11 @@ def main(argv: list[str] | None = None) -> int:
                             "would exceed N bytes (core/events.EventLog "
                             "max_bytes) — bounds a long-lived daemon's "
                             "log; default: never rotate")
+    p_srv.add_argument("--decisions", default=None, metavar="FILE",
+                       help="append the decision log (plan provenance: "
+                            "obs/provenance.DecisionLog) here; reopening "
+                            "resumes the seq so restarts never reset the "
+                            "audit trail. Default: in-memory only")
 
     p_top = sub.add_parser(
         "top", help="live terminal dashboard over a running daemon's "
@@ -714,6 +726,53 @@ def main(argv: list[str] | None = None) -> int:
                             "(forecast the arrival trend and scale BEFORE "
                             "the rate crosses the feasible ceiling)")
 
+    p_why = sub.add_parser(
+        "why", help="why is this plan being served: walk the decision "
+                    "log's causal parent chain from a plan (or a "
+                    "tenant's latest decision) back to its root trigger, "
+                    "with the attributed cost diff at every hop")
+    p_why.add_argument("fingerprint", nargs="?", default=None,
+                       help="plan fingerprint to explain (a query "
+                            "fingerprint — what /plan responses echo — "
+                            "also matches; omit with --tenant or --seq)")
+    p_why.add_argument("--tenant", default=None,
+                       help="explain this tenant's latest decision "
+                            "instead of a plan fingerprint")
+    p_why.add_argument("--seq", type=int, default=None,
+                       help="explain the decision with this exact seq")
+    p_why.add_argument("--decisions", default=None, metavar="FILE",
+                       help="decision JSONL (metis-tpu serve --decisions)")
+    p_why.add_argument("--remote", default=None,
+                       help="fetch decisions from a running daemon "
+                            "(http://HOST:PORT or unix:/path) instead "
+                            "of a file")
+    p_why.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the chain + per-hop diffs as JSON")
+    p_why.add_argument("--output", default="-",
+                       help="output path ('-' = stdout)")
+
+    p_diff = sub.add_parser(
+        "diff", help="attributed diff between two plans by fingerprint: "
+                     "per-component cost deltas (summing exactly to the "
+                     "total delta) plus every decision axis that moved")
+    p_diff.add_argument("fp_a", help="plan fingerprint A (the baseline)")
+    p_diff.add_argument("fp_b", help="plan fingerprint B")
+    p_diff.add_argument("--decisions", default=None, metavar="FILE",
+                        help="decision JSONL to resolve fingerprints from")
+    p_diff.add_argument("--remote", default=None,
+                        help="resolve fingerprints from a running "
+                             "daemon's decision log")
+    p_diff.add_argument("--plans", action="append", default=[],
+                        metavar="FILE",
+                        help="plan-dump JSON (metis-tpu hetero/tpu "
+                             "output) to resolve fingerprints from; "
+                             "repeatable, carries the structural axes "
+                             "decision records lack")
+    p_diff.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the diff as JSON")
+    p_diff.add_argument("--output", default="-",
+                        help="output path ('-' = stdout)")
+
     args = parser.parse_args(argv)
 
     _pin_platform(args)
@@ -729,6 +788,10 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_top(args)
     if args.command == "accuracy":
         return _cmd_accuracy(args)
+    if args.command == "why":
+        return _cmd_why(args)
+    if args.command == "diff":
+        return _cmd_diff(args)
     if args.command == "calibrate":
         return _cmd_calibrate(args)
     if args.command == "profile":
@@ -797,16 +860,19 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     """Boot the plan daemon and serve until interrupted (or POST
     /shutdown).  Prints the bound address as one JSON line so wrappers
     can parse it even with --port 0."""
+    from metis_tpu.obs.provenance import DecisionLog
     from metis_tpu.serve.daemon import PlanService, make_server, run_server
 
     cluster = ClusterSpec.from_files(args.hostfile, args.clusterfile)
     profiles = ProfileStore.from_dir(args.profile_dir)
     events = (EventLog(args.events, max_bytes=args.events_max_bytes)
               if args.events else NULL_LOG)
+    decisions = (DecisionLog(args.decisions, events=events)
+                 if args.decisions else None)
     service = PlanService(
         cluster, profiles, cache_capacity=args.cache_size,
         state_capacity=args.state_cache_size, events=events,
-        drift_band_pct=args.drift_band)
+        drift_band_pct=args.drift_band, decisions=decisions)
     server = make_server(service, host=args.host, port=args.port,
                          socket_path=args.socket)
     print(json.dumps({
@@ -816,6 +882,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         "cache_capacity": args.cache_size,
     }), flush=True)
     run_server(server)
+    service.close()
     events.close()
     return 0
 
@@ -933,7 +1000,7 @@ def _cmd_replay(args: argparse.Namespace) -> int:
 
 def _cmd_report(args: argparse.Namespace) -> int:
     """Span-tree/counters report over an event JSONL (core/trace.py)."""
-    from metis_tpu.core.events import read_events
+    from metis_tpu.core.events import read_events_rotated
     from metis_tpu.core.trace import (
         build_span_tree,
         render_span_table,
@@ -941,7 +1008,10 @@ def _cmd_report(args: argparse.Namespace) -> int:
     )
 
     try:
-        events = read_events(args.events_file)
+        # rotated-aware: when the daemon rolled the log to <name>.1
+        # (EventLog max_bytes), prepend the roll so spans that straddle
+        # the rotation still pair up
+        events = read_events_rotated(args.events_file)
     except OSError as e:
         print(f"cannot read {args.events_file}: {e}", file=sys.stderr)
         return 1
@@ -1357,6 +1427,11 @@ def _cmd_accuracy(args: argparse.Namespace) -> int:
             detector.observe(s.error_pct)
     status = detector.status()
 
+    residuals = None
+    if args.components or args.by_device:
+        residuals = ledger.component_residuals(
+            fingerprint=args.fingerprint, by_device=args.by_device)
+
     if args.as_json:
         payload = summary.to_json_dict()
         payload["drift"] = {
@@ -1367,6 +1442,8 @@ def _cmd_accuracy(args: argparse.Namespace) -> int:
             "band_pct": status.band_pct,
             "alarms": status.alarms,
         }
+        if residuals is not None:
+            payload["component_residuals"] = residuals
         _emit(args, json.dumps(payload, indent=2))
         return 0
 
@@ -1412,7 +1489,156 @@ def _cmd_accuracy(args: argparse.Namespace) -> int:
                 f"  stage {sr['stage']}: signed "
                 f"{sr['signed_error_pct']:+.1f}% mape {sr['mape_pct']:.1f}% "
                 f"(n={sr['n']})")
+    if residuals is not None:
+        lines.append("")
+        if not residuals:
+            lines.append("component residuals: none (no sample carries a "
+                         "component-attributed prediction)")
+        elif args.by_device:
+            lines.append("component residuals by device (|residual| ms):")
+            for dev, comps in residuals.items():
+                lines.append(f"  {dev or '(unlabeled)'}:")
+                for comp, st in comps.items():
+                    lines.append(
+                        f"    {comp}: n={st['n']} mean "
+                        f"{st['mean_ms']:+.3f} p50 {st['p50_abs_ms']:.3f} "
+                        f"p95 {st['p95_abs_ms']:.3f}")
+        else:
+            lines.append("component residuals (|residual| ms):")
+            for comp, st in residuals.items():
+                lines.append(
+                    f"  {comp}: n={st['n']} mean {st['mean_ms']:+.3f} "
+                    f"p50 {st['p50_abs_ms']:.3f} p95 {st['p95_abs_ms']:.3f}")
     _emit(args, "\n".join(lines))
+    return 0
+
+
+def _load_decision_records(args: argparse.Namespace):
+    """DecisionRecords from ``--decisions FILE`` or a ``--remote`` daemon
+    (None + stderr message when neither source yields records)."""
+    from metis_tpu.obs.provenance import DecisionRecord
+
+    if args.remote:
+        from metis_tpu.serve.client import PlanServiceClient
+
+        dicts = PlanServiceClient(args.remote).decisions()
+        return [DecisionRecord.from_json_dict(d) for d in dicts]
+    if not args.decisions:
+        print("need a decision source: --decisions FILE (metis-tpu serve "
+              "--decisions) or --remote ADDRESS", file=sys.stderr)
+        return None
+    from pathlib import Path
+
+    path = Path(args.decisions)
+    if not path.exists():
+        print(f"no such decision log: {args.decisions}", file=sys.stderr)
+        return None
+    records = []
+    for line in path.read_text().splitlines():
+        if not line.strip():
+            continue
+        try:
+            records.append(DecisionRecord.from_json_dict(json.loads(line)))
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            continue  # check_decisions_schema.py reports corruption
+    return records
+
+
+def _cmd_why(args: argparse.Namespace) -> int:
+    """Causal-chain reconstruction: find the leaf decision (by plan
+    fingerprint, tenant, or seq), walk parent_seq edges to the root
+    trigger, render each hop with its attributed diff."""
+    from metis_tpu.obs.provenance import causal_chain, chain_json, render_chain
+
+    if args.fingerprint is None and args.tenant is None and args.seq is None:
+        print("give a plan fingerprint, --tenant NAME, or --seq N",
+              file=sys.stderr)
+        return 2
+    records = _load_decision_records(args)
+    if records is None:
+        return 1
+    leaf = None
+    if args.seq is not None:
+        leaf = next((r for r in records if r.seq == args.seq), None)
+    else:
+        # latest record wins: "why is this plan/tenant served NOW".
+        # A fingerprint matches the plan OR the query fingerprint — the
+        # /plan response echoes the query one, so that's what a user
+        # usually has in hand.
+        for rec in reversed(records):
+            if args.fingerprint is not None \
+                    and args.fingerprint not in (rec.plan_fingerprint,
+                                                 rec.query_fingerprint):
+                continue
+            if args.tenant is not None and rec.tenant != args.tenant:
+                continue
+            leaf = rec
+            break
+    if leaf is None:
+        want = (f"seq {args.seq}" if args.seq is not None
+                else f"tenant {args.tenant!r}" if args.tenant is not None
+                else f"plan {args.fingerprint}")
+        print(f"no decision matching {want} among {len(records)} records",
+              file=sys.stderr)
+        return 1
+    chain = causal_chain(records, leaf)
+    if args.as_json:
+        _emit(args, json.dumps(chain_json(chain), indent=2))
+    else:
+        _emit(args, render_chain(chain))
+    return 0
+
+
+def _cmd_diff(args: argparse.Namespace) -> int:
+    """Attributed plan diff by fingerprint: resolve each fingerprint from
+    plan dumps (structural axes + breakdown) and/or decision records
+    (breakdown only), then render ``diff_plans``' attribution."""
+    from metis_tpu.obs.provenance import diff_plans, fingerprint_plan_dict
+
+    by_fp: dict[str, object] = {}
+    # decision records first, so a plan dump carrying the same
+    # fingerprint overrides with its richer structural axes
+    if args.decisions or args.remote:
+        records = _load_decision_records(args)
+        if records is None:
+            return 1
+        for rec in records:  # later (newer) records win
+            if rec.plan_fingerprint and rec.breakdown is not None:
+                by_fp[rec.plan_fingerprint] = rec
+    from pathlib import Path
+
+    for plans_file in args.plans:
+        try:
+            payload = json.loads(Path(plans_file).read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read plan dump {plans_file}: {e}",
+                  file=sys.stderr)
+            return 1
+        entries = (payload.get("plans", [])
+                   if isinstance(payload, dict) else payload)
+        for entry in entries:
+            fp = fingerprint_plan_dict(entry)
+            if fp:
+                by_fp[fp] = entry
+    if not by_fp:
+        print("no plans to diff: give --plans FILE, --decisions FILE, "
+              "or --remote ADDRESS", file=sys.stderr)
+        return 2
+    missing = [fp for fp in (args.fp_a, args.fp_b) if fp not in by_fp]
+    if missing:
+        known = ", ".join(sorted(by_fp)) or "(none)"
+        print(f"fingerprint(s) not found: {', '.join(missing)} — "
+              f"known: {known}", file=sys.stderr)
+        return 1
+    diff = diff_plans(by_fp[args.fp_a], by_fp[args.fp_b])
+    if args.as_json:
+        _emit(args, json.dumps(diff.to_json_dict(), indent=2))
+    else:
+        header = (f"plan {args.fp_a} -> {args.fp_b}"
+                  + (f": {diff.total_a_ms:.3f} -> {diff.total_b_ms:.3f} ms"
+                     f" ({diff.total_delta_ms:+.3f})"
+                     if diff.total_delta_ms is not None else ""))
+        _emit(args, header + "\n\n" + diff.render())
     return 0
 
 

@@ -190,6 +190,8 @@ def replan(
     config: SearchConfig,
     old_result: PlannerResult | None = None,
     search_old: bool = True,
+    decisions=None,
+    decision_meta: dict | None = None,
     **plan_kwargs,
 ) -> ReplanReport:
     """Re-plan against ``new_cluster`` and report the topology delta and cost
@@ -197,12 +199,26 @@ def replan(
     and plan identity; otherwise the old cluster is re-planned too — unless
     ``search_old=False``, which searches ONLY the survivor topology (the
     time-critical elastic-recovery path: old-plan comparison is then
-    reported as unknown rather than paid for)."""
+    reported as unknown rather than paid for).
+
+    ``decisions`` / ``decision_meta`` (``obs.provenance``): record the NEW
+    search as one decision record — kind ``delta_replan`` unless the meta
+    overrides it.  The old-comparison search is never recorded; it picks
+    no plan, it only prices the one being displaced."""
     delta = ClusterDelta.between(old_cluster, new_cluster)
     if old_result is None and search_old:
         old_result = plan_hetero(old_cluster, profiles, model, config,
                                  **plan_kwargs)
+    meta = None
+    if decisions is not None:
+        meta = {"kind": "delta_replan", **(decision_meta or {})}
+        detail = dict(meta.get("detail") or {})
+        detail.setdefault("removed", delta.removed)
+        detail.setdefault("added", delta.added)
+        if detail:
+            meta["detail"] = detail
     new_result = plan_hetero(new_cluster, profiles, model, config,
+                             decisions=decisions, decision_meta=meta,
                              **plan_kwargs)
 
     old_best = old_result.best if old_result is not None else None
@@ -229,6 +245,8 @@ def replan_on_drift(
     model: ModelSpec,
     config: SearchConfig,
     old_result: PlannerResult | None = None,
+    decisions=None,
+    decision_meta: dict | None = None,
     **plan_kwargs,
 ) -> ReplanReport | None:
     """Cost-model-drift replan trigger.
@@ -244,5 +262,10 @@ def replan_on_drift(
     """
     if not getattr(status, "in_drift", False):
         return None
+    meta = None
+    if decisions is not None:
+        meta = {"kind": "drift_replan", "cause": "drift_alarm",
+                **(decision_meta or {})}
     return replan(cluster, cluster, profiles, model, config,
-                  old_result=old_result, search_old=False, **plan_kwargs)
+                  old_result=old_result, search_old=False,
+                  decisions=decisions, decision_meta=meta, **plan_kwargs)

@@ -227,6 +227,7 @@ class TrainingSupervisor:
         optimizer=None,
         install_signal_handler: bool = False,
         sleep: Callable[[float], None] = time.sleep,
+        decisions=None,
     ):
         if steps < 1:
             raise ValueError("steps must be >= 1")
@@ -248,6 +249,37 @@ class TrainingSupervisor:
         self._sleep = sleep
         self._drain = False
         self._drain_reason = ""
+        # obs.provenance.DecisionLog (or None): every recovery appends a
+        # cluster_delta root (cause = the real-world event), the replan it
+        # forces as a delta_replan child, and the migrate-vs-ckpt call as
+        # a migration_decision grandchild — the same chain shape the serve
+        # daemon writes, so `metis-tpu why` reads both identically.
+        self.decisions = decisions
+
+    # -- provenance helpers ------------------------------------------------
+
+    def _recovery_root(self, cause: str, step: int, **detail):
+        """(root record, replan decision_meta) for one recovery — (None,
+        None) when no decision log is attached."""
+        if self.decisions is None:
+            return None, None
+        root = self.decisions.record(
+            "cluster_delta", cause=cause, detail={"step": step, **detail})
+        return root, {"cause": cause, "parent_seq": root.seq}
+
+    def _record_migration(self, cause: str, best, migrated: bool,
+                          step: int) -> None:
+        if self.decisions is None:
+            return
+        from metis_tpu.obs.ledger import fingerprint_ranked_plan
+
+        parent = self.decisions.last_seq or None
+        self.decisions.record(
+            "migration_decision",
+            plan_fingerprint=fingerprint_ranked_plan(best),
+            parent_seq=parent, cause=cause,
+            detail={"path": "migrate" if migrated else "ckpt",
+                    "resumed_step": step})
 
     # -- build helpers ----------------------------------------------------
 
@@ -493,12 +525,18 @@ class TrainingSupervisor:
                             "preemption", step=step, tier="spot",
                             lost=",".join(f"{t}={n}"
                                           for t, n in lost.items()))
+                    cause = ("preemption" if kind == "spot_preemption"
+                             else "device_loss")
+                    _, dec_meta = self._recovery_root(cause, step,
+                                                      removed=lost)
                     with tracer.span("recovery", kind=kind):
                         old = (exe, layout, art, state, self.cluster)
                         survivor = shrink_cluster(self.cluster, lost)
                         rep = replan(self.cluster, survivor, self.profiles,
                                      self.model, self.search_config,
-                                     search_old=False)
+                                     search_old=False,
+                                     decisions=self.decisions,
+                                     decision_meta=dec_meta)
                         if rep.result.best is None:
                             raise InfeasiblePlanError(
                                 "no feasible plan on survivor topology")
@@ -508,6 +546,8 @@ class TrainingSupervisor:
                         fresh = exe.init(jax.random.PRNGKey(0))
                         state, step, migrated = self._switch_state(
                             old, exe, layout, art, fresh, step)
+                        self._record_migration(cause, rep.result.best,
+                                               migrated, step)
                         batches = self._batches(art, exe, mesh, skip=step)
                         detector.reset()
                         timer = StepTimer(events=self.events,
@@ -546,13 +586,17 @@ class TrainingSupervisor:
                             "spot_return", step=step,
                             returned=",".join(f"{t}={n}"
                                               for t, n in returned.items()))
+                        _, dec_meta = self._recovery_root(
+                            "spot_return", step, added=returned)
                         with tracer.span("recovery", kind="spot_return"):
                             old = (exe, layout, art, state, self.cluster)
                             grown = grow_cluster(
                                 self.cluster, self.full_cluster, returned)
                             rep = replan(self.cluster, grown, self.profiles,
                                          self.model, self.search_config,
-                                         search_old=False)
+                                         search_old=False,
+                                         decisions=self.decisions,
+                                         decision_meta=dec_meta)
                             if rep.result.best is None:
                                 raise InfeasiblePlanError(
                                     "no feasible plan on grown topology")
@@ -563,6 +607,9 @@ class TrainingSupervisor:
                             fresh = exe.init(jax.random.PRNGKey(0))
                             state, step, migrated = self._switch_state(
                                 old, exe, layout, art, fresh, step)
+                            self._record_migration(
+                                "spot_return", rep.result.best, migrated,
+                                step)
                             batches = self._batches(art, exe, mesh,
                                                     skip=step)
                             detector.reset()

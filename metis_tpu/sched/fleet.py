@@ -148,12 +148,20 @@ class FleetScheduler:
                  *, events: EventLog = NULL_LOG,
                  top_k: int | None = None,
                  search_state_provider=None,
-                 metrics=None):
+                 metrics=None, decisions=None):
         self.full_cluster = full_cluster
         self.cluster = full_cluster
         self.profiles = profiles
         self.events = events
         self.top_k = top_k
+        # obs.provenance.DecisionLog (or None — library use records no
+        # provenance): every re-partition appends one ``fleet_repartition``
+        # record, every displaced tenant one ``tenant_replan`` (and, for
+        # training tenants, one ``migration_decision``) child, so
+        # `metis-tpu why` can walk a served tenant plan back to the
+        # capacity event that displaced it.
+        self.decisions = decisions
+        self.last_decision_seq: int | None = None
         # obs.metrics.MetricsRegistry (the serve daemon passes its own):
         # fleet utilization/objective + per-tenant gauges refresh on every
         # schedule(); preemption counters tick in apply_delta().  None
@@ -424,10 +432,16 @@ class FleetScheduler:
 
     # -- fleet operations --------------------------------------------------
 
-    def schedule(self) -> FleetPlan:
+    def schedule(self, decision_cause: str = "",
+                 decision_parent: int | None = None) -> FleetPlan:
         """Carve the CURRENT cluster across all registered tenants and
         return the objective-maximizing fleet plan.  Deterministic: ties
-        between candidates keep the earliest in enumeration order."""
+        between candidates keep the earliest in enumeration order.
+
+        ``decision_cause`` / ``decision_parent`` label the provenance
+        record ("preemption", the triggering ``cluster_delta`` seq, ...)
+        when a :class:`~metis_tpu.obs.provenance.DecisionLog` is
+        attached."""
         order = self.registry.allocation_order()
         cap = self.cluster.total_devices
         if not order:
@@ -468,11 +482,24 @@ class FleetScheduler:
                     tenant=a.tenant).set(a.utility_frac)
             m.gauge("metis_fleet_tenant_devices",
                     tenant=a.tenant).set(a.devices)
+        if self.decisions is not None:
+            dec = self.decisions.record(
+                "fleet_repartition",
+                cause=decision_cause, parent_seq=decision_parent,
+                detail={"objective": round(best.objective, 9),
+                        "utilization_frac": round(
+                            best.utilization_frac, 9),
+                        "shares_label": best.shares_label,
+                        "tenants": len(order),
+                        "cluster_devices": cap})
+            self.last_decision_seq = dec.seq
         self.last_plan = best
         return best
 
     def apply_delta(self, removed: dict[str, int] | None = None,
-                    added: dict[str, int] | None = None
+                    added: dict[str, int] | None = None,
+                    decision_cause: str = "",
+                    decision_parent: int | None = None
                     ) -> tuple[FleetPlan, dict[str, dict]]:
         """Re-partition after capacity change — the robustness core.
 
@@ -504,7 +531,8 @@ class FleetScheduler:
         # commit the new topology only once scheduling on it succeeds
         self.cluster = new_cluster
         try:
-            plan = self.schedule()
+            plan = self.schedule(decision_cause=decision_cause,
+                                 decision_parent=decision_parent)
         except Exception:
             self.cluster = old_cluster
             self.last_plan = old_plan
@@ -538,7 +566,50 @@ class FleetScheduler:
                     "preempted": preempted,
                     "feasible": new_alloc.feasible,
                 }
+                if self.decisions is not None:
+                    # child chain: repartition -> tenant_replan ->
+                    # migration_decision, so a tenant's served plan walks
+                    # back through its displacement to the capacity event
+                    trep = self.decisions.record(
+                        "tenant_replan",
+                        plan_fingerprint=self._alloc_fingerprint(
+                            new_alloc),
+                        parent_seq=self.last_decision_seq,
+                        cause=decision_cause, tenant=t.name,
+                        detail={"devices": new_alloc.devices,
+                                "from_devices": old_alloc.devices,
+                                "preempted": preempted,
+                                "feasible": new_alloc.feasible})
+                    decisions[t.name]["decision_seq"] = trep.seq
+                    if t.workload is None:
+                        self.decisions.record(
+                            "migration_decision",
+                            plan_fingerprint=self._alloc_fingerprint(
+                                new_alloc),
+                            parent_seq=trep.seq, cause=decision_cause,
+                            tenant=t.name,
+                            detail={"path": decision.get("path"),
+                                    "migration_ms":
+                                        decision.get("migration_ms")})
         return plan, decisions
+
+    @staticmethod
+    def _alloc_fingerprint(alloc: TenantAllocation) -> str:
+        """Plan fingerprint of an allocation's best ranked plan, from its
+        serialized dump ("" when infeasible or not parseable)."""
+        if not alloc.plan_json:
+            return ""
+        try:
+            data = json.loads(alloc.plan_json)
+        except (ValueError, TypeError):
+            return ""
+        if isinstance(data, dict):  # dump_inference_plans payload
+            data = data.get("plans") or []
+        if not (isinstance(data, list) and data
+                and isinstance(data[0], dict)):
+            return ""
+        from metis_tpu.obs.provenance import fingerprint_plan_dict
+        return fingerprint_plan_dict(data[0])
 
     def _switch_decision(self, spec: TenantSpec,
                          old_alloc: TenantAllocation,

@@ -6,11 +6,11 @@ unix socket (``unix:/run/metis-plan.sock``).  One connection per request —
 thread-safe by construction, which is what the ≥64-thread concurrency
 contract of ``tools/serve_smoke.py`` leans on.
 
-Every request that causes daemon-side work mints a ``trace_id`` (or
-forwards the caller's) so the daemon can stamp every span, event, and
-background thread the request triggers — the handle
-``metis-tpu report --trace ID`` reconstructs one request's story from.
-The response echoes it back as ``trace_id``.
+Every request mints a ``trace_id`` (or forwards the caller's) — POSTs in
+the JSON body, GETs as a ``trace_id`` query parameter — so the daemon can
+stamp every span, event, and background thread the request triggers: the
+handle ``metis-tpu report --trace ID`` reconstructs one request's story
+from.  The response echoes it back as ``trace_id``.
 """
 from __future__ import annotations
 
@@ -168,8 +168,11 @@ class PlanServiceClient:
     def tenant_remove(self, name: str) -> dict:
         return self._request("POST", "/tenant_remove", {"name": name})
 
-    def tenant_status(self, name: str | None = None) -> dict:
-        path = "/tenant" if name is None else f"/tenant?name={name}"
+    def tenant_status(self, name: str | None = None,
+                      trace_id: str | None = None) -> dict:
+        tid = trace_id or mint_trace_id()
+        path = (f"/tenant?trace_id={tid}" if name is None
+                else f"/tenant?name={name}&trace_id={tid}")
         return self._request("GET", path)
 
     def accuracy_sample(self, fingerprint: str, measured_ms: float,
@@ -188,21 +191,40 @@ class PlanServiceClient:
     def cluster_delta(self, removed: dict[str, int] | None = None,
                       added: dict[str, int] | None = None,
                       replan: bool = False,
-                      trace_id: str | None = None) -> dict:
-        return self._request("POST", "/cluster_delta", {
+                      trace_id: str | None = None,
+                      cause: str | None = None) -> dict:
+        """``cause`` labels the delta's trigger in the decision log
+        ("preemption", "spot_return", "autoscale", ...) so every replan
+        it fans out to chains back to the real-world event."""
+        payload: dict[str, Any] = {
             "removed": removed or {}, "added": added or {},
-            "replan": replan, "trace_id": trace_id or mint_trace_id()})
+            "replan": replan, "trace_id": trace_id or mint_trace_id()}
+        if cause:
+            payload["cause"] = cause
+        return self._request("POST", "/cluster_delta", payload)
 
     def invalidate(self, fingerprint: str | None = None,
                    drop_states: bool = False) -> dict:
         return self._request("POST", "/invalidate", {
             "fingerprint": fingerprint, "drop_states": drop_states})
 
-    def notifications(self, since: int = 0,
-                      timeout_s: float = 0.0) -> list[dict]:
+    def notifications(self, since: int = 0, timeout_s: float = 0.0,
+                      trace_id: str | None = None) -> list[dict]:
+        tid = trace_id or mint_trace_id()
         out = self._request(
-            "GET", f"/notifications?since={since}&timeout={timeout_s}")
+            "GET", f"/notifications?since={since}&timeout={timeout_s}"
+                   f"&trace_id={tid}")
         return out.get("notifications", [])
+
+    def decisions(self, since: int = 0,
+                  trace_id: str | None = None) -> list[dict]:
+        """Decision records with ``seq > since`` from ``GET /decisions``
+        — the durable provenance feed (``obs/provenance.DecisionLog``).
+        Each entry is a ``DecisionRecord.to_json_dict()``."""
+        tid = trace_id or mint_trace_id()
+        out = self._request(
+            "GET", f"/decisions?since={since}&trace_id={tid}")
+        return out.get("decisions", [])
 
     def stats(self) -> dict:
         return self._request("GET", "/stats")

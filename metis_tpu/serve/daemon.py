@@ -51,8 +51,15 @@ from metis_tpu.core.types import dump_ranked_plans
 from metis_tpu.obs.ledger import (
     AccuracyLedger,
     AccuracyMonitor,
+    calibration_fingerprint,
     fingerprint_ranked_plan,
     query_fingerprint,
+)
+from metis_tpu.obs.provenance import (
+    DecisionLog,
+    artifact_digest,
+    planner_decision_fields,
+    profile_store_digest,
 )
 from metis_tpu.inference.planner import (
     dump_inference_plans,
@@ -104,6 +111,9 @@ class _QueryRecord:
     # ClusterDelta invalidates exactly the cache entries whose set
     # intersects the changed nodes (None = unknown, always invalidated)
     node_id_set: frozenset | None = None
+    # seq of the decision-log record that picked the served plan — the
+    # causal parent every cache hit / drift replan for this query cites
+    decision_seq: int | None = None
 
 
 class PlanService:
@@ -123,6 +133,7 @@ class PlanService:
         drift_min_samples: int = 5,
         search_wait_s: float = 300.0,
         metrics: MetricsRegistry | None = None,
+        decisions: DecisionLog | None = None,
     ):
         self.cluster = cluster
         # boot topology: the elastic ceiling scale-up deltas grow back toward
@@ -143,6 +154,20 @@ class PlanService:
                                metrics=self.metrics)
         self.state_capacity = state_capacity
         self.ledger = AccuracyLedger(None)  # in-memory: daemon-lifetime
+        # decisions=None keeps the audit trail in memory (GET /decisions
+        # still answers); pass DecisionLog(path) for a durable log whose
+        # seq numbering survives restarts
+        self.decisions = decisions if decisions is not None \
+            else DecisionLog(None, events=self.events)
+        # content digests stamped onto every decision record: which
+        # calibration and profile store the choice was made against
+        self._digests: dict[str, str] = {}
+        cal_fp = calibration_fingerprint(calibration)
+        if cal_fp:
+            self._digests["calibration"] = cal_fp
+        prof_fp = profile_store_digest(profiles)
+        if prof_fp:
+            self._digests["profiles"] = prof_fp
         # _lock: registry/state-table mutations.  _search_lock: serializes
         # searches (warm evaluators are not reentrant).  _accuracy_lock:
         # ledger + monitors.  Ordering: never take _lock while holding it
@@ -258,6 +283,15 @@ class PlanService:
             if entry is not None:
                 ev.emit("plan_cache_hit", fingerprint=qfp)
                 span.set(cached=True)
+                # one cheap append: the hit's causal parent is the search
+                # decision that filled the entry (bench pins this path's
+                # overhead ≤ 2%, so no confidence/digest work here)
+                self.decisions.record(
+                    "cache_hit",
+                    plan_fingerprint=entry.get("plan_fingerprint") or "",
+                    query_fingerprint=qfp, trace_id=trace_id,
+                    parent_seq=entry.get("decision_seq"),
+                    total_ms=entry.get("best_cost_ms"))
                 return self._respond(entry, cached=True, t_req=t_req,
                                      trace_id=trace_id)
             ev.emit("plan_cache_miss", fingerprint=qfp)
@@ -281,6 +315,14 @@ class PlanService:
                     self.metrics.histogram(
                         "metis_serve_coalesced_wait_ms").observe(
                         (time.perf_counter() - waited_since) * 1000)
+                    self.decisions.record(
+                        "cache_hit",
+                        plan_fingerprint=entry.get("plan_fingerprint")
+                        or "",
+                        query_fingerprint=qfp, trace_id=trace_id,
+                        parent_seq=entry.get("decision_seq"),
+                        total_ms=entry.get("best_cost_ms"),
+                        detail={"coalesced": True})
                     return self._respond(entry, cached=True, t_req=t_req,
                                          trace_id=trace_id)
                 # leader failed or timed out — loop to become the leader
@@ -288,10 +330,11 @@ class PlanService:
                 if workload is not None:
                     entry = self._search_inference(qfp, key, model, config,
                                                    workload, top_k,
-                                                   events=ev)
+                                                   events=ev,
+                                                   trace_id=trace_id)
                 else:
                     entry = self._search(qfp, key, model, config, top_k,
-                                         events=ev)
+                                         events=ev, trace_id=trace_id)
             finally:
                 with self._lock:
                     done = self._inflight.pop(key, None)
@@ -302,7 +345,11 @@ class PlanService:
 
     def _search(self, qfp: str, key: str, model: ModelSpec,
                 config: SearchConfig, top_k: int | None,
-                events: EventLog | None = None) -> dict:
+                events: EventLog | None = None,
+                trace_id: str | None = None,
+                decision_kind: str = "cold_search",
+                parent_seq: int | None = None, cause: str = "",
+                tenant: str | None = None) -> dict:
         ev = events if events is not None else self.events
         queue_depth = self.metrics.gauge("metis_serve_queue_depth")
         queue_depth.inc()
@@ -339,17 +386,46 @@ class PlanService:
             # exact-backend cold search: the optimality certificate rides
             # the /plan response (and the cached entry) verbatim
             entry["certificate"] = result.certificate.to_json_dict()
+        # provenance: one decision record per search — runner-up/margin,
+        # breakdown, certificate (planner_decision_fields), content
+        # digests, and the ledger's per-component residual stats as the
+        # model-confidence context for the margin
+        with self._accuracy_lock:
+            confidence = self.ledger.component_residuals() or None
+        fields = planner_decision_fields(result)
+        fields.pop("plan_fingerprint", None)
+        dec = self.decisions.record(
+            decision_kind, plan_fingerprint=plan_fp or "",
+            query_fingerprint=qfp, trace_id=trace_id,
+            parent_seq=parent_seq, cause=cause, tenant=tenant,
+            confidence=confidence,
+            digests={**self._digests,
+                     "config": artifact_digest(
+                         dataclasses.asdict(config))},
+            detail={"cache_key": key, "num_costed": result.num_costed,
+                    "search_seconds": entry["search_seconds"]},
+            **fields)
+        entry["decision_seq"] = dec.seq
         with self._lock:
             self._queries[key] = _QueryRecord(
                 model=model, config=config, top_k=top_k, key=key,
                 plan_fingerprint=plan_fp,
                 plan_layout=self._best_layout(best),
-                node_id_set=frozenset(self._full_node_ids(self.cluster)))
+                node_id_set=frozenset(self._full_node_ids(self.cluster)),
+                decision_seq=dec.seq)
         if best is not None and plan_fp is not None:
             with self._accuracy_lock:
                 if plan_fp not in self.ledger.predictions:
+                    # component-resolved prediction: the breakdown's
+                    # additive shares feed the per-component residual
+                    # analytics once measurements arrive
                     self.ledger.record_prediction(
-                        plan_fp, best.cost.total_ms, source="serve")
+                        plan_fp, best.cost.total_ms,
+                        components=(best.breakdown.components
+                                    if best.breakdown is not None
+                                    else None),
+                        source="serve",
+                        device_type="+".join(self.cluster.device_types))
         self.cache.put(key, entry)
         return entry
 
@@ -357,7 +433,12 @@ class PlanService:
                           config: SearchConfig,
                           workload: InferenceWorkload,
                           top_k: int | None,
-                          events: EventLog | None = None) -> dict:
+                          events: EventLog | None = None,
+                          trace_id: str | None = None,
+                          decision_kind: str = "cold_search",
+                          parent_seq: int | None = None,
+                          cause: str = "",
+                          tenant: str | None = None) -> dict:
         """Cold inference search.  No warm state — the pool search is
         orders of magnitude smaller than a training search — but it still
         serializes behind ``_search_lock`` so the cluster it reads cannot
@@ -393,11 +474,23 @@ class PlanService:
             "num_pruned": result.num_pruned,
             "search_seconds": round(elapsed, 6),
         }
+        dec = self.decisions.record(
+            decision_kind, plan_fingerprint=plan_fp or "",
+            query_fingerprint=qfp, trace_id=trace_id,
+            parent_seq=parent_seq, cause=cause, tenant=tenant,
+            digests={**self._digests,
+                     "config": artifact_digest(
+                         dataclasses.asdict(config))},
+            detail={"cache_key": key, "workload_kind": "inference",
+                    "num_costed": result.num_costed,
+                    "search_seconds": entry["search_seconds"]})
+        entry["decision_seq"] = dec.seq
         with self._lock:
             self._queries[key] = _QueryRecord(
                 model=model, config=config, top_k=top_k, key=key,
                 plan_fingerprint=plan_fp, workload=workload,
-                node_id_set=frozenset(self._full_node_ids(self.cluster)))
+                node_id_set=frozenset(self._full_node_ids(self.cluster)),
+                decision_seq=dec.seq)
         self.cache.put(key, entry)
         return entry
 
@@ -482,7 +575,8 @@ class PlanService:
             ev = (self.events.with_fields(trace_id=trace_id)
                   if trace_id else self.events)
             threading.Thread(
-                target=self._replan_for, args=(fingerprint, status, ev),
+                target=self._replan_for,
+                args=(fingerprint, status, ev, trace_id),
                 name="metis-serve-replan", daemon=True).start()
         return {
             "fingerprint": fingerprint,
@@ -494,13 +588,20 @@ class PlanService:
         }
 
     def _replan_for(self, plan_fp: str, status,
-                    events: EventLog | None = None) -> list[dict]:
+                    events: EventLog | None = None,
+                    trace_id: str | None = None) -> list[dict]:
         """Drift-alarm fallout: re-search every registered query whose
         best plan is ``plan_fp``, refresh the cache, notify trainers."""
         ev = events if events is not None else self.events
         with self._lock:
             targets = [rec for rec in self._queries.values()
                        if rec.plan_fingerprint == plan_fp]
+        # the drifting plan's own per-component residuals are the
+        # evidence the alarm fired on — they ride the drift decision as
+        # its confidence context
+        with self._accuracy_lock:
+            drift_conf = self.ledger.component_residuals(
+                fingerprint=plan_fp) or None
         notes: list[dict] = []
         for rec in targets:
             self.cache.invalidate(rec.key)
@@ -531,17 +632,37 @@ class PlanService:
                 "num_bound_pruned": report.result.num_bound_pruned,
                 "search_seconds": round(report.result.search_seconds, 6),
             }
+            changed = bool(report.plan_changed) and new_fp != plan_fp
+            fields = planner_decision_fields(report.result)
+            fields.pop("plan_fingerprint", None)
+            dec = self.decisions.record(
+                "drift_replan", plan_fingerprint=new_fp or "",
+                query_fingerprint=qfp, trace_id=trace_id,
+                parent_seq=rec.decision_seq, cause="drift_alarm",
+                confidence=drift_conf,
+                digests=dict(self._digests),
+                detail={"old_fingerprint": plan_fp,
+                        "plan_changed": changed,
+                        "rolling_mape_pct": getattr(
+                            status, "rolling_mape_pct", None)},
+                **fields)
+            entry["decision_seq"] = dec.seq
             self.cache.put(new_key, entry)
             with self._lock:
                 self._queries.pop(rec.key, None)
                 self._queries[new_key] = _QueryRecord(
                     model=rec.model, config=rec.config, top_k=rec.top_k,
-                    key=new_key, plan_fingerprint=new_fp)
+                    key=new_key, plan_fingerprint=new_fp,
+                    decision_seq=dec.seq)
             with self._accuracy_lock:
                 if new_fp not in self.ledger.predictions:
                     self.ledger.record_prediction(
-                        new_fp, best.cost.total_ms, source="serve")
-            changed = bool(report.plan_changed) and new_fp != plan_fp
+                        new_fp, best.cost.total_ms,
+                        components=(best.breakdown.components
+                                    if best.breakdown is not None
+                                    else None),
+                        source="serve",
+                        device_type="+".join(self.cluster.device_types))
             note = self._push_note({
                 "kind": "replan_push",
                 "fingerprint": plan_fp,
@@ -550,11 +671,12 @@ class PlanService:
                 "plan_changed": changed,
                 "new_best_cost_ms": best.cost.total_ms,
                 "reason": "drift_alarm",
+                "decision_seq": dec.seq,
             })
             ev.emit(
                 "replan_push", fingerprint=plan_fp, new_fingerprint=new_fp,
                 reason="drift_alarm", plan_changed=changed,
-                seq=note["seq"])
+                seq=note["seq"], decision_seq=dec.seq)
             notes.append(note)
         return notes
 
@@ -562,7 +684,8 @@ class PlanService:
     def apply_cluster_delta(self, removed: dict[str, int] | None = None,
                             added: dict[str, int] | None = None,
                             replan: bool = False,
-                            trace_id: str | None = None) -> dict:
+                            trace_id: str | None = None,
+                            cause: str | None = None) -> dict:
         """Elastic topology change: lose ``removed`` devices and/or restore
         ``added`` (type -> count, capped by the boot topology).  Swaps in
         the new cluster, drops every cache entry and warm state, notifies
@@ -599,6 +722,18 @@ class PlanService:
             # the incremental-replan keep/drop pivot for warm states and
             # record-tagged cache entries alike
             changed = self._changed_node_ids(self.cluster, new_cluster)
+            # the provenance ROOT of everything this delta causes: the
+            # fleet re-partition, every tenant displacement, and every
+            # background delta-replan chain back to this seq.  The kind
+            # distinguishes an autoscaler's delta from an operator's.
+            root_kind = ("autoscale_delta"
+                         if (cause or "").startswith("autoscale")
+                         else "cluster_delta")
+            root_dec = self.decisions.record(
+                root_kind, trace_id=trace_id, cause=cause or "",
+                detail={"removed": delta.removed, "added": delta.added,
+                        "devices": new_cluster.total_devices,
+                        "changed_nodes": sorted(changed)})
             with self._lock:
                 pre_states = list(self._states.keys())
             # multi-tenant mode: re-partition the fleet FIRST (it raises
@@ -611,7 +746,9 @@ class PlanService:
             if self.sched is not None and len(self.sched.registry):
                 old_fleet = self.sched.last_plan
                 fleet_plan, fleet_decisions = self.sched.apply_delta(
-                    removed=delta.removed, added=delta.added)
+                    removed=delta.removed, added=delta.added,
+                    decision_cause=cause or "",
+                    decision_parent=root_dec.seq)
             # incremental replanning: keep every warm state whose tagged
             # node set misses the changed nodes — its costed candidates
             # stay bit-valid (fingerprint-keyed states can never serve a
@@ -670,6 +807,7 @@ class PlanService:
             "added": delta.added,
             "invalidated": invalidated,
             "devices": new_cluster.total_devices,
+            "decision_seq": root_dec.seq,
         })
         for name in sorted(fleet_decisions):
             d = fleet_decisions[name]
@@ -684,23 +822,33 @@ class PlanService:
                 "devices": d["devices"], "path": d.get("path"),
                 "migration_ms": d.get("migration_ms"),
                 "feasible": d.get("feasible"),
+                "decision_seq": d.get("decision_seq"),
             })
         if replan:
             self.counters.inc("serve.delta_replans")
             threading.Thread(
-                target=self._replan_all, args=("cluster_delta", ev),
+                target=self._replan_all,
+                args=("cluster_delta", ev, trace_id, root_dec.seq,
+                      cause or ""),
                 name="metis-serve-delta-replan", daemon=True).start()
         return {"invalidated": invalidated, "removed": delta.removed,
                 "added": delta.added,
                 "devices": new_cluster.total_devices, "seq": note["seq"],
                 "replanning": replan,
+                "decision_seq": root_dec.seq,
                 "tenants_changed": sorted(fleet_decisions)}
 
     def _replan_all(self, reason: str,
-                    events: EventLog | None = None) -> list[dict]:
+                    events: EventLog | None = None,
+                    trace_id: str | None = None,
+                    parent_seq: int | None = None,
+                    cause: str = "") -> list[dict]:
         """Re-search every registered query against the CURRENT topology
         and push a ``replan_push`` note per query — the cluster-delta
-        counterpart of the drift path's ``_replan_for``."""
+        counterpart of the drift path's ``_replan_for``.  Each re-search
+        records a ``delta_replan`` decision parented on the triggering
+        delta's seq, completing the causal chain from capacity event to
+        pushed plan."""
         ev = events if events is not None else self.events
         with self._lock:
             targets = list(self._queries.values())
@@ -715,10 +863,16 @@ class PlanService:
                 if rec.workload is not None:
                     entry = self._search_inference(
                         qfp, new_key, rec.model, rec.config, rec.workload,
-                        rec.top_k, events=ev)
+                        rec.top_k, events=ev, trace_id=trace_id,
+                        decision_kind="delta_replan",
+                        parent_seq=parent_seq, cause=cause or reason)
                 else:
                     entry = self._search(qfp, new_key, rec.model,
-                                         rec.config, rec.top_k, events=ev)
+                                         rec.config, rec.top_k, events=ev,
+                                         trace_id=trace_id,
+                                         decision_kind="delta_replan",
+                                         parent_seq=parent_seq,
+                                         cause=cause or reason)
             except MetisError:
                 # the shrunken topology may not fit this query at all —
                 # subscribers learn from the absence of a push
@@ -741,6 +895,7 @@ class PlanService:
                 "plan_changed": changed,
                 "new_best_cost_ms": entry.get("best_cost_ms"),
                 "reason": reason,
+                "decision_seq": entry.get("decision_seq"),
             }
             if mig is not None:
                 # one-time cost of resharding the old plan's live state
@@ -752,7 +907,7 @@ class PlanService:
                 "replan_push", fingerprint=rec.plan_fingerprint,
                 new_fingerprint=new_fp, reason=reason,
                 plan_changed=changed, migration_cost_ms=mig,
-                seq=note["seq"])
+                seq=note["seq"], decision_seq=entry.get("decision_seq"))
             notes.append(note)
         return notes
 
@@ -816,7 +971,7 @@ class PlanService:
                 sched = FleetScheduler(
                     self.full_cluster, self.profiles, events=self.events,
                     search_state_provider=self._tenant_search_state,
-                    metrics=self.metrics)
+                    metrics=self.metrics, decisions=self.decisions)
                 sched.cluster = self.cluster  # may already be shrunk
                 self.sched = sched
             return self.sched
@@ -876,7 +1031,7 @@ class PlanService:
             old_plan = sched.last_plan
             sched.admit(spec)
             try:
-                plan = sched.schedule()
+                plan = sched.schedule(decision_cause="tenant_admit")
             except Exception:
                 # admission is atomic: node granularity can defeat a
                 # floor the admit-time pre-check accepted, and a 400
@@ -912,7 +1067,7 @@ class PlanService:
         with self._search_lock:
             old_plan = sched.last_plan
             sched.remove(name)
-            plan = sched.schedule()
+            plan = sched.schedule(decision_cause="tenant_remove")
         changed = self._invalidate_changed_tenants(old_plan, plan)
         gone = {name}
         self.cache.invalidate_where(lambda _k, v: v.get("tenant") in gone)
@@ -956,11 +1111,26 @@ class PlanService:
         entry = self.cache.get(key)
         if entry is not None:
             ev.emit("plan_cache_hit", fingerprint=qfp)
+            self.decisions.record(
+                "cache_hit",
+                plan_fingerprint=entry.get("plan_fingerprint") or "",
+                query_fingerprint=qfp, trace_id=trace_id,
+                parent_seq=entry.get("decision_seq"), tenant=name)
             return self._respond(entry, cached=True, t_req=t_req,
                                  trace_id=trace_id)
         ev.emit("plan_cache_miss", fingerprint=qfp)
+        # the plan being served was chosen by the fleet scheduler, not by
+        # this request: point the cache entry at the tenant's latest
+        # provenance record (its tenant_replan after a delta, or the
+        # admitting fleet_repartition) so tenant cache hits chain into
+        # the same causal tree
+        tdec = self.decisions.find(tenant=name)
+        plan_fp = ""
+        if alloc is not None:
+            plan_fp = FleetScheduler._alloc_fingerprint(alloc)
         entry = {
             "fingerprint": qfp,
+            "plan_fingerprint": plan_fp or None,
             "tenant": name,
             "kind": spec.kind,
             "devices": alloc.devices if alloc else 0,
@@ -969,6 +1139,8 @@ class PlanService:
             "plans": alloc.plan_json if alloc else None,
             "utility": round(alloc.utility, 9) if alloc else 0.0,
             "utility_frac": round(alloc.utility_frac, 9) if alloc else 0.0,
+            "decision_seq": (tdec.seq if tdec is not None
+                             else sched.last_decision_seq),
         }
         self.cache.put(key, entry)
         return self._respond(entry, cached=False, t_req=t_req,
@@ -1036,6 +1208,9 @@ class PlanService:
         with self._note_cond:
             self._closed = True
             self._note_cond.notify_all()
+        # flush + release the durable decision-log handle; a restarted
+        # daemon re-opens it and resumes the seq where this one stopped
+        self.decisions.close()
 
     # -- introspection ------------------------------------------------------
     def healthz(self) -> dict:
@@ -1099,6 +1274,8 @@ class PlanService:
             "monitors": len(self._monitors),
             "queries": len(self._queries),
             "note_seq": self._note_seq,
+            "decisions": len(self.decisions),
+            "decision_seq": self.decisions.last_seq,
             "tenants": len(self.sched.registry) if self.sched else 0,
         }
 
@@ -1114,7 +1291,7 @@ class PlanService:
 _KNOWN_ENDPOINTS = {
     "/plan", "/tenant", "/tenant_remove", "/accuracy_sample",
     "/cluster_delta", "/invalidate", "/shutdown",
-    "/stats", "/healthz", "/metrics", "/notifications",
+    "/stats", "/healthz", "/metrics", "/notifications", "/decisions",
 }
 
 
@@ -1196,8 +1373,19 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
         self._instrumented(self._do_post)
 
+    def _get_event(self, endpoint: str, trace_id: str | None) -> None:
+        """One ``get_request`` event per monitoring GET, stamped with the
+        caller's trace_id (query parameter) when given — the read-side
+        counterpart of the POST paths' event trail, so a trace shows what
+        the operator *looked at*, not only what the daemon did."""
+        ev = (self.service.events.with_fields(trace_id=trace_id)
+              if trace_id else self.service.events)
+        ev.emit("get_request", endpoint=endpoint)
+
     def _do_get(self) -> None:
         parsed = urlparse(self.path)
+        q = parse_qs(parsed.query)
+        trace_id = q.get("trace_id", [None])[0]
         if parsed.path == "/stats":
             self._json(200, self.service.stats())
         elif parsed.path == "/healthz":
@@ -1206,15 +1394,22 @@ class _Handler(BaseHTTPRequestHandler):
         elif parsed.path == "/metrics":
             self._text(200, self.service.render_metrics())
         elif parsed.path == "/notifications":
-            q = parse_qs(parsed.query)
             since = int(q.get("since", ["0"])[0])
             timeout_s = float(q.get("timeout", ["0"])[0])
+            self._get_event(parsed.path, trace_id)
             notes = self.service.notifications(since=since,
                                                timeout_s=timeout_s)
             self._json(200, {"notifications": notes})
+        elif parsed.path == "/decisions":
+            since = int(q.get("since", ["0"])[0])
+            self._get_event(parsed.path, trace_id)
+            recs = self.service.decisions.records(since=since)
+            self._json(200, {
+                "decisions": [r.to_json_dict() for r in recs],
+                "last_seq": self.service.decisions.last_seq})
         elif parsed.path == "/tenant":
-            q = parse_qs(parsed.query)
             name = q.get("name", [None])[0]
+            self._get_event(parsed.path, trace_id)
             try:
                 self._json(200, self.service.tenant_status(name=name))
             except MetisError as e:
@@ -1260,11 +1455,13 @@ class _Handler(BaseHTTPRequestHandler):
                     trace_id=trace_id)
                 self._json(200, out)
             elif self.path == "/cluster_delta":
+                cause = body.get("cause")
                 out = self.service.apply_cluster_delta(
                     removed=body.get("removed"),
                     added=body.get("added"),
                     replan=bool(body.get("replan", False)),
-                    trace_id=trace_id)
+                    trace_id=trace_id,
+                    cause=str(cause) if cause is not None else None)
                 self._json(200, out)
             elif self.path == "/invalidate":
                 out = self.service.invalidate(

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Validate a decision-log JSONL file (``obs/provenance.DecisionLog``).
+
+The decision log is the audit trail `metis-tpu why` walks, so its
+integrity contract is stricter than the event log's:
+
+- every line parses as a JSON object with integer ``seq``, numeric
+  ``ts``, and a ``kind`` from the documented decision vocabulary;
+- ``seq`` is strictly increasing down the file (the append-only
+  guarantee restarts must preserve);
+- every ``parent_seq`` resolves to an EARLIER record in the log (a
+  dangling parent means a causal chain that cannot be reconstructed);
+- when a record carries a cost ``breakdown``, its additive components
+  sum to the breakdown's ``total_ms`` within float tolerance (the
+  attribution invariant ``metis-tpu diff`` relies on).
+
+Usage:  python tools/check_decisions_schema.py decisions.jsonl [...]
+
+Also importable: ``validate_decisions(list_of_dicts) -> list[str]`` —
+the tier-1 test (tests/test_provenance.py) runs it over a freshly
+written log so contract drift breaks the build, not the audit trail.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+try:
+    from metis_tpu.obs.provenance import DECISION_KINDS
+except ImportError:  # standalone use without the package on sys.path
+    DECISION_KINDS = (
+        "cold_search", "cache_hit", "drift_replan", "cluster_delta",
+        "autoscale_delta", "delta_replan", "fleet_repartition",
+        "tenant_replan", "migration_decision")
+
+# |sum(components) - total_ms| tolerance: breakdowns round-trip through
+# JSON with per-component rounding, so exact equality is too strict
+SUM_TOL_MS = 1e-3
+
+
+def validate_decisions(records: list[dict]) -> list[str]:
+    """Problems (empty = valid) for already-parsed decision dicts,
+    oldest first."""
+    problems: list[str] = []
+    seen_seqs: set[int] = set()
+    last_seq: int | None = None
+    for i, rec in enumerate(records, 1):
+        where = f"record {i}"
+        if not isinstance(rec, dict):
+            problems.append(f"{where}: not a JSON object")
+            continue
+        seq = rec.get("seq")
+        if not isinstance(seq, int):
+            problems.append(f"{where}: missing/non-integer 'seq'")
+            continue
+        where = f"record {i} (seq {seq})"
+        if not isinstance(rec.get("ts"), (int, float)):
+            problems.append(f"{where}: missing/non-numeric 'ts'")
+        kind = rec.get("kind")
+        if not isinstance(kind, str):
+            problems.append(f"{where}: missing/non-string 'kind'")
+        elif kind not in DECISION_KINDS:
+            problems.append(f"{where}: unknown decision kind {kind!r}")
+        if last_seq is not None and seq <= last_seq:
+            problems.append(
+                f"{where}: seq not strictly increasing "
+                f"(previous was {last_seq})")
+        parent = rec.get("parent_seq")
+        if parent is not None:
+            if not isinstance(parent, int):
+                problems.append(
+                    f"{where}: non-integer parent_seq {parent!r}")
+            elif parent not in seen_seqs:
+                problems.append(
+                    f"{where}: parent_seq {parent} does not resolve to "
+                    "an earlier record")
+        bd = rec.get("breakdown")
+        if bd is not None:
+            if not isinstance(bd, dict):
+                problems.append(f"{where}: breakdown is not an object")
+            else:
+                comps = bd.get("components")
+                total = bd.get("total_ms")
+                if not isinstance(comps, dict) \
+                        or not isinstance(total, (int, float)):
+                    problems.append(
+                        f"{where}: breakdown needs 'components' object "
+                        "and numeric 'total_ms'")
+                else:
+                    s = sum(float(v) for v in comps.values())
+                    if abs(s - float(total)) > SUM_TOL_MS:
+                        problems.append(
+                            f"{where}: breakdown components sum to "
+                            f"{s:.6f} ms but total_ms is {total:.6f} "
+                            "(additivity violated)")
+        seen_seqs.add(seq)
+        last_seq = seq
+    return problems
+
+
+def validate_file(path: str | Path) -> tuple[int, list[str]]:
+    """(num_records, problems) for one decision JSONL file; unparseable
+    lines are problems, not crashes."""
+    records: list[dict] = []
+    problems: list[str] = []
+    try:
+        lines = Path(path).read_text().splitlines()
+    except OSError as e:
+        return 0, [f"cannot read {path}: {e}"]
+    for lineno, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            problems.append(f"line {lineno}: invalid JSON ({e.msg})")
+    problems.extend(validate_decisions(records))
+    return len(records), problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("files", nargs="+", help="decision JSONL file(s)")
+    parser.add_argument("--max-problems", type=int, default=20,
+                        help="report at most N problems per file")
+    args = parser.parse_args(argv)
+    rc = 0
+    for path in args.files:
+        n, problems = validate_file(path)
+        if problems:
+            rc = 1
+            print(f"{path}: {n} records, {len(problems)} problem(s)")
+            for p in problems[:args.max_problems]:
+                print(f"  {p}")
+            if len(problems) > args.max_problems:
+                print(f"  ... {len(problems) - args.max_problems} more")
+        else:
+            print(f"{path}: {n} records, schema OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -137,6 +137,13 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # record of every fresh file after a roll — where the predecessor
     # went and how large it was when it rolled
     "event_log_rotated": {"rotated_to", "size_bytes"},
+    # plan provenance (obs/provenance.py): one decision_record per
+    # DecisionLog append — the seq joins the event stream to the durable
+    # decision log (`metis-tpu why` walks the latter; traces show the
+    # former); one get_request per monitoring GET the daemon serves
+    # (serve/daemon.py), stamped with the caller's trace_id when given
+    "decision_record": {"seq", "kind"},
+    "get_request": {"endpoint"},
 }
 
 # Events the serve daemon emits once per client request.  When a client
@@ -146,7 +153,13 @@ EVENT_SCHEMA: dict[str, set[str]] = {
 # means a code path lost the binding (exactly the regression the
 # end-to-end tracing contract exists to catch).
 REQUEST_SCOPED_EVENTS = {"plan_request", "plan_cache_hit",
-                         "plan_cache_miss", "replan_push"}
+                         "plan_cache_miss", "replan_push", "get_request"}
+
+# decision_record events are request-scoped only for the decision kinds
+# that happen INSIDE a client request (a cold search or a cache hit);
+# fleet re-partitions and background replans legitimately outlive or
+# precede any single request, so their stamps are best-effort.
+REQUEST_SCOPED_DECISION_KINDS = {"cold_search", "cache_hit"}
 
 
 def validate_events(events: list[dict]) -> list[str]:
@@ -181,25 +194,45 @@ def validate_events(events: list[dict]) -> list[str]:
             problems.append(
                 f"{where} ({name}): request-scoped event missing trace_id "
                 "in a traced log")
+        elif traced and name == "decision_record" \
+                and ev.get("kind") in REQUEST_SCOPED_DECISION_KINDS \
+                and not ev.get("trace_id"):
+            problems.append(
+                f"{where} (decision_record/{ev.get('kind')}): "
+                "request-scoped decision missing trace_id in a traced log")
     return problems
 
 
-def validate_file(path: str | Path) -> tuple[int, list[str]]:
+def validate_file(path: str | Path,
+                  include_rotated: bool = True) -> tuple[int, list[str]]:
     """(num_events, problems) for one JSONL file; unparseable lines are
-    problems, not crashes."""
+    problems, not crashes.
+
+    When size-based rotation (``EventLog(max_bytes=...)``) has rolled the
+    log, the predecessor sits next to it as ``<path>.1`` — its events are
+    prepended (oldest first) so cross-event checks like trace
+    completeness span the roll instead of judging half a run.  Pass
+    ``include_rotated=False`` to validate exactly one file."""
     events: list[dict] = []
     problems: list[str] = []
-    try:
-        lines = Path(path).read_text().splitlines()
-    except OSError as e:
-        return 0, [f"cannot read {path}: {e}"]
-    for lineno, line in enumerate(lines, 1):
-        if not line.strip():
-            continue
+    sources: list[tuple[str, Path]] = []
+    roll = Path(str(path) + ".1")
+    if include_rotated and roll.exists():
+        sources.append((f"{roll}:", roll))
+    sources.append(("line ", Path(path)))
+    for prefix, src in sources:
         try:
-            events.append(json.loads(line))
-        except json.JSONDecodeError as e:
-            problems.append(f"line {lineno}: invalid JSON ({e.msg})")
+            lines = src.read_text().splitlines()
+        except OSError as e:
+            return 0, [f"cannot read {src}: {e}"]
+        for lineno, line in enumerate(lines, 1):
+            if not line.strip():
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                problems.append(
+                    f"{prefix}{lineno}: invalid JSON ({e.msg})")
     problems.extend(validate_events(events))
     return len(events), problems
 

@@ -88,7 +88,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 from metis_tpu.cluster.spec import ClusterSpec, NodeSpec  # noqa: E402
 from metis_tpu.cluster.tpu import slice_from_name  # noqa: E402
 from metis_tpu.core.config import ModelSpec, SearchConfig  # noqa: E402
-from metis_tpu.core.events import EventLog, read_events  # noqa: E402
+from metis_tpu.core.events import (  # noqa: E402
+    EventLog,
+    read_events,
+    read_events_rotated,
+)
 from tools.check_events_schema import validate_events  # noqa: E402
 
 RESERVED_TYPE = "tpu_v6e"
@@ -202,21 +206,28 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
                     return_rate_per_hr: float = 0.35,
                     spot_recover_s: float = 30.0, seed: int = 0,
                     migrate: bool = True,
+                    events_max_bytes: int | None = None,
                     verbose: bool = False) -> dict:
     """Seeded Poisson preemption chaos against a live daemon.  Returns the
     fleet report dict; raises AssertionError when a recovery guarantee is
     violated.  ``migrate=False`` restores the checkpoint-restore-only
-    accounting (every delta charged ``spot_recover_s``)."""
+    accounting (every delta charged ``spot_recover_s``).
+    ``events_max_bytes`` rotates the event log mid-drill (the rotation
+    regression: the schema/causality checks must still pass over the
+    ``<name>.1`` roll)."""
     from metis_tpu.cost.volume import TransformerVolume
     from metis_tpu.execution.reshard import (layout_moved_bytes,
                                              price_migration_ms)
+    from metis_tpu.obs.provenance import DecisionLog
     from metis_tpu.profiles.synthetic import synthesize_profiles
     from metis_tpu.serve.client import PlanServiceClient
     from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from tools.check_decisions_schema import validate_file as validate_dlog
 
     tmp_dir = Path(tmp_dir)
     tmp_dir.mkdir(parents=True, exist_ok=True)
     events_path = tmp_dir / "fleet_events.jsonl"
+    decisions_path = tmp_dir / "fleet_decisions.jsonl"
     model = fleet_model()
     cluster = fleet_cluster(devices, chips_per_node, spot_rate_per_hr)
     config = fleet_search_config(spot_recover_s)
@@ -242,8 +253,10 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
     fault_pending = migrate
 
     trajectory: list[dict] = []
-    with EventLog(events_path) as events:
-        service = PlanService(cluster, profiles, events=events)
+    with EventLog(events_path, max_bytes=events_max_bytes) as events:
+        service = PlanService(cluster, profiles, events=events,
+                              decisions=DecisionLog(decisions_path,
+                                                    events=events))
         server, thread, address = serve_in_thread(service)
         try:
             client = PlanServiceClient(address)
@@ -274,7 +287,8 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
                     lost = {SPOT_TYPE: lost_nodes * chips_per_node}
                     events.emit("preemption", step=tick, tier="spot",
                                 lost=f"{SPOT_TYPE}={lost[SPOT_TYPE]}")
-                    client.cluster_delta(removed=lost, replan=True)
+                    client.cluster_delta(removed=lost, replan=True,
+                                         cause="preemption")
                     live_spot -= lost_nodes
                     n_deltas += 1
                     preemptions += lost_nodes
@@ -282,7 +296,8 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
                     back = {SPOT_TYPE: returned_nodes * chips_per_node}
                     events.emit("spot_return", step=tick,
                                 returned=f"{SPOT_TYPE}={back[SPOT_TYPE]}")
-                    client.cluster_delta(added=back, replan=True)
+                    client.cluster_delta(added=back, replan=True,
+                                         cause="spot_return")
                     live_spot += returned_nodes
                     n_deltas += 1
                     returns += returned_nodes
@@ -361,12 +376,14 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
             # drain the background replan notifications: one replan_push
             # per registered query per delta
             pushes, seen = 0, 0
+            push_notes: list[dict] = []
             for _ in range(120 if n_deltas else 0):
                 more = client.notifications(since=seen, timeout_s=1.0)
                 if more:
                     seen = max(n["seq"] for n in more)
-                    pushes += sum(1 for n in more
-                                  if n.get("kind") == "replan_push")
+                    push_notes += [n for n in more
+                                   if n.get("kind") == "replan_push"]
+                    pushes = len(push_notes)
                 if pushes >= n_deltas:
                     break
             final = client.plan(model, config, top_k=3)
@@ -394,8 +411,39 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
     assert _state_digest(state) == state_digest0, \
         "state diverged across the drill's migrations"
 
+    # -- provenance: every replan push causally chains to its eviction ----
+    # Reopen the decision log FROM DISK (the daemon is down) — the audit
+    # trail must be reconstructable from the JSONL alone, and it must
+    # pass the decision-schema invariants (seq monotonic, parents
+    # resolve, breakdown components additive).
+    n_recs, dlog_problems = validate_dlog(decisions_path)
+    assert not dlog_problems, \
+        "decision log problems:\n  " + "\n  ".join(dlog_problems)
+    audit = DecisionLog(decisions_path)
+    assert len(audit) == n_recs > 0, "decision log did not persist"
+    chains_verified = 0
+    for note in push_notes:
+        dseq = note.get("decision_seq")
+        assert dseq is not None, f"replan_push without decision_seq: {note}"
+        chain = audit.chain(dseq)
+        assert chain, f"decision_seq {dseq} not in the log"
+        root, leaf = chain[0], chain[-1]
+        assert leaf.seq == dseq and leaf.kind == "delta_replan", \
+            f"push decision {dseq} is a {leaf.kind}, not a delta_replan"
+        assert root.kind == "cluster_delta" \
+            and root.cause in ("preemption", "spot_return"), \
+            f"push decision {dseq} roots at {root.kind}/{root.cause!r}, " \
+            "not the eviction/return cluster_delta"
+        chains_verified += 1
+    assert chains_verified == pushes
+
     # -- schema-valid, causally ordered event stream ----------------------
-    evs = read_events(events_path)
+    evs = read_events_rotated(events_path)
+    if events_max_bytes is not None:
+        roll = events_path.with_name(events_path.name + ".1")
+        assert roll.exists(), \
+            f"events_max_bytes={events_max_bytes} never rotated the log " \
+            f"({events_path.stat().st_size} bytes written)"
     problems = validate_events(evs)
     assert not problems, "event schema problems:\n  " + "\n  ".join(problems)
     tick_of = {}   # tick -> index of its fleet_tick event
@@ -442,6 +490,8 @@ def run_fleet_drill(tmp_dir: str | Path, *, devices: int = 256,
         "migrations": migrations,
         "migration_fallbacks": fallbacks,
         "migration_stall_ms_total": round(migration_stall_ms_total, 3),
+        "decision_records": n_recs,
+        "provenance_chains_verified": chains_verified,
         "baseline_cost_ms": c0,
         "baseline_expected_recovery_ms": base_recovery_ms,
         "fleet_goodput_frac": sum(goodputs) / len(goodputs),
@@ -473,15 +523,18 @@ def run_tenant_drill(tmp_dir: str | Path, *, tenants: int = 3,
     scheduler.  Returns the tenant report dict; raises AssertionError
     when a quota or recovery guarantee is violated."""
     from metis_tpu.inference.workload import InferenceWorkload
+    from metis_tpu.obs.provenance import DecisionLog
     from metis_tpu.profiles.synthetic import synthesize_profiles
     from metis_tpu.sched import TenantSpec
     from metis_tpu.serve.client import PlanServiceClient
     from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from tools.check_decisions_schema import validate_file as validate_dlog
 
     assert tenants >= 3, "the multi-tenant drill needs >= 3 tenants"
     tmp_dir = Path(tmp_dir)
     tmp_dir.mkdir(parents=True, exist_ok=True)
     events_path = tmp_dir / "tenant_events.jsonl"
+    decisions_path = tmp_dir / "tenant_decisions.jsonl"
     cluster = fleet_cluster(devices, chips_per_node, spot_rate_per_hr)
     n_reserved = sum(1 for n in cluster.nodes
                      if n.device_type == RESERVED_TYPE)
@@ -544,7 +597,9 @@ def run_tenant_drill(tmp_dir: str | Path, *, tenants: int = 3,
     attained = {s.name: 0 for s in specs}
     utils: list[float] = []
     with EventLog(events_path) as events:
-        service = PlanService(cluster, profiles, events=events)
+        service = PlanService(cluster, profiles, events=events,
+                              decisions=DecisionLog(decisions_path,
+                                                    events=events))
         server, thread, address = serve_in_thread(service)
         try:
             client = PlanServiceClient(address)
@@ -581,7 +636,7 @@ def run_tenant_drill(tmp_dir: str | Path, *, tenants: int = 3,
                     lost = {SPOT_TYPE: lost_nodes * chips_per_node}
                     events.emit("preemption", step=tick, tier="spot",
                                 lost=f"{SPOT_TYPE}={lost[SPOT_TYPE]}")
-                    client.cluster_delta(removed=lost)
+                    client.cluster_delta(removed=lost, cause="preemption")
                     live_spot -= lost_nodes
                     n_deltas += 1
                     preemptions += lost_nodes
@@ -589,7 +644,7 @@ def run_tenant_drill(tmp_dir: str | Path, *, tenants: int = 3,
                     back = {SPOT_TYPE: returned_nodes * chips_per_node}
                     events.emit("spot_return", step=tick,
                                 returned=f"{SPOT_TYPE}={back[SPOT_TYPE]}")
-                    client.cluster_delta(added=back)
+                    client.cluster_delta(added=back, cause="spot_return")
                     live_spot += returned_nodes
                     n_deltas += 1
                     returns += returned_nodes
@@ -670,6 +725,39 @@ def run_tenant_drill(tmp_dir: str | Path, *, tenants: int = 3,
         assert e["to_devices"] >= floors[e["tenant"]], \
             f"tenant_preempt drove {e['tenant']} below its quota floor"
 
+    # -- provenance: `metis-tpu why --tenant` reconstructs the chain ------
+    # Drive the REAL CLI over the on-disk decision log (the daemon is
+    # down): for every tenant that a spot eviction preempted, the causal
+    # chain from its served plan must walk back to the eviction/return
+    # cluster_delta that triggered it.
+    n_recs, dlog_problems = validate_dlog(decisions_path)
+    assert not dlog_problems, \
+        "decision log problems:\n  " + "\n  ".join(dlog_problems)
+    from metis_tpu.planner.cli import main as cli_main
+
+    preempted = sorted({e["tenant"] for e in evs
+                        if e["event"] == "tenant_preempt"})
+    why_depths: dict[str, int] = {}
+    for name in preempted:
+        out_path = tmp_dir / f"why_{name}.json"
+        rc = cli_main(["why", "--tenant", name,
+                       "--decisions", str(decisions_path),
+                       "--json", "--output", str(out_path)])
+        assert rc == 0, f"metis-tpu why --tenant {name} failed (rc {rc})"
+        why = json.loads(out_path.read_text())
+        hops = [h["record"] for h in why["hops"]]
+        assert why["depth"] >= 2 and hops, \
+            f"why --tenant {name}: no causal chain ({why['depth']} hops)"
+        root, leaf = hops[0], hops[-1]
+        assert leaf.get("tenant") == name, \
+            f"why --tenant {name} resolved a record for " \
+            f"{leaf.get('tenant')!r}"
+        assert root["kind"] == "cluster_delta" \
+            and root.get("cause") in ("preemption", "spot_return"), \
+            f"why --tenant {name} roots at {root['kind']}/" \
+            f"{root.get('cause')!r}, not the eviction/return delta"
+        why_depths[name] = why["depth"]
+
     slo = {name: attained[name] / (ticks + 1) for name in attained}
     report = {
         "tenants": [s.name for s in specs],
@@ -682,6 +770,8 @@ def run_tenant_drill(tmp_dir: str | Path, *, tenants: int = 3,
         "returned_nodes": returns,
         "cluster_deltas": n_deltas,
         "tenant_preempt_events": n_preempt_events,
+        "decision_records": n_recs,
+        "why_chain_depths": why_depths,
         "fleet_utilization_frac": sum(utils) / len(utils),
         "min_utilization_frac": min(utils),
         "tenant_slo_attainment": slo,
