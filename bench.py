@@ -31,6 +31,9 @@ probe transcript / capture cache documented at ``probe_tpu``/``tpu_capture``.
 ``resilience`` carries the fault-tolerance numbers: digest-verified
 checkpoint save/restore latency and the supervisor's measured
 time-to-recover from an injected device loss (``tools/chaos_drill.py``).
+``ha`` carries the serve control plane's durability numbers: kill -9 →
+``--state-dir`` warm reboot time and tenant plans lost across a standby
+promotion (``tools/ha_drill.py``; both asserted zero-loss in-drill).
 
 Telemetry is INCREMENTAL (``SectionRecorder``): every section appends its
 own record to ``bench_sections.jsonl`` (and stderr) the moment it
@@ -1832,6 +1835,55 @@ def migration_bench(record: dict, timeout_s: float = 600.0) -> None:
     }
 
 
+def ha_bench(record: dict, timeout_s: float = 600.0) -> None:
+    """Durable control plane: both HA drills (tools/ha_drill.py) in a
+    CPU-pinned subprocess — kill -9 of a serving daemon followed by a
+    --state-dir reboot (``ha_restore_s`` headline: in-daemon snapshot load
+    + oplog replay, budget 1 s, cache + certificates byte-identical), and
+    a primary kill with a replicating standby promoting itself
+    (``ha_failover_lost_plans`` headline: tenant plans lost across the
+    failover, asserted zero by the drill itself)."""
+    code = (
+        "import json; "
+        "from tools.ha_drill import run_failover_drill, run_restore_drill; "
+        "restore = run_restore_drill(); "
+        "failover = run_failover_drill(tenants=2); "
+        "print('HA_JSON ' + json.dumps({'restore': restore, "
+        "'failover': failover}))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=Path(__file__).resolve().parent,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        record["ha"] = {
+            "skipped_reason": f"ha drill exceeded the {timeout_s:.0f}s "
+                              f"section budget"}
+        return
+    marker = [ln for ln in proc.stdout.splitlines()
+              if ln.startswith("HA_JSON ")]
+    if proc.returncode != 0 or not marker:
+        tail = (proc.stderr.strip().splitlines()[-1][:160]
+                if proc.stderr.strip() else f"rc={proc.returncode}")
+        record["ha"] = {"error": f"rc={proc.returncode}: {tail}"}
+        return
+    drills = json.loads(marker[-1].split(" ", 1)[1])
+    restore, failover = drills["restore"], drills["failover"]
+    record["ha"] = {
+        "ha_restore_s": restore.get("restore_s"),
+        "restore_reboot_wall_s": restore.get("reboot_wall_s"),
+        "ha_failover_lost_plans": failover.get("lost_plans"),
+        "failover_promote_s": failover.get("promote_s"),
+        "failover_first_answer_s": failover.get("failover_first_answer_s"),
+        "failover_tenants": failover.get("tenants"),
+        # the drills' own contracts held end to end (byte-identical cache
+        # + certificate after kill -9; zero tenant plans lost across the
+        # standby promotion)
+        "drills_ok": bool(restore.get("ok") and failover.get("ok")),
+    }
+
+
 def tpu_validation(record: dict) -> None:
     """North-star error on REAL hardware: profile per-layer times on the TPU
     chip, plan a single-chip uniform schedule from those profiles, execute
@@ -2223,6 +2275,16 @@ def main() -> None:
 
     recorder.run("migration", _migration_section, record)
 
+    # both HA drills boot real daemon subprocesses; clamp to the remaining
+    # deadline like the migration drill
+    def _ha_section(rec: dict) -> None:
+        remaining = recorder.remaining_s()
+        timeout = (600.0 if remaining is None
+                   else max(min(600.0, remaining), 60.0))
+        ha_bench(rec, timeout_s=timeout)
+
+    recorder.run("ha", _ha_section, record)
+
     # TPU sections run in a TIMEOUT-GUARDED SUBPROCESS: the probe only
     # proves the tunnel was alive at bench start — it wedged MID-RUN once
     # (r4) and the inline tpu_step hung the whole bench past the driver's
@@ -2363,6 +2425,10 @@ def _headline(record: dict) -> dict:
         .get("migration_vs_ckpt_speedup"),
         "migration_skipped": (record.get("migration") or {})
         .get("skipped_reason"),
+        "ha_restore_s": (record.get("ha") or {}).get("ha_restore_s"),
+        "ha_failover_lost_plans": (record.get("ha") or {})
+        .get("ha_failover_lost_plans"),
+        "ha_skipped": (record.get("ha") or {}).get("skipped_reason"),
         "scale256_exact_prune_parity": s256.get(
             "exact_prune_parity_top20_64dev"),
         "optimality_gap_frac": (record.get("exact_search") or {})

@@ -120,3 +120,19 @@ class MigrationError(MetisError):
 class TrainingAnomalyError(MetisError):
     """A loss anomaly (NaN/inf or spike) with no checkpoint to roll back
     to, or with rollback disabled — training cannot safely continue."""
+
+
+class SnapshotCorruptError(MetisError):
+    """A serve-daemon state snapshot failed integrity verification — a
+    truncated or garbage JSON file, or a sha256 digest mismatch against
+    the digest recorded at write.  The restore path raises this (never a
+    raw deserialization traceback) so boot can fall back to the retained
+    ``.prev`` generation (``serve/persist.py``)."""
+
+
+class StandbyReadOnlyError(MetisError):
+    """A state-mutating request reached a standby daemon.  A standby
+    replicates the primary's oplog and answers read-only queries; writes
+    must go to the primary (or wait for promotion).  The HTTP layer maps
+    this to 503 with ``"standby": true`` so a failover-aware client can
+    advance to the next address (``serve/standby.py``)."""

@@ -367,6 +367,17 @@ METRIC_CATALOG: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "gauge", "seconds since the daemon booted", ()),
     "metis_serve_tenants": (
         "gauge", "registered tenants", ()),
+    "metis_snapshot_age_seconds": (
+        "gauge", "seconds since the last durable state snapshot was "
+                 "written (staleness = the periodic snapshotter is "
+                 "failing)", ()),
+    "metis_snapshot_size_bytes": (
+        "gauge", "size of the last written state snapshot", ()),
+    "metis_oplog_appends_total": (
+        "counter", "state-mutation ops appended to the oplog", ()),
+    "metis_standby_oplog_lag": (
+        "gauge", "ops the standby still trails the primary by "
+                 "(0 once caught up or promoted)", ()),
     "metis_search_duration_seconds": (
         "histogram", "end-to-end search time per cold plan query",
         ("kind",)),

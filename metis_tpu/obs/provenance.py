@@ -193,6 +193,16 @@ class DecisionLog:
         with self._lock:
             return self._last_seq
 
+    def resume_seq(self, seq: int) -> None:
+        """Fast-forward the sequence cursor to at least ``seq`` (never
+        backwards).  A durable log resumes from its own file on open;
+        this covers the in-memory case, where a restored daemon snapshot
+        remembers the seq the previous process reached — ``GET
+        /decisions?since=N`` subscribers rely on seq numbers never being
+        reissued across a restart."""
+        with self._lock:
+            self._last_seq = max(self._last_seq, int(seq))
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._records)

@@ -655,7 +655,27 @@ def main(argv: list[str] | None = None) -> int:
                        help="append the decision log (plan provenance: "
                             "obs/provenance.DecisionLog) here; reopening "
                             "resumes the seq so restarts never reset the "
-                            "audit trail. Default: in-memory only")
+                            "audit trail. Default: in-memory only "
+                            "(with --state-dir, defaults to "
+                            "STATE_DIR/decisions.jsonl)")
+    p_srv.add_argument("--state-dir", default=None, metavar="DIR",
+                       help="durable control plane (serve/persist.py): "
+                            "atomic digest-verified state snapshots plus "
+                            "an append-only oplog in DIR; a restarted "
+                            "daemon restores its plan cache, tenants and "
+                            "cursors from them (restart ≈ warm). "
+                            "Default: memory-only")
+    p_srv.add_argument("--snapshot-interval", type=float, default=30.0,
+                       metavar="S",
+                       help="seconds between periodic state snapshots "
+                            "when --state-dir is set (mutating endpoints "
+                            "also snapshot synchronously; 0 disables the "
+                            "periodic loop)")
+    p_srv.add_argument("--standby-of", default=None, metavar="ADDR",
+                       help="boot as a read-only standby replicating "
+                            "ADDR's oplog (serve/standby.py): serves "
+                            "reads, answers mutations 503, and promotes "
+                            "itself to primary when ADDR stops answering")
 
     p_top = sub.add_parser(
         "top", help="live terminal dashboard over a running daemon's "
@@ -860,6 +880,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     """Boot the plan daemon and serve until interrupted (or POST
     /shutdown).  Prints the bound address as one JSON line so wrappers
     can parse it even with --port 0."""
+    from pathlib import Path
+
     from metis_tpu.obs.provenance import DecisionLog
     from metis_tpu.serve.daemon import PlanService, make_server, run_server
 
@@ -867,21 +889,44 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     profiles = ProfileStore.from_dir(args.profile_dir)
     events = (EventLog(args.events, max_bytes=args.events_max_bytes)
               if args.events else NULL_LOG)
-    decisions = (DecisionLog(args.decisions, events=events)
-                 if args.decisions else None)
+    decisions_path = args.decisions
+    if decisions_path is None and args.state_dir:
+        # the decision log is part of the durable control plane: default
+        # it into the state dir so seq numbering survives restarts too
+        decisions_path = str(Path(args.state_dir) / "decisions.jsonl")
+    decisions = (DecisionLog(decisions_path, events=events)
+                 if decisions_path else None)
     service = PlanService(
         cluster, profiles, cache_capacity=args.cache_size,
         state_capacity=args.state_cache_size, events=events,
-        drift_band_pct=args.drift_band, decisions=decisions)
+        drift_band_pct=args.drift_band, decisions=decisions,
+        state_dir=args.state_dir,
+        snapshot_interval=args.snapshot_interval,
+        read_only=args.standby_of is not None)
+    tailer = None
+    if args.standby_of is not None:
+        from metis_tpu.serve.standby import StandbyTailer
+
+        tailer = StandbyTailer(service, args.standby_of)
+        tailer.start()
     server = make_server(service, host=args.host, port=args.port,
                          socket_path=args.socket)
-    print(json.dumps({
+    boot = {
         "serving": server.address,
         "devices": cluster.total_devices,
         "device_types": list(cluster.device_types),
         "cache_capacity": args.cache_size,
-    }), flush=True)
+    }
+    if args.state_dir:
+        boot["state_dir"] = args.state_dir
+        boot["restore_s"] = service.restore_s
+        boot["restored_seq"] = service.stats()["note_seq"]
+    if args.standby_of is not None:
+        boot["standby_of"] = args.standby_of
+    print(json.dumps(boot), flush=True)
     run_server(server)
+    if tailer is not None:
+        tailer.stop()
     service.close()
     events.close()
     return 0

@@ -593,6 +593,105 @@ class FleetScheduler:
                                         decision.get("migration_ms")})
         return plan, decisions
 
+    # -- durable state ------------------------------------------------------
+
+    def export_state(self) -> dict:
+        """JSON-serializable fleet state for the serve daemon's snapshot/
+        oplog (``serve/persist.py``): registered tenant specs, baselines,
+        the memoized per-carve search outcomes, and the last fleet plan.
+
+        ``plan_json`` strings are carried VERBATIM (never parsed and
+        re-dumped) — byte-identity of a tenant's served plan across a
+        restore is the HA drill's closing assertion.  The memo's live
+        ``best`` objects are not serializable and restore as None; the
+        one degradation is that :meth:`_switch_decision` prices a
+        displaced tenant's first post-restore move as "ckpt" instead of
+        comparing layouts (documented in README "Persistence & HA")."""
+        import dataclasses as _dc
+
+        def _alloc(a: TenantAllocation) -> dict:
+            return {
+                "tenant": a.tenant, "kind": a.kind,
+                "priority": a.priority,
+                "node_indices": list(a.node_indices),
+                "devices": a.devices,
+                "reserved_devices": a.reserved_devices,
+                "spot_devices": a.spot_devices,
+                "feasible": a.feasible,
+                "utility": a.utility,
+                "utility_frac": a.utility_frac,
+                "plan_json": a.plan_json,
+            }
+
+        plan = self.last_plan
+        return {
+            "tenants": [_dc.asdict(t) for t in
+                        self.registry.allocation_order()],
+            "baseline": dict(self._baseline),
+            "memo": [
+                [[name, [list(shape) for shape in shapes]],
+                 {"feasible": p.feasible, "utility": p.utility,
+                  "plan_json": p.plan_json}]
+                for (name, shapes), p in self._memo.items()],
+            "last_plan": None if plan is None else {
+                "cluster_devices": plan.cluster_devices,
+                "shares_label": plan.shares_label,
+                "objective": plan.objective,
+                "utilization_frac": plan.utilization_frac,
+                "allocations": [_alloc(a) for a in plan.allocations],
+            },
+            "last_decision_seq": self.last_decision_seq,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Rebuild fleet state from :meth:`export_state` output without
+        re-running a single search — restore must be fast (the HA drill
+        budgets 1 s for the whole daemon), and re-searching could not
+        reproduce the served plans byte-identically anyway if profiles
+        changed underneath.  Per-tenant profile-store overrides are not
+        persisted (the daemon's ``tenant_register`` never passes one);
+        every restored tenant plans against the shared store."""
+        from metis_tpu.sched.tenant import tenant_from_dict
+
+        self.registry = TenantRegistry()
+        self._stores = {}
+        for td in state.get("tenants", []):
+            spec = tenant_from_dict(td)
+            self.registry.register(spec)
+            self._stores[spec.name] = self.profiles
+        self._baseline = {name: float(v) for name, v in
+                          state.get("baseline", {}).items()}
+        self._memo = {
+            (key[0], tuple((shape[0], int(shape[1]))
+                           for shape in key[1])):
+                _Planned(feasible=bool(p["feasible"]),
+                         utility=float(p["utility"]),
+                         plan_json=p.get("plan_json"), best=None)
+            for key, p in state.get("memo", [])}
+        lp = state.get("last_plan")
+        if lp is None:
+            self.last_plan = None
+        else:
+            self.last_plan = FleetPlan(
+                cluster_devices=int(lp["cluster_devices"]),
+                shares_label=lp["shares_label"],
+                objective=float(lp["objective"]),
+                utilization_frac=float(lp["utilization_frac"]),
+                allocations=tuple(
+                    TenantAllocation(
+                        tenant=a["tenant"], kind=a["kind"],
+                        priority=int(a["priority"]),
+                        node_indices=tuple(a["node_indices"]),
+                        devices=int(a["devices"]),
+                        reserved_devices=int(a["reserved_devices"]),
+                        spot_devices=int(a["spot_devices"]),
+                        feasible=bool(a["feasible"]),
+                        utility=float(a["utility"]),
+                        utility_frac=float(a["utility_frac"]),
+                        plan_json=a.get("plan_json"))
+                    for a in lp["allocations"]))
+        self.last_decision_seq = state.get("last_decision_seq")
+
     @staticmethod
     def _alloc_fingerprint(alloc: TenantAllocation) -> str:
         """Plan fingerprint of an allocation's best ranked plan, from its

@@ -46,6 +46,13 @@ class PlanCache:
         self._occupancy = self.metrics.gauge("metis_serve_cache_entries")
         self._lock = threading.Lock()
         self._entries: OrderedDict[str, dict] = OrderedDict()
+        # optional callable(key) fired (outside the lock) once per entry
+        # dropped by invalidate/invalidate_where/invalidate_all — how the
+        # daemon's oplog records every invalidation uniformly, whichever
+        # path (drift alarm, cluster delta, operator) caused it.  LRU
+        # *evictions* do not fire it: eviction is capacity management,
+        # not a state decision, and replaying one would be wrong.
+        self.on_invalidate = None
 
     def _inc(self, name: str) -> None:
         if self.counters is not None:
@@ -83,6 +90,8 @@ class PlanCache:
             self._occupancy.set(len(self._entries))
         if existed:
             self._inc("invalidate")
+            if self.on_invalidate is not None:
+                self.on_invalidate(key)
         return existed
 
     def invalidate_where(self, predicate) -> list[str]:
@@ -94,19 +103,23 @@ class PlanCache:
             for k in doomed:
                 del self._entries[k]
             self._occupancy.set(len(self._entries))
-        for _ in doomed:
+        for k in doomed:
             self._inc("invalidate")
+            if self.on_invalidate is not None:
+                self.on_invalidate(k)
         return doomed
 
     def invalidate_all(self) -> int:
         """Drop everything (cluster topology changed); returns the count."""
         with self._lock:
-            n = len(self._entries)
+            doomed = list(self._entries)
             self._entries.clear()
             self._occupancy.set(0)
-        for _ in range(n):
+        for k in doomed:
             self._inc("invalidate")
-        return n
+            if self.on_invalidate is not None:
+                self.on_invalidate(k)
+        return len(doomed)
 
     def __len__(self) -> int:
         with self._lock:
@@ -120,6 +133,14 @@ class PlanCache:
         """Snapshot of keys, LRU-first (eviction order)."""
         with self._lock:
             return list(self._entries)
+
+    def items(self) -> list[list]:
+        """``[key, payload]`` pairs LRU-first, with NO side effects — no
+        recency refresh, no hit/miss accounting.  The snapshot capture
+        path uses this: re-``put``-ing the pairs in this order into an
+        empty cache reproduces both contents and eviction order."""
+        with self._lock:
+            return [[k, v] for k, v in self._entries.items()]
 
     def stats(self) -> dict[str, Any]:
         counters = self.counters.as_dict() if self.counters else {}

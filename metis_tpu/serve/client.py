@@ -11,6 +11,14 @@ the JSON body, GETs as a ``trace_id`` query parameter — so the daemon can
 stamp every span, event, and background thread the request triggers: the
 handle ``metis-tpu report --trace ID`` reconstructs one request's story
 from.  The response echoes it back as ``trace_id``.
+
+Failover: the constructor accepts a LIST of addresses (primary first,
+standbys after).  Because every endpoint is idempotent — plan answers are
+deterministic + cached, and ``/cluster_delta`` carries a client-minted
+``delta_id`` the daemon deduplicates — a request that finds its address
+dead (or answering the standby 503) simply moves to the next address in
+the list and retries; the address that answers becomes the preferred one
+for subsequent requests.
 """
 from __future__ import annotations
 
@@ -20,7 +28,7 @@ import json
 import socket
 import time
 import uuid
-from typing import Any
+from typing import Any, Sequence
 
 from metis_tpu.core.config import ModelSpec, SearchConfig
 from metis_tpu.core.errors import MetisError
@@ -28,6 +36,11 @@ from metis_tpu.core.errors import MetisError
 
 class ServeClientError(MetisError):
     """Daemon unreachable, or it answered with an error status."""
+
+
+class _StandbyAnswer(Exception):
+    """Internal: the address answered 503 + ``"standby": true`` — not an
+    error, a redirect-to-the-next-address signal for the failover loop."""
 
 
 def mint_trace_id() -> str:
@@ -48,47 +61,98 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
         self.sock = sock
 
 
+def _parse_address(address: str) -> tuple:
+    """``("unix", path)`` or ``("tcp", host, port)``; raises the same
+    typed error for malformed addresses the single-address client did."""
+    if address.startswith("unix:"):
+        return ("unix", address[len("unix:"):])
+    hostport = address
+    if hostport.startswith("http://"):
+        hostport = hostport[len("http://"):]
+    hostport = hostport.rstrip("/")
+    host, _, port = hostport.rpartition(":")
+    if not host or not port.isdigit():
+        raise ServeClientError(
+            f"bad daemon address {address!r} — expected "
+            "http://HOST:PORT or unix:/path/to.sock")
+    return ("tcp", host, int(port))
+
+
 class PlanServiceClient:
-    """Client for one daemon address; every method is one round-trip."""
+    """Client for one daemon address — or an address LIST (primary first,
+    standbys after) for transparent failover; every method is one
+    round-trip against the currently-preferred address."""
 
-    def __init__(self, address: str, timeout: float = 300.0):
-        self.address = address
+    def __init__(self, address: str | Sequence[str], timeout: float = 300.0):
+        addresses = ([address] if isinstance(address, str)
+                     else [str(a) for a in address])
+        if not addresses:
+            raise ServeClientError("need at least one daemon address")
+        self.addresses = list(addresses)
+        # back-compat: .address stays the constructor's (first) address;
+        # .active_address is the one currently answering
+        self.address = self.addresses[0]
         self.timeout = timeout
-        if address.startswith("unix:"):
-            self._unix_path: str | None = address[len("unix:"):]
-            self._host, self._port = "localhost", 0
-        else:
-            self._unix_path = None
-            hostport = address
-            if hostport.startswith("http://"):
-                hostport = hostport[len("http://"):]
-            hostport = hostport.rstrip("/")
-            host, _, port = hostport.rpartition(":")
-            if not host or not port.isdigit():
-                raise ServeClientError(
-                    f"bad daemon address {address!r} — expected "
-                    "http://HOST:PORT or unix:/path/to.sock")
-            self._host, self._port = host, int(port)
+        self._endpoints = [_parse_address(a) for a in self.addresses]
+        self._active = 0
 
-    def _connection(self, timeout: float | None = None
+    @property
+    def active_address(self) -> str:
+        """The address the last successful request used (the failover
+        loop's current preference)."""
+        return self.addresses[self._active]
+
+    def _connection(self, endpoint: tuple,
+                    timeout: float | None = None
                     ) -> http.client.HTTPConnection:
         t = timeout if timeout is not None else self.timeout
-        if self._unix_path is not None:
-            return _UnixHTTPConnection(self._unix_path, t)
-        return http.client.HTTPConnection(self._host, self._port,
+        if endpoint[0] == "unix":
+            return _UnixHTTPConnection(endpoint[1], t)
+        return http.client.HTTPConnection(endpoint[1], endpoint[2],
                                           timeout=t)
 
     def _request(self, method: str, path: str,
-                 payload: dict | None = None, _retries: int = 3,
+                 payload: dict | None = None,
                  timeout: float | None = None, raw: bool = False,
                  error_ok: bool = False) -> Any:
-        """One round-trip.  ``timeout`` overrides the client default for
-        this call (the monitoring GETs want seconds, not the 300 s plan
-        budget).  ``raw=True`` returns the decoded body text instead of
-        parsed JSON (/metrics is text exposition, not JSON).
-        ``error_ok=True`` returns error-status bodies instead of raising
-        (/healthz answers 503 by design when not ready)."""
-        conn = self._connection(timeout=timeout)
+        """One logical round-trip with failover: each configured address
+        is tried in order starting from the active one; an unreachable
+        address or a standby's read-only 503 advances to the next.  The
+        retry across addresses is safe for the same reason the in-address
+        connect retry is — every endpoint is idempotent."""
+        last_err: ServeClientError | None = None
+        n = len(self._endpoints)
+        for attempt in range(n):
+            ix = (self._active + attempt) % n
+            try:
+                out = self._request_one(ix, method, path, payload,
+                                        timeout=timeout, raw=raw,
+                                        error_ok=error_ok)
+            except _StandbyAnswer:
+                last_err = ServeClientError(
+                    f"plan daemon at {self.addresses[ix]} is a read-only "
+                    "standby")
+                continue
+            except ServeClientError as e:
+                last_err = e
+                continue
+            self._active = ix
+            return out
+        assert last_err is not None
+        raise last_err
+
+    def _request_one(self, ix: int, method: str, path: str,
+                     payload: dict | None = None, _retries: int = 3,
+                     timeout: float | None = None, raw: bool = False,
+                     error_ok: bool = False) -> Any:
+        """One round-trip against one address.  ``timeout`` overrides the
+        client default for this call (the monitoring GETs want seconds,
+        not the 300 s plan budget).  ``raw=True`` returns the decoded body
+        text instead of parsed JSON (/metrics is text exposition, not
+        JSON).  ``error_ok=True`` returns error-status bodies instead of
+        raising (/healthz answers 503 by design when not ready)."""
+        address = self.addresses[ix]
+        conn = self._connection(self._endpoints[ix], timeout=timeout)
         try:
             body = json.dumps(payload).encode() if payload is not None \
                 else None
@@ -105,16 +169,16 @@ class PlanServiceClient:
                 if _retries > 0:
                     conn.close()
                     time.sleep(0.05)
-                    return self._request(method, path, payload,
-                                         _retries=_retries - 1,
-                                         timeout=timeout, raw=raw,
-                                         error_ok=error_ok)
+                    return self._request_one(ix, method, path, payload,
+                                             _retries=_retries - 1,
+                                             timeout=timeout, raw=raw,
+                                             error_ok=error_ok)
                 raise ServeClientError(
-                    f"plan daemon at {self.address} unreachable: {e}") \
+                    f"plan daemon at {address} unreachable: {e}") \
                     from e
             except (OSError, http.client.HTTPException) as e:
                 raise ServeClientError(
-                    f"plan daemon at {self.address} unreachable: {e}") \
+                    f"plan daemon at {address} unreachable: {e}") \
                     from e
             if raw:
                 if status >= 400 and not error_ok:
@@ -127,6 +191,11 @@ class PlanServiceClient:
                 raise ServeClientError(
                     f"daemon sent invalid JSON ({e.msg})") from e
             if status >= 400 and not error_ok:
+                if status == 503 and isinstance(out, dict) \
+                        and out.get("standby"):
+                    # a mutation hit a standby: not this request's fault —
+                    # signal the failover loop to try the next address
+                    raise _StandbyAnswer()
                 detail = out.get("error") if isinstance(out, dict) else None
                 raise ServeClientError(
                     f"daemon error {status}: {detail or data!r}")
@@ -192,13 +261,20 @@ class PlanServiceClient:
                       added: dict[str, int] | None = None,
                       replan: bool = False,
                       trace_id: str | None = None,
-                      cause: str | None = None) -> dict:
+                      cause: str | None = None,
+                      delta_id: str | None = None) -> dict:
         """``cause`` labels the delta's trigger in the decision log
         ("preemption", "spot_return", "autoscale", ...) so every replan
-        it fans out to chains back to the real-world event."""
+        it fans out to chains back to the real-world event.
+
+        Deltas are RELATIVE, so this is the one endpoint a blind retry
+        could corrupt: a ``delta_id`` (minted here when not supplied) is
+        sent with the request and the daemon answers a duplicate id from
+        its dedup window instead of applying the delta twice."""
         payload: dict[str, Any] = {
             "removed": removed or {}, "added": added or {},
-            "replan": replan, "trace_id": trace_id or mint_trace_id()}
+            "replan": replan, "trace_id": trace_id or mint_trace_id(),
+            "delta_id": delta_id or mint_trace_id()}
         if cause:
             payload["cause"] = cause
         return self._request("POST", "/cluster_delta", payload)
@@ -210,11 +286,32 @@ class PlanServiceClient:
 
     def notifications(self, since: int = 0, timeout_s: float = 0.0,
                       trace_id: str | None = None) -> list[dict]:
+        return self.notifications_window(
+            since=since, timeout_s=timeout_s,
+            trace_id=trace_id).get("notifications", [])
+
+    def notifications_window(self, since: int = 0, timeout_s: float = 0.0,
+                             trace_id: str | None = None) -> dict:
+        """The full ``/notifications`` document: ``notifications`` plus
+        the gap-detection metadata — ``truncated`` means notes past
+        ``since`` already fell off the daemon's bounded backlog and the
+        caller must resync (re-query, or replay ``oplog(since=...)``)
+        instead of trusting the list to be complete."""
         tid = trace_id or mint_trace_id()
-        out = self._request(
+        return self._request(
             "GET", f"/notifications?since={since}&timeout={timeout_s}"
                    f"&trace_id={tid}")
-        return out.get("notifications", [])
+
+    def oplog(self, since: int = 0, trace_id: str | None = None) -> dict:
+        """State-mutation oplog entries with ``seq > since`` from
+        ``GET /oplog`` — the replication feed a standby tails.  The
+        document carries ``entries``, ``last_seq``, ``oldest_seq`` and
+        ``truncated`` (True when the requested range predates what the
+        daemon still holds, so the tailer must bootstrap from a snapshot
+        instead)."""
+        tid = trace_id or mint_trace_id()
+        return self._request(
+            "GET", f"/oplog?since={since}&trace_id={tid}")
 
     def decisions(self, since: int = 0,
                   trace_id: str | None = None) -> list[dict]:

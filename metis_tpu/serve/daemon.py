@@ -36,6 +36,7 @@ import os
 import socket
 import threading
 import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
@@ -44,7 +45,11 @@ from urllib.parse import parse_qs, urlparse
 
 from metis_tpu.cluster.spec import ClusterSpec
 from metis_tpu.core.config import ModelSpec, SearchConfig
-from metis_tpu.core.errors import MetisError, TenantSpecError
+from metis_tpu.core.errors import (
+    MetisError,
+    StandbyReadOnlyError,
+    TenantSpecError,
+)
 from metis_tpu.core.events import EventLog, NULL_LOG
 from metis_tpu.core.trace import Counters, Tracer
 from metis_tpu.core.types import dump_ranked_plans
@@ -78,6 +83,7 @@ from metis_tpu.planner.replan import (
 from metis_tpu.profiles.store import ProfileStore
 from metis_tpu.sched.fleet import FleetPlan, FleetScheduler
 from metis_tpu.sched.tenant import TenantSpec, tenant_from_dict
+from metis_tpu.serve import persist
 from metis_tpu.serve.cache import PlanCache
 
 
@@ -118,7 +124,29 @@ class _QueryRecord:
 
 class PlanService:
     """Transport-agnostic daemon core; the HTTP layer is a thin shim so
-    tests and the smoke tool can drive this in-process."""
+    tests and the smoke tool can drive this in-process.
+
+    ``state_dir`` turns on the durable control plane (``serve/persist``):
+    a digest-verified snapshot of the daemon's logical state plus an
+    append-only oplog of every mutation.  Boot restores the snapshot and
+    replays the oplog tail, so a restarted daemon serves the identical
+    plan cache (dumps, certificates, decision-seq continuity) its
+    predecessor held — ``restore_s`` records how long that took.
+    ``read_only=True`` makes this instance a standby: it applies
+    replicated oplog entries (``serve/standby.py``) and answers read
+    queries, but rejects every state-mutating request with
+    :class:`StandbyReadOnlyError` (HTTP 503 + ``"standby": true``) until
+    promoted."""
+
+    # notification window: how many notes /notifications retains.  Ops
+    # beyond the window stay in the oplog; the window's truncation
+    # metadata (``oldest_seq``/``truncated``) tells a slow poller to
+    # resync from ``GET /oplog`` instead of silently missing pushes.
+    NOTES_WINDOW = 256
+    # bounded in-memory op tail for /oplog when no state_dir is set
+    OP_TAIL_WINDOW = 4096
+    # how many applied delta ids the idempotency table remembers
+    DELTA_DEDUP_WINDOW = 256
 
     def __init__(
         self,
@@ -134,6 +162,9 @@ class PlanService:
         search_wait_s: float = 300.0,
         metrics: MetricsRegistry | None = None,
         decisions: DecisionLog | None = None,
+        state_dir: str | Path | None = None,
+        snapshot_interval: float = 30.0,
+        read_only: bool = False,
     ):
         self.cluster = cluster
         # boot topology: the elastic ceiling scale-up deltas grow back toward
@@ -182,6 +213,10 @@ class PlanService:
         self._monitors: dict[str, AccuracyMonitor] = {}
         self._handled_alarms: dict[str, int] = {}
         self._notes: list[dict] = []
+        # highest note seq ever dropped from the window — the truncation
+        # watermark /notifications reports so a poller that fell behind
+        # can detect the gap instead of silently missing pushes
+        self._notes_dropped_high = 0
         self._note_seq = 0
         self._note_cond = threading.Condition()
         self._closed = False
@@ -189,6 +224,36 @@ class PlanService:
         # None = classic single-job daemon, behavior byte-identical to
         # before sched/ existed
         self.sched: FleetScheduler | None = None
+        # -- durable control plane (serve/persist) --------------------------
+        self.read_only = read_only
+        self.snapshot_interval = float(snapshot_interval)
+        # client-minted delta-id -> response: makes POST /cluster_delta
+        # idempotent under the client's connection-error retries (a
+        # replayed shrink must not double-apply)
+        self._applied_deltas: OrderedDict[str, dict] = OrderedDict()
+        # recent ops for GET /oplog when no durable oplog is configured
+        self._op_tail: deque[dict] = deque(maxlen=self.OP_TAIL_WINDOW)
+        # True while restore/standby replay applies entries: suppresses
+        # fresh op logging for mutations that ARE replayed ops
+        self._replaying = False
+        self.restore_s: float | None = None
+        self._snapshot_store: persist.SnapshotStore | None = None
+        self._oplog: persist.Oplog | None = None
+        self._last_snapshot_seq = 0
+        self._snap_lock = threading.Lock()
+        self._snap_stop = threading.Event()
+        self._snap_thread: threading.Thread | None = None
+        self.cache.on_invalidate = self._on_cache_invalidate
+        if state_dir is not None:
+            self._snapshot_store = persist.SnapshotStore(state_dir)
+            self._oplog = persist.Oplog(
+                Path(state_dir) / persist.OPLOG_FILE)
+            self._boot_restore()
+            if not read_only and self.snapshot_interval > 0:
+                self._snap_thread = threading.Thread(
+                    target=self._snapshot_loop,
+                    name="metis-serve-snapshot", daemon=True)
+                self._snap_thread.start()
         self._t_start = time.monotonic()
 
     # -- cache keys ---------------------------------------------------------
@@ -226,6 +291,154 @@ class PlanService:
                  zip(self._full_node_ids(new_cluster), new_cluster.nodes)}
         return frozenset(fid for fid in old_w.keys() | new_w.keys()
                          if old_w.get(fid) != new_w.get(fid))
+
+    # -- durable control plane ----------------------------------------------
+    def _boot_restore(self) -> None:
+        """Load the latest verified snapshot, then replay the oplog tail
+        past its cursor — restart ≈ warm.  A corrupt primary snapshot
+        falls back to ``.prev`` inside :class:`persist.SnapshotStore`;
+        both generations corrupt raises (never serve partial state).
+        ``restore_s`` is measured here, around exactly the state work —
+        process relaunch cost (interpreter + jax imports) is the host's
+        problem, not the control plane's."""
+        t0 = time.perf_counter()
+        doc = self._snapshot_store.load()
+        entries = 0
+        self._replaying = True
+        try:
+            if doc is not None:
+                persist.restore_state(self, doc["payload"])
+            for entry in self._oplog.entries(since=self._note_seq):
+                persist.apply_entry(self, entry)
+                entries += 1
+        finally:
+            self._replaying = False
+        self._last_snapshot_seq = (
+            int(doc["payload"].get("op_seq", 0)) if doc is not None else 0)
+        self.restore_s = round(time.perf_counter() - t0, 6)
+        if doc is not None or entries:
+            self.events.emit(
+                "snapshot_restore", seq=self._note_seq, entries=entries,
+                source=(doc.get("source") if doc is not None else "oplog"))
+
+    def snapshot_now(self) -> dict | None:
+        """Capture + atomically persist the full logical state; returns
+        the written snapshot's meta (None when persistence is off or
+        this is a standby).  Called by the periodic loop, synchronously
+        after tenant/cluster mutations (keeping replay tails short), and
+        once more on :meth:`close`."""
+        if self._snapshot_store is None or self.read_only:
+            return None
+        with self._snap_lock:
+            payload = persist.capture_state(self)
+            meta = self._snapshot_store.write(payload)
+            self._last_snapshot_seq = payload["op_seq"]
+        self.events.emit(
+            "snapshot_write", seq=payload["op_seq"],
+            entries=len(payload["cache"]), bytes=meta["bytes"])
+        self.counters.inc("serve.snapshots")
+        return meta
+
+    def _snapshot_loop(self) -> None:
+        while not self._snap_stop.wait(self.snapshot_interval):
+            with self._note_cond:
+                dirty = self._note_seq != self._last_snapshot_seq
+            if not dirty:
+                continue
+            try:
+                self.snapshot_now()
+            except Exception:
+                # a failed periodic snapshot must never kill the daemon;
+                # the age gauge going stale is the operator's signal
+                self.counters.inc("serve.snapshot_errors")
+
+    def _check_writable(self, what: str) -> None:
+        if self.read_only:
+            raise StandbyReadOnlyError(
+                f"standby daemon is read-only: {what} must go to the "
+                "primary (or wait for promotion)")
+
+    def _append_op(self, op: str, note: dict | None = None,
+                   **data) -> tuple[dict, dict | None]:
+        """Append one state-mutation op to the unified sequence — THE
+        mutation record.  Op seqs are dense (every mutation takes exactly
+        one); notes are the subset of ops that carry a notification, so
+        note seqs are sparse within the op namespace.  The entry lands in
+        the in-memory tail (for ``GET /oplog``) and, when a state_dir is
+        configured, in the durable oplog before this returns."""
+        with self._note_cond:
+            self._note_seq += 1
+            seq = self._note_seq
+            ts = time.time()
+            entry = {"seq": seq, "ts": ts, "op": op, **data}
+            if note is not None:
+                note = {"seq": seq, "ts": ts, **note}
+                entry["note"] = note
+                self._notes.append(note)
+                if len(self._notes) > self.NOTES_WINDOW:
+                    dropped = self._notes[:-self.NOTES_WINDOW]
+                    self._notes_dropped_high = max(
+                        self._notes_dropped_high, dropped[-1]["seq"])
+                    del self._notes[:-self.NOTES_WINDOW]
+            self._op_tail.append(entry)
+            self._note_cond.notify_all()
+        if self._oplog is not None:
+            self._oplog.append(entry)
+        self.metrics.counter("metis_oplog_appends_total").inc()
+        self.events.emit("oplog_append", seq=seq, op=op)
+        return entry, note
+
+    def _on_cache_invalidate(self, key: str) -> None:
+        """PlanCache invalidation hook: one ``plan_invalidate`` op per
+        dropped entry, whichever path (drift alarm, cluster delta,
+        operator ``/invalidate``) dropped it — suppressed while restore/
+        standby replay is itself applying logged ops."""
+        if self._replaying:
+            return
+        self._append_op("plan_invalidate", key=key)
+
+    def _log_plan_insert(self, key: str, entry: dict) -> None:
+        """One ``plan_insert`` op per cache fill, carrying the full
+        response payload (plans, certificate, decision_seq) plus the
+        serialized query record — everything a standby or a restore
+        replay needs to reproduce the entry byte-identically."""
+        if self._replaying:
+            return
+        with self._lock:
+            rec = self._queries.get(key)
+        self._append_op(
+            "plan_insert", key=key, entry=entry,
+            query=persist.query_record_to_dict(rec)
+            if rec is not None else None)
+
+    def _cluster_state_dict(self) -> dict:
+        """Current topology as an absolute delta from the boot topology —
+        what cluster-affecting ops carry so replay is idempotent."""
+        delta = ClusterDelta.between(self.full_cluster, self.cluster)
+        return {"removed": delta.removed, "added": delta.added}
+
+    def oplog_window(self, since: int = 0) -> dict:
+        """Ops with ``seq > since`` for ``GET /oplog`` — from the durable
+        oplog when one is configured, else the bounded in-memory tail.
+        Op seqs are dense, so ``truncated`` is exact: the reader has a
+        gap iff ops between its cursor and the oldest held seq are gone
+        (resync path: re-bootstrap from the snapshot)."""
+        if self._oplog is not None:
+            entries = self._oplog.entries(since=since)
+            oldest = self._oplog.first_seq
+        else:
+            with self._note_cond:
+                held = list(self._op_tail)
+            entries = [e for e in held if e["seq"] > since]
+            oldest = held[0]["seq"] if held else None
+        with self._note_cond:
+            last = self._note_seq
+        return {
+            "entries": entries,
+            "last_seq": last,
+            "oldest_seq": oldest,
+            "truncated": oldest is not None and since < oldest - 1,
+        }
 
     # -- warm search state --------------------------------------------------
     def _state_for(self, qfp: str, model: ModelSpec, config: SearchConfig):
@@ -296,6 +509,9 @@ class PlanService:
                                      trace_id=trace_id)
             ev.emit("plan_cache_miss", fingerprint=qfp)
             span.set(cached=False)
+            # a standby serves replicated cache hits but never searches —
+            # its state must stay a pure function of the primary's oplog
+            self._check_writable("plan search (cache miss)")
             # single-flight: identical concurrent misses wait for the
             # leader's search to land in the cache instead of repeating it
             waited_since = None
@@ -427,6 +643,7 @@ class PlanService:
                         source="serve",
                         device_type="+".join(self.cluster.device_types))
         self.cache.put(key, entry)
+        self._log_plan_insert(key, entry)
         return entry
 
     def _search_inference(self, qfp: str, key: str, model: ModelSpec,
@@ -492,6 +709,7 @@ class PlanService:
                 node_id_set=frozenset(self._full_node_ids(self.cluster)),
                 decision_seq=dec.seq)
         self.cache.put(key, entry)
+        self._log_plan_insert(key, entry)
         return entry
 
     @staticmethod
@@ -546,6 +764,7 @@ class PlanService:
         """Feed one measured step for a served plan; on a drift alarm a
         background thread replans every query whose cached best is that
         plan and pushes ``replan_push`` notifications."""
+        self._check_writable("accuracy sample")
         self.counters.inc("serve.accuracy_samples")
         with self._accuracy_lock:
             if (predicted_ms is not None
@@ -654,6 +873,7 @@ class PlanService:
                     model=rec.model, config=rec.config, top_k=rec.top_k,
                     key=new_key, plan_fingerprint=new_fp,
                     decision_seq=dec.seq)
+            self._log_plan_insert(new_key, entry)
             with self._accuracy_lock:
                 if new_fp not in self.ledger.predictions:
                     self.ledger.record_prediction(
@@ -685,7 +905,8 @@ class PlanService:
                             added: dict[str, int] | None = None,
                             replan: bool = False,
                             trace_id: str | None = None,
-                            cause: str | None = None) -> dict:
+                            cause: str | None = None,
+                            delta_id: str | None = None) -> dict:
         """Elastic topology change: lose ``removed`` devices and/or restore
         ``added`` (type -> count, capped by the boot topology).  Swaps in
         the new cluster, drops every cache entry and warm state, notifies
@@ -694,7 +915,23 @@ class PlanService:
         pushing one ``replan_push`` note per refreshed plan (the elastic
         scale path the traffic-replay driver exercises).  A no-op delta
         (nothing changed, e.g. a remove cancelled by an add in the same
-        call) keeps the cache and warm states and pushes nothing."""
+        call) keeps the cache and warm states and pushes nothing.
+
+        ``delta_id`` makes the call idempotent end-to-end: deltas are
+        RELATIVE (applying the same shrink twice removes twice the
+        devices), so a client retry after a lost response would corrupt
+        the topology.  A client-minted id is checked against a bounded
+        window of applied ids and a duplicate returns the original
+        response (flagged ``deduplicated``) without touching anything."""
+        self._check_writable("cluster delta")
+        if delta_id is not None:
+            with self._lock:
+                hit = self._applied_deltas.get(delta_id)
+            if hit is not None:
+                self.counters.inc("serve.delta_dedup")
+                resp = dict(hit)
+                resp["deduplicated"] = True
+                return resp
         removed = {str(t): int(n) for t, n in (removed or {}).items()}
         added = {str(t): int(n) for t, n in (added or {}).items()}
         ev = (self.events.with_fields(trace_id=trace_id)
@@ -801,14 +1038,24 @@ class PlanService:
                 states_kept=kept, states_dropped=dropped,
                 reused=reused, recosted=recosted,
                 invalidated=invalidated)
-        note = self._push_note({
-            "kind": "cluster_delta",
-            "removed": delta.removed,
-            "added": delta.added,
-            "invalidated": invalidated,
-            "devices": new_cluster.total_devices,
-            "decision_seq": root_dec.seq,
-        })
+        # the oplog op carries the ABSOLUTE post-delta topology (delta
+        # from the boot topology) and the full post-partition fleet, so a
+        # replica replaying it lands on this exact state no matter how
+        # many times the entry is applied
+        _op, note = self._append_op(
+            "cluster_delta",
+            note={
+                "kind": "cluster_delta",
+                "removed": delta.removed,
+                "added": delta.added,
+                "invalidated": invalidated,
+                "devices": new_cluster.total_devices,
+                "decision_seq": root_dec.seq,
+            },
+            cluster=self._cluster_state_dict(),
+            delta_id=delta_id,
+            fleet=(self.sched.export_state()
+                   if fleet_plan is not None else None))
         for name in sorted(fleet_decisions):
             d = fleet_decisions[name]
             if d.get("preempted"):
@@ -831,12 +1078,22 @@ class PlanService:
                 args=("cluster_delta", ev, trace_id, root_dec.seq,
                       cause or ""),
                 name="metis-serve-delta-replan", daemon=True).start()
-        return {"invalidated": invalidated, "removed": delta.removed,
+        resp = {"invalidated": invalidated, "removed": delta.removed,
                 "added": delta.added,
                 "devices": new_cluster.total_devices, "seq": note["seq"],
                 "replanning": replan,
                 "decision_seq": root_dec.seq,
                 "tenants_changed": sorted(fleet_decisions)}
+        if delta_id is not None:
+            with self._lock:
+                self._applied_deltas[delta_id] = dict(resp)
+                while len(self._applied_deltas) > self.DELTA_DEDUP_WINDOW:
+                    self._applied_deltas.popitem(last=False)
+        # force a snapshot: topology changes are rare and expensive to
+        # lose, and it shrinks the window in which a replica's dedup map
+        # holds the oplog's stub response instead of the full one
+        self.snapshot_now()
+        return resp
 
     def _replan_all(self, reason: str,
                     events: EventLog | None = None,
@@ -916,6 +1173,7 @@ class PlanService:
         """Drop cache entries (all, or those for one query fingerprint);
         warm states survive unless ``drop_states`` — the knob bench uses
         to separate warm-state from cold-process search cost."""
+        self._check_writable("cache invalidation")
         if fingerprint is None:
             n = self.cache.invalidate_all()
         else:
@@ -1010,6 +1268,7 @@ class PlanService:
         current fleet plan without re-partitioning.  A *different* spec
         under the same name still raises (that is a conflict, not a
         retry)."""
+        self._check_writable("tenant register")
         sched = self._ensure_sched()
         with self._search_lock:
             if spec.name in sched.registry \
@@ -1041,14 +1300,19 @@ class PlanService:
                 raise
         changed = self._invalidate_changed_tenants(old_plan, plan)
         alloc = plan.allocation(spec.name)
-        note = self._push_note({
-            "kind": "tenant_admit",
-            "tenant": spec.name,
-            "priority": spec.priority,
-            "devices": alloc.devices if alloc else 0,
-            "feasible": bool(alloc and alloc.feasible),
-        })
+        _op, note = self._append_op(
+            "tenant_register",
+            note={
+                "kind": "tenant_admit",
+                "tenant": spec.name,
+                "priority": spec.priority,
+                "devices": alloc.devices if alloc else 0,
+                "feasible": bool(alloc and alloc.feasible),
+            },
+            cluster=self._cluster_state_dict(),
+            fleet=sched.export_state())
         self.counters.inc("serve.tenants_admitted")
+        self.snapshot_now()
         return {
             "tenant": spec.name,
             "kind": spec.kind,
@@ -1061,6 +1325,7 @@ class PlanService:
         }
 
     def tenant_remove(self, name: str) -> dict:
+        self._check_writable("tenant remove")
         sched = self.sched
         if sched is None:
             raise TenantSpecError(f"no such tenant: {name!r}")
@@ -1071,7 +1336,12 @@ class PlanService:
         changed = self._invalidate_changed_tenants(old_plan, plan)
         gone = {name}
         self.cache.invalidate_where(lambda _k, v: v.get("tenant") in gone)
-        note = self._push_note({"kind": "tenant_remove", "tenant": name})
+        _op, note = self._append_op(
+            "tenant_remove",
+            note={"kind": "tenant_remove", "tenant": name},
+            cluster=self._cluster_state_dict(),
+            fleet=sched.export_state())
+        self.snapshot_now()
         return {"tenant": name, "tenants_changed": changed,
                 "seq": note["seq"]}
 
@@ -1142,7 +1412,13 @@ class PlanService:
             "decision_seq": (tdec.seq if tdec is not None
                              else sched.last_decision_seq),
         }
-        self.cache.put(key, entry)
+        if not self.read_only:
+            # a standby serves the computed entry without caching it:
+            # inserting locally would mint state the primary's oplog never
+            # saw, and the entry is cheap to recompute from the replicated
+            # fleet plan anyway
+            self.cache.put(key, entry)
+            self._log_plan_insert(key, entry)
         return self._respond(entry, cached=False, t_req=t_req,
                              trace_id=trace_id)
 
@@ -1176,12 +1452,11 @@ class PlanService:
 
     # -- notifications ------------------------------------------------------
     def _push_note(self, note: dict) -> dict:
-        with self._note_cond:
-            self._note_seq += 1
-            note = {"seq": self._note_seq, "ts": time.time(), **note}
-            self._notes.append(note)
-            del self._notes[:-256]  # bounded backlog
-            self._note_cond.notify_all()
+        """Pure notification (replan_push, tenant_preempt, tenant_replan):
+        an op whose only payload is the note itself — it rides the oplog
+        like every other mutation so a standby replays the subscriber
+        stream too."""
+        _op, note = self._append_op("note", note=note)
         return note
 
     def notifications(self, since: int = 0,
@@ -1190,13 +1465,31 @@ class PlanService:
         first new one (long-poll).  A :meth:`close` (daemon shutdown)
         wakes every blocked poller immediately — it returns whatever is
         already pending instead of holding the socket until timeout."""
+        return self.notifications_window(since=since,
+                                         timeout_s=timeout_s)["notifications"]
+
+    def notifications_window(self, since: int = 0,
+                             timeout_s: float = 0.0) -> dict:
+        """:meth:`notifications` plus the metadata a client needs to
+        DETECT a gap instead of silently missing notes: ``oldest_seq``
+        (the oldest note still buffered, None when empty) and
+        ``truncated`` — True when notes with seq > ``since`` have already
+        been dropped from the bounded backlog, in which case the client's
+        move is a full resync (or an ``/oplog?since=`` replay), not a
+        catch-up from this response."""
         deadline = time.monotonic() + max(0.0, timeout_s)
         with self._note_cond:
             while True:
                 out = [n for n in self._notes if n["seq"] > since]
                 remaining = deadline - time.monotonic()
                 if out or remaining <= 0 or self._closed:
-                    return out
+                    return {
+                        "notifications": out,
+                        "last_seq": self._note_seq,
+                        "oldest_seq": (self._notes[0]["seq"]
+                                       if self._notes else None),
+                        "truncated": since < self._notes_dropped_high,
+                    }
                 self._note_cond.wait(remaining)
 
     def close(self) -> None:
@@ -1208,6 +1501,18 @@ class PlanService:
         with self._note_cond:
             self._closed = True
             self._note_cond.notify_all()
+        # stop the periodic snapshotter, then take one final snapshot so
+        # a clean shutdown restores with zero oplog replay
+        self._snap_stop.set()
+        if self._snap_thread is not None:
+            self._snap_thread.join(timeout=5.0)
+            self._snap_thread = None
+        try:
+            self.snapshot_now()
+        except Exception:  # pragma: no cover - best-effort on shutdown
+            self.counters.inc("serve.snapshot_errors")
+        if self._oplog is not None:
+            self._oplog.close()
         # flush + release the durable decision-log handle; a restarted
         # daemon re-opens it and resumes the seq where this one stopped
         self.decisions.close()
@@ -1236,6 +1541,7 @@ class PlanService:
             "live": live,
             "ready": live and all(checks.values()),
             "checks": checks,
+            "standby": self.read_only,
             "uptime_s": round(time.monotonic() - self._t_start, 3),
         }
 
@@ -1261,6 +1567,12 @@ class PlanService:
             time.monotonic() - self._t_start)
         m.gauge("metis_serve_tenants").set(
             len(self.sched.registry) if self.sched else 0)
+        if self._snapshot_store is not None \
+                and self._snapshot_store.last_ts is not None:
+            m.gauge("metis_snapshot_age_seconds").set(
+                max(0.0, time.time() - self._snapshot_store.last_ts))
+            m.gauge("metis_snapshot_size_bytes").set(
+                self._snapshot_store.last_bytes or 0)
         return m.render()
 
     def stats(self) -> dict:
@@ -1277,6 +1589,11 @@ class PlanService:
             "decisions": len(self.decisions),
             "decision_seq": self.decisions.last_seq,
             "tenants": len(self.sched.registry) if self.sched else 0,
+            "read_only": self.read_only,
+            "state_dir": (str(self._snapshot_store.path.parent)
+                          if self._snapshot_store is not None else None),
+            "last_snapshot_seq": self._last_snapshot_seq,
+            "restore_s": self.restore_s,
         }
 
 
@@ -1292,6 +1609,7 @@ _KNOWN_ENDPOINTS = {
     "/plan", "/tenant", "/tenant_remove", "/accuracy_sample",
     "/cluster_delta", "/invalidate", "/shutdown",
     "/stats", "/healthz", "/metrics", "/notifications", "/decisions",
+    "/oplog",
 }
 
 
@@ -1397,9 +1715,12 @@ class _Handler(BaseHTTPRequestHandler):
             since = int(q.get("since", ["0"])[0])
             timeout_s = float(q.get("timeout", ["0"])[0])
             self._get_event(parsed.path, trace_id)
-            notes = self.service.notifications(since=since,
-                                               timeout_s=timeout_s)
-            self._json(200, {"notifications": notes})
+            self._json(200, self.service.notifications_window(
+                since=since, timeout_s=timeout_s))
+        elif parsed.path == "/oplog":
+            since = int(q.get("since", ["0"])[0])
+            self._get_event(parsed.path, trace_id)
+            self._json(200, self.service.oplog_window(since=since))
         elif parsed.path == "/decisions":
             since = int(q.get("since", ["0"])[0])
             self._get_event(parsed.path, trace_id)
@@ -1456,12 +1777,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, out)
             elif self.path == "/cluster_delta":
                 cause = body.get("cause")
+                delta_id = body.get("delta_id")
                 out = self.service.apply_cluster_delta(
                     removed=body.get("removed"),
                     added=body.get("added"),
                     replan=bool(body.get("replan", False)),
                     trace_id=trace_id,
-                    cause=str(cause) if cause is not None else None)
+                    cause=str(cause) if cause is not None else None,
+                    delta_id=(str(delta_id) if delta_id is not None
+                              else None))
                 self._json(200, out)
             elif self.path == "/invalidate":
                 out = self.service.invalidate(
@@ -1476,6 +1800,12 @@ class _Handler(BaseHTTPRequestHandler):
                                  daemon=True).start()
             else:
                 self._json(404, {"error": f"no such endpoint: {self.path}"})
+        except StandbyReadOnlyError as e:
+            # before the MetisError catch: a mutation on a standby is not
+            # a bad request — 503 + the standby flag tells a failover-
+            # aware client to try the next address in its list
+            self._json(503, {"error": f"{type(e).__name__}: {e}",
+                             "standby": True})
         except (KeyError, TypeError, ValueError, MetisError) as e:
             self._json(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # pragma: no cover - last-resort 500

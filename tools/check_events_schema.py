@@ -144,6 +144,16 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # (serve/daemon.py), stamped with the caller's trace_id when given
     "decision_record": {"seq", "kind"},
     "get_request": {"endpoint"},
+    # durable control plane (serve/persist.py, serve/standby.py): one
+    # snapshot_write per persisted state snapshot (op-seq cursor, cache
+    # entries captured, bytes on disk); one snapshot_restore per boot
+    # that found state (source = latest / prev generation, or "oplog"
+    # when only the log existed); one oplog_append per state-mutation op;
+    # one failover per standby promotion (last replicated seq + why)
+    "snapshot_write": {"seq", "entries", "bytes"},
+    "snapshot_restore": {"seq", "entries", "source"},
+    "oplog_append": {"seq", "op"},
+    "failover": {"last_seq", "reason"},
 }
 
 # Events the serve daemon emits once per client request.  When a client

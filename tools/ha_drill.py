@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""HA drill: prove the serve daemon's durability and failover contracts.
+
+Two drills, both against real daemon processes on the parity fixture:
+
+**Restore drill** (``run_restore_drill``): boot ``metis-tpu serve
+--state-dir``, prime the plan cache (one beam query + one exact-backend
+query so an optimality certificate is in the cache), ``kill -9`` the
+process mid-life, boot a fresh daemon on the same state dir, and assert
+
+- the restored daemon answers BOTH queries as cache hits,
+- byte-identical payloads (plans JSON, certificates, decision_seq —
+  everything except the per-request ``cached``/``serve_ms``/``trace_id``),
+- decision-log seq numbering resumed (never reset),
+- in-daemon restore time (snapshot load + oplog replay, reported as
+  ``restore_s`` in the boot line) under the 1 s budget.
+
+**Failover drill** (``run_failover_drill``): boot a primary with a state
+dir, register tenants and record their served plans, attach an
+oplog-replicating standby (``serve/standby.py``) plus a failover-aware
+client holding both addresses, ``kill -9`` the primary, wait for the
+standby to promote itself, and assert the client transparently fails
+over with ZERO tenant plans lost — every post-failover ``tenant_plan``
+answer byte-identical to the primary's.
+
+Usage:  python tools/ha_drill.py [--drill restore|failover|both] [--json]
+Also importable from tests/test_ha.py (tier-1 wiring).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+RESTORE_BUDGET_S = 1.0
+BOOT_TIMEOUT_S = 180.0
+
+# per-request fields legitimately different between two servings of the
+# same cache entry — everything else must be byte-identical
+VOLATILE_FIELDS = ("cached", "serve_ms", "trace_id")
+
+
+def canonical(payload: dict) -> str:
+    """Response payload minus per-request fields, canonical JSON."""
+    trimmed = {k: v for k, v in payload.items()
+               if k not in VOLATILE_FIELDS}
+    return json.dumps(trimmed, sort_keys=True, default=str)
+
+
+def _spawn_daemon(fixture_dir: Path, state_dir: Path,
+                  extra_args: list[str] | None = None):
+    """Launch ``metis-tpu serve --state-dir`` as a subprocess; returns
+    ``(proc, boot)`` where ``boot`` is the parsed boot JSON line."""
+    cmd = [sys.executable, "-m", "metis_tpu.planner.cli", "serve",
+           "--hostfile", str(fixture_dir / "hostfile"),
+           "--clusterfile", str(fixture_dir / "clusterfile.json"),
+           "--profile-dir", str(fixture_dir / "profiles"),
+           "--port", "0", "--state-dir", str(state_dir),
+           *(extra_args or [])]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env,
+                            cwd=str(REPO))
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    boot = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        line = line.strip()
+        if line.startswith("{"):
+            boot = json.loads(line)
+            break
+    if boot is None:
+        proc.kill()
+        _, err = proc.communicate(timeout=30)
+        raise AssertionError(
+            f"daemon did not print a boot line within {BOOT_TIMEOUT_S}s: "
+            f"{err[-2000:]}")
+    return proc, boot
+
+
+def _sigkill(proc) -> None:
+    proc.kill()  # SIGKILL on POSIX: no atexit, no flush, no cleanup
+    try:
+        proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired:  # pragma: no cover
+        proc.terminate()
+
+
+def run_restore_drill(work_dir: str | Path | None = None,
+                      restore_budget_s: float = RESTORE_BUDGET_S) -> dict:
+    """kill -9 -> --state-dir reboot -> byte-identical cache; raises
+    AssertionError on any contract violation."""
+    from serve_smoke import parity_inputs
+
+    from metis_tpu.serve.client import PlanServiceClient
+
+    own_tmp = None
+    if work_dir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="metis-ha-drill-")
+        work_dir = own_tmp.name
+    work_dir = Path(work_dir)
+    out: dict = {"drill": "restore"}
+    try:
+        _cluster, _profiles, model, config = parity_inputs(work_dir)
+        exact_config = dataclasses.replace(config, backend="exact")
+        state_dir = work_dir / "state"
+
+        proc, _boot = _spawn_daemon(work_dir, state_dir)
+        client = PlanServiceClient(_boot["serving"], timeout=300.0)
+        try:
+            beam = client.plan(model, config, top_k=5)
+            exact = client.plan(model, exact_config, top_k=5)
+            assert exact.get("certificate"), (
+                "exact-backend query carried no optimality certificate — "
+                "the drill cannot prove certificate durability")
+            pre_stats = client.stats()
+        finally:
+            _sigkill(proc)
+        out["primed_note_seq"] = pre_stats["note_seq"]
+        out["primed_decision_seq"] = pre_stats["decision_seq"]
+
+        t0 = time.monotonic()
+        proc2, boot2 = _spawn_daemon(work_dir, state_dir)
+        out["reboot_wall_s"] = round(time.monotonic() - t0, 3)
+        out["restore_s"] = boot2.get("restore_s")
+        client2 = PlanServiceClient(boot2["serving"], timeout=300.0)
+        try:
+            assert out["restore_s"] is not None, (
+                "boot line carried no restore_s — state restore did not "
+                "run")
+            assert out["restore_s"] < restore_budget_s, (
+                f"restore took {out['restore_s']}s, over the "
+                f"{restore_budget_s}s budget")
+            beam2 = client2.plan(model, config, top_k=5)
+            exact2 = client2.plan(model, exact_config, top_k=5)
+            lost = [name for name, a, b in (
+                ("beam", beam, beam2), ("exact", exact, exact2))
+                if not b.get("cached") or canonical(a) != canonical(b)]
+            assert not lost, (
+                f"restored daemon lost / altered cache entries: {lost}")
+            assert exact2["certificate"] == exact["certificate"], (
+                "optimality certificate did not survive the restore")
+            post_stats = client2.stats()
+            assert post_stats["decision_seq"] >= \
+                pre_stats["decision_seq"], (
+                    "decision-log seq went BACKWARDS across the restart "
+                    f"({pre_stats['decision_seq']} -> "
+                    f"{post_stats['decision_seq']}): the audit trail "
+                    "reset")
+            assert post_stats["note_seq"] >= pre_stats["note_seq"], (
+                "op seq went backwards across the restart")
+            out["restored_note_seq"] = post_stats["note_seq"]
+            out["restored_decision_seq"] = post_stats["decision_seq"]
+            try:
+                client2.shutdown()
+            except Exception:
+                pass
+        finally:
+            _sigkill(proc2)
+        out["ok"] = True
+        return out
+    finally:
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+def run_failover_drill(work_dir: str | Path | None = None,
+                       tenants: int = 3,
+                       promote_timeout_s: float = 30.0) -> dict:
+    """kill -9 the primary -> standby promotes -> failover client keeps
+    serving every tenant plan byte-identically (zero lost)."""
+    from serve_smoke import parity_inputs
+
+    from metis_tpu.sched.tenant import TenantSpec
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from metis_tpu.serve.standby import StandbyTailer
+
+    own_tmp = None
+    if work_dir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="metis-ha-drill-")
+        work_dir = own_tmp.name
+    work_dir = Path(work_dir)
+    out: dict = {"drill": "failover", "tenants": tenants}
+    standby_server = tailer = None
+    try:
+        cluster, profiles, model, config = parity_inputs(work_dir)
+        state_dir = work_dir / "primary_state"
+        proc, boot = _spawn_daemon(work_dir, state_dir)
+        primary_addr = boot["serving"]
+        client = PlanServiceClient(primary_addr, timeout=300.0)
+
+        served: dict[str, str] = {}
+        try:
+            for i in range(tenants):
+                spec = TenantSpec(name=f"tenant{i}", model=model,
+                                  config=config, priority=i)
+                client.tenant_register(spec)
+            for i in range(tenants):
+                served[f"tenant{i}"] = canonical(
+                    client.tenant_plan(f"tenant{i}"))
+            primary_seq = client.stats()["note_seq"]
+
+            # standby: read-only replica of the primary's oplog, serving
+            # on its own address
+            standby_svc = PlanService(cluster, profiles, read_only=True)
+            tailer = StandbyTailer(standby_svc, primary_addr,
+                                   poll_interval_s=0.1, promote_after=3,
+                                   client_timeout_s=2.0)
+            standby_server, _thread, standby_addr = serve_in_thread(
+                standby_svc)
+            tailer.start()
+            deadline = time.monotonic() + 60.0
+            while standby_svc._note_seq < primary_seq:
+                assert time.monotonic() < deadline, (
+                    f"standby never caught up (at "
+                    f"{standby_svc._note_seq}/{primary_seq})")
+                time.sleep(0.05)
+            out["replicated_seq"] = standby_svc._note_seq
+        finally:
+            t_kill = time.monotonic()
+            _sigkill(proc)
+
+        deadline = time.monotonic() + promote_timeout_s
+        while not tailer.promoted:
+            assert time.monotonic() < deadline, (
+                f"standby did not promote within {promote_timeout_s}s "
+                "of primary death")
+            time.sleep(0.05)
+        out["promote_s"] = round(time.monotonic() - t_kill, 3)
+
+        ha_client = PlanServiceClient([primary_addr, standby_addr],
+                                      timeout=60.0)
+        lost = []
+        t0 = time.monotonic()
+        for name, before in served.items():
+            try:
+                after = canonical(ha_client.tenant_plan(name))
+            except Exception as e:
+                lost.append(f"{name}: {e}")
+                continue
+            if after != before:
+                lost.append(f"{name}: plan changed across failover")
+        out["failover_first_answer_s"] = round(time.monotonic() - t0, 3)
+        out["lost_plans"] = len(lost)
+        assert not lost, f"failover lost tenant plans: {lost}"
+        assert ha_client.active_address == standby_addr, (
+            "client did not fail over to the standby address")
+        notes = ha_client.notifications(since=0)
+        assert any(n.get("kind") == "failover" for n in notes), (
+            "promoted standby pushed no failover note")
+        out["ok"] = True
+        return out
+    finally:
+        if tailer is not None:
+            tailer.stop()
+        if standby_server is not None:
+            standby_server.shutdown()
+            standby_server.server_close()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--drill", choices=("restore", "failover", "both"),
+                        default="both")
+    parser.add_argument("--tenants", type=int, default=3)
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+    results = []
+    try:
+        if args.drill in ("restore", "both"):
+            results.append(run_restore_drill())
+        if args.drill in ("failover", "both"):
+            results.append(run_failover_drill(tenants=args.tenants))
+    except AssertionError as e:
+        print(f"ha drill FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(results, indent=2))
+    else:
+        for r in results:
+            if r["drill"] == "restore":
+                print(f"restore drill OK: kill -9 -> warm in "
+                      f"{r['restore_s']}s in-daemon "
+                      f"({r['reboot_wall_s']}s wall), cache + "
+                      f"certificates byte-identical, decision seq "
+                      f"resumed at {r['restored_decision_seq']}")
+            else:
+                print(f"failover drill OK: standby promoted "
+                      f"{r['promote_s']}s after kill -9, "
+                      f"{r['tenants']} tenants, {r['lost_plans']} plans "
+                      f"lost, first answer in "
+                      f"{r['failover_first_answer_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
