@@ -359,6 +359,32 @@ METRIC_CATALOG: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "metis_serve_cache_invalidations_total": (
         "counter", "plan cache entries dropped by drift alarms, deltas, "
                    "or explicit invalidation", ()),
+    "metis_serve_cache_shard_lock_wait_ms": (
+        "histogram", "time blocked acquiring one plan-cache shard lock "
+                     "(uncontended acquires are not timed)", ("shard",)),
+    "metis_serve_keepalive_reuse_total": (
+        "counter", "HTTP requests served on an already-open keep-alive "
+                   "connection (2nd and later request per connection)",
+        ()),
+    "metis_serve_pool_threads": (
+        "gauge", "handler worker-pool size", ()),
+    "metis_serve_pool_busy_threads": (
+        "gauge", "handler pool threads currently serving a connection",
+        ()),
+    "metis_serve_pool_backlog": (
+        "gauge", "accepted connections queued for a free pool thread",
+        ()),
+    "metis_serve_pool_queue_wait_ms": (
+        "histogram", "time an accepted connection waited in the backlog "
+                     "before a pool thread picked it up", ()),
+    "metis_serve_overload_total": (
+        "counter", "connections shed with 503 + Retry-After because the "
+                   "worker pool and its backlog were both full", ()),
+    "metis_search_pool_workers": (
+        "gauge", "resident cold-search worker processes (0 = pool off or "
+                 "closed)", ()),
+    "metis_search_pool_inflight": (
+        "gauge", "searches currently executing on the worker pool", ()),
     "metis_serve_warm_states": (
         "gauge", "retained warm search states", ()),
     "metis_serve_notes_backlog": (

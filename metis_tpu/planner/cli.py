@@ -637,6 +637,23 @@ def main(argv: list[str] | None = None) -> int:
                        help="serve on this unix socket path instead of TCP")
     p_srv.add_argument("--cache-size", type=int, default=128,
                        help="plan cache capacity (LRU entries)")
+    p_srv.add_argument("--cache-shards", type=int, default=4,
+                       help="plan-cache lock shards (serve/cache.py): "
+                            "concurrent requests on distinct fingerprints "
+                            "never contend; capacity stays a single "
+                            "global LRU bound")
+    p_srv.add_argument("--serve-threads", type=int, default=None,
+                       metavar="N",
+                       help="handler worker-pool size (default 64); when "
+                            "pool and backlog are both full, new "
+                            "connections get 503 + Retry-After instead "
+                            "of unbounded thread growth")
+    p_srv.add_argument("--search-pool", type=int, default=0, metavar="N",
+                       help="resident cold-search worker processes "
+                            "(serve/pool.py): N index-stride shards per "
+                            "search, warm evaluators per query shape, "
+                            "byte-identical ranking; 0 = serial in-"
+                            "process search (default)")
     p_srv.add_argument("--state-cache-size", type=int, default=8,
                        help="warm search states retained (one per query "
                             "shape; each holds estimator + memo tables)")
@@ -898,10 +915,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                  if decisions_path else None)
     service = PlanService(
         cluster, profiles, cache_capacity=args.cache_size,
+        cache_shards=args.cache_shards,
         state_capacity=args.state_cache_size, events=events,
         drift_band_pct=args.drift_band, decisions=decisions,
         state_dir=args.state_dir,
         snapshot_interval=args.snapshot_interval,
+        search_pool=args.search_pool,
         read_only=args.standby_of is not None)
     tailer = None
     if args.standby_of is not None:
@@ -910,13 +929,20 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         tailer = StandbyTailer(service, args.standby_of)
         tailer.start()
     server = make_server(service, host=args.host, port=args.port,
-                         socket_path=args.socket)
+                         socket_path=args.socket,
+                         threads=args.serve_threads)
     boot = {
         "serving": server.address,
         "devices": cluster.total_devices,
         "device_types": list(cluster.device_types),
         "cache_capacity": args.cache_size,
+        "cache_shards": args.cache_shards,
+        "serve_threads": server.pool_threads,
     }
+    if args.search_pool:
+        boot["search_pool_workers"] = (
+            service.search_pool.num_workers
+            if service.search_pool is not None else 0)
     if args.state_dir:
         boot["state_dir"] = args.state_dir
         boot["restore_s"] = service.restore_s

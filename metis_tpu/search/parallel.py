@@ -443,111 +443,140 @@ class CandidateEvaluator:
         return out
 
 
-def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
-                 model, config, bandwidth_factory, inter_filter, top_k,
-                 want_counters):
-    """One shard of the search, in a child process.
+def build_shard_pruner(ctx, profiles):
+    """A fresh :class:`SearchPruner` for one shard run of ``ctx`` — the
+    same construction the serial driver and every worker use, including
+    the tight relaxation bound when the config calls for it (built from
+    the evaluator's own tables, so the bound floats match the serial
+    run's exactly: pure functions of the shared profiles/config)."""
+    config = ctx.config
+    bound_fn = None
+    if (getattr(config, "tight_bound", True)
+            and config.prune_to_top_k is not None
+            and not config.strict_compat):
+        from metis_tpu.search.exact import RelaxationBound
+
+        bound_fn = RelaxationBound.from_evaluator(ctx)
+    return SearchPruner(config, ctx.cluster, profiles, ctx.model,
+                        counters=ctx.counters, bound_fn=bound_fn)
+
+
+def run_worker_shard(ctx, pruner, worker_id, num_workers,
+                     inter_filter=None, top_k=None, progress=None):
+    """One index-stride shard of the search, in the calling process.
 
     Enumerates the FULL flat candidate stream (bumping ``inter_enumerated``
     only for owned candidates, so worker sums equal the serial total) and
     runs the shared cost loop on every ``idx % num_workers == worker_id``
-    candidate with its own pruner.  Reports ``("progress", ...)`` every
-    ``config.progress_every`` heartbeat ticks and one final
-    ``("result", ...)`` carrying the (locally sorted, optionally top-k
-    truncated) tagged plans plus the accounting.
+    candidate.  ``progress(ticks, elapsed_s, best_ms, n_plans, n_pruned)``
+    fires every ``config.progress_every`` heartbeat ticks when given.
+    Returns ``(plans, num_costed, pruned, num_bound_pruned)`` where
+    ``plans`` is the locally sorted, optionally top-k truncated list of
+    ``(total_ms, global_idx, seq, RankedPlan)`` merge tuples.
+
+    Shared verbatim by the one-shot fork-per-search workers here and the
+    daemon's persistent pre-warmed pool (``serve/pool.py``) — one
+    implementation, so the byte-identical-ranking guarantee cannot drift
+    between them.
     """
+    config = ctx.config
+    counters = ctx.counters
+    plans: list[tuple] = []  # (total_ms, global_idx, seq, RankedPlan)
+    pruned = 0
+    ticks = 0
+    best_ms = float("inf")
+    t0 = time.perf_counter()
+    every = max(int(config.progress_every), 1)
+    next_emit = every
+    stream = inter_stage_plans(
+        ctx.cluster.device_types, ctx.cluster.total_devices, config.gbs,
+        ctx.model.num_layers, variance=config.min_group_scale_variance,
+        max_permute_len=config.max_permute_len)
+    # With the bound/beam prunes active, admit() must see each
+    # candidate's recorded costs before judging the next — batching
+    # would admit with stale bounds and change the prune counters.
+    # Batch size 1 keeps every mode byte-identical to the serial loop.
+    batch: list[tuple[int, object]] = []
+    bsize = 1 if pruner.active else 64
+
+    def _drain():
+        nonlocal ticks, pruned, best_ms, next_emit
+        pos = 0
+        for _inter, events in ctx.evaluate_batch(
+                [rec[1] for rec in batch], pruner):
+            idx = batch[pos][0]
+            pos += 1
+            seq = 0
+            for kind, item in events:
+                if kind == "plan":
+                    if item.cost.total_ms < best_ms:
+                        best_ms = item.cost.total_ms
+                    plans.append((item.cost.total_ms, idx, seq, item))
+                    seq += 1
+                    ticks += 1
+                else:
+                    pruned += 1
+                    if item:
+                        ticks += 1
+                if progress is not None and ticks >= next_emit:
+                    next_emit = ticks + every
+                    progress(ticks, time.perf_counter() - t0,
+                             best_ms if best_ms != float("inf") else None,
+                             len(plans), pruned)
+        batch.clear()
+
+    for idx, inter in enumerate(stream):
+        if idx % num_workers != worker_id:
+            continue
+        if counters is not None:
+            counters.inc("inter_enumerated")
+        if inter_filter is not None and not inter_filter(inter):
+            pruned += 1
+            if counters is not None:
+                counters.inc("pruned_inter_filter")
+            continue
+        if not pruner.admit(inter):
+            continue
+        batch.append((idx, inter))
+        if len(batch) >= bsize:
+            _drain()
+    if batch:
+        _drain()
+    num_costed = len(plans)
+    # local sort by the global stable-tie-break key; with a top_k the
+    # merged top-k is a subset of the union of local top-ks, so the
+    # tail never needs to cross the process boundary
+    plans.sort(key=lambda rec: rec[:3])
+    if top_k is not None:
+        plans = plans[:top_k]
+    return plans, num_costed, pruned, pruner.num_pruned
+
+
+def _worker_main(worker_id, num_workers, out_queue, cluster, profiles,
+                 model, config, bandwidth_factory, inter_filter, top_k,
+                 want_counters):
+    """One shard of the search, in a one-shot child process: build the
+    evaluator + pruner, run :func:`run_worker_shard`, report
+    ``("progress", ...)`` heartbeats and one final ``("result", ...)``
+    carrying the tagged plans plus the accounting."""
     try:
         counters = Counters() if want_counters else None
         ctx = CandidateEvaluator(
             cluster, profiles, model, config,
             bandwidth_factory=bandwidth_factory, counters=counters)
-        bound_fn = None
-        if (getattr(config, "tight_bound", True)
-                and config.prune_to_top_k is not None
-                and not config.strict_compat):
-            # same tight relaxation bound the serial driver installs — each
-            # worker builds its own from its own evaluator tables, so the
-            # bound floats match the serial run's exactly (pure functions
-            # of the shared profiles/config)
-            from metis_tpu.search.exact import RelaxationBound
+        pruner = build_shard_pruner(ctx, profiles)
 
-            bound_fn = RelaxationBound.from_evaluator(ctx)
-        pruner = SearchPruner(config, cluster, profiles, model,
-                              counters=counters, bound_fn=bound_fn)
-        plans: list[tuple] = []  # (total_ms, global_idx, seq, RankedPlan)
-        pruned = 0
-        ticks = 0
-        best_ms = float("inf")
-        t0 = time.perf_counter()
-        every = max(int(config.progress_every), 1)
-        next_emit = every
-        stream = inter_stage_plans(
-            cluster.device_types, cluster.total_devices, config.gbs,
-            model.num_layers, variance=config.min_group_scale_variance,
-            max_permute_len=config.max_permute_len)
-        # With the bound/beam prunes active, admit() must see each
-        # candidate's recorded costs before judging the next — batching
-        # would admit with stale bounds and change the prune counters.
-        # Batch size 1 keeps every mode byte-identical to the serial loop.
-        batch: list[tuple[int, object]] = []
-        bsize = 1 if pruner.active else 64
+        def _progress(ticks, elapsed, best, n_plans, n_pruned):
+            out_queue.put(("progress", worker_id, ticks, elapsed, best,
+                           n_plans, n_pruned))
 
-        def _drain():
-            nonlocal ticks, pruned, best_ms, next_emit
-            pos = 0
-            for _inter, events in ctx.evaluate_batch(
-                    [rec[1] for rec in batch], pruner):
-                idx = batch[pos][0]
-                pos += 1
-                seq = 0
-                for kind, item in events:
-                    if kind == "plan":
-                        if item.cost.total_ms < best_ms:
-                            best_ms = item.cost.total_ms
-                        plans.append((item.cost.total_ms, idx, seq, item))
-                        seq += 1
-                        ticks += 1
-                    else:
-                        pruned += 1
-                        if item:
-                            ticks += 1
-                    if ticks >= next_emit:
-                        next_emit = ticks + every
-                        elapsed = time.perf_counter() - t0
-                        out_queue.put((
-                            "progress", worker_id, ticks, elapsed,
-                            best_ms if best_ms != float("inf") else None,
-                            len(plans), pruned))
-            batch.clear()
-
-        for idx, inter in enumerate(stream):
-            if idx % num_workers != worker_id:
-                continue
-            if counters is not None:
-                counters.inc("inter_enumerated")
-            if inter_filter is not None and not inter_filter(inter):
-                pruned += 1
-                if counters is not None:
-                    counters.inc("pruned_inter_filter")
-                continue
-            if not pruner.admit(inter):
-                continue
-            batch.append((idx, inter))
-            if len(batch) >= bsize:
-                _drain()
-        if batch:
-            _drain()
-        num_costed = len(plans)
-        # local sort by the global stable-tie-break key; with a top_k the
-        # merged top-k is a subset of the union of local top-ks, so the
-        # tail never needs to cross the process boundary
-        plans.sort(key=lambda rec: rec[:3])
-        if top_k is not None:
-            plans = plans[:top_k]
+        plans, num_costed, pruned, bound_pruned = run_worker_shard(
+            ctx, pruner, worker_id, num_workers,
+            inter_filter=inter_filter, top_k=top_k, progress=_progress)
         out_queue.put((
             "result", worker_id, plans,
             counters.as_dict() if counters is not None else None,
-            num_costed, pruned, pruner.num_pruned))
+            num_costed, pruned, bound_pruned))
     except BaseException as e:  # noqa: BLE001 — report; parent falls back
         out_queue.put(("error", worker_id, f"{type(e).__name__}: {e}"))
 

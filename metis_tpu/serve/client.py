@@ -2,9 +2,23 @@
 
 Speaks the ``serve/daemon.py`` JSON-over-HTTP protocol against either a
 TCP address (``http://127.0.0.1:8642`` or bare ``127.0.0.1:8642``) or a
-unix socket (``unix:/run/metis-plan.sock``).  One connection per request —
-thread-safe by construction, which is what the ≥64-thread concurrency
-contract of ``tools/serve_smoke.py`` leans on.
+unix socket (``unix:/run/metis-plan.sock``).
+
+Connections are POOLED per address: a request checks an idle keep-alive
+socket out of the pool, runs one round-trip, and checks it back in when
+the daemon left it open — the per-request TCP handshake the old
+one-connection-per-request client paid on every call is gone, which is
+most of the cached-hit latency at high qps.  Thread-safe: the pool is a
+lock-guarded free list, a connection is owned by exactly one thread
+between checkout and checkin, and any number of threads can hold
+distinct connections concurrently (the ≥64-thread concurrency contract
+of ``tools/serve_smoke.py``).  A pooled socket the daemon idle-closed
+between requests surfaces as an EOF on reuse; every endpoint is
+idempotent, so the request transparently retries once on a fresh
+connection.  Long-poll and streaming GETs (``notifications``,
+``metrics``, ``healthz`` — anything holding the socket for seconds) use
+dedicated non-pooled sockets so monitoring can never starve the
+plan-query pool.
 
 Every request mints a ``trace_id`` (or forwards the caller's) — POSTs in
 the JSON body, GETs as a ``trace_id`` query parameter — so the daemon can
@@ -26,6 +40,7 @@ import dataclasses
 import http.client
 import json
 import socket
+import threading
 import time
 import uuid
 from typing import Any, Sequence
@@ -83,7 +98,13 @@ class PlanServiceClient:
     standbys after) for transparent failover; every method is one
     round-trip against the currently-preferred address."""
 
-    def __init__(self, address: str | Sequence[str], timeout: float = 300.0):
+    # idle keep-alive connections retained per address; checkouts beyond
+    # this just open fresh sockets, so the cap bounds idle FDs, not
+    # concurrency
+    MAX_IDLE = 16
+
+    def __init__(self, address: str | Sequence[str], timeout: float = 300.0,
+                 pool_connections: bool = True):
         addresses = ([address] if isinstance(address, str)
                      else [str(a) for a in address])
         if not addresses:
@@ -93,8 +114,37 @@ class PlanServiceClient:
         # .active_address is the one currently answering
         self.address = self.addresses[0]
         self.timeout = timeout
+        self.pool_connections = pool_connections
         self._endpoints = [_parse_address(a) for a in self.addresses]
         self._active = 0
+        self._pool_lock = threading.Lock()
+        self._idle: list[list[http.client.HTTPConnection]] = [
+            [] for _ in self.addresses]
+        self._reused = 0
+        self._opened = 0
+
+    def pool_stats(self) -> dict:
+        """Connection-pool accounting: sockets opened, requests served on
+        a reused keep-alive socket, idle sockets currently pooled."""
+        with self._pool_lock:
+            return {"opened": self._opened, "reused": self._reused,
+                    "idle": sum(len(p) for p in self._idle)}
+
+    def close(self) -> None:
+        """Drop every pooled idle connection.  Safe to keep using the
+        client afterwards — requests just open fresh sockets."""
+        with self._pool_lock:
+            doomed = [c for p in self._idle for c in p]
+            for p in self._idle:
+                p.clear()
+        for c in doomed:
+            c.close()
+
+    def __enter__(self) -> "PlanServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     @property
     def active_address(self) -> str:
@@ -111,10 +161,46 @@ class PlanServiceClient:
         return http.client.HTTPConnection(endpoint[1], endpoint[2],
                                           timeout=t)
 
+    def _acquire(self, ix: int,
+                 timeout: float | None, dedicated: bool
+                 ) -> tuple[http.client.HTTPConnection, bool]:
+        """A connection for address ``ix``: ``(conn, reused)``.  Pooled
+        unless the caller wants a dedicated socket or a per-call timeout
+        (a pooled socket's timeout was fixed at creation)."""
+        if dedicated or timeout is not None or not self.pool_connections:
+            return self._connection(self._endpoints[ix],
+                                    timeout=timeout), False
+        with self._pool_lock:
+            idle = self._idle[ix]
+            if idle:
+                self._reused += 1
+                return idle.pop(), True
+            self._opened += 1
+        return self._connection(self._endpoints[ix]), False
+
+    def _release(self, ix: int, conn: http.client.HTTPConnection) -> None:
+        """Return a fully-drained keep-alive connection to the pool (or
+        close it past the idle cap)."""
+        with self._pool_lock:
+            idle = self._idle[ix]
+            if len(idle) < self.MAX_IDLE:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def _drop_idle(self, ix: int) -> None:
+        """Close every pooled connection to one address — it just failed,
+        so its idle sockets are presumed dead too."""
+        with self._pool_lock:
+            doomed = self._idle[ix]
+            self._idle[ix] = []
+        for c in doomed:
+            c.close()
+
     def _request(self, method: str, path: str,
                  payload: dict | None = None,
                  timeout: float | None = None, raw: bool = False,
-                 error_ok: bool = False) -> Any:
+                 error_ok: bool = False, dedicated: bool = False) -> Any:
         """One logical round-trip with failover: each configured address
         is tried in order starting from the active one; an unreachable
         address or a standby's read-only 503 advances to the next.  The
@@ -127,13 +213,15 @@ class PlanServiceClient:
             try:
                 out = self._request_one(ix, method, path, payload,
                                         timeout=timeout, raw=raw,
-                                        error_ok=error_ok)
+                                        error_ok=error_ok,
+                                        dedicated=dedicated)
             except _StandbyAnswer:
                 last_err = ServeClientError(
                     f"plan daemon at {self.addresses[ix]} is a read-only "
                     "standby")
                 continue
             except ServeClientError as e:
+                self._drop_idle(ix)
                 last_err = e
                 continue
             self._active = ix
@@ -144,64 +232,84 @@ class PlanServiceClient:
     def _request_one(self, ix: int, method: str, path: str,
                      payload: dict | None = None, _retries: int = 3,
                      timeout: float | None = None, raw: bool = False,
-                     error_ok: bool = False) -> Any:
+                     error_ok: bool = False, dedicated: bool = False) -> Any:
         """One round-trip against one address.  ``timeout`` overrides the
         client default for this call (the monitoring GETs want seconds,
         not the 300 s plan budget).  ``raw=True`` returns the decoded body
         text instead of parsed JSON (/metrics is text exposition, not
         JSON).  ``error_ok=True`` returns error-status bodies instead of
-        raising (/healthz answers 503 by design when not ready)."""
+        raising (/healthz answers 503 by design when not ready).
+        ``dedicated=True`` bypasses the connection pool — long-poll and
+        streaming GETs hold the socket for seconds and must never park a
+        plan-query connection behind them."""
         address = self.addresses[ix]
-        conn = self._connection(self._endpoints[ix], timeout=timeout)
+        conn, reused = self._acquire(ix, timeout, dedicated)
+        body = json.dumps(payload).encode() if payload is not None \
+            else None
+        headers = {"Content-Type": "application/json"} if body else {}
         try:
-            body = json.dumps(payload).encode() if payload is not None \
-                else None
-            headers = {"Content-Type": "application/json"} if body else {}
-            try:
-                conn.request(method, path, body=body, headers=headers)
-                resp = conn.getresponse()
-                data = resp.read()
-                status = resp.status
-            except ConnectionError as e:
-                # a connect burst can still outrun the daemon's accept
-                # backlog; every endpoint is idempotent (plan answers are
-                # deterministic + cached), so a short retry is safe
-                if _retries > 0:
-                    conn.close()
-                    time.sleep(0.05)
-                    return self._request_one(ix, method, path, payload,
-                                             _retries=_retries - 1,
-                                             timeout=timeout, raw=raw,
-                                             error_ok=error_ok)
-                raise ServeClientError(
-                    f"plan daemon at {address} unreachable: {e}") \
-                    from e
-            except (OSError, http.client.HTTPException) as e:
-                raise ServeClientError(
-                    f"plan daemon at {address} unreachable: {e}") \
-                    from e
-            if raw:
-                if status >= 400 and not error_ok:
-                    raise ServeClientError(
-                        f"daemon error {status}: {data!r}")
-                return data.decode("utf-8", errors="replace")
-            try:
-                out = json.loads(data) if data else {}
-            except json.JSONDecodeError as e:
-                raise ServeClientError(
-                    f"daemon sent invalid JSON ({e.msg})") from e
-            if status >= 400 and not error_ok:
-                if status == 503 and isinstance(out, dict) \
-                        and out.get("standby"):
-                    # a mutation hit a standby: not this request's fault —
-                    # signal the failover loop to try the next address
-                    raise _StandbyAnswer()
-                detail = out.get("error") if isinstance(out, dict) else None
-                raise ServeClientError(
-                    f"daemon error {status}: {detail or data!r}")
-            return out
-        finally:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            status = resp.status
+        except ConnectionError as e:
             conn.close()
+            if reused:
+                # a pooled socket the daemon idle-closed between requests:
+                # EOF on reuse is expected, not a failure — retry once on
+                # a fresh connection without burning the connect budget
+                # (safe: every endpoint is idempotent)
+                return self._request_one(ix, method, path, payload,
+                                         _retries=_retries,
+                                         timeout=timeout, raw=raw,
+                                         error_ok=error_ok,
+                                         dedicated=dedicated)
+            # a connect burst can still outrun the daemon's accept
+            # backlog; every endpoint is idempotent (plan answers are
+            # deterministic + cached), so a short retry is safe
+            if _retries > 0:
+                time.sleep(0.05)
+                return self._request_one(ix, method, path, payload,
+                                         _retries=_retries - 1,
+                                         timeout=timeout, raw=raw,
+                                         error_ok=error_ok,
+                                         dedicated=dedicated)
+            raise ServeClientError(
+                f"plan daemon at {address} unreachable: {e}") \
+                from e
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise ServeClientError(
+                f"plan daemon at {address} unreachable: {e}") \
+                from e
+        # the response is fully drained: pool the socket when the daemon
+        # left it open (even on an error status — a 4xx/standby 503 is a
+        # healthy connection), close it otherwise
+        if (not dedicated and timeout is None and self.pool_connections
+                and not resp.will_close and conn.sock is not None):
+            self._release(ix, conn)
+        else:
+            conn.close()
+        if raw:
+            if status >= 400 and not error_ok:
+                raise ServeClientError(
+                    f"daemon error {status}: {data!r}")
+            return data.decode("utf-8", errors="replace")
+        try:
+            out = json.loads(data) if data else {}
+        except json.JSONDecodeError as e:
+            raise ServeClientError(
+                f"daemon sent invalid JSON ({e.msg})") from e
+        if status >= 400 and not error_ok:
+            if status == 503 and isinstance(out, dict) \
+                    and out.get("standby"):
+                # a mutation hit a standby: not this request's fault —
+                # signal the failover loop to try the next address
+                raise _StandbyAnswer()
+            detail = out.get("error") if isinstance(out, dict) else None
+            raise ServeClientError(
+                f"daemon error {status}: {detail or data!r}")
+        return out
 
     # -- endpoints ----------------------------------------------------------
     def plan(self, model: ModelSpec, config: SearchConfig,
@@ -300,7 +408,7 @@ class PlanServiceClient:
         tid = trace_id or mint_trace_id()
         return self._request(
             "GET", f"/notifications?since={since}&timeout={timeout_s}"
-                   f"&trace_id={tid}")
+                   f"&trace_id={tid}", dedicated=True)
 
     def oplog(self, since: int = 0, trace_id: str | None = None) -> dict:
         """State-mutation oplog entries with ``seq > since`` from
@@ -329,15 +437,19 @@ class PlanServiceClient:
     def metrics(self, timeout: float | None = None) -> str:
         """Raw Prometheus text exposition from ``GET /metrics``.  Pass a
         short ``timeout`` when scraping on a schedule — the endpoint
-        never searches, so a slow answer means a sick daemon."""
-        return self._request("GET", "/metrics", timeout=timeout, raw=True)
+        never searches, so a slow answer means a sick daemon.  Scrapes run
+        on a dedicated socket so a slow scraper can't park a plan-query
+        connection."""
+        return self._request("GET", "/metrics", timeout=timeout, raw=True,
+                             dedicated=True)
 
     def healthz(self, timeout: float | None = None) -> dict:
         """Liveness/readiness from ``GET /healthz``.  Returns the health
         document even on 503 (not-ready IS the answer, not an error);
-        raises only when the daemon is unreachable."""
+        raises only when the daemon is unreachable.  Probes run on a
+        dedicated socket, same rationale as :meth:`metrics`."""
         return self._request("GET", "/healthz", timeout=timeout,
-                             error_ok=True)
+                             error_ok=True, dedicated=True)
 
     def shutdown(self) -> dict:
         return self._request("POST", "/shutdown", {})

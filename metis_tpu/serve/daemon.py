@@ -33,12 +33,13 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import queue
 import socket
 import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, HTTPServer
 from pathlib import Path
 from typing import Any
 from urllib.parse import parse_qs, urlparse
@@ -85,6 +86,7 @@ from metis_tpu.sched.fleet import FleetPlan, FleetScheduler
 from metis_tpu.sched.tenant import TenantSpec, tenant_from_dict
 from metis_tpu.serve import persist
 from metis_tpu.serve.cache import PlanCache
+from metis_tpu.serve.pool import SearchPoolError, SearchWorkerPool
 
 
 def model_spec_from_dict(d: dict) -> ModelSpec:
@@ -154,7 +156,9 @@ class PlanService:
         profiles: ProfileStore,
         *,
         cache_capacity: int = 128,
+        cache_shards: int = 4,
         state_capacity: int = 8,
+        search_pool: int = 0,
         events: EventLog = NULL_LOG,
         calibration=None,
         drift_band_pct: float = 20.0,
@@ -182,7 +186,7 @@ class PlanService:
         # uninstrumented baseline (bench telemetry section)
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.cache = PlanCache(cache_capacity, counters=self.counters,
-                               metrics=self.metrics)
+                               metrics=self.metrics, shards=cache_shards)
         self.state_capacity = state_capacity
         self.ledger = AccuracyLedger(None)  # in-memory: daemon-lifetime
         # decisions=None keeps the audit trail in memory (GET /decisions
@@ -244,6 +248,22 @@ class PlanService:
         self._snap_stop = threading.Event()
         self._snap_thread: threading.Thread | None = None
         self.cache.on_invalidate = self._on_cache_invalidate
+        # persistent cold-search worker pool (serve/pool.py): spawned once
+        # here — BEFORE the snapshot thread exists, so fork-started
+        # workers never inherit a live background thread — and fed
+        # searches over queues for the daemon's lifetime.  0 = off (cold
+        # misses serialize behind _search_lock exactly as before); a
+        # standby never searches, so it never pays for a pool.
+        self.search_pool: SearchWorkerPool | None = None
+        if search_pool > 0 and not read_only:
+            try:
+                self.search_pool = SearchWorkerPool(
+                    cluster, profiles, search_pool,
+                    state_capacity=state_capacity, metrics=self.metrics)
+            except SearchPoolError as e:
+                self.counters.inc("serve.pool_boot_failed")
+                self.events.emit("parallel_fallback",
+                                 reason=f"search pool boot: {e}")
         if state_dir is not None:
             self._snapshot_store = persist.SnapshotStore(state_dir)
             self._oplog = persist.Oplog(
@@ -477,6 +497,31 @@ class PlanService:
         (client-minted) stamps every event, span, and worker heartbeat
         this query causes — the handle ``metis-tpu report --trace``
         reconstructs one request's span tree from."""
+        return self._plan_query(model, config, top_k=top_k,
+                                workload=workload, trace_id=trace_id,
+                                encoded=False)
+
+    def plan_query_encoded(self, model: ModelSpec, config: SearchConfig,
+                           top_k: int | None = None,
+                           workload: InferenceWorkload | None = None,
+                           trace_id: str | None = None) -> bytes:
+        """:meth:`plan_query` returning the final serialized UTF-8
+        response body — the HTTP hot path.  A cache hit splices the
+        request tail (``cached``/``serve_ms``/``trace_id``) onto the
+        pre-encoded entry bytes the cache stored at ``put`` time, so the
+        per-hit cost is a byte concatenation, not a ``json.dumps`` of a
+        multi-kilobyte plan dump.  The bytes are identical to
+        ``json.dumps(plan_query(...))`` by construction (asserted in
+        tests/test_serve.py)."""
+        return self._plan_query(model, config, top_k=top_k,
+                                workload=workload, trace_id=trace_id,
+                                encoded=True)
+
+    def _plan_query(self, model: ModelSpec, config: SearchConfig,
+                    top_k: int | None = None,
+                    workload: InferenceWorkload | None = None,
+                    trace_id: str | None = None,
+                    encoded: bool = False):
         t_req = time.perf_counter()
         qfp = query_fingerprint(model, self.cluster, config,
                                 calibration=self.calibration,
@@ -492,8 +537,9 @@ class PlanService:
             ev.emit("plan_request", fingerprint=qfp,
                     model=model.name, gbs=config.gbs, top_k=top_k,
                     workload=kind)
-            entry = self.cache.get(key)
-            if entry is not None:
+            hit = self.cache.get_with_body(key)
+            if hit is not None:
+                entry, body = hit
                 ev.emit("plan_cache_hit", fingerprint=qfp)
                 span.set(cached=True)
                 # one cheap append: the hit's causal parent is the search
@@ -505,8 +551,8 @@ class PlanService:
                     query_fingerprint=qfp, trace_id=trace_id,
                     parent_seq=entry.get("decision_seq"),
                     total_ms=entry.get("best_cost_ms"))
-                return self._respond(entry, cached=True, t_req=t_req,
-                                     trace_id=trace_id)
+                return self._finish(entry, body, cached=True, t_req=t_req,
+                                    trace_id=trace_id, encoded=encoded)
             ev.emit("plan_cache_miss", fingerprint=qfp)
             span.set(cached=False)
             # a standby serves replicated cache hits but never searches —
@@ -526,8 +572,9 @@ class PlanService:
                     self.metrics.counter(
                         "metis_serve_coalesced_waits_total").inc()
                 waiter.wait(timeout=self.search_wait_s)
-                entry = self.cache.get(key)
-                if entry is not None:
+                hit = self.cache.get_with_body(key)
+                if hit is not None:
+                    entry, body = hit
                     self.metrics.histogram(
                         "metis_serve_coalesced_wait_ms").observe(
                         (time.perf_counter() - waited_since) * 1000)
@@ -539,8 +586,9 @@ class PlanService:
                         parent_seq=entry.get("decision_seq"),
                         total_ms=entry.get("best_cost_ms"),
                         detail={"coalesced": True})
-                    return self._respond(entry, cached=True, t_req=t_req,
-                                         trace_id=trace_id)
+                    return self._finish(entry, body, cached=True,
+                                        t_req=t_req, trace_id=trace_id,
+                                        encoded=encoded)
                 # leader failed or timed out — loop to become the leader
             try:
                 if workload is not None:
@@ -556,8 +604,8 @@ class PlanService:
                     done = self._inflight.pop(key, None)
                 if done is not None:
                     done.set()
-            return self._respond(entry, cached=False, t_req=t_req,
-                                 trace_id=trace_id)
+            return self._finish(entry, None, cached=False, t_req=t_req,
+                                trace_id=trace_id, encoded=encoded)
 
     def _search(self, qfp: str, key: str, model: ModelSpec,
                 config: SearchConfig, top_k: int | None,
@@ -570,19 +618,32 @@ class PlanService:
         queue_depth = self.metrics.gauge("metis_serve_queue_depth")
         queue_depth.inc()
         try:
-            with self._search_lock:
-                t0 = time.perf_counter()
-                # warm state only helps the serial path; workers>1 queries
-                # go through search/parallel.py's own per-worker shards
-                state = (self._state_for(qfp, model, config)
-                         if config.workers == 1 else None)
-                result = plan_hetero(self.cluster, self.profiles, model,
-                                     config, top_k=top_k, events=ev,
-                                     search_state=state,
-                                     metrics=self.metrics)
-                self.metrics.histogram(
-                    "metis_search_duration_seconds",
-                    kind="training").observe(time.perf_counter() - t0)
+            result = None
+            pool = self.search_pool
+            if pool is not None and getattr(config, "backend",
+                                            "beam") != "exact":
+                # resident worker pool: index-stride shards across warm
+                # processes, byte-identical ranking (serve/pool.py), and
+                # the daemon thread never holds _search_lock for the
+                # search itself.  Exact-backend queries stay serial — the
+                # certificate comes from the branch-and-bound driver.
+                result = self._pool_search(pool, qfp, model, config,
+                                           top_k, ev)
+            if result is None:
+                with self._search_lock:
+                    t0 = time.perf_counter()
+                    # warm state only helps the serial path; workers>1
+                    # queries go through search/parallel.py's own
+                    # per-worker shards
+                    state = (self._state_for(qfp, model, config)
+                             if config.workers == 1 else None)
+                    result = plan_hetero(self.cluster, self.profiles,
+                                         model, config, top_k=top_k,
+                                         events=ev, search_state=state,
+                                         metrics=self.metrics)
+                    self.metrics.histogram(
+                        "metis_search_duration_seconds",
+                        kind="training").observe(time.perf_counter() - t0)
         finally:
             queue_depth.dec()
         best = result.best
@@ -645,6 +706,75 @@ class PlanService:
         self.cache.put(key, entry)
         self._log_plan_insert(key, entry)
         return entry
+
+    def _pool_search(self, pool: SearchWorkerPool, qfp: str,
+                     model: ModelSpec, config: SearchConfig,
+                     top_k: int | None, ev: EventLog):
+        """Run one training search on the resident worker pool; returns a
+        ``PlannerResult`` identical to the serial path's, or None to fall
+        back (worker death, timeout, unpicklable inputs).
+
+        The search itself runs lock-free in the pool; only the short
+        explain pass (breakdowns for the top-k, via the parent's warm
+        state) takes ``_search_lock``.  The workers' ``touched_nodes`` /
+        ``tagged_candidates`` merge into the parent state so
+        ``apply_cluster_delta``'s incremental keep/drop pivot still sees
+        which fleet nodes this query's candidates priced against."""
+        from metis_tpu.planner.api import DEFAULT_EXPLAIN_K, PlannerResult
+        t0 = time.perf_counter()
+        try:
+            out = pool.search(qfp, self.cluster, model, config, top_k,
+                              self._full_node_ids(self.cluster), events=ev)
+        except SearchPoolError as e:
+            self.counters.inc("serve.pool_fallback")
+            ev.emit("parallel_fallback", reason=f"search pool: {e}")
+            return None
+        self.counters.inc("serve.pool_search")
+        if out.warm:
+            self.counters.inc("serve.pool_warm_hit")
+        if out.counters:
+            self.counters.merge(out.counters)
+        results = list(out.plans)
+        explain_k = min(len(results),
+                        top_k if top_k is not None else DEFAULT_EXPLAIN_K)
+        with self._search_lock:
+            state = self._state_for(qfp, model, config)
+            state.touched_nodes |= set(out.touched_nodes)
+            state.tagged_candidates = max(state.tagged_candidates,
+                                          out.tagged_candidates)
+            for i in range(explain_k):
+                rp = results[i]
+                try:
+                    _, bd = state.estimator.get_breakdown(
+                        rp.inter, rp.intra.strategies,
+                        rp.intra.layer_partition,
+                        schedule=rp.intra.schedule,
+                        virtual_stages=rp.intra.virtual_stages)
+                except KeyError:  # pragma: no cover - costed once already
+                    continue
+                results[i] = dataclasses.replace(rp, breakdown=bd)
+                ev.emit(
+                    "plan_explain", rank=i + 1,
+                    fingerprint=fingerprint_ranked_plan(rp),
+                    total_ms=round(bd.total_ms, 4),
+                    components={k: round(v, 4)
+                                for k, v in bd.components.items()},
+                    schedule=rp.intra.schedule)
+        elapsed = time.perf_counter() - t0
+        self.metrics.histogram(
+            "metis_search_duration_seconds",
+            kind="training").observe(elapsed)
+        ev.emit(
+            "search_finished", mode="hetero", num_costed=out.num_costed,
+            num_pruned=out.num_pruned, seconds=round(elapsed, 4),
+            best_cost_ms=(results[0].cost.total_ms if results else None),
+            num_bound_pruned=out.num_bound_pruned,
+            workers=pool.num_workers)
+        return PlannerResult(
+            plans=tuple(results), num_costed=out.num_costed,
+            num_pruned=out.num_pruned,
+            search_seconds=out.search_seconds,
+            num_bound_pruned=out.num_bound_pruned)
 
     def _search_inference(self, qfp: str, key: str, model: ModelSpec,
                           config: SearchConfig,
@@ -755,6 +885,35 @@ class PlanService:
             # to `metis-tpu report --trace`
             out["trace_id"] = trace_id
         return out
+
+    @classmethod
+    def _finish(cls, entry: dict, body: bytes | None, *, cached: bool,
+                t_req: float, trace_id: str | None,
+                encoded: bool):
+        """Render the response: a dict (classic API) or the final UTF-8
+        body bytes (HTTP hot path).  The encoded hit path splices the
+        per-request tail onto the cache's pre-encoded entry bytes —
+        ``json.dumps(entry)[:-1] + ", " + json.dumps(tail)[1:]`` is
+        byte-identical to ``json.dumps({**entry, **tail})`` under the
+        default separators, because the tail keys (``cached``,
+        ``serve_ms``, ``trace_id``) never occur in a cache entry and
+        ``dict`` preserves insertion order."""
+        if not encoded:
+            return cls._respond(entry, cached=cached, t_req=t_req,
+                                trace_id=trace_id)
+        if body is None or len(body) < 3:
+            # no pre-encoded form (fresh search, or an unserializable
+            # payload): one dumps, exactly what the handler used to pay
+            return json.dumps(cls._respond(
+                entry, cached=cached, t_req=t_req,
+                trace_id=trace_id)).encode("utf-8")
+        tail: dict[str, Any] = {
+            "cached": cached,
+            "serve_ms": round((time.perf_counter() - t_req) * 1000, 3),
+        }
+        if trace_id is not None:
+            tail["trace_id"] = trace_id
+        return body[:-1] + b", " + json.dumps(tail).encode("utf-8")[1:]
 
     # -- accuracy + drift ---------------------------------------------------
     def post_accuracy_sample(self, fingerprint: str, measured_ms: float,
@@ -1511,6 +1670,8 @@ class PlanService:
             self.snapshot_now()
         except Exception:  # pragma: no cover - best-effort on shutdown
             self.counters.inc("serve.snapshot_errors")
+        if self.search_pool is not None:
+            self.search_pool.close()
         if self._oplog is not None:
             self._oplog.close()
         # flush + release the durable decision-log handle; a restarted
@@ -1583,6 +1744,8 @@ class PlanService:
             "cache": self.cache.stats(),
             "counters": self.counters.as_dict(),
             "warm_states": len(self._states),
+            "search_pool_workers": (self.search_pool.num_workers
+                                    if self.search_pool is not None else 0),
             "monitors": len(self._monitors),
             "queries": len(self._queries),
             "note_seq": self._note_seq,
@@ -1615,6 +1778,31 @@ _KNOWN_ENDPOINTS = {
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "metis-serve/1"
+    # HTTP/1.1 => persistent connections by default.  Safe because every
+    # response path below goes through _send, which always sets an exact
+    # Content-Length (no chunked framing, no implicit close).  A client
+    # that pools its socket skips the TCP+accept handshake per request —
+    # the single biggest fixed cost on the cached-hit path.
+    protocol_version = "HTTP/1.1"
+    # idle keep-alive bound: StreamRequestHandler puts this on the socket,
+    # and handle_one_request turns a timed-out wait-for-next-request into
+    # close_connection, so an abandoned client frees its handler thread
+    # instead of parking it forever
+    timeout = 30.0
+    # buffer the whole response and flush once per request
+    # (handle_one_request's trailing flush): headers + body leave in ONE
+    # segment.  Unbuffered writes on a reused connection trip Nagle +
+    # delayed-ACK — the body segment waits ~40ms for the peer's ACK of
+    # the header segment, which would swamp a ~1ms cached hit.
+    wbufsize = -1
+
+    def setup(self) -> None:
+        super().setup()
+        try:
+            self.connection.setsockopt(socket.IPPROTO_TCP,
+                                       socket.TCP_NODELAY, True)
+        except OSError:  # AF_UNIX has no Nagle to disable
+            pass
 
     # quiet by default (the daemon's story is the events JSONL, not stderr)
     def log_message(self, format: str, *args: Any) -> None:
@@ -1630,25 +1818,38 @@ class _Handler(BaseHTTPRequestHandler):
     def service(self) -> PlanService:
         return self.server.service  # type: ignore[attr-defined]
 
-    def _json(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
-        self._status = code
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _text(self, code: int, text: str,
-              content_type: str = "text/plain; version=0.0.4; "
-                                  "charset=utf-8") -> None:
-        body = text.encode()
+    def _send(self, code: int, body: bytes,
+              content_type: str = "application/json") -> None:
+        """Single response-writing chokepoint: exact Content-Length
+        always, and an HONEST ``Connection`` header — when the worker
+        pool has a backlog, the connection is closed after this response
+        (and says so) so a stalled client cannot park a pooled thread
+        while accepted-but-unserved sockets wait in the queue."""
         self._status = code
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if not self.close_connection:
+            backlog = getattr(self.server, "pool_backlog_size", None)
+            if backlog is not None and backlog() > 0:
+                self.close_connection = True
+        if self.close_connection:
+            self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
+
+    def _json(self, code: int, payload: dict) -> None:
+        self._send(code, json.dumps(payload).encode())
+
+    def _raw_json(self, code: int, body: bytes) -> None:
+        """Pre-encoded JSON straight to the socket — the zero-copy leg of
+        the cached /plan hit (PlanService.plan_query_encoded)."""
+        self._send(code, body)
+
+    def _text(self, code: int, text: str,
+              content_type: str = "text/plain; version=0.0.4; "
+                                  "charset=utf-8") -> None:
+        self._send(code, text.encode(), content_type)
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -1669,6 +1870,11 @@ class _Handler(BaseHTTPRequestHandler):
         endpoint = (path.lstrip("/") if path in _KNOWN_ENDPOINTS
                     else "other")
         self._status = 200
+        # handler instances persist for the lifetime of one connection,
+        # so a per-instance request count measures keep-alive reuse
+        self._reqs_on_conn = getattr(self, "_reqs_on_conn", 0) + 1
+        if self._reqs_on_conn > 1:
+            m.counter("metis_serve_keepalive_reuse_total").inc()
         m.gauge("metis_serve_inflight_requests").inc()
         t0 = time.perf_counter()
         try:
@@ -1755,12 +1961,12 @@ class _Handler(BaseHTTPRequestHandler):
                 config = search_config_from_dict(body["config"])
                 top_k = body.get("top_k")
                 wl = body.get("workload")
-                out = self.service.plan_query(
+                out = self.service.plan_query_encoded(
                     model, config,
                     top_k=int(top_k) if top_k is not None else None,
                     workload=workload_from_dict(wl) if wl else None,
                     trace_id=trace_id)
-                self._json(200, out)
+                self._raw_json(200, out)
             elif self.path == "/tenant":
                 out = self.service.tenant_register(tenant_from_dict(body))
                 self._json(200, out)
@@ -1827,21 +2033,121 @@ class _ServiceShutdownMixin:
         super().shutdown()
 
 
-class _TCPServer(_ServiceShutdownMixin, ThreadingHTTPServer):
+class _WorkerPoolMixin:
+    """Bounded worker-thread pool in place of ThreadingMixIn's
+    thread-per-connection.
+
+    Under keep-alive, a connection IS a long-lived unit of work (one
+    handler thread serves it until it closes), so unbounded spawning
+    turns a connection flood into a thread flood.  Here ``accept`` stays
+    cheap: ``process_request`` enqueues the connection on a bounded
+    queue; ``pool_threads`` resident workers drain it.  When pool AND
+    backlog are both full, the server sheds load honestly — a raw
+    ``503`` with ``Retry-After: 1`` and ``Connection: close`` written
+    straight to the socket — instead of accepting work it cannot start.
+    """
+
+    pool_threads = 64
+    pool_backlog = 128
+
+    def init_pool(self, threads: int | None = None) -> None:
+        """Start the workers.  Call AFTER ``server.service`` is set (the
+        pool metrics live in the service's registry); ``make_server``
+        does this."""
+        if threads is not None and threads >= 1:
+            self.pool_threads = int(threads)
+        m = self.service.metrics
+        self._task_q: queue.Queue = queue.Queue(self.pool_backlog)
+        self._backlog_gauge = m.gauge("metis_serve_pool_backlog")
+        self._busy_gauge = m.gauge("metis_serve_pool_busy_threads")
+        self._wait_hist = m.histogram("metis_serve_pool_queue_wait_ms")
+        self._overload_counter = m.counter("metis_serve_overload_total")
+        m.gauge("metis_serve_pool_threads").set(self.pool_threads)
+        for i in range(self.pool_threads):
+            threading.Thread(target=self._worker_loop,
+                             name=f"metis-serve-worker-{i}",
+                             daemon=True).start()
+
+    def pool_backlog_size(self) -> int:
+        q = getattr(self, "_task_q", None)
+        return q.qsize() if q is not None else 0
+
+    def process_request(self, request, client_address) -> None:
+        q = getattr(self, "_task_q", None)
+        if q is None:  # pool never initialised: serve inline (tests)
+            super().process_request(request, client_address)
+            return
+        try:
+            q.put_nowait((request, client_address, time.perf_counter()))
+        except queue.Full:
+            self._reject_overload(request)
+            return
+        self._backlog_gauge.set(q.qsize())
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self._task_q.get()
+            if item is None:
+                return
+            request, client_address, t_enq = item
+            self._wait_hist.observe(
+                (time.perf_counter() - t_enq) * 1000)
+            self._backlog_gauge.set(self._task_q.qsize())
+            self._busy_gauge.inc()
+            try:
+                self.finish_request(request, client_address)
+            except Exception:
+                self.handle_error(request, client_address)
+            finally:
+                self._busy_gauge.dec()
+                self.shutdown_request(request)
+
+    def _reject_overload(self, request) -> None:
+        """Every worker busy and the backlog full: answer 503 without a
+        handler (there is no thread to run one) and close."""
+        body = (b'{"error": "server overloaded: worker pool and backlog'
+                b' full", "retry_after_s": 1}')
+        head = (b"HTTP/1.1 503 Service Unavailable\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() +
+                b"\r\nRetry-After: 1\r\nConnection: close\r\n\r\n")
+        try:
+            request.sendall(head + body)
+        except OSError:  # peer already gone — shedding still succeeded
+            pass
+        self._overload_counter.inc()
+        service = getattr(self, "service", None)
+        if service is not None:
+            service.counters.inc("serve.overload")
+            service.events.emit("serve_overload",
+                                backlog=self.pool_backlog,
+                                threads=self.pool_threads)
+        self.shutdown_request(request)
+
+    def server_close(self) -> None:
+        q = getattr(self, "_task_q", None)
+        if q is not None:
+            for _ in range(self.pool_threads):
+                try:
+                    q.put_nowait(None)
+                except queue.Full:  # workers are daemons; best-effort
+                    break
+        super().server_close()
+
+
+class _TCPServer(_WorkerPoolMixin, _ServiceShutdownMixin, HTTPServer):
     """Loopback TCP server tuned for bursty local clients: the default
     listen backlog of 5 resets connections the moment 64 threads connect
     at once, which the smoke tool's concurrency contract forbids."""
 
     request_queue_size = 128
-    daemon_threads = True
 
 
-class _UnixHTTPServer(_ServiceShutdownMixin, ThreadingHTTPServer):
-    """ThreadingHTTPServer over an AF_UNIX socket path."""
+class _UnixHTTPServer(_WorkerPoolMixin, _ServiceShutdownMixin, HTTPServer):
+    """Pool-backed HTTP server over an AF_UNIX socket path."""
 
     address_family = socket.AF_UNIX
     request_queue_size = 128
-    daemon_threads = True
 
     def __init__(self, path: str, handler) -> None:
         self._socket_path = path
@@ -1865,9 +2171,12 @@ class _UnixHTTPServer(_ServiceShutdownMixin, ThreadingHTTPServer):
 
 
 def make_server(service: PlanService, host: str = "127.0.0.1",
-                port: int = 0, socket_path: str | Path | None = None):
+                port: int = 0, socket_path: str | Path | None = None,
+                threads: int | None = None):
     """Bound, ready-to-serve HTTP server; ``server.address`` is the
-    client-facing address string (``http://...`` or ``unix:...``)."""
+    client-facing address string (``http://...`` or ``unix:...``).
+    ``threads`` sizes the handler worker pool (default
+    ``_WorkerPoolMixin.pool_threads``)."""
     if socket_path is not None:
         server = _UnixHTTPServer(str(socket_path), _Handler)
         server.address = f"unix:{socket_path}"
@@ -1876,11 +2185,13 @@ def make_server(service: PlanService, host: str = "127.0.0.1",
         bound_host, bound_port = server.server_address[:2]
         server.address = f"http://{bound_host}:{bound_port}"
     server.service = service
+    server.init_pool(threads)
     return server
 
 
 def serve_in_thread(service: PlanService, host: str = "127.0.0.1",
-                    port: int = 0, socket_path: str | Path | None = None):
+                    port: int = 0, socket_path: str | Path | None = None,
+                    threads: int | None = None):
     """Start serving on a background thread.
 
     Returns ``(server, thread, address)`` — the in-process boot path the
@@ -1888,7 +2199,7 @@ def serve_in_thread(service: PlanService, host: str = "127.0.0.1",
     ``server.shutdown()``) ends the thread; then ``server.server_close()``.
     """
     server = make_server(service, host=host, port=port,
-                         socket_path=socket_path)
+                         socket_path=socket_path, threads=threads)
     thread = threading.Thread(target=server.serve_forever,
                               name="metis-serve", daemon=True)
     thread.start()

@@ -19,6 +19,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 import pytest
@@ -564,3 +565,323 @@ def test_serve_smoke_unix_socket(tmp_path):
                     unix_socket=True, work_dir=tmp_path)
     assert out["ok"] is True
     assert out["address"].startswith("unix:")
+
+
+# ---------------------------------------------------------------------------
+# sharded cache invariants
+# ---------------------------------------------------------------------------
+
+
+class TestPlanCacheSharding:
+    def test_shard_counters_reconcile_with_global(self):
+        """sum(per-shard hits/misses) == the serve.cache.* counters —
+        the reconciliation invariant /stats consumers depend on."""
+        c = Counters()
+        cache = PlanCache(capacity=64, counters=c, shards=4)
+        for i in range(32):
+            cache.put(f"k{i}", {"v": i})
+        for i in range(32):
+            assert cache.get(f"k{i}") == {"v": i}
+        for i in range(10):
+            assert cache.get(f"absent{i}") is None
+        stats = cache.shard_stats()
+        assert len(stats) == 4
+        assert sum(s["hits"] for s in stats) == c.get("serve.cache.hit")
+        assert sum(s["misses"] for s in stats) == \
+            c.get("serve.cache.miss")
+        assert sum(s["size"] for s in stats) == len(cache) == 32
+        # keys spread over more than one shard (crc32 on this keyset)
+        assert sum(1 for s in stats if s["size"]) > 1
+
+    def test_global_lru_bound_under_concurrent_fill(self):
+        """8 threads racing puts through different shards must never
+        leave the cache over its GLOBAL capacity."""
+        import threading as _threading
+
+        c = Counters()
+        cache = PlanCache(capacity=32, counters=c, shards=4)
+
+        def _fill(tid: int) -> None:
+            for i in range(100):
+                cache.put(f"t{tid}-k{i}", {"t": tid, "i": i})
+                cache.get(f"t{tid}-k{i % 7}")
+
+        threads = [_threading.Thread(target=_fill, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(cache) <= 32
+        assert sum(s["size"] for s in cache.shard_stats()) == len(cache)
+        # accounting reconciles after the race: inserts - evictions -
+        # invalidations == residents
+        assert 8 * 100 - c.get("serve.cache.evict") == len(cache)
+
+    def test_invalidate_where_visits_every_shard(self):
+        cache = PlanCache(capacity=256, counters=Counters(), shards=8)
+        for i in range(64):
+            cache.put(f"k{i}", {"doomed": i % 2 == 0})
+        populated = sum(1 for s in cache.shard_stats() if s["size"])
+        assert populated == 8  # every shard holds keys on this keyset
+        dropped = cache.invalidate_where(lambda _k, v: v["doomed"])
+        assert len(dropped) == 32
+        assert len(cache) == 32
+        for k in dropped:
+            assert k not in cache
+
+    def test_single_shard_export_matches_pre_shard_semantics(self):
+        """shards=1 must dump byte-identically to the pre-shard cache:
+        items()/keys() in exact LRU order for the same op sequence, and
+        any shard count reproduces the same global order."""
+        def _ops(cache):
+            for i in range(6):
+                cache.put(f"k{i}", {"v": i})
+            cache.get("k1")
+            cache.put("k2", {"v": 22})  # refresh
+            cache.get("k0")
+            cache.invalidate("k3")
+            return cache
+
+        # the pre-shard implementation was one OrderedDict with
+        # move_to_end on access — its export order for this op sequence:
+        expected_keys = ["k4", "k5", "k1", "k2", "k0"]
+        one = _ops(PlanCache(capacity=16, shards=1))
+        assert one.keys() == expected_keys
+        dump_one = json.dumps(one.items())
+        many = _ops(PlanCache(capacity=16, shards=4))
+        assert json.dumps(many.items()) == dump_one
+        # restore round-trip: re-putting the export into a different
+        # shard count reproduces contents AND eviction order
+        restored = PlanCache(capacity=16, shards=2)
+        for k, payload in one.items():
+            restored.put(k, payload)
+        assert restored.keys() == expected_keys
+        assert json.dumps(restored.items()) == dump_one
+
+    def test_get_with_body_pre_encoded_bytes(self):
+        cache = PlanCache(capacity=4, counters=Counters())
+        payload = {"plans": "x" * 50, "best_cost_ms": 1.25}
+        cache.put("a", payload)
+        got, body = cache.get_with_body("a")
+        assert got == payload
+        assert body == json.dumps(payload).encode("utf-8")
+        # unserializable payloads carry no body; the parsed form works
+        cache.put("b", {"bad": object()})
+        got_b, body_b = cache.get_with_body("b")
+        assert body_b is None and got_b["bad"] is not None
+
+    def test_rejects_nonpositive_shards(self):
+        with pytest.raises(ValueError):
+            PlanCache(capacity=4, shards=0)
+
+
+# ---------------------------------------------------------------------------
+# zero-copy encoded responses
+# ---------------------------------------------------------------------------
+
+
+class TestEncodedResponses:
+    def test_encoded_hit_byte_identical_to_dumps_of_plan_query(
+            self, small_workload, service):
+        """The spliced cache-hit bytes must be EXACTLY what
+        ``json.dumps(plan_query(...))`` would have produced — proven by
+        round-tripping: parse the bytes, re-dumps, compare bytes (key
+        order and float repr both survive), then compare the parsed dict
+        field-by-field against the classic path modulo serve_ms."""
+        _, _, model, config = small_workload
+        cold_bytes = service.plan_query_encoded(model, config, top_k=5)
+        cold = json.loads(cold_bytes)
+        assert cold["cached"] is False
+        assert json.dumps(cold).encode("utf-8") == cold_bytes
+
+        hit_bytes = service.plan_query_encoded(model, config, top_k=5)
+        hit = json.loads(hit_bytes)
+        assert hit["cached"] is True
+        assert json.dumps(hit).encode("utf-8") == hit_bytes
+
+        plain = service.plan_query(model, config, top_k=5)
+        assert set(plain) == set(hit)
+        for k in plain:
+            if k != "serve_ms":
+                assert plain[k] == hit[k], f"field {k} differs"
+
+    def test_encoded_with_trace_id_and_tail_order(self, small_workload,
+                                                  service):
+        _, _, model, config = small_workload
+        service.plan_query(model, config, top_k=5)  # prime
+        body = service.plan_query_encoded(model, config, top_k=5,
+                                          trace_id="t-123")
+        parsed = json.loads(body)
+        assert parsed["trace_id"] == "t-123"
+        assert json.dumps(parsed).encode("utf-8") == body
+        # tail keys land last, in insertion order, like _respond's dict
+        assert list(parsed)[-3:] == ["cached", "serve_ms", "trace_id"]
+
+    def test_tail_keys_never_collide_with_entries(self, small_workload,
+                                                  service):
+        """The splice is only sound while cache entries never contain the
+        tail keys — pin that invariant on a real entry."""
+        _, _, model, config = small_workload
+        service.plan_query(model, config, top_k=5)
+        key = next(iter(service.cache.keys()))
+        entry = service.cache.get(key)
+        assert not {"cached", "serve_ms", "trace_id"} & set(entry)
+
+
+# ---------------------------------------------------------------------------
+# keep-alive transport + bounded worker pool
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def http_service(small_workload):
+    """Service + live TCP server; yields (service, server, host, port)."""
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+
+    cluster, profiles, _model, _config = small_workload
+    service = PlanService(cluster, profiles, drift_min_samples=5)
+    server, thread, address = serve_in_thread(service)
+    host, port = address[len("http://"):].rsplit(":", 1)
+    yield service, server, host, int(port)
+    server.shutdown()
+    thread.join(10)
+    server.server_close()
+
+
+class TestKeepAliveTransport:
+    def test_connection_reuse_over_one_socket(self, http_service):
+        import http.client as hc
+
+        service, _server, host, port = http_service
+        conn = hc.HTTPConnection(host, port, timeout=10)
+        try:
+            for i in range(3):
+                conn.request("GET", "/stats")
+                resp = conn.getresponse()
+                body = resp.read()
+                assert resp.status == 200
+                assert not resp.will_close, (
+                    f"server closed the keep-alive connection on "
+                    f"request {i + 1}")
+                assert json.loads(body)["cluster_devices"] == 8
+        finally:
+            conn.close()
+        reuse = service.metrics.counter(
+            "metis_serve_keepalive_reuse_total").value
+        assert reuse >= 2
+
+    def test_pool_metrics_exported(self, http_service):
+        service, server, _host, _port = http_service
+        text = service.render_metrics()
+        assert f"metis_serve_pool_threads {server.pool_threads}" in text
+        assert "metis_serve_pool_backlog" in text
+
+    def test_overload_sheds_with_503_retry_after(self, small_workload):
+        """threads=1 + backlog=1: with the lone worker parked on a
+        long-poll, the next connection queues and the one after that
+        must get an immediate 503 + Retry-After + Connection: close."""
+        import http.client as hc
+
+        from metis_tpu.serve.daemon import (_Handler, _TCPServer,
+                                            PlanService)
+
+        cluster, profiles, _model, _config = small_workload
+        service = PlanService(cluster, profiles)
+        server = _TCPServer(("127.0.0.1", 0), _Handler)
+        server.service = service
+        server.pool_backlog = 1
+        server.init_pool(threads=1)
+        host, port = server.server_address[:2]
+        import threading as _threading
+        thread = _threading.Thread(target=server.serve_forever,
+                                   daemon=True)
+        thread.start()
+        conns = []
+        try:
+            # park the only worker on a long-poll
+            busy = hc.HTTPConnection(host, port, timeout=30)
+            conns.append(busy)
+            busy.request("GET", "/notifications?timeout=8")
+            time.sleep(0.3)  # let the worker pick it up
+            # fill the backlog (accepted, never served while parked)
+            filler = hc.HTTPConnection(host, port, timeout=30)
+            conns.append(filler)
+            filler.request("GET", "/stats")
+            time.sleep(0.2)
+            # overload: must be shed, not queued
+            shed = hc.HTTPConnection(host, port, timeout=10)
+            conns.append(shed)
+            shed.request("GET", "/stats")
+            resp = shed.getresponse()
+            assert resp.status == 503
+            assert resp.getheader("Retry-After") == "1"
+            assert resp.getheader("Connection") == "close"
+            body = json.loads(resp.read())
+            assert "overloaded" in body["error"]
+            assert service.counters.get("serve.overload") >= 1
+            assert service.metrics.counter(
+                "metis_serve_overload_total").value >= 1
+        finally:
+            for c in conns:
+                c.close()
+            server.shutdown()
+            thread.join(10)
+            server.server_close()
+
+
+class TestClientConnectionPool:
+    def test_pool_reuses_sockets(self, http_service):
+        from metis_tpu.serve.client import PlanServiceClient
+
+        _service, _server, host, port = http_service
+        with PlanServiceClient(f"http://{host}:{port}") as client:
+            for _ in range(4):
+                client.stats()
+            ps = client.pool_stats()
+            assert ps["opened"] == 1
+            assert ps["reused"] == 3
+            assert ps["idle"] == 1
+
+    def test_reconnects_when_pooled_socket_dies(self, http_service):
+        """A pooled socket the daemon closed between requests must be
+        retried transparently on a fresh connection (idempotent
+        endpoints), not surfaced as an error."""
+        import socket as _socket
+
+        from metis_tpu.serve.client import PlanServiceClient
+
+        _service, _server, host, port = http_service
+        with PlanServiceClient(f"http://{host}:{port}") as client:
+            client.stats()
+            # simulate a server-side idle close of the pooled socket
+            with client._pool_lock:
+                stale = client._idle[0][0]
+            stale.sock.shutdown(_socket.SHUT_RDWR)
+            out = client.stats()
+            assert out["cluster_devices"] == 8
+            assert client.pool_stats()["opened"] == 2
+
+    def test_long_poll_and_monitoring_get_dedicated_sockets(
+            self, http_service):
+        from metis_tpu.serve.client import PlanServiceClient
+
+        _service, _server, host, port = http_service
+        with PlanServiceClient(f"http://{host}:{port}") as client:
+            assert client.healthz()["live"] is True
+            assert "metis_serve_requests_total" in client.metrics()
+            client.notifications(since=0, timeout_s=0.0)
+            # none of those went through (or into) the pool
+            ps = client.pool_stats()
+            assert ps == {"opened": 0, "reused": 0, "idle": 0}
+
+    def test_pooling_can_be_disabled(self, http_service):
+        from metis_tpu.serve.client import PlanServiceClient
+
+        _service, _server, host, port = http_service
+        client = PlanServiceClient(f"http://{host}:{port}",
+                                   pool_connections=False)
+        client.stats()
+        client.stats()
+        assert client.pool_stats() == {"opened": 0, "reused": 0,
+                                       "idle": 0}

@@ -1,0 +1,83 @@
+"""Tier-1 wiring for the closed-loop serve load generator
+(tools/serve_load.py): a short multi-process storm must complete with
+zero errors and zero byte-identity mismatches, and the qps baseline gate
+must either pass or skip with an honest reason — never crash, never
+silently pass on a host that cannot support the comparison."""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tools.serve_load import (
+    BASELINE_PATH,
+    GATE_FRACTION,
+    MIN_GATE_CORES,
+    gate_against_baseline,
+    run_load,
+)
+
+
+@pytest.fixture(scope="module")
+def load_result(tmp_path_factory):
+    try:
+        return run_load(procs=2, duration_s=1.5,
+                        work_dir=tmp_path_factory.mktemp("serve-load"))
+    except RuntimeError as e:  # no mp start method on this platform
+        pytest.skip(str(e))
+
+
+def test_load_generator_correctness(load_result):
+    out = load_result
+    assert out["requests"] > 0, "closed loop made no requests"
+    assert out["errors"] == 0, f"{out['errors']} request errors under load"
+    assert out["mismatches"] == 0, (
+        f"{out['mismatches']} byte-identity mismatches under load")
+    assert out["qps"] > 0
+
+
+def test_load_generator_keepalive(load_result):
+    # the storm must actually ride keep-alive connections: the client
+    # pools report reuse AND the server's reuse counter agrees
+    assert load_result["connections_reused"] > 0
+    assert load_result["server_keepalive_reuse"] > 0
+    # closed loop over pooled connections opens ~1 socket per worker,
+    # not one per request
+    assert load_result["connections_opened"] < \
+        load_result["requests"] / 2
+
+
+def test_qps_gate_is_honest(load_result):
+    gate = gate_against_baseline(load_result, BASELINE_PATH)
+    cores = os.cpu_count() or 1
+    if cores < MIN_GATE_CORES:
+        assert "skipped_reason" in gate
+        assert str(cores) in gate["skipped_reason"]
+    else:
+        baseline = json.loads(BASELINE_PATH.read_text())
+        if baseline.get("cores", 0) < MIN_GATE_CORES:
+            # baseline from a small host: comparison must refuse itself
+            assert "skipped_reason" in gate
+        else:
+            assert gate["floor_qps"] == round(
+                GATE_FRACTION * baseline["qps"], 1)
+            assert gate["ok"], (
+                f"serve qps {gate['qps']} below {gate['floor_qps']} "
+                f"(80% of baseline {gate['baseline_qps']})")
+
+
+def test_gate_skips_without_baseline(tmp_path):
+    gate = gate_against_baseline(
+        {"qps": 1.0, "cores": 64}, tmp_path / "missing.json")
+    assert "skipped_reason" in gate
+
+
+def test_gate_fails_on_regression(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"qps": 10000.0, "cores": 8}))
+    gate = gate_against_baseline({"qps": 7000.0, "cores": 8}, path)
+    assert gate == {"ok": False, "qps": 7000.0, "baseline_qps": 10000.0,
+                    "floor_qps": 8000.0, "baseline_cores": 8}
+    gate = gate_against_baseline({"qps": 9500.0, "cores": 8}, path)
+    assert gate["ok"] is True

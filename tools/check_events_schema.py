@@ -32,8 +32,14 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # the integer ``worker`` id that emitted it; serial heartbeats omit it
     "search_progress": {"n", "elapsed_s"},
     # parallel search fell back to the serial loop (search/parallel.py):
-    # unpicklable inputs, no start method, or a worker failure
+    # unpicklable inputs, no start method, or a worker failure — also
+    # emitted by the daemon when its resident search pool fails a query
+    # (serve/pool.py) and the serial path answers instead
     "parallel_fallback": {"reason"},
+    # the serve transport shed a connection with 503 + Retry-After
+    # because the handler worker pool and its backlog were both full
+    # (serve/daemon.py _WorkerPoolMixin)
+    "serve_overload": {"backlog", "threads"},
     "counters": {"scope", "counters"},
     "span_begin": {"name", "span_id", "path"},
     "span_end": {"name", "span_id", "path", "dur_ms"},

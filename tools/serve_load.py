@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Closed-loop multi-process load generator for the serve daemon.
+
+Boots ``PlanService`` + HTTP server in-process on loopback TCP, primes
+the plan cache with one cold query, then forks ``--procs`` worker
+processes that each run a CLOSED loop of cached ``POST /plan`` queries
+over keep-alive connections (``PlanServiceClient`` pools its sockets) —
+one request in flight per worker, the next sent the moment the previous
+response is fully read.  Closed-loop offered load equals served load, so
+``qps = total_requests / duration`` is an honest throughput number, not
+an arrival-rate fiction.
+
+Every worker re-verifies byte-identity: each response's ``plans`` string
+is hashed and compared against the cold answer, so a framing or
+zero-copy-splice bug under load is a counted mismatch, not a silent
+corruption.
+
+Baseline gate (``tools/serve_qps_baseline.json``, checked in):
+
+* ``--update-baseline`` re-records {qps, cores, procs} for this host.
+* On a comparable host (>= 4 cores here AND in the baseline), measured
+  qps below 80% of baseline fails (exit 1) — the serve hot path
+  regressed.
+* On smaller hosts the gate SKIPS with an honest ``skipped_reason``
+  (a 1-core container cannot reproduce a multicore qps number), while
+  the correctness checks (zero errors, zero mismatches) still apply.
+
+Usage:  python tools/serve_load.py [--procs N] [--duration S] [--json]
+                                   [--update-baseline]
+Also importable: ``run_load(...) -> dict`` and
+``gate_against_baseline(result, path) -> dict``
+(tests/test_serve_perf.py runs both; bench.py's serve section reuses
+``run_load`` for its keep-alive qps row).
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BASELINE_PATH = Path(__file__).resolve().parent / "serve_qps_baseline.json"
+MIN_GATE_CORES = 4
+GATE_FRACTION = 0.8
+# per-worker latency samples shipped back to the parent (bounds queue
+# payload; the percentile estimate is over min(requests, this) samples)
+MAX_SAMPLES = 5000
+
+
+def _plans_digest(plans) -> str:
+    blob = (plans.encode() if isinstance(plans, str)
+            else json.dumps(plans).encode())
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _load_worker(worker_id: int, address: str, model, config, top_k: int,
+                 expected_digest: str, deadline_wall: float,
+                 out_q) -> None:
+    """One closed loop: request, read fully, verify, repeat until the
+    wall deadline.  Runs in a child process; ships aggregates home."""
+    from metis_tpu.serve.client import PlanServiceClient
+
+    client = PlanServiceClient(address)
+    count = errors = mismatches = 0
+    lats: list[float] = []
+    try:
+        while time.time() < deadline_wall:
+            t0 = time.perf_counter()
+            try:
+                resp = client.plan(model, config, top_k=top_k)
+            except Exception:
+                errors += 1
+                continue
+            if len(lats) < MAX_SAMPLES:
+                lats.append((time.perf_counter() - t0) * 1e3)
+            count += 1
+            if _plans_digest(resp["plans"]) != expected_digest:
+                mismatches += 1
+        stats = client.pool_stats()
+        out_q.put((worker_id, count, errors, mismatches, lats,
+                   stats["reused"], stats["opened"]))
+    finally:
+        client.close()
+
+
+def run_load(procs: int | None = None, duration_s: float = 3.0,
+             serve_threads: int | None = None,
+             cache_shards: int = 4,
+             work_dir: str | Path | None = None) -> dict:
+    """Boot the daemon, run the closed-loop storm, return measurements.
+
+    Raises RuntimeError when no multiprocessing start method is
+    available (the generator is multi-process by contract — a threaded
+    fallback would measure the GIL, not the daemon)."""
+    from metis_tpu.search.parallel import _mp_context
+    from metis_tpu.serve.client import PlanServiceClient
+    from metis_tpu.serve.daemon import PlanService, serve_in_thread
+    from tools.serve_smoke import SMOKE_TOP_K, parity_inputs
+
+    ctx = _mp_context()
+    if ctx is None:
+        raise RuntimeError("no multiprocessing start method available")
+    cores = os.cpu_count() or 1
+    if procs is None:
+        procs = max(2, min(8, cores))
+    own_tmp = None
+    if work_dir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="metis-serve-load-")
+        work_dir = own_tmp.name
+    out: dict = {"procs": procs, "cores": cores,
+                 "duration_s": duration_s}
+    try:
+        cluster, profiles, model, config = parity_inputs(work_dir)
+        service = PlanService(cluster, profiles,
+                              cache_shards=cache_shards)
+        server, thread, address = serve_in_thread(
+            service, threads=serve_threads)
+        try:
+            client = PlanServiceClient(address)
+            cold = client.plan(model, config, top_k=SMOKE_TOP_K)
+            expected = _plans_digest(cold["plans"])
+
+            out_q = ctx.Queue()
+            deadline = time.time() + duration_s
+            workers = [
+                ctx.Process(target=_load_worker,
+                            args=(i, address, model, config, SMOKE_TOP_K,
+                                  expected, deadline, out_q),
+                            daemon=True)
+                for i in range(procs)
+            ]
+            t0 = time.perf_counter()
+            for p in workers:
+                p.start()
+            results = [out_q.get(timeout=duration_s + 60.0)
+                       for _ in workers]
+            wall = time.perf_counter() - t0
+            for p in workers:
+                p.join(timeout=10.0)
+
+            total = sum(r[1] for r in results)
+            lats = sorted(x for r in results for x in r[4])
+            out.update({
+                "requests": total,
+                "errors": sum(r[2] for r in results),
+                "mismatches": sum(r[3] for r in results),
+                # wall includes process spawn; duration_s is the loop
+                # window every worker ran — the honest denominator
+                "qps": round(total / duration_s, 1),
+                "wall_s": round(wall, 3),
+                "connections_reused": sum(r[5] for r in results),
+                "connections_opened": sum(r[6] for r in results),
+            })
+            if lats:
+                out["p50_ms"] = round(statistics.median(lats), 3)
+                out["p99_ms"] = round(
+                    lats[min(len(lats) - 1, int(0.99 * len(lats)))], 3)
+            reuse = [ln for ln in client.metrics().splitlines()
+                     if ln.startswith("metis_serve_keepalive_reuse_total ")]
+            out["server_keepalive_reuse"] = (
+                float(reuse[0].split()[-1]) if reuse else 0)
+        finally:
+            try:
+                client.shutdown()
+            except Exception:
+                server.shutdown()
+            thread.join(10)
+            server.server_close()
+        return out
+    finally:
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+def gate_against_baseline(result: dict,
+                          baseline_path: str | Path = BASELINE_PATH
+                          ) -> dict:
+    """Judge ``result`` against the checked-in baseline.
+
+    Returns ``{"ok": True/False, ...}`` on a comparable host, or
+    ``{"skipped_reason": ...}`` when this host (or the baseline's) cannot
+    support an apples-to-apples qps comparison."""
+    cores = result.get("cores", 0)
+    if cores < MIN_GATE_CORES:
+        return {"skipped_reason":
+                f"host has {cores} core(s) < {MIN_GATE_CORES}: "
+                "keep-alive qps gate needs a multicore host"}
+    path = Path(baseline_path)
+    if not path.exists():
+        return {"skipped_reason": f"no baseline at {path}"}
+    baseline = json.loads(path.read_text())
+    if baseline.get("cores", 0) < MIN_GATE_CORES:
+        return {"skipped_reason":
+                f"baseline was recorded on a {baseline.get('cores')}-core "
+                f"host (< {MIN_GATE_CORES}): not comparable"}
+    floor = GATE_FRACTION * baseline["qps"]
+    return {
+        "ok": result["qps"] >= floor,
+        "qps": result["qps"],
+        "baseline_qps": baseline["qps"],
+        "floor_qps": round(floor, 1),
+        "baseline_cores": baseline.get("cores"),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--procs", type=int, default=None,
+                        help="load worker processes "
+                             "(default: min(8, cores), at least 2)")
+    parser.add_argument("--duration", type=float, default=3.0,
+                        help="seconds each worker's closed loop runs")
+    parser.add_argument("--serve-threads", type=int, default=None,
+                        help="daemon handler pool size (default 64)")
+    parser.add_argument("--baseline", default=str(BASELINE_PATH))
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="re-record the baseline for this host "
+                             "instead of gating against it")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+
+    out = run_load(procs=args.procs, duration_s=args.duration,
+                   serve_threads=args.serve_threads)
+    if out["errors"] or out["mismatches"]:
+        print(f"serve load FAILED: {out['errors']} errors, "
+              f"{out['mismatches']} byte-identity mismatches over "
+              f"{out['requests']} requests", file=sys.stderr)
+        return 1
+
+    if args.update_baseline:
+        baseline = {"qps": out["qps"], "cores": out["cores"],
+                    "procs": out["procs"],
+                    "duration_s": out["duration_s"],
+                    "p50_ms": out.get("p50_ms")}
+        Path(args.baseline).write_text(
+            json.dumps(baseline, indent=2) + "\n")
+        out["baseline_updated"] = str(args.baseline)
+    else:
+        out["gate"] = gate_against_baseline(out, args.baseline)
+
+    if args.as_json:
+        print(json.dumps(out, indent=2))
+    else:
+        line = (f"serve load: {out['qps']} qps over {out['requests']} "
+                f"requests ({out['procs']} procs x {out['duration_s']}s, "
+                f"p50 {out.get('p50_ms')}ms, "
+                f"{out['connections_reused']} conns reused)")
+        gate = out.get("gate", {})
+        if "skipped_reason" in gate:
+            line += f" [gate skipped: {gate['skipped_reason']}]"
+        elif gate:
+            line += (f" [gate {'OK' if gate['ok'] else 'FAILED'}: floor "
+                     f"{gate['floor_qps']} qps]")
+        print(line)
+    gate = out.get("gate", {})
+    if gate and not gate.get("skipped_reason") and not gate.get("ok"):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -161,6 +161,57 @@ def run_smoke(threads: int = 64, per_thread: int = 2,
             assert all(g == offline_json for g in got), (
                 "corrupt response in the concurrent cold wave")
 
+            # 5c. keep-alive leg: 128-way concurrency over a handful of
+            # connection-pooling clients, so most requests ride an
+            # already-open socket.  Byte-identity must hold over reused
+            # connections too (a framing bug — stale Content-Length,
+            # spliced body corruption — shows up exactly here), the
+            # server must report actual keep-alive reuse, and the cached
+            # p50 re-measured over a pooled connection stays under the
+            # same budget as step 4.
+            ka_threads = max(threads, 128)
+            ka_clients = [PlanServiceClient(address) for _ in range(8)]
+            try:
+                def _ka_query(i: int) -> str:
+                    c = ka_clients[i % len(ka_clients)]
+                    return c.plan(model, config,
+                                  top_k=SMOKE_TOP_K)["plans"]
+
+                with ThreadPoolExecutor(max_workers=ka_threads) as pool:
+                    got = list(pool.map(_ka_query, range(ka_threads * 2)))
+                assert len(got) == ka_threads * 2, (
+                    "dropped keep-alive responses")
+                bad = sum(1 for g in got if g != offline_json)
+                assert bad == 0, (
+                    f"{bad}/{len(got)} corrupt responses over keep-alive "
+                    "connections")
+                lat_ka = []
+                for _ in range(min(cached_queries, 20)):
+                    t0 = time.perf_counter()
+                    hit = ka_clients[0].plan(model, config,
+                                             top_k=SMOKE_TOP_K)
+                    lat_ka.append((time.perf_counter() - t0) * 1e3)
+                    assert hit["plans"] == offline_json
+                out["keepalive_threads"] = ka_threads
+                out["keepalive_p50_ms"] = round(
+                    statistics.median(lat_ka), 3)
+                assert out["keepalive_p50_ms"] < p50_budget_ms, (
+                    f"keep-alive cached p50 {out['keepalive_p50_ms']}ms "
+                    f"over the {p50_budget_ms}ms budget")
+                out["keepalive_client_reused"] = sum(
+                    c.pool_stats()["reused"] for c in ka_clients)
+                reuse_line = [
+                    ln for ln in client.metrics().splitlines()
+                    if ln.startswith("metis_serve_keepalive_reuse_total ")]
+                out["keepalive_server_reuse"] = (
+                    float(reuse_line[0].split()[-1]) if reuse_line else 0)
+                assert out["keepalive_server_reuse"] > 0, (
+                    "server reported zero keep-alive connection reuse "
+                    "under the pooled-client storm")
+            finally:
+                for c in ka_clients:
+                    c.close()
+
             # 6. drift: post 2x-predicted samples until the replan lands
             plan_fp = cold["plan_fingerprint"]
             predicted = cold["best_cost_ms"]
