@@ -1632,6 +1632,178 @@ def provenance_bench(record: dict) -> None:
     record["provenance"] = entry
 
 
+def uncertainty_bench(record: dict) -> None:
+    """Risk-aware planning payoff (metis_tpu/cost/uncertainty):
+
+    - ``quantile_regret_p95`` (headline, budget <= 0): two device pools
+      compete for the same workload — BURST is ~12% faster on paper but
+      its ledger residuals are noisy (sigma 0.35, biased +8%), STABLE is
+      slightly slower and well-calibrated.  Point ranking picks BURST;
+      quantile ranking (q=0.95 of the ledger-fit residual distribution)
+      picks STABLE.  Both choices are then scored against the TRUE noise
+      distributions: the headline is the relative p95 realized-cost
+      regret of the quantile choice vs the point choice, <= 0 iff
+      risk-aware ranking never pays more at the tail.
+    - ``transfer_gap_frac`` (headline, budget <= 0.15): roofline profile
+      transfer A100 -> T4 on the parity store (T4 profiles dropped, then
+      re-synthesized from spec-sheet microbenchmarks via
+      ``fit_transfer_scale``): relative error of the transferred store's
+      best plan cost vs the fully-profiled store's.
+    - ``confidence_p``: the exact backend's probabilistic certificate on
+      the noisy pool — honest (< 1) because the fitted sigma is large.
+    """
+    import dataclasses
+    import math
+    import random
+    import statistics
+
+    from metis_tpu.cluster.spec import ClusterSpec
+    from metis_tpu.core.events import EventLog
+    from metis_tpu.cost.calibration import (
+        fit_transfer_scale,
+        transfer_profiles,
+    )
+    from metis_tpu.cost.uncertainty import fit_residual_model, make_risk_scorer
+    from metis_tpu.obs.ledger import AccuracyLedger
+    from metis_tpu.planner.api import plan_hetero
+    from metis_tpu.profiles.store import ProfileStore
+    from metis_tpu.profiles.synthetic import CHIP_PERF, ChipPerf, synthesize_profiles
+    from tools.check_events_schema import validate_file as validate_events
+    from tools.serve_smoke import parity_inputs
+
+    entry: dict = {}
+    noise = {"BURST": (0.08, 0.35), "STABLE": (0.0, 0.01)}  # (mu, sigma) of log-ratio
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        events_path = tmp / "uncertainty_events.jsonl"
+        events = EventLog(events_path)
+        cluster, profiles, model, config = parity_inputs(tmp)
+
+        # --- two-pool quantile-regret drill -------------------------------
+        perf = {
+            "BURST": ChipPerf("BURST", bf16_tflops=312, hbm_bw_gbps=2039,
+                              hbm_gb=80),
+            "STABLE": ChipPerf("STABLE", bf16_tflops=275, hbm_bw_gbps=1800,
+                               hbm_gb=80),
+        }
+        pool_profiles = synthesize_profiles(
+            model, ["BURST", "STABLE"], tps=[1, 2, 4], bss=[1, 2, 4, 8, 16],
+            chip_perf=perf)
+        pools: dict[str, ClusterSpec] = {}
+        for i, dev in enumerate(("BURST", "STABLE")):
+            ips = [f"0.0.{i + 1}.{j}" for j in (1, 2)]
+            (tmp / f"hostfile_{dev}").write_text(
+                "".join(f"{ip} slots=4\n" for ip in ips))
+            (tmp / f"clusterfile_{dev}.json").write_text(json.dumps({
+                ip: {"instance_type": dev, "inter_bandwidth": 10,
+                     "intra_bandwidth": 46, "memory": 80} for ip in ips}))
+            pools[dev] = ClusterSpec.from_files(
+                tmp / f"hostfile_{dev}", tmp / f"clusterfile_{dev}.json")
+
+        # synthetic ledger: per-type residual ratios from the TRUE dists
+        rng = random.Random(20260807)
+        ledger = AccuracyLedger(None)
+        for dev, (mu, sigma) in noise.items():
+            fp = f"synthetic-{dev}"
+            ledger.record_prediction(fp, predicted_ms=100.0)
+            for _ in range(48):
+                ledger.record_measurement(
+                    fp, measured_ms=100.0 * math.exp(rng.gauss(mu, sigma)),
+                    device_type=dev)
+        rmodel = fit_residual_model(ledger, events=events)
+        assert rmodel is not None
+        entry["residual_rel_sigma"] = {
+            dev: round(rmodel.rel_sigma((dev,)), 4) for dev in noise}
+
+        cfg_q = dataclasses.replace(config, risk_quantile=0.95)
+        scorer = make_risk_scorer(cfg_q, rmodel)
+        best = {dev: plan_hetero(pools[dev], pool_profiles, model, cfg_q,
+                                 residual_model=rmodel, top_k=1).plans[0]
+                for dev in pools}
+        entry["pool_point_ms"] = {
+            dev: round(rp.cost.total_ms, 2) for dev, rp in best.items()}
+        entry["pool_q95_score_ms"] = {
+            dev: round(scorer.score(rp.cost.total_ms, rp.inter.node_sequence), 2)
+            for dev, rp in best.items()}
+        point_choice = min(best, key=lambda d: best[d].cost.total_ms)
+        quant_choice = min(best, key=lambda d: scorer.score(
+            best[d].cost.total_ms, best[d].inter.node_sequence))
+        entry["point_choice"] = point_choice
+        entry["quantile_choice"] = quant_choice
+
+        def realized_p95(dev: str, draws: int = 2048, seed: int = 7) -> float:
+            r = random.Random(seed)
+            mu, sigma = noise[dev]
+            total = best[dev].cost.total_ms
+            realized = sorted(total * math.exp(r.gauss(mu, sigma))
+                              for _ in range(draws))
+            return realized[int(0.95 * (draws - 1))]
+
+        p95_point = realized_p95(point_choice)
+        p95_quant = realized_p95(quant_choice)
+        entry["realized_p95_ms"] = {"point": round(p95_point, 2),
+                                    "quantile": round(p95_quant, 2)}
+        entry["quantile_regret_p95"] = round(
+            (p95_quant - p95_point) / p95_point, 4)
+
+        # exact backend on the noisy pool: the certificate's confidence p
+        # must be honest — well below 1 with sigma 0.35 residuals
+        cfg_exact = dataclasses.replace(config, backend="exact",
+                                        risk_quantile=0.95)
+        res_exact = plan_hetero(pools["BURST"], pool_profiles, model,
+                                cfg_exact, residual_model=rmodel, top_k=3)
+        cert = res_exact.certificate
+        if cert is not None:
+            entry["confidence_p"] = cert.confidence_p
+            entry["certificate_complete"] = cert.complete
+
+        # --- cross-device profile transfer gap ----------------------------
+        source, target = "A100", "T4"
+        reduced = ProfileStore(
+            {k: profiles.get(*k) for k in profiles.configs(source)},
+            profiles.model, {source: profiles.type_meta[source]})
+        reduced.attn = profiles.attn
+        benches = {
+            dev: {"kind": "microbenchmark_chip", "device_kind": dev,
+                  "matmul_tflops": CHIP_PERF[dev].bf16_tflops,
+                  "hbm_stream_gbps": CHIP_PERF[dev].hbm_bw_gbps}
+            for dev in (source, target)}
+        scales = fit_transfer_scale(benches[source], benches[target])
+        entry["transfer_time_scale"] = scales["time_scale"]
+        transferred = transfer_profiles(reduced, source, target, scales,
+                                        events=events)
+        entry["transfer_provenance"] = transferred.transferred.get(
+            target, {}).get("transferred", False)
+
+        # per-entry layer-time error vs the real (measured) T4 profiles
+        per_entry = []
+        for (_, tp, bs) in profiles.configs(target):
+            real = sum(profiles.get(target, tp, bs).layer_times_ms)
+            synth = sum(transferred.get(target, tp, bs).layer_times_ms)
+            per_entry.append(abs(synth - real) / real)
+        entry["transfer_entry_gap_mean"] = round(
+            statistics.mean(per_entry), 4)
+        entry["transfer_entry_gap_max"] = round(max(per_entry), 4)
+
+        # plan-level gap: best plan cost with transferred vs real profiles
+        best_real = plan_hetero(cluster, profiles, model, config,
+                                top_k=1).plans[0].cost.total_ms
+        best_xfer = plan_hetero(cluster, transferred, model, config,
+                                top_k=1).plans[0].cost.total_ms
+        entry["best_plan_ms"] = {"profiled": round(best_real, 2),
+                                 "transferred": round(best_xfer, 2)}
+        entry["transfer_gap_frac"] = round(
+            abs(best_xfer - best_real) / best_real, 4)
+
+        events.close()
+        _n, problems = validate_events(events_path)
+        entry["events_schema_valid"] = not problems
+        if problems:
+            entry["events_problems"] = problems[:5]
+    record["uncertainty"] = entry
+
+
 def inference_bench(record: dict) -> None:
     """Latency-SLO serving planner (metis_tpu/inference) on the parity
     workload:
@@ -2289,6 +2461,7 @@ def main() -> None:
     recorder.run("serve", serve_bench, record)
     recorder.run("telemetry", telemetry_bench, record)
     recorder.run("provenance", provenance_bench, record)
+    recorder.run("uncertainty", uncertainty_bench, record)
     recorder.run("inference", inference_bench, record)
     recorder.run("fleet", fleet_bench, record)
     recorder.run("sched", sched_bench, record)
@@ -2425,6 +2598,14 @@ def _headline(record: dict) -> dict:
         "provenance_log_valid": (record.get("provenance") or {})
         .get("log_schema_valid"),
         "provenance_skipped": (record.get("provenance") or {})
+        .get("skipped_reason"),
+        "quantile_regret_p95": (record.get("uncertainty") or {})
+        .get("quantile_regret_p95"),
+        "transfer_gap_frac": (record.get("uncertainty") or {})
+        .get("transfer_gap_frac"),
+        "plan_confidence_p": (record.get("uncertainty") or {})
+        .get("confidence_p"),
+        "uncertainty_skipped": (record.get("uncertainty") or {})
         .get("skipped_reason"),
         "slo_p99_ttft_ms": (record.get("inference") or {})
         .get("slo_p99_ttft_ms"),

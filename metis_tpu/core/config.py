@@ -225,6 +225,19 @@ class SearchConfig:
     # keeps its incumbent and certifies the REMAINING gap — the
     # Certificate reports complete=False and the proven bound at stop.
     exact_deadline_s: float | None = None
+    # Risk-aware ranking knobs (cost/uncertainty.py).  risk_quantile
+    # ranks candidates by the given tail quantile of their residual
+    # cost distribution (fit from the accuracy ledger); cvar_alpha
+    # ranks by CVaR-alpha (expected cost in the worst 1-alpha tail).
+    # Both default to 0.0 = point mode, which is byte-identical to the
+    # pre-uncertainty behavior; when set they must lie in [0.5, 1) —
+    # the >= 0.5 floor keeps every risk score >= the point estimate,
+    # so the point-cost pruning bounds stay admissible.  Mutually
+    # exclusive; a fitted ResidualModel must be supplied at plan time
+    # or the knobs are inert.  Both are fingerprint-significant, so the
+    # serve daemon caches per-quantile automatically.
+    risk_quantile: float = 0.0
+    cvar_alpha: float = 0.0
 
     def __post_init__(self) -> None:
         if self.gbs < 1:
@@ -252,6 +265,14 @@ class SearchConfig:
                 f"backend must be 'beam' or 'exact', got {self.backend!r}")
         if self.exact_deadline_s is not None and self.exact_deadline_s < 0:
             raise ValueError("exact_deadline_s must be >= 0")
+        for name, v in (("risk_quantile", self.risk_quantile),
+                        ("cvar_alpha", self.cvar_alpha)):
+            if v and not 0.5 <= v < 1.0:
+                raise ValueError(
+                    f"{name} must be 0 (off) or in [0.5, 1), got {v!r}")
+        if self.risk_quantile and self.cvar_alpha:
+            raise ValueError(
+                "risk_quantile and cvar_alpha are mutually exclusive")
 
 
 @dataclass(frozen=True)

@@ -203,6 +203,12 @@ class CostBreakdown:
     estimator priced as overlapped with compute — NOT part of the additive
     ``components`` sum; ``hidden["pp_comm"] + components["pp_comm_exposed"]``
     is the full serial pp send cost (likewise dp).
+
+    ``component_variance`` (uncertainty layer only — cost/uncertainty.py)
+    carries the residual variance (ms^2) of each component, so each entry
+    of ``components`` reads as a (mean, variance) pair; empty — and
+    omitted from JSON — in point-estimate mode, keeping pre-uncertainty
+    dumps byte-identical.
     """
 
     total_ms: float
@@ -213,6 +219,7 @@ class CostBreakdown:
     stage_optimizer_ms: tuple[float, ...] = ()
     schedule: str = "gpipe"
     hidden: dict[str, float] = field(default_factory=dict)
+    component_variance: dict[str, float] = field(default_factory=dict)
 
     @property
     def component_sum_ms(self) -> float:
@@ -245,6 +252,8 @@ class CostBreakdown:
         }
         if self.hidden:
             d["hidden"] = dict(self.hidden)
+        if self.component_variance:
+            d["component_variance"] = dict(self.component_variance)
         return d
 
     @staticmethod
@@ -258,6 +267,7 @@ class CostBreakdown:
             stage_optimizer_ms=tuple(d.get("stage_optimizer_ms", ())),
             schedule=d.get("schedule", "gpipe"),
             hidden=dict(d.get("hidden", {})),
+            component_variance=dict(d.get("component_variance", {})),
         )
 
 
@@ -387,7 +397,13 @@ class Certificate:
     branch-and-bound ran to exhaustion (every node expanded or provably
     bounded) — then the bound equals the best cost and the gap is 0.0;
     a deadline stop (``SearchConfig.exact_deadline_s``) keeps the
-    incumbent and certifies the remaining gap instead."""
+    incumbent and certifies the remaining gap instead.
+
+    ``confidence_p`` (uncertainty layer, cost/uncertainty.py) upgrades
+    the point certificate to "optimal at confidence p": the probability
+    the incumbent is truly best given the ledger-fit residual variance.
+    None — and omitted from JSON — in point mode (no residual model),
+    keeping pre-uncertainty certificates byte-identical."""
 
     best_ms: float
     lower_bound_ms: float
@@ -396,9 +412,10 @@ class Certificate:
     nodes_bounded: int
     wall_s: float
     complete: bool = True
+    confidence_p: float | None = None
 
     def to_json_dict(self) -> dict:
-        return {
+        d = {
             "best_ms": self.best_ms,
             "lower_bound_ms": self.lower_bound_ms,
             "gap_frac": self.gap_frac,
@@ -407,6 +424,9 @@ class Certificate:
             "wall_s": self.wall_s,
             "complete": self.complete,
         }
+        if self.confidence_p is not None:
+            d["confidence_p"] = self.confidence_p
+        return d
 
     @staticmethod
     def from_json_dict(d: dict) -> "Certificate":
@@ -418,6 +438,7 @@ class Certificate:
             nodes_bounded=int(d["nodes_bounded"]),
             wall_s=d["wall_s"],
             complete=bool(d.get("complete", True)),
+            confidence_p=d.get("confidence_p"),
         )
 
 

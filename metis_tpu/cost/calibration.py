@@ -37,6 +37,15 @@ COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
                "ppermute")
 
 
+class CalibrationError(ValueError):
+    """A calibration/transfer fit cannot be computed from the given
+    samples (empty ledger, degenerate probe measurements, ...).
+
+    Subclasses ValueError so pre-existing ``except ValueError`` /
+    ``pytest.raises(ValueError)`` call sites keep working; new callers
+    can catch the typed error and degrade (e.g. to an identity fit)."""
+
+
 @dataclass(frozen=True)
 class CollectiveSample:
     """One timed collective: ``nbytes`` is the logical payload the analytic
@@ -310,20 +319,30 @@ def fit_ledger_correction(samples) -> dict:
     Returns ``{"scale", "n", "mape_before_pct", "mape_after_pct"}``; apply
     with ``CollectiveCalibration.with_correction(scale)`` or by scaling any
     ``predict_ms`` output directly.
+
+    Degrades gracefully on thin ledgers: an empty/unmatched sample set
+    raises the typed :class:`CalibrationError` (a ValueError subclass —
+    existing handlers keep working); a single matched sample fits the
+    exact one-point scale; non-finite (NaN/inf) pairs are skipped like
+    unmatched ones rather than poisoning the fit.
     """
+    import math
+
     pairs: list[tuple[float, float]] = []
     for s in samples:
         if hasattr(s, "predicted_ms"):
-            if s.predicted_ms is None or s.measured_ms <= 0:
-                continue
-            pairs.append((float(s.predicted_ms), float(s.measured_ms)))
+            p, m = s.predicted_ms, s.measured_ms
         else:
             p, m = s
-            if p is None or m is None or m <= 0:
-                continue
-            pairs.append((float(p), float(m)))
+        if p is None or m is None:
+            continue
+        p, m = float(p), float(m)
+        if not math.isfinite(p) or not math.isfinite(m) or m <= 0:
+            continue
+        pairs.append((p, m))
     if not pairs:
-        raise ValueError("no matched (predicted, measured) samples to fit")
+        raise CalibrationError(
+            "no matched (predicted, measured) samples to fit")
     sxx = sum(p * p for p, _ in pairs)
     sxy = sum(p * m for p, m in pairs)
     scale = sxy / sxx if sxx > 0 else 1.0
@@ -711,3 +730,123 @@ def microbenchmark_chip(device=None, iters: int = 10) -> dict:
         dt = timed(scale_chain, big)
         out["hbm_stream_gbps"] = round(2 * m * 4 / dt / 1e9, 1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# cross-device profile transfer (AMP-style roofline scaling)
+# ---------------------------------------------------------------------------
+
+
+# Default compute share of a transformer layer's step time for the
+# roofline mix: large-matmul transformer layers are mostly MXU-bound,
+# the remainder streams activations/weights from HBM.
+TRANSFER_COMPUTE_MIX = 0.7
+
+
+def fit_transfer_scale(source_bench: dict, target_bench: dict,
+                       compute_mix: float = TRANSFER_COMPUTE_MIX) -> dict:
+    """Fit roofline scale factors between a profiled and an unprofiled
+    chip from two ``microbenchmark_chip`` artifacts.
+
+    AMP-style cross-type generalization (arXiv 2210.07297): a layer's
+    step time splits into a compute-bound share (scales with achievable
+    matmul TFLOP/s) and a memory-bound share (scales with HBM stream
+    bandwidth), so
+
+    ``time_target = time_source * (mix / compute_scale
+                                   + (1 - mix) / mem_scale)``
+
+    where ``compute_scale = target_tflops / source_tflops`` and
+    ``mem_scale = target_gbps / source_gbps``.  Returns ``{"compute_scale",
+    "mem_scale", "time_scale", "compute_mix", "source_kind",
+    "target_kind"}``; raises :class:`CalibrationError` when either probe
+    artifact is missing or degenerate (non-positive roofline numbers)."""
+    if not 0.0 <= compute_mix <= 1.0:
+        raise CalibrationError(
+            f"compute_mix must be in [0, 1], got {compute_mix!r}")
+    vals = {}
+    for name, bench in (("source", source_bench), ("target", target_bench)):
+        try:
+            tflops = float(bench["matmul_tflops"])
+            gbps = float(bench["hbm_stream_gbps"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise CalibrationError(
+                f"{name} probe artifact lacks roofline numbers: {e}") from None
+        if tflops <= 0 or gbps <= 0:
+            raise CalibrationError(
+                f"{name} probe artifact has non-positive roofline numbers")
+        vals[name] = (tflops, gbps)
+    compute_scale = vals["target"][0] / vals["source"][0]
+    mem_scale = vals["target"][1] / vals["source"][1]
+    time_scale = compute_mix / compute_scale + (1.0 - compute_mix) / mem_scale
+    return {
+        "compute_scale": round(compute_scale, 6),
+        "mem_scale": round(mem_scale, 6),
+        "time_scale": round(time_scale, 6),
+        "compute_mix": compute_mix,
+        "source_kind": source_bench.get("device_kind", ""),
+        "target_kind": target_bench.get("device_kind", ""),
+    }
+
+
+def transfer_profiles(store, source_type: str, target_type: str,
+                      scales: dict, events=None) -> "object":
+    """Synthesize profiles for an unprofiled device type by roofline-
+    scaling a profiled one (:func:`fit_transfer_scale` output).
+
+    Every (``source_type``, tp, bs) entry is copied to ``target_type``
+    with layer/decode times and fb_sync multiplied by
+    ``scales["time_scale"]`` (memory rows are model- not chip-shaped and
+    pass through); the per-type optimizer/batch-generator metas scale
+    the same way.  The returned merged store carries the provenance tag
+    ``store.transferred[target_type] = {"source": ..., **scales,
+    "transferred": True}`` — planner decision records pick it up so a
+    plan built on transferred profiles is auditable as such.  Emits one
+    ``transfer_fit`` event when an event log is passed."""
+    from metis_tpu.profiles.store import (
+        DeviceTypeMeta,
+        LayerProfile,
+        ProfileStore,
+    )
+
+    src_keys = store.configs(source_type)
+    if not src_keys:
+        raise CalibrationError(
+            f"no profiled entries for source type {source_type!r}")
+    if store.configs(target_type):
+        raise CalibrationError(
+            f"target type {target_type!r} is already profiled")
+    ts = float(scales["time_scale"])
+    if not ts > 0:
+        raise CalibrationError(f"time_scale must be > 0, got {ts!r}")
+    entries = {}
+    for (t, tp, bs) in src_keys:
+        prof = store.get(t, tp, bs)
+        entries[(target_type, tp, bs)] = LayerProfile(
+            layer_times_ms=tuple(x * ts for x in prof.layer_times_ms),
+            layer_memory_mb=prof.layer_memory_mb,
+            fb_sync_ms=prof.fb_sync_ms * ts,
+            decode_layer_times_ms=(
+                tuple(x * ts for x in prof.decode_layer_times_ms)
+                if prof.decode_layer_times_ms is not None else None),
+            decode_context_len=prof.decode_context_len,
+        )
+    src_meta = store.type_meta[source_type]
+    extra = ProfileStore(
+        entries, store.model,
+        {target_type: DeviceTypeMeta(
+            optimizer_time_ms=src_meta.optimizer_time_ms * ts,
+            batch_generator_ms=src_meta.batch_generator_ms * ts)})
+    extra.attn = store.attn
+    merged = store.merged_with(extra)
+    merged.transferred = dict(getattr(store, "transferred", {}) or {})
+    merged.transferred[target_type] = {
+        "source": source_type, "transferred": True, **scales}
+    if events is not None:
+        events.emit("transfer_fit", source_type=source_type,
+                    target_type=target_type,
+                    time_scale=scales.get("time_scale"),
+                    compute_scale=scales.get("compute_scale"),
+                    mem_scale=scales.get("mem_scale"),
+                    n_entries=len(entries))
+    return merged

@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import math
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -304,26 +305,65 @@ class AccuracyLedger:
     plan (validate).  Opening an existing path loads both sides and re-joins
     them, so the file round-trips; ``AccuracyLedger(None)`` is an in-memory
     ledger (nothing persisted).
+
+    Loading is fault-hardened the same way ``serve.persist.Oplog`` is:
+    a torn trailing line (crash mid-append), a record with NaN/inf
+    times, or a measurement missing its value is SKIPPED and counted
+    (``n_skipped``; one ``ledger_skip`` event with the per-reason
+    tally) instead of crashing the open or poisoning residual fits.
     """
 
-    def __init__(self, path: str | Path | None = None):
+    def __init__(self, path: str | Path | None = None,
+                 events: EventLog = NULL_LOG):
         self.path = Path(path) if path is not None else None
+        self.events = events
         self._fh: IO[str] | None = None
         self.predictions: dict[str, dict] = {}
         self.samples: list[AccuracySample] = []
+        self.n_skipped = 0
         if self.path is not None and self.path.exists():
             self._load()
 
+    @staticmethod
+    def _finite(v) -> bool:
+        return (isinstance(v, (int, float))
+                and math.isfinite(v))
+
     def _load(self) -> None:
+        skipped: dict[str, int] = {}
         for line in self.path.read_text().splitlines():
             if not line.strip():
                 continue
-            rec = json.loads(line)
-            kind = rec.get("kind")
-            if kind == "prediction":
-                self.predictions[rec["fingerprint"]] = rec
-            elif kind == "measurement":
-                self.samples.append(self._join(rec))
+            reason = None
+            try:
+                rec = json.loads(line)
+                kind = rec.get("kind")
+                if kind == "prediction":
+                    fp = rec["fingerprint"]
+                    if not self._finite(rec.get("predicted_ms")):
+                        reason = "non_finite"
+                    else:
+                        self.predictions[fp] = rec
+                elif kind == "measurement":
+                    rec["fingerprint"]
+                    m = rec.get("measured_ms")
+                    if m is None:
+                        # predicted-only / valueless measurement row
+                        reason = "missing_measurement"
+                    elif not self._finite(m):
+                        reason = "non_finite"
+                    else:
+                        self.samples.append(self._join(rec))
+            except json.JSONDecodeError:
+                reason = "torn_line"
+            except (KeyError, TypeError, ValueError):
+                reason = "bad_record"
+            if reason is not None:
+                skipped[reason] = skipped.get(reason, 0) + 1
+        if skipped:
+            self.n_skipped = sum(skipped.values())
+            self.events.emit("ledger_skip", n_skipped=self.n_skipped,
+                             reasons=dict(sorted(skipped.items())))
 
     def _join(self, rec: dict) -> AccuracySample:
         pred = self.predictions.get(rec["fingerprint"])

@@ -433,6 +433,13 @@ METRIC_CATALOG: dict[str, tuple[str, str, tuple[str, ...]]] = {
                    "replay", ("policy",)),
     "metis_replay_ticks_total": (
         "counter", "traffic-replay ticks simulated", ("policy",)),
+    "metis_plan_confidence_p": (
+        "gauge", "confidence p of the last exact-backend certificate "
+                 "(probability the certified plan is truly optimal "
+                 "under the ledger-fit residual model)", ()),
+    "metis_transfer_scale_factor": (
+        "gauge", "roofline time-scale factor applied to transferred "
+                 "(unprofiled-device) profiles", ("target_type",)),
 }
 
 

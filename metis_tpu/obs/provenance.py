@@ -61,6 +61,7 @@ DECISION_KINDS = (
     "fleet_repartition", # multi-tenant carve re-scored (sched/fleet.py)
     "tenant_replan",     # one tenant's carve changed -> new plan
     "migration_decision",# migrate-vs-checkpoint-restore choice
+    "profile_transfer",  # roofline transfer to an unprofiled device type
 )
 
 
@@ -588,6 +589,28 @@ def render_chain(chain: Sequence[DecisionRecord],
         if rec.total_ms is not None:
             head += f" {rec.total_ms:.3f} ms"
         lines.append(("  " * depth) + ("-> " if depth else "") + head)
+        # risk posture (uncertainty layer): how this plan was ranked —
+        # point (default, unannotated), tail-quantile, or CVaR — and
+        # whether it was priced off transferred (unprofiled) profiles
+        detail = rec.detail or {}
+        ranking = detail.get("ranking")
+        transferred = detail.get("transferred_profiles")
+        if ranking or transferred:
+            bits = []
+            if ranking == "quantile":
+                bits.append("quantile-ranked "
+                            f"(q={detail.get('risk_quantile')})")
+            elif ranking == "cvar":
+                bits.append(f"CVaR-ranked (alpha={detail.get('cvar_alpha')})")
+            elif ranking == "point" and detail.get("risk_requested"):
+                bits.append("point-ranked (risk requested; ledger too "
+                            "thin to fit)")
+            elif ranking:
+                bits.append(f"{ranking}-ranked")
+            if transferred:
+                bits.append("transferred profiles: "
+                            + ", ".join(transferred))
+            lines.append(("  " * depth) + "   risk: " + "; ".join(bits))
         if rec.margin_ms is not None and rec.runner_up is not None:
             conf = ""
             if rec.confidence:

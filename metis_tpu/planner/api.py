@@ -170,9 +170,19 @@ def plan_hetero(
     metrics=None,
     decisions=None,
     decision_meta: dict | None = None,
+    residual_model=None,
 ) -> PlannerResult:
     """Full heterogeneous search: inter-stage × intra-stage candidates,
     costed and ranked (≅ ``cost_het_cluster``).
+
+    ``residual_model``: an optional ``cost.uncertainty.ResidualModel``
+    (fit from the accuracy ledger).  Together with the config's
+    ``risk_quantile``/``cvar_alpha`` knobs it switches ranking from the
+    point estimate to the configured tail quantile or CVaR of each
+    candidate's residual cost distribution, and annotates the top-k
+    breakdowns with per-component variances.  None (the default) — or
+    both knobs at 0 — is the point mode, byte-identical to the
+    pre-uncertainty planner.
 
     ``inter_filter``: optional predicate on InterStagePlan applied before
     intra-stage expansion — topology validity filters (e.g. the TPU
@@ -211,12 +221,27 @@ def plan_hetero(
     ``decision_meta``: extra DecisionRecord fields (``kind``, ``cause``,
     ``parent_seq``, ``trace_id``, ``query_fingerprint``, ...)."""
     _check_profile_attn(profiles, model)
+    from metis_tpu.cost.uncertainty import make_risk_scorer
+
+    scorer = make_risk_scorer(config, residual_model)
 
     def _record(result: PlannerResult) -> PlannerResult:
         if decisions is not None:
             from metis_tpu.obs.provenance import record_planner_decision
 
             meta = dict(decision_meta or {})
+            # risk-posture audit trail (`metis-tpu why`): whether this
+            # ranking was point-ranked, quantile/CVaR-ranked, or built
+            # from transferred (unprofiled-device) profiles
+            posture: dict = (scorer.describe() if scorer is not None
+                             else {})
+            transferred = getattr(profiles, "transferred", None)
+            if transferred:
+                posture["transferred_profiles"] = sorted(transferred)
+            if posture:
+                detail = dict(meta.get("detail") or {})
+                detail.update(posture)
+                meta["detail"] = detail
             record_planner_decision(
                 decisions, result, kind=meta.pop("kind", "cold_search"),
                 **meta)
@@ -231,8 +256,10 @@ def plan_hetero(
             cluster, profiles, model, config,
             bandwidth_factory=bandwidth_factory, top_k=top_k,
             events=events, inter_filter=inter_filter,
-            search_state=search_state))
-    if config.workers > 1:
+            search_state=search_state, residual_model=residual_model))
+    if config.workers > 1 and scorer is None:
+        # risk-ranked searches take the serial loop below — the sharded
+        # workers don't carry a residual model across the process boundary
         from metis_tpu.search.parallel import try_parallel_plan_hetero
 
         parallel_result = try_parallel_plan_hetero(
@@ -297,7 +324,7 @@ def plan_hetero(
     pruner = SearchPruner(config, cluster, profiles, model,
                           counters=tracer.counters if tracer.enabled
                           else None,
-                          bound_fn=bound_fn)
+                          bound_fn=bound_fn, scorer=scorer)
     # per-search symmetry accounting: the evaluator's hit/miss totals are
     # lifetime (warm states span searches), so the event reports deltas
     sym_h0, sym_m0 = ctx.sym_hits, ctx.sym_misses
@@ -377,7 +404,15 @@ def plan_hetero(
     cost_acc.close()
     t_rank = time.perf_counter()
     with tracer.span("ranking", num_plans=len(results)):
-        results.sort(key=lambda r: r.cost.total_ms)
+        if scorer is not None:
+            # tail-risk ranking: the configured quantile/CVaR of each
+            # candidate's residual distribution.  With equal per-type
+            # variance the factor is constant, so this is a monotone
+            # transform of the point total and the order is unchanged.
+            results.sort(key=lambda r: scorer.score(
+                r.cost.total_ms, r.inter.node_sequence))
+        else:
+            results.sort(key=lambda r: r.cost.total_ms)
     if metrics is not None:
         phase_obs = [("setup", setup_s),
                      ("ranking", time.perf_counter() - t_rank)]
@@ -412,6 +447,11 @@ def plan_hetero(
                         virtual_stages=rp.intra.virtual_stages)
                 except KeyError:  # pragma: no cover - costed once already
                     continue
+                if residual_model is not None and residual_model:
+                    from metis_tpu.cost.uncertainty import annotate_breakdown
+
+                    bd = annotate_breakdown(bd, residual_model,
+                                            rp.inter.node_sequence)
                 results[i] = dataclasses.replace(rp, breakdown=bd)
                 events.emit(
                     "plan_explain", rank=i + 1,

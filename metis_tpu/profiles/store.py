@@ -134,6 +134,13 @@ class ProfileStore:
         # it against ModelSpec.attn so a dense-measured dir can never
         # silently price a flash model (VERDICT r4 weak #2).
         self.attn: str | None = None
+        # Cross-device transfer provenance (cost/calibration.
+        # transfer_profiles): {target_type: {"source", "transferred": True,
+        # "time_scale", ...}} for every device type whose entries were
+        # roofline-scaled from another chip rather than measured.  Empty
+        # for fully-profiled stores; planner decision records surface it
+        # so transferred-profile plans stay auditable.
+        self.transferred: dict[str, dict] = {}
         types: list[str] = []
         for (t, _, _) in self._entries:
             if t not in types:
@@ -233,6 +240,7 @@ class ProfileStore:
             overhead[(t, tp)] = a_total
         smoothed = ProfileStore(entries, self.model, self.type_meta)
         smoothed.attn = self.attn
+        smoothed.transferred = dict(self.transferred)
         return smoothed, overhead
 
     def merged_with(self, other: "ProfileStore") -> "ProfileStore":
@@ -252,6 +260,7 @@ class ProfileStore:
         type_meta.update(other.type_meta)
         merged = ProfileStore(entries, self.model, type_meta)
         merged.attn = self.attn if self.attn is not None else other.attn
+        merged.transferred = {**self.transferred, **other.transferred}
         return merged
 
     # -- serialization -----------------------------------------------------

@@ -73,7 +73,7 @@ class _NullPruner:
     def begin_candidate(self) -> None:
         pass
 
-    def record(self, total_ms: float) -> None:
+    def record(self, total_ms: float, inter=None) -> None:
         pass
 
     def end_candidate(self, inter) -> None:
@@ -247,6 +247,7 @@ def exact_plan_hetero(
     events: EventLog = NULL_LOG,
     inter_filter=None,
     search_state=None,
+    residual_model=None,
 ):
     """Branch-and-bound heterogeneous search with an optimality certificate.
 
@@ -254,15 +255,30 @@ def exact_plan_hetero(
     dispatches here on ``config.backend == "exact"``); runs serially —
     ``config.workers`` is ignored.  The returned ``PlannerResult`` carries
     a :class:`~metis_tpu.core.types.Certificate` (None only when the space
-    yields no costable plan at all)."""
+    yields no costable plan at all).
+
+    ``residual_model`` (cost/uncertainty.ResidualModel, optional): prices
+    each candidate's residual distribution.  With the config's
+    ``risk_quantile``/``cvar_alpha`` knobs set, incumbents and the final
+    ranking live in SCORE space (point total x tail factor, >= the point
+    total, so the point-cost relaxation bounds stay admissible and the
+    bound-stop only prunes provably score-worse frontiers).  With a model
+    — knobs or not — the Certificate carries ``confidence_p``: the
+    probability the incumbent is truly optimal given the residual sigma.
+    None keeps everything byte-identical to the point-mode backend."""
     from metis_tpu.core.types import InterStagePlan
     from metis_tpu.planner.api import (
         DEFAULT_EXPLAIN_K,
         PlannerResult,
         make_search_state,
     )
+    from metis_tpu.cost.uncertainty import (
+        certificate_confidence,
+        make_risk_scorer,
+    )
     from metis_tpu.search.device_groups import arrangements_of_composition
 
+    scorer = make_risk_scorer(config, residual_model)
     tracer = Tracer(events)
     root = tracer.span("plan_exact", mode="hetero", model=model.name,
                        devices=cluster.total_devices)
@@ -349,10 +365,14 @@ def exact_plan_hetero(
                 for _inter, evs in ctx.evaluate_batch([inter], pruner):
                     for kind, item in evs:
                         if kind == "plan":
-                            if item.cost.total_ms < incumbent:
-                                incumbent = item.cost.total_ms
+                            score = (scorer.score(item.cost.total_ms,
+                                                  node_sequence)
+                                     if scorer is not None
+                                     else item.cost.total_ms)
+                            if score < incumbent:
+                                incumbent = score
                             results.append(item)
-                            order.append((item.cost.total_ms, node_idx, seq))
+                            order.append((score, node_idx, seq))
                             seq += 1
                         else:
                             pruned += 1
@@ -378,16 +398,36 @@ def exact_plan_hetero(
 
     certificate = None
     if best_cost is not None:
-        gap = ((best_cost - proven_lb) / best_cost
-               if best_cost > 0 else 0.0)
+        # with a scorer the incumbent/proven_lb pair lives in score
+        # space, so the whole certificate (best_ms, bound, gap) is
+        # certified there too — best_ms >= lower_bound always holds in
+        # one space; point mode is unchanged (score == total then,
+        # float-identical)
+        skeys = sorted(k[0] for k in order)
+        best_score = skeys[0]
+        gap = ((best_score - proven_lb) / best_score
+               if best_score > 0 else 0.0)
+        confidence_p = None
+        if residual_model is not None and residual_model:
+            best_plan = ranked[0]
+            sigma = residual_model.sigma_ms(
+                best_cost, best_plan.inter.node_sequence)
+            margin = skeys[1] - best_score if len(skeys) > 1 else float("inf")
+            if not complete:
+                # unexplored frontier could hold a plan as low as the
+                # proven bound — that hypothetical is the competitor
+                margin = min(margin, proven_lb - best_score)
+            confidence_p = round(certificate_confidence(
+                margin, sigma, scorer.z_q if scorer is not None else 0.0), 6)
         certificate = Certificate(
-            best_ms=best_cost,
+            best_ms=best_score,
             lower_bound_ms=proven_lb,
             gap_frac=max(0.0, gap),
             nodes_explored=nodes_explored,
             nodes_bounded=nodes_bounded + num_doomed,
             wall_s=elapsed,
             complete=complete,
+            confidence_p=confidence_p,
         )
         events.emit("certificate", **certificate.to_json_dict())
 
@@ -411,6 +451,11 @@ def exact_plan_hetero(
                         virtual_stages=rp.intra.virtual_stages)
                 except KeyError:  # pragma: no cover - costed once already
                     continue
+                if residual_model is not None and residual_model:
+                    from metis_tpu.cost.uncertainty import annotate_breakdown
+
+                    bd = annotate_breakdown(bd, residual_model,
+                                            rp.inter.node_sequence)
                 ranked[i] = dataclasses.replace(rp, breakdown=bd)
                 events.emit(
                     "plan_explain", rank=i + 1,

@@ -243,7 +243,7 @@ class CandidateEvaluator:
                         self._inc("pruned_profile_miss")
                         yield "miss", True
                         continue
-                    pruner.record(cost.total_ms)
+                    pruner.record(cost.total_ms, inter)
                     self._inc("costed")
                     yield "plan", RankedPlan(inter=inter, intra=intra,
                                              cost=cost)
@@ -275,7 +275,7 @@ class CandidateEvaluator:
                         self._inc("pruned_profile_miss")
                         yield "miss", True
                         continue
-                    pruner.record(cost.total_ms)
+                    pruner.record(cost.total_ms, inter)
                     self._inc("costed")
                     yield "plan", RankedPlan(inter=inter, intra=intra,
                                              cost=cost)
@@ -354,7 +354,7 @@ class CandidateEvaluator:
         events = []
         for kind, item in cached:
             if kind == "plan":
-                pruner.record(item.cost.total_ms)
+                pruner.record(item.cost.total_ms, inter)
                 self._inc("costed")
                 events.append(
                     ("plan", dataclasses.replace(item, inter=inter)))
@@ -393,7 +393,7 @@ class CandidateEvaluator:
                 self._inc("pruned_profile_miss")
                 events.append(("miss", True))
             else:
-                pruner.record(cost.total_ms)
+                pruner.record(cost.total_ms, inter)
                 self._inc("costed")
                 events.append(
                     ("plan", RankedPlan(inter=inter, intra=intra,

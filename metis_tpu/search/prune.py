@@ -194,7 +194,7 @@ class SearchPruner:
 
     def __init__(self, config: SearchConfig, cluster: ClusterSpec,
                  profiles: ProfileStore, model: ModelSpec,
-                 counters=None, bound_fn=None):
+                 counters=None, bound_fn=None, scorer=None):
         # optional core.trace.Counters: prune-family accounting for the
         # flight recorder (``prune.doom``/``prune.bound``/``prune.beam``
         # mirror num_doomed/num_bounded/num_beamed; ``prune.bound.tight``
@@ -208,6 +208,15 @@ class SearchPruner:
         # (composition ceiling, stage count, batches) class — or the
         # prune_to_top_k exactness guarantee breaks.
         self._bound_fn = bound_fn
+        # optional cost/uncertainty.RiskScorer: when set, ``record``
+        # keeps the top-K heap in SCORE space (total * tail factor for
+        # the candidate's device types) instead of point space.  Scores
+        # are >= the point total by construction (factors clamped at
+        # 1.0), so the point-cost lower bounds compared against the
+        # score-space kth best prune strictly less than in point mode —
+        # never wrongly.  None (the default) is byte-identical to the
+        # pre-uncertainty pruner.
+        self._scorer = scorer
         self.max_bs = config.max_profiled_bs
         self.gbs = config.gbs
         self.top_k = (config.prune_to_top_k
@@ -344,9 +353,11 @@ class SearchPruner:
     def begin_candidate(self) -> None:
         self._improved = False
 
-    def record(self, total_ms: float) -> None:
+    def record(self, total_ms: float, inter=None) -> None:
         if self.top_k is None:
             return
+        if self._scorer is not None and inter is not None:
+            total_ms = self._scorer.score(total_ms, inter.node_sequence)
         if len(self._heap) < self.top_k:
             heapq.heappush(self._heap, -total_ms)
             self._improved = True

@@ -607,6 +607,36 @@ class PlanService:
             return self._finish(entry, None, cached=False, t_req=t_req,
                                 trace_id=trace_id, encoded=encoded)
 
+    def _risk_posture(self, config: SearchConfig,
+                      residual_model) -> dict:
+        """Risk-posture annotation for a search's decision record: was
+        the served ranking point-ranked, quantile/CVaR-ranked (with the
+        parameter), or built on transferred profiles?  Empty dict for a
+        plain point-ranked, fully-profiled search — decision records
+        stay byte-identical for those.  Also refreshes the
+        ``metis_transfer_scale_factor`` gauge per transferred type."""
+        posture: dict = {}
+        q = getattr(config, "risk_quantile", 0.0)
+        a = getattr(config, "cvar_alpha", 0.0)
+        if residual_model is not None and (q or a):
+            if a:
+                posture.update(ranking="cvar", cvar_alpha=a)
+            else:
+                posture.update(ranking="quantile", risk_quantile=q)
+        elif q or a:
+            # knobs asked for but the ledger was too thin to fit
+            posture.update(ranking="point", risk_requested=True)
+        transferred = getattr(self.profiles, "transferred", None)
+        if transferred:
+            posture["transferred_profiles"] = sorted(transferred)
+            for target, prov in transferred.items():
+                scale = prov.get("time_scale")
+                if scale is not None:
+                    self.metrics.gauge(
+                        "metis_transfer_scale_factor",
+                        target_type=target).set(scale)
+        return posture
+
     def _search(self, qfp: str, key: str, model: ModelSpec,
                 config: SearchConfig, top_k: int | None,
                 events: EventLog | None = None,
@@ -617,11 +647,28 @@ class PlanService:
         ev = events if events is not None else self.events
         queue_depth = self.metrics.gauge("metis_serve_queue_depth")
         queue_depth.inc()
+        # risk-aware queries (risk_quantile/cvar_alpha, or an exact-
+        # backend query wanting a confidence-p certificate): fit the
+        # residual model from the live accuracy ledger ONCE per search
+        # (emits residual_fit); stays None — point mode, byte-identical
+        # — when the knobs are off and the backend is beam, or when the
+        # ledger is too thin to fit
+        residual_model = None
+        risk_active = bool(getattr(config, "risk_quantile", 0.0)
+                           or getattr(config, "cvar_alpha", 0.0))
+        if risk_active or getattr(config, "backend", "beam") == "exact":
+            from metis_tpu.cost.uncertainty import fit_residual_model
+
+            with self._accuracy_lock:
+                residual_model = fit_residual_model(self.ledger, events=ev)
         try:
             result = None
             pool = self.search_pool
-            if pool is not None and getattr(config, "backend",
-                                            "beam") != "exact":
+            if (pool is not None and getattr(config, "backend",
+                                             "beam") != "exact"
+                    and not (risk_active and residual_model is not None)):
+                # (risk-ranked searches take the serial path — the pool
+                # workers don't carry the ledger-fit residual model)
                 # resident worker pool: index-stride shards across warm
                 # processes, byte-identical ranking (serve/pool.py), and
                 # the daemon thread never holds _search_lock for the
@@ -640,7 +687,8 @@ class PlanService:
                     result = plan_hetero(self.cluster, self.profiles,
                                          model, config, top_k=top_k,
                                          events=ev, search_state=state,
-                                         metrics=self.metrics)
+                                         metrics=self.metrics,
+                                         residual_model=residual_model)
                     self.metrics.histogram(
                         "metis_search_duration_seconds",
                         kind="training").observe(time.perf_counter() - t0)
@@ -663,6 +711,9 @@ class PlanService:
             # exact-backend cold search: the optimality certificate rides
             # the /plan response (and the cached entry) verbatim
             entry["certificate"] = result.certificate.to_json_dict()
+            if result.certificate.confidence_p is not None:
+                self.metrics.gauge("metis_plan_confidence_p").set(
+                    result.certificate.confidence_p)
         # provenance: one decision record per search — runner-up/margin,
         # breakdown, certificate (planner_decision_fields), content
         # digests, and the ledger's per-component residual stats as the
@@ -680,7 +731,8 @@ class PlanService:
                      "config": artifact_digest(
                          dataclasses.asdict(config))},
             detail={"cache_key": key, "num_costed": result.num_costed,
-                    "search_seconds": entry["search_seconds"]},
+                    "search_seconds": entry["search_seconds"],
+                    **self._risk_posture(config, residual_model)},
             **fields)
         entry["decision_seq"] = dec.seq
         with self._lock:
@@ -1959,6 +2011,17 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 model = model_spec_from_dict(body["model"])
                 config = search_config_from_dict(body["config"])
+                # top-level risk knobs: a client can ask for a tail-
+                # quantile/CVaR-ranked answer without rebuilding its
+                # config dict.  They land in the SearchConfig, which is
+                # fingerprint-significant — so each (query, quantile)
+                # pair caches independently (per-quantile caching).
+                rq, ca = body.get("risk_quantile"), body.get("cvar_alpha")
+                if rq is not None or ca is not None:
+                    config = dataclasses.replace(
+                        config,
+                        risk_quantile=float(rq) if rq is not None else 0.0,
+                        cvar_alpha=float(ca) if ca is not None else 0.0)
                 top_k = body.get("top_k")
                 wl = body.get("workload")
                 out = self.service.plan_query_encoded(

@@ -365,3 +365,76 @@ def test_replan_on_drift_reuses_old_result(workload):
     report = replan_on_drift(bad, cluster, store, model,
                              SearchConfig(gbs=64), old_result=old)
     assert report.old_best_cost_ms == old.best.cost.total_ms
+
+
+# ---------------------------------------------------------------------------
+# fault-hardened loading: torn lines, non-finite values, valueless
+# measurements are skipped + counted, never crash the open
+# ---------------------------------------------------------------------------
+
+
+def _write_ledger(path, lines):
+    path.write_text("".join(
+        (json.dumps(l) if isinstance(l, dict) else l) + "\n" for l in lines))
+
+
+def test_ledger_load_survives_torn_trailing_line(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    _write_ledger(path, [
+        {"kind": "prediction", "fingerprint": "fp", "predicted_ms": 100.0},
+        {"kind": "measurement", "fingerprint": "fp", "measured_ms": 110.0},
+        '{"kind": "measurement", "fingerprint": "fp", "measu',  # crash mid-append
+    ])
+    ev_path = tmp_path / "events.jsonl"
+    led = AccuracyLedger(path, events=EventLog(ev_path))
+    assert len(led.samples) == 1
+    assert led.samples[0].measured_ms == 110.0
+    assert led.n_skipped == 1
+    skips = [e for e in read_events(ev_path) if e["event"] == "ledger_skip"]
+    assert len(skips) == 1
+    assert skips[0]["n_skipped"] == 1
+    assert skips[0]["reasons"] == {"torn_line": 1}
+
+
+def test_ledger_load_skips_non_finite_and_valueless(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    _write_ledger(path, [
+        {"kind": "prediction", "fingerprint": "ok", "predicted_ms": 100.0},
+        # NaN/inf prediction: dropped, never poisons residual fits
+        {"kind": "prediction", "fingerprint": "bad", "predicted_ms":
+         float("nan")},
+        {"kind": "prediction", "fingerprint": "bad2", "predicted_ms":
+         float("inf")},
+        {"kind": "measurement", "fingerprint": "ok", "measured_ms": 105.0},
+        # valueless measurement row
+        {"kind": "measurement", "fingerprint": "ok"},
+        # non-finite measurement
+        {"kind": "measurement", "fingerprint": "ok", "measured_ms":
+         float("inf")},
+        # record missing its fingerprint entirely
+        {"kind": "measurement", "measured_ms": 50.0},
+    ])
+    ev_path = tmp_path / "events.jsonl"
+    led = AccuracyLedger(path, events=EventLog(ev_path))
+    assert len(led.samples) == 1 and led.samples[0].predicted_ms == 100.0
+    assert "bad" not in led.predictions and "bad2" not in led.predictions
+    assert led.n_skipped == 5
+    (skip,) = [e for e in read_events(ev_path)
+               if e["event"] == "ledger_skip"]
+    assert skip["reasons"] == {"bad_record": 1, "missing_measurement": 1,
+                               "non_finite": 3}
+    # the surviving sample still does accuracy math
+    assert led.summary().n_matched == 1
+
+
+def test_ledger_clean_file_emits_no_skip_event(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    _write_ledger(path, [
+        {"kind": "prediction", "fingerprint": "fp", "predicted_ms": 100.0},
+        {"kind": "measurement", "fingerprint": "fp", "measured_ms": 99.0},
+    ])
+    ev_path = tmp_path / "events.jsonl"
+    led = AccuracyLedger(path, events=EventLog(ev_path))
+    assert led.n_skipped == 0
+    assert not ev_path.exists() or not [
+        e for e in read_events(ev_path) if e["event"] == "ledger_skip"]

@@ -1,0 +1,457 @@
+"""Planning under uncertainty (cost/uncertainty.py + the risk plumbing
+through config, search, exact backend and calibration transfer).
+
+The load-bearing contracts:
+
+- point mode is byte-identical: no residual model (or risk knobs off)
+  must reproduce the pre-uncertainty rankings exactly (the frozen-golden
+  contract lives in test_cost_parity_frozen; here the scorer-off path);
+- uniform per-type variance is a monotone transform, so quantile order
+  == point order with equal variances (satellite-3 invariant);
+- the exact backend's ``confidence_p`` is honest: -> 1 as variance -> 0,
+  degrades as variance grows;
+- ``fit_ledger_correction`` and the transfer fitters degrade with a
+  typed :class:`CalibrationError`, never an IndexError.
+"""
+import dataclasses
+import math
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_decisions_schema  # noqa: E402
+
+from metis_tpu.cluster.spec import ClusterSpec, DeviceSpec, NodeSpec
+from metis_tpu.core.config import ModelSpec, SearchConfig
+from metis_tpu.core.events import EventLog, read_events
+from metis_tpu.core.types import CostBreakdown, dump_ranked_plans
+from metis_tpu.cost.calibration import (
+    CalibrationError,
+    fit_ledger_correction,
+    fit_transfer_scale,
+    transfer_profiles,
+)
+from metis_tpu.cost.uncertainty import (
+    MIN_LOGNORMAL_SAMPLES,
+    ResidualModel,
+    annotate_breakdown,
+    breakdown_sigma_ms,
+    certificate_confidence,
+    fit_residual_model,
+    make_risk_scorer,
+    mc_max_moments,
+    propagate_sum_variance,
+    z_score,
+)
+from metis_tpu.obs.ledger import AccuracyLedger
+from metis_tpu.planner import plan_hetero
+from metis_tpu.profiles import synthesize_profiles
+
+
+def make_ledger(per_type_ratios: dict[str, list[float]],
+                predicted_ms: float = 100.0) -> AccuracyLedger:
+    """In-memory ledger with the given measured/predicted ratios."""
+    led = AccuracyLedger(None)
+    for dev, ratios in per_type_ratios.items():
+        fp = f"fp-{dev or 'pooled'}"
+        led.record_prediction(fp, predicted_ms)
+        for r in ratios:
+            led.record_measurement(fp, measured_ms=predicted_ms * r,
+                                   device_type=dev)
+    return led
+
+
+def _workload(types=("A100", "T4")):
+    model = ModelSpec(name="unc-wl", num_layers=8, hidden_size=256,
+                      sequence_length=256, vocab_size=8192, num_heads=8)
+    store = synthesize_profiles(model, list(types), tps=[1, 2, 4],
+                                bss=[1, 2, 4, 8, 16])
+    specs = {"A100": DeviceSpec("A100", 80, 100, 25),
+             "T4": DeviceSpec("T4", 15, 50, 10)}
+    cluster = ClusterSpec(
+        nodes=tuple(NodeSpec(t, 4) for t in types),
+        devices={t: specs[t] for t in types})
+    return model, store, cluster
+
+
+# ---------------------------------------------------------------------------
+# residual fits: lognormal-or-empirical, clamping, tail ordering
+# ---------------------------------------------------------------------------
+
+
+def test_fit_is_lognormal_with_enough_positive_samples():
+    ratios = [0.9, 1.0, 1.1, 1.2, 1.05]
+    model = fit_residual_model(make_ledger({"A100": ratios}))
+    fit = model.fits["A100"]
+    assert fit.kind == "lognormal" and fit.n == len(ratios)
+    assert fit.sigma > 0
+    # pooled fit always present alongside per-type fits
+    assert "" in model.fits
+
+
+def test_fit_falls_back_to_empirical_below_min_samples():
+    ratios = [1.0, 1.3, 0.8][:MIN_LOGNORMAL_SAMPLES - 1]
+    model = fit_residual_model(make_ledger({"A100": ratios}))
+    fit = model.fits["A100"]
+    assert fit.kind == "empirical"
+    assert fit.ratios == tuple(sorted(ratios))
+
+
+def test_quantile_factor_clamped_at_one():
+    # every ratio < 1: an over-predicting estimator must not DISCOUNT
+    # risk scores below the point estimate (bound admissibility)
+    model = fit_residual_model(make_ledger({"A100": [0.5, 0.6, 0.7, 0.8]}))
+    assert model.quantile_factor(0.95, ("A100",)) == 1.0
+    assert model.cvar_factor(0.9, ("A100",)) == 1.0
+
+
+def test_quantile_factor_monotone_and_cvar_dominates_var():
+    ratios = [0.9, 1.0, 1.1, 1.25, 1.4, 1.6]
+    model = fit_residual_model(make_ledger({"A100": ratios}))
+    q50 = model.quantile_factor(0.5, ("A100",))
+    q90 = model.quantile_factor(0.9, ("A100",))
+    q99 = model.quantile_factor(0.99, ("A100",))
+    assert q50 <= q90 <= q99
+    # CVaR-alpha (tail mean) >= the alpha-quantile (tail floor)
+    assert model.cvar_factor(0.9, ("A100",)) >= q90
+
+
+def test_single_sample_fit_p50_equals_p95():
+    # one ratio: the empirical distribution is a point mass, every
+    # quantile answers the same factor (satellite-3 edge case)
+    model = fit_residual_model(
+        make_ledger({"A100": [1.2]}), min_samples=1)
+    assert model.quantile_factor(0.5, ("A100",)) == pytest.approx(
+        model.quantile_factor(0.95, ("A100",)))
+
+
+def test_fit_for_picks_riskiest_type_and_pools_unknown():
+    model = fit_residual_model(make_ledger({
+        "A100": [1.0, 1.01, 0.99, 1.0],
+        "T4": [0.7, 1.5, 0.9, 1.3]}))
+    assert model.fit_for(("A100", "T4")).device_type == "T4"
+    # a never-measured type answers from the pooled fit
+    assert model.fit_for(("H100",)).device_type == ""
+
+
+def test_fit_returns_none_below_min_samples_and_skips_bad_pairs():
+    led = AccuracyLedger(None)
+    led.record_measurement("unmatched", 100.0)   # no prediction
+    assert fit_residual_model(led) is None
+    led2 = make_ledger({"A100": [1.0]})
+    assert fit_residual_model(led2, min_samples=2) is None
+
+
+def test_fit_emits_residual_fit_event(tmp_path):
+    ev_path = tmp_path / "events.jsonl"
+    fit_residual_model(make_ledger({"A100": [1.0, 1.1, 0.9, 1.2]}),
+                       events=EventLog(ev_path))
+    (ev,) = [e for e in read_events(ev_path)
+             if e["event"] == "residual_fit"]
+    assert ev["n_samples"] == 4 and ev["n_device_types"] == 1
+    assert ev["kind"] == "lognormal" and ev["rel_sigma"] > 0
+
+
+# ---------------------------------------------------------------------------
+# risk scorer + config validation
+# ---------------------------------------------------------------------------
+
+
+def test_scorer_score_is_total_times_factor():
+    model = fit_residual_model(
+        make_ledger({"A100": [1.0, 1.2, 1.1, 1.3]}))
+    cfg = SearchConfig(gbs=64, risk_quantile=0.9)
+    scorer = make_risk_scorer(cfg, model)
+    expected = 100.0 * model.quantile_factor(0.9, ("A100",))
+    assert scorer.score(100.0, ("A100",)) == pytest.approx(expected)
+    assert scorer.describe() == {"ranking": "quantile",
+                                 "risk_quantile": 0.9}
+
+
+def test_scorer_none_when_knobs_off_or_model_empty():
+    model = fit_residual_model(make_ledger({"A100": [1.0, 1.2]}))
+    assert make_risk_scorer(SearchConfig(gbs=64), model) is None
+    assert make_risk_scorer(
+        SearchConfig(gbs=64, risk_quantile=0.9), None) is None
+    assert make_risk_scorer(
+        SearchConfig(gbs=64, risk_quantile=0.9), ResidualModel()) is None
+
+
+def test_cvar_mode_describe():
+    model = fit_residual_model(make_ledger({"A100": [1.0, 1.2, 0.9, 1.4]}))
+    scorer = make_risk_scorer(SearchConfig(gbs=64, cvar_alpha=0.9), model)
+    assert scorer.describe() == {"ranking": "cvar", "cvar_alpha": 0.9}
+    assert scorer.score(50.0, ("A100",)) >= 50.0
+
+
+@pytest.mark.parametrize("knobs", [
+    {"risk_quantile": 0.3}, {"risk_quantile": 1.0},
+    {"cvar_alpha": 0.2}, {"cvar_alpha": 1.5},
+    {"risk_quantile": 0.9, "cvar_alpha": 0.9},
+])
+def test_config_rejects_bad_risk_knobs(knobs):
+    with pytest.raises(ValueError):
+        SearchConfig(gbs=64, **knobs)
+
+
+# ---------------------------------------------------------------------------
+# variance propagation edge cases (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def test_propagate_sum_variance_zero_and_negative_guard():
+    assert propagate_sum_variance([]) == 0.0
+    assert propagate_sum_variance([0.0, 0.0]) == 0.0
+    assert propagate_sum_variance([4.0, -1.0, 5.0]) == 9.0
+
+
+def test_mc_max_moments_deterministic_and_zero_variance_exact():
+    m1 = mc_max_moments([10.0, 12.0], [1.0, 1.5])
+    m2 = mc_max_moments([10.0, 12.0], [1.0, 1.5])
+    assert m1 == m2  # fixed seed: byte-identical repeats
+    # all-zero sigmas: the max is deterministic, variance exactly 0
+    mean, var = mc_max_moments([10.0, 12.0], [0.0, 0.0])
+    assert (mean, var) == (12.0, 0.0)
+    assert mc_max_moments([], []) == (0.0, 0.0)
+
+
+def test_annotate_breakdown_roundtrip_and_passthrough():
+    bd = CostBreakdown(total_ms=100.0,
+                       components={"compute": 80.0, "pp_comm": 20.0},
+                       stage_execution_ms=(40.0, 40.0))
+    # no stats at all: input returned unchanged -> JSON omits the field
+    empty = ResidualModel(fits={}, component_stats={})
+    assert annotate_breakdown(bd, empty, ("A100",)) is bd
+    assert "component_variance" not in bd.to_json_dict()
+
+    model = fit_residual_model(make_ledger(
+        {"A100": [1.0, 1.2, 0.9, 1.3]}))
+    out = annotate_breakdown(bd, model, ("A100",))
+    if out.component_variance:
+        assert breakdown_sigma_ms(out) > 0
+        # round-trips through JSON with the variances intact
+        again = CostBreakdown.from_json_dict(out.to_json_dict())
+        assert again.component_variance == out.component_variance
+    # point-mode breakdown sigma is 0
+    assert breakdown_sigma_ms(bd) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# confidence: honest degradation
+# ---------------------------------------------------------------------------
+
+
+def test_certificate_confidence_limits():
+    assert certificate_confidence(5.0, 0.0) == 1.0          # no variance
+    assert certificate_confidence(math.inf, 10.0) == 1.0    # sole plan
+    p = certificate_confidence(5.0, 1.0)
+    assert 0.5 < p < 1.0
+
+
+def test_certificate_confidence_monotone():
+    # degrades as sigma grows, at fixed margin
+    ps = [certificate_confidence(10.0, s) for s in (0.1, 1.0, 10.0, 100.0)]
+    assert ps == sorted(ps, reverse=True)
+    # grows with margin, at fixed sigma
+    pm = [certificate_confidence(m, 5.0) for m in (0.0, 5.0, 50.0)]
+    assert pm == sorted(pm)
+    # z_q > 0 (risk-ranked incumbent) only raises confidence
+    assert certificate_confidence(5.0, 5.0, z_q=z_score(0.95)) >= \
+        certificate_confidence(5.0, 5.0)
+
+
+# ---------------------------------------------------------------------------
+# planner integration: ordering invariance + honest exact certificates
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_ranking_equals_point_ranking_with_uniform_variance():
+    # equal per-type variance => uniform factor => monotone transform
+    model_wl, store, cluster = _workload()
+    ratios = [0.9, 1.0, 1.1, 1.2]
+    rmodel = fit_residual_model(
+        make_ledger({"A100": ratios, "T4": ratios}))
+    point = plan_hetero(cluster, store, model_wl,
+                        SearchConfig(gbs=64), top_k=5)
+    risky = plan_hetero(cluster, store, model_wl,
+                        SearchConfig(gbs=64, risk_quantile=0.95),
+                        residual_model=rmodel, top_k=5)
+    assert [r.inter for r in risky.plans] == [r.inter for r in point.plans]
+    assert [r.cost.total_ms for r in risky.plans] == \
+        [r.cost.total_ms for r in point.plans]
+
+
+def test_risk_knob_without_model_is_byte_identical_to_point():
+    model_wl, store, cluster = _workload()
+    point = plan_hetero(cluster, store, model_wl,
+                        SearchConfig(gbs=64), top_k=5)
+    risky = plan_hetero(cluster, store, model_wl,
+                        SearchConfig(gbs=64, risk_quantile=0.95),
+                        residual_model=None, top_k=5)
+    assert dump_ranked_plans(risky.plans) == dump_ranked_plans(point.plans)
+
+
+def test_exact_confidence_p_degrades_with_variance():
+    model_wl, store, cluster = _workload(types=("A100",))
+    cfg = SearchConfig(gbs=64, backend="exact")
+    tight = fit_residual_model(make_ledger(
+        {"A100": [1.0, 1.001, 0.999, 1.0]}))
+    noisy = fit_residual_model(make_ledger(
+        {"A100": [0.5, 1.0, 1.6, 2.2]}))
+
+    point = plan_hetero(cluster, store, model_wl, cfg, top_k=3)
+    assert point.certificate is not None
+    assert point.certificate.confidence_p is None  # point mode: omitted
+
+    p_tight = plan_hetero(cluster, store, model_wl, cfg,
+                          residual_model=tight,
+                          top_k=3).certificate.confidence_p
+    p_noisy = plan_hetero(cluster, store, model_wl, cfg,
+                          residual_model=noisy,
+                          top_k=3).certificate.confidence_p
+    assert p_tight is not None and p_noisy is not None
+    assert p_noisy < p_tight <= 1.0
+    # same certified plan either way — confidence changes, optimum not
+    assert point.certificate.best_ms == pytest.approx(
+        plan_hetero(cluster, store, model_wl, cfg, residual_model=noisy,
+                    top_k=3).certificate.best_ms)
+
+
+def test_exact_risk_ranking_never_below_point_cost():
+    model_wl, store, cluster = _workload()
+    rmodel = fit_residual_model(make_ledger(
+        {"A100": [1.0, 1.3, 0.9, 1.5], "T4": [1.0, 1.05, 0.95, 1.1]}))
+    cfg = SearchConfig(gbs=64, backend="exact", risk_quantile=0.95)
+    res = plan_hetero(cluster, store, model_wl, cfg,
+                      residual_model=rmodel, top_k=3)
+    cert = res.certificate
+    assert cert is not None and res.plans
+    # the certificate lives in score space: best >= bound in one space,
+    # and the score is never below the point total (clamped factor)
+    assert cert.best_ms >= cert.lower_bound_ms - 1e-6
+    assert cert.best_ms >= res.plans[0].cost.total_ms - 1e-6
+
+
+# ---------------------------------------------------------------------------
+# calibration: typed degradation + roofline transfer
+# ---------------------------------------------------------------------------
+
+
+def test_fit_ledger_correction_empty_raises_typed_error():
+    with pytest.raises(CalibrationError):
+        fit_ledger_correction([])
+    led = AccuracyLedger(None)
+    led.record_measurement("never-predicted", 100.0)
+    with pytest.raises(CalibrationError):
+        fit_ledger_correction(led.samples)
+
+
+def test_fit_ledger_correction_single_sample_ok():
+    led = make_ledger({"A100": [1.25]})
+    fit = fit_ledger_correction(led.samples)
+    assert fit["n"] == 1
+    assert fit["scale"] == pytest.approx(1.25)
+    assert fit["mape_after_pct"] == pytest.approx(0.0, abs=1e-3)
+
+
+def test_fit_transfer_scale_roofline_math():
+    src = {"matmul_tflops": 312.0, "hbm_stream_gbps": 2039.0}
+    tgt = {"matmul_tflops": 65.0, "hbm_stream_gbps": 320.0}
+    s = fit_transfer_scale(src, tgt, compute_mix=0.7)
+    assert s["compute_scale"] == pytest.approx(65.0 / 312.0, rel=1e-4)
+    assert s["mem_scale"] == pytest.approx(320.0 / 2039.0, rel=1e-4)
+    assert s["time_scale"] == pytest.approx(
+        0.7 / s["compute_scale"] + 0.3 / s["mem_scale"], rel=1e-4)
+    # identical chips: unit scale
+    assert fit_transfer_scale(src, dict(src))["time_scale"] == \
+        pytest.approx(1.0)
+
+
+@pytest.mark.parametrize("bad", [
+    {},  # missing roofline keys
+    {"matmul_tflops": 0.0, "hbm_stream_gbps": 100.0},   # degenerate
+    {"matmul_tflops": 100.0, "hbm_stream_gbps": -1.0},
+])
+def test_fit_transfer_scale_rejects_bad_artifacts(bad):
+    good = {"matmul_tflops": 312.0, "hbm_stream_gbps": 2039.0}
+    with pytest.raises(CalibrationError):
+        fit_transfer_scale(good, bad)
+    with pytest.raises(CalibrationError):
+        fit_transfer_scale(good, good, compute_mix=1.5)
+
+
+def test_transfer_profiles_scales_times_not_memory(tmp_path):
+    model_wl, store, _ = _workload(types=("A100",))
+    scales = {"time_scale": 2.0, "compute_scale": 0.5, "mem_scale": 0.5}
+    ev_path = tmp_path / "events.jsonl"
+    merged = transfer_profiles(store, "A100", "H100", scales,
+                               events=EventLog(ev_path))
+    assert set(merged.device_types) == {"A100", "H100"}
+    src = store.get("A100", 1, 1)
+    out = merged.get("H100", 1, 1)
+    assert out.layer_times_ms == pytest.approx(
+        tuple(t * 2.0 for t in src.layer_times_ms))
+    assert out.layer_memory_mb == src.layer_memory_mb  # model-shaped
+    assert out.fb_sync_ms == pytest.approx(src.fb_sync_ms * 2.0)
+    # provenance tag + event
+    assert merged.transferred["H100"]["source"] == "A100"
+    assert merged.transferred["H100"]["transferred"] is True
+    (ev,) = [e for e in read_events(ev_path)
+             if e["event"] == "transfer_fit"]
+    assert ev["target_type"] == "H100" and ev["n_entries"] == \
+        len(store.configs("A100"))
+    # the source store itself is untouched
+    assert not store.transferred
+
+
+def test_transfer_profiles_typed_errors():
+    _, store, _ = _workload(types=("A100", "T4"))
+    scales = {"time_scale": 2.0}
+    with pytest.raises(CalibrationError):
+        transfer_profiles(store, "H100", "B200", scales)  # unprofiled src
+    with pytest.raises(CalibrationError):
+        transfer_profiles(store, "A100", "T4", scales)    # already profiled
+    with pytest.raises(CalibrationError):
+        transfer_profiles(store, "A100", "H100", {"time_scale": 0.0})
+
+
+def test_transferred_plan_posture_reaches_decision_detail():
+    model_wl, store, cluster = _workload(types=("A100", "T4"))
+    reduced_entries = {k: store.get(*k) for k in store.configs("A100")}
+    from metis_tpu.profiles.store import ProfileStore
+    reduced = ProfileStore(reduced_entries, store.model,
+                           {"A100": store.type_meta["A100"]})
+    scales = fit_transfer_scale(
+        {"matmul_tflops": 312.0, "hbm_stream_gbps": 2039.0},
+        {"matmul_tflops": 65.0, "hbm_stream_gbps": 320.0})
+    merged = transfer_profiles(reduced, "A100", "T4", scales)
+
+    from metis_tpu.obs.provenance import DecisionLog
+    dlog = DecisionLog(None)
+    res = plan_hetero(cluster, merged, model_wl, SearchConfig(gbs=64),
+                      top_k=3, decisions=dlog)
+    assert res.plans
+    rec = dlog.records()[-1]
+    assert rec.detail.get("transferred_profiles") == ["T4"]
+    # and the schema checker accepts the posture vocabulary
+    assert not check_decisions_schema.validate_decisions(
+        [r.to_json_dict() for r in dlog.records()])
+
+
+# ---------------------------------------------------------------------------
+# decisions-schema detail validation (satellite 5)
+# ---------------------------------------------------------------------------
+
+
+def test_decisions_schema_rejects_bad_risk_posture():
+    base = {"seq": 1, "ts": 1.0, "kind": "cold_search"}
+    ok = dict(base, detail={"ranking": "quantile", "risk_quantile": 0.95})
+    assert not check_decisions_schema.validate_decisions([ok])
+    bad_rank = dict(base, detail={"ranking": "vibes"})
+    assert check_decisions_schema.validate_decisions([bad_rank])
+    bad_knob = dict(base, seq=1,
+                    detail={"ranking": "cvar", "cvar_alpha": 1.2})
+    assert check_decisions_schema.validate_decisions([bad_knob])

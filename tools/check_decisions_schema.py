@@ -33,7 +33,11 @@ except ImportError:  # standalone use without the package on sys.path
     DECISION_KINDS = (
         "cold_search", "cache_hit", "drift_replan", "cluster_delta",
         "autoscale_delta", "delta_replan", "fleet_repartition",
-        "tenant_replan", "migration_decision")
+        "tenant_replan", "migration_decision", "profile_transfer")
+
+# Risk-posture vocabulary a record's ``detail.ranking`` may carry
+# (uncertainty layer, cost/uncertainty.py)
+RANKING_KINDS = ("point", "quantile", "cvar")
 
 # |sum(components) - total_ms| tolerance: breakdowns round-trip through
 # JSON with per-component rounding, so exact equality is too strict
@@ -95,6 +99,23 @@ def validate_decisions(records: list[dict]) -> list[str]:
                             f"{where}: breakdown components sum to "
                             f"{s:.6f} ms but total_ms is {total:.6f} "
                             "(additivity violated)")
+        detail = rec.get("detail")
+        if isinstance(detail, dict):
+            # risk-posture annotation (uncertainty layer): a bounded
+            # vocabulary + knob ranges, so `metis-tpu why` can always
+            # explain how a served plan was ranked
+            ranking = detail.get("ranking")
+            if ranking is not None and ranking not in RANKING_KINDS:
+                problems.append(
+                    f"{where}: unknown detail.ranking {ranking!r}")
+            for knob in ("risk_quantile", "cvar_alpha"):
+                v = detail.get(knob)
+                if v is None:
+                    continue
+                if not isinstance(v, (int, float)) or not 0.5 <= v < 1.0:
+                    problems.append(
+                        f"{where}: detail.{knob} must be numeric in "
+                        f"[0.5, 1), got {v!r}")
         seen_seqs.add(seq)
         last_seq = seq
     return problems

@@ -160,6 +160,17 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "snapshot_restore": {"seq", "entries", "source"},
     "oplog_append": {"seq", "op"},
     "failover": {"last_seq", "reason"},
+    # planning under uncertainty (cost/uncertainty.py, cost/calibration.py,
+    # obs/ledger.py): one residual_fit per ledger-fit ResidualModel (the
+    # pooled relative-sigma + fit kind the risk ranking runs on); one
+    # transfer_fit per cross-device profile transfer (the roofline scale
+    # factors applied to the unprofiled target type); one ledger_skip per
+    # ledger load that dropped malformed lines — the per-reason tally of
+    # torn/NaN/valueless records skipped instead of poisoning fits
+    "residual_fit": {"n_samples", "n_device_types", "rel_sigma", "kind"},
+    "transfer_fit": {"source_type", "target_type", "time_scale",
+                     "compute_scale", "mem_scale", "n_entries"},
+    "ledger_skip": {"n_skipped", "reasons"},
 }
 
 # Events the serve daemon emits once per client request.  When a client
